@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""Headline benchmark: single-source BFS TEPS on an R-MAT graph (TPU).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "TEPS", "vs_baseline": N}
+
+Baseline: the reference's best serial number — largeG 15.2M directed edges /
+1.170 s ≈ 13 M TEPS (BASELINE.md, derived from docs/BigData_Project.pdf §1.5
+Table 7; the reference's own parallel version never beat it, OOMing on
+largeG).  TEPS here = directed edge count / median fused-BFS wall time,
+loop fully on-device (compile excluded, like the paper excludes Spark
+startup).
+
+Env knobs: BENCH_SCALE (default 22), BENCH_EDGE_FACTOR (16), BENCH_REPEATS (5).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bfs_tpu.graph.csr import build_device_graph
+from bfs_tpu.graph.generators import rmat_graph
+from bfs_tpu.models.bfs import _bfs_fused
+
+BASELINE_TEPS = 15_172_126 / 1.170  # ≈ 13.0 M TEPS (BASELINE.md derived floor)
+
+
+def main():
+    scale = int(os.environ.get("BENCH_SCALE", "22"))
+    edge_factor = int(os.environ.get("BENCH_EDGE_FACTOR", "16"))
+    repeats = int(os.environ.get("BENCH_REPEATS", "5"))
+
+    graph = rmat_graph(scale, edge_factor, seed=42)
+    dg = build_device_graph(graph, block=8 * 1024)
+    # Deterministic source inside the giant component: the max-degree vertex.
+    degrees = np.bincount(graph.src, minlength=graph.num_vertices)
+    source = int(np.argmax(degrees))
+
+    src = jnp.asarray(dg.src)
+    dst = jnp.asarray(dg.dst)
+    args = (src, dst, jnp.int32(source), dg.num_vertices, dg.num_vertices)
+
+    state = _bfs_fused(*args)  # warm-up: compile + first run
+    jax.block_until_ready(state)
+    levels = int(state.level)
+    reached = int((np.asarray(state.dist[: dg.num_vertices]) != np.iinfo(np.int32).max).sum())
+
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.block_until_ready(_bfs_fused(*args))
+        times.append(time.perf_counter() - t0)
+    t = float(np.median(times))
+    teps = graph.num_edges / t
+
+    print(
+        json.dumps(
+            {
+                "metric": f"rmat{scale}_ssbfs_teps",
+                "value": teps,
+                "unit": "TEPS",
+                "vs_baseline": teps / BASELINE_TEPS,
+                "details": {
+                    "device": str(jax.devices()[0]),
+                    "num_vertices": graph.num_vertices,
+                    "num_directed_edges": graph.num_edges,
+                    "source": source,
+                    "supersteps": levels,
+                    "vertices_reached": reached,
+                    "median_seconds": t,
+                    "times": times,
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,67 @@
+"""bfs_tpu — a TPU-native BFS-with-MapReduce framework.
+
+A ground-up re-design of NorthernDemon/BFS-with-MapReduce (iterative Spark
+MapReduce single-source BFS, see SURVEY.md) for TPU: the superstep loop is a
+single compiled XLA program (`jax.lax.while_loop`), frontier expansion is a
+segmented-min relaxation over dst-sorted edge arrays, and scaling is a
+`shard_map` over a `jax.sharding.Mesh` with `pmin` all-reduces riding ICI —
+replacing the Spark shuffle, driver collect, and filesystem superstep carry.
+
+Public API surface (capability map to the reference):
+  graph.io / graph.csr      — ingest + graph model (GraphFileUtil, algs4 Graph)
+  graph.vertex              — Vertex/Color wire format, state dumps (Vertex.java)
+  oracle                    — sequential queue BFS + check() (algs4 BreadthFirstPaths)
+  models.bfs                — the parallel engine (BfsSpark superstep loop)
+  models.multisource        — batched multi-source BFS (vmapped frontier axis)
+  parallel.sharded          — mesh-sharded engine (Spark worker parallelism)
+  config                    — service.properties layer (ServiceConfiguration)
+  utils.{timing,metrics,checkpoint,logging} — aux subsystems (SURVEY.md §5)
+  runners                   — CLI drivers (BfsSpark.main / SequentialTest.main)
+"""
+
+from .graph.csr import (
+    Graph,
+    DeviceGraph,
+    build_device_graph,
+    INF_DIST,
+    NO_PARENT,
+)
+from .graph.io import read_sedgewick, parse_sedgewick, read_snap_edge_list
+from .graph.generators import rmat_graph, gnm_graph, path_graph
+from .graph.vertex import Color, Vertex, path_to, serialize_state, parse_state
+from .oracle.bfs import queue_bfs, canonical_bfs, check
+from .models.bfs import bfs, BfsResult, SuperstepRunner
+from .models.multisource import bfs_multi, MultiBfsResult, collapse_multi_source
+from .config import ServiceConfiguration
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Graph",
+    "DeviceGraph",
+    "build_device_graph",
+    "INF_DIST",
+    "NO_PARENT",
+    "read_sedgewick",
+    "parse_sedgewick",
+    "read_snap_edge_list",
+    "rmat_graph",
+    "gnm_graph",
+    "path_graph",
+    "Color",
+    "Vertex",
+    "path_to",
+    "serialize_state",
+    "parse_state",
+    "queue_bfs",
+    "canonical_bfs",
+    "check",
+    "bfs",
+    "BfsResult",
+    "SuperstepRunner",
+    "bfs_multi",
+    "MultiBfsResult",
+    "collapse_multi_source",
+    "ServiceConfiguration",
+    "__version__",
+]

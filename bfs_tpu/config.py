@@ -1,0 +1,70 @@
+"""Framework configuration: the ``service.properties`` layer, TPU-shaped.
+
+Parity with ``ServiceConfiguration`` (ServiceConfiguration.java:30-63): a
+``key=value`` properties file loaded once, exposing app name and the
+comma-separated problem-file list (``problemFiles``).  TPU-specific keys
+replace the Spark master coordinates (ip/port/jar — obsolete: XLA programs
+are dispatched to the mesh, not shipped as jars):
+
+    app-name       = BFS with MapReduce, TPU edition
+    problemFiles   = test-sets/tinyCG.txt, test-sets/mediumG.txt
+    source         = 0
+    mesh-batch     = 1
+    mesh-graph     = 0            # 0 = all devices
+    dump-supersteps = false       # write problemFile_i-style text dumps
+    checkpoint-every = 0          # supersteps between .npz checkpoints
+
+Unlike the reference, a missing/corrupt file raises instead of being
+swallowed into null getters (ServiceConfiguration.java:40-42 logs and
+continues — a latent NPE factory we deliberately do not reproduce).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+
+def parse_properties(text: str) -> dict[str, str]:
+    """Minimal Java-properties subset: ``k=v`` lines, ``#``/``!`` comments,
+    whitespace-trimmed keys/values."""
+    out: dict[str, str] = {}
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#") or line.startswith("!"):
+            continue
+        if "=" not in line:
+            raise ValueError(f"malformed properties line: {raw!r}")
+        k, _, v = line.partition("=")
+        out[k.strip()] = v.strip()
+    return out
+
+
+@dataclass(frozen=True)
+class ServiceConfiguration:
+    app_name: str = "BFS with MapReduce, TPU edition"
+    problem_files: tuple[str, ...] = ()
+    source: int = 0
+    mesh_batch: int = 1
+    mesh_graph: int = 0  # 0 = use all devices
+    dump_supersteps: bool = False
+    checkpoint_every: int = 0
+    work_dir: str = "."
+
+    @classmethod
+    def load(cls, path: str | os.PathLike) -> "ServiceConfiguration":
+        with open(path, "r") as f:
+            props = parse_properties(f.read())
+        files = tuple(
+            p.strip() for p in props.get("problemFiles", "").split(",") if p.strip()
+        )
+        return cls(
+            app_name=props.get("app-name", cls.app_name),
+            problem_files=files,
+            source=int(props.get("source", "0")),
+            mesh_batch=int(props.get("mesh-batch", "1")),
+            mesh_graph=int(props.get("mesh-graph", "0")),
+            dump_supersteps=props.get("dump-supersteps", "false").lower() == "true",
+            checkpoint_every=int(props.get("checkpoint-every", "0")),
+            work_dir=props.get("work-dir", os.path.dirname(os.fspath(path)) or "."),
+        )

@@ -1,0 +1,4 @@
+from .csr import Graph, DeviceGraph, build_device_graph, INF_DIST, NO_PARENT  # noqa: F401
+from .io import read_sedgewick, parse_sedgewick, read_snap_edge_list, write_sedgewick  # noqa: F401
+from .generators import rmat_graph, gnm_graph, path_graph, rmat_edges  # noqa: F401
+from .vertex import Color, Vertex  # noqa: F401

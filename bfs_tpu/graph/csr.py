@@ -1,0 +1,182 @@
+"""Graph containers: edge lists and CSR adjacency, TPU-friendly padded device form.
+
+Capability parity with the reference's graph layer:
+  * ``algs4 Graph`` (sequential-libs/algs4.jar!/Graph.java:59,85-94,145-148) —
+    adjacency-list undirected graph built from (V, E, edge pairs); `addEdge`
+    inserts both directions.  Here: :class:`Graph` + :func:`build_csr`.
+  * ``GraphFileUtil.convert`` bi-directing (GraphFileUtil.java:64-65) —
+    :func:`Graph.from_undirected_edges`.
+
+TPU-first differences from the reference:
+  * The distributed representation is NOT per-vertex records shipped through a
+    shuffle (Vertex.java:22 ``Serializable``); it is flat ``(src, dst)`` edge
+    arrays sorted by destination, padded to a static shape with sentinel edges
+    so the whole BFS compiles to one XLA program (static shapes, MXU/VPU-
+    friendly segmented reductions).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass
+
+import numpy as np
+
+INT32_MAX = np.int32(np.iinfo(np.int32).max)
+#: Distance of an unreached vertex.  Matches Java ``Integer.MAX_VALUE`` used by
+#: GraphFileUtil.java:55 so text state dumps are bit-identical.
+INF_DIST = int(INT32_MAX)
+#: Parent of a vertex with no parent yet (source's parent is itself).
+NO_PARENT = -1
+
+
+@dataclass(frozen=True)
+class Graph:
+    """A directed multigraph as flat edge arrays (int32), plus lazy CSR.
+
+    ``num_vertices`` is V; ``src``/``dst`` hold E directed edges.  Undirected
+    inputs are stored bi-directed (both (u,v) and (v,u)), mirroring
+    ``Graph.addEdge`` (Graph.java:145-148).
+    """
+
+    num_vertices: int
+    src: np.ndarray
+    dst: np.ndarray
+
+    def __post_init__(self):
+        object.__setattr__(self, "src", np.ascontiguousarray(self.src, dtype=np.int32))
+        object.__setattr__(self, "dst", np.ascontiguousarray(self.dst, dtype=np.int32))
+        if self.src.shape != self.dst.shape or self.src.ndim != 1:
+            raise ValueError("src/dst must be 1-D arrays of equal length")
+        if self.num_edges and (
+            int(min(self.src.min(initial=0), self.dst.min(initial=0))) < 0
+            or int(max(self.src.max(initial=0), self.dst.max(initial=0))) >= self.num_vertices
+        ):
+            raise ValueError("edge endpoint out of range")
+
+    @property
+    def num_edges(self) -> int:
+        """Directed edge count (an undirected input counts twice), matching the
+        paper's bi-directed E column (docs/BigData_Project.pdf §1.5)."""
+        return int(self.src.shape[0])
+
+    @classmethod
+    def from_undirected_edges(cls, num_vertices: int, edges: np.ndarray) -> "Graph":
+        """Insert every undirected edge in both directions
+        (GraphFileUtil.java:64-65, Graph.java:145-148 parity)."""
+        edges = np.asarray(edges, dtype=np.int32).reshape(-1, 2)
+        src = np.concatenate([edges[:, 0], edges[:, 1]])
+        dst = np.concatenate([edges[:, 1], edges[:, 0]])
+        return cls(num_vertices, src, dst)
+
+    @classmethod
+    def from_directed_edges(cls, num_vertices: int, edges: np.ndarray) -> "Graph":
+        edges = np.asarray(edges, dtype=np.int32).reshape(-1, 2)
+        return cls(num_vertices, edges[:, 0].copy(), edges[:, 1].copy())
+
+    # -- CSR (adjacency-list) view: the oracle's native format ---------------
+    def csr(self) -> tuple[np.ndarray, np.ndarray]:
+        """Return ``(indptr int64[V+1], indices int32[E])`` with each vertex's
+        neighbours sorted ascending (deterministic, unlike algs4's Bag order)."""
+        if not hasattr(self, "_csr_cache"):
+            order = np.lexsort((self.dst, self.src))
+            indices = self.dst[order]
+            counts = np.bincount(self.src, minlength=self.num_vertices)
+            indptr = np.zeros(self.num_vertices + 1, dtype=np.int64)
+            np.cumsum(counts, out=indptr[1:])
+            object.__setattr__(self, "_csr_cache", (indptr, indices))
+        return self._csr_cache
+
+    def degree(self, v: int) -> int:
+        """Parity with ``Graph.degree`` (Graph.java:169-172)."""
+        indptr, _ = self.csr()
+        return int(indptr[v + 1] - indptr[v])
+
+    def adj(self, v: int) -> np.ndarray:
+        """Parity with ``Graph.adj`` (Graph.java:158-161); sorted ascending."""
+        indptr, indices = self.csr()
+        return indices[indptr[v] : indptr[v + 1]]
+
+
+@dataclass(frozen=True)
+class DeviceGraph:
+    """Static-shape, padded edge arrays ready for the XLA BFS engine.
+
+    * Edges are sorted by ``dst`` (then ``src``) so ``segment_min`` runs with
+      ``indices_are_sorted=True`` and writes are sequential in HBM.
+    * Padding edges are ``(sentinel, sentinel)`` where ``sentinel == V``; all
+      state arrays have V+1 slots and slot V is never a real vertex, so padded
+      lanes are inert without masks.
+    * ``num_shards > 1`` pre-splits edges into equal contiguous blocks (the
+      vertex-cut analogue of Spark's hash-partitioned RDD blocks,
+      SURVEY.md §2.4) for `shard_map` over a device mesh.
+    """
+
+    num_vertices: int
+    num_edges: int  # real (unpadded) directed edges
+    src: np.ndarray  # int32[num_shards, padded_e // num_shards] or [padded_e]
+    dst: np.ndarray
+    num_shards: int = 1
+
+    @property
+    def padded_edges(self) -> int:
+        return int(self.src.size)
+
+    @property
+    def sentinel(self) -> int:
+        return self.num_vertices
+
+
+def pad_to_multiple(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def build_device_graph(
+    graph: Graph, *, num_shards: int = 1, block: int = 1024
+) -> DeviceGraph:
+    """Sort edges by destination, pad with sentinel edges, optionally shard.
+
+    Sharding is round-robin over dst-sorted edges so each shard sees a similar
+    dst range distribution — contiguous blocks would skew `segment_min` output
+    density per device. Each shard is then re-sorted so `indices_are_sorted`
+    still holds per-shard.
+    """
+    if num_shards < 1:
+        raise ValueError("num_shards must be >= 1")
+    order = np.lexsort((graph.src, graph.dst))
+    src = graph.src[order]
+    dst = graph.dst[order]
+    sentinel = np.int32(graph.num_vertices)
+    e = graph.num_edges
+    per_shard = pad_to_multiple(max(pad_to_multiple(e, num_shards) // num_shards, 1), block)
+    total = per_shard * num_shards
+    pad = total - e
+    src = np.concatenate([src, np.full(pad, sentinel, dtype=np.int32)])
+    dst = np.concatenate([dst, np.full(pad, sentinel, dtype=np.int32)])
+    if num_shards > 1:
+        # Strided split keeps per-shard dst distributions balanced.
+        src = src.reshape(per_shard, num_shards).T
+        dst = dst.reshape(per_shard, num_shards).T
+        # Re-sort each shard by dst so segment_min stays sorted per shard.
+        for s in range(num_shards):
+            o = np.lexsort((src[s], dst[s]))
+            src[s] = src[s][o]
+            dst[s] = dst[s][o]
+        src = np.ascontiguousarray(src)
+        dst = np.ascontiguousarray(dst)
+    return DeviceGraph(
+        num_vertices=graph.num_vertices,
+        num_edges=e,
+        src=src,
+        dst=dst,
+        num_shards=num_shards,
+    )
+
+
+def reshard(dg: DeviceGraph, num_shards: int, *, block: int = 1024) -> DeviceGraph:
+    """Re-partition an existing DeviceGraph into a new shard count."""
+    flat_src = dg.src.reshape(-1)
+    flat_dst = dg.dst.reshape(-1)
+    keep = flat_src != dg.sentinel
+    g = Graph(dg.num_vertices, flat_src[keep], flat_dst[keep])
+    return build_device_graph(g, num_shards=num_shards, block=block)

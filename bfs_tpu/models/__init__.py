@@ -1,0 +1,2 @@
+from .bfs import bfs, BfsResult, SuperstepRunner  # noqa: F401
+from .multisource import bfs_multi, MultiBfsResult, collapse_multi_source  # noqa: F401

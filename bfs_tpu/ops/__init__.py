@@ -1,0 +1,1 @@
+from .relax import BfsState, init_state, init_batched_state, relax_superstep, relax_superstep_batched, frontier_size, INT32_MAX  # noqa: F401

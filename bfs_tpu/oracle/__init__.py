@@ -1,0 +1,2 @@
+from .bfs import queue_bfs, canonical_bfs, check, has_path_to, dist_to, path_to  # noqa: F401
+from .native import native_bfs, native_available  # noqa: F401

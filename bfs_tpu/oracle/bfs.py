@@ -1,0 +1,186 @@
+"""Sequential BFS oracle: the correctness anchor for the TPU engine.
+
+Re-implements (from behavior, not code) the vendored algs4 oracle used by the
+reference's ``SequentialTest``:
+
+  * :func:`queue_bfs` — classic FIFO queue BFS with ``dist/parent/marked``,
+    single- and multi-source, mirroring ``BreadthFirstPaths.bfs``
+    (sequential-libs/algs4.jar!/BreadthFirstPaths.java:93-111 single-source,
+    :114-132 multi-source).
+  * :func:`canonical_bfs` — level-synchronous BFS whose parent choice is the
+    canonical *minimum* frontier neighbour.  The reference's parallel reducer
+    tie-break is order-dependent (BfsSpark.java:97, paper Table 2: "0,5,3 or
+    0,2,3 depending on the order"); both this oracle and the TPU engine use
+    min-parent so distances AND parents are bit-exact across engines
+    (SURVEY.md §5 race-detection row).
+  * :func:`check` — port of the ``check()`` optimality verifier
+    (BreadthFirstPaths.java:172-221), exposed as a reusable invariant
+    function instead of a JVM ``assert``.
+  * Query API :func:`has_path_to` / :func:`dist_to` / ``path_to``
+    (BreadthFirstPaths.java:139-168).
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from collections.abc import Sequence
+
+import numpy as np
+
+from ..graph.csr import Graph, INF_DIST, NO_PARENT
+from ..graph.vertex import path_to  # re-exported query API
+
+__all__ = [
+    "queue_bfs",
+    "canonical_bfs",
+    "check",
+    "has_path_to",
+    "dist_to",
+    "path_to",
+]
+
+
+def _sources_array(sources: int | Sequence[int], num_vertices: int) -> np.ndarray:
+    arr = np.atleast_1d(np.asarray(sources, dtype=np.int64))
+    if arr.size == 0:
+        raise ValueError("at least one source required")
+    if arr.min() < 0 or arr.max() >= num_vertices:
+        raise ValueError("source vertex out of range")
+    return arr
+
+
+def queue_bfs(graph: Graph, sources: int | Sequence[int] = 0):
+    """FIFO-queue BFS.  Returns ``(dist int32[V], parent int32[V])``.
+
+    Parent is first-discovery order (enqueue order), exactly like algs4's
+    ``edgeTo`` (BreadthFirstPaths.java:93-111); with our sorted-adjacency CSR
+    this is deterministic.  Sources have ``parent == themselves``; unreached
+    vertices have ``dist == INF_DIST`` and ``parent == NO_PARENT``.
+    """
+    v = graph.num_vertices
+    srcs = _sources_array(sources, v)
+    indptr, indices = graph.csr()
+    dist = np.full(v, INF_DIST, dtype=np.int32)
+    parent = np.full(v, NO_PARENT, dtype=np.int32)
+    q = deque()
+    for s in srcs:  # multi-source seeds the queue with all sources at dist 0
+        if dist[s] != 0:
+            dist[s] = 0
+            parent[s] = s
+            q.append(int(s))
+    while q:
+        u = q.popleft()
+        for w in indices[indptr[u] : indptr[u + 1]]:
+            w = int(w)
+            if parent[w] == NO_PARENT:
+                parent[w] = u
+                dist[w] = dist[u] + 1
+                q.append(w)
+    return dist, parent
+
+
+def canonical_bfs(graph: Graph, sources: int | Sequence[int] = 0):
+    """Level-synchronous BFS with canonical min-parent tie-break.
+
+    Per level, every next-frontier vertex's parent is the MINIMUM id among its
+    current-frontier neighbours — the same deterministic rule the TPU engine's
+    ``segment_min`` implements, so outputs are comparable bit-for-bit.
+    Distances agree with :func:`queue_bfs` always; only parents may differ.
+    """
+    v = graph.num_vertices
+    srcs = _sources_array(sources, v)
+    dist = np.full(v, INF_DIST, dtype=np.int32)
+    parent = np.full(v, NO_PARENT, dtype=np.int32)
+    dist[srcs] = 0
+    parent[srcs] = srcs
+    src_arr, dst_arr = graph.src, graph.dst
+    frontier = np.zeros(v, dtype=bool)
+    frontier[srcs] = True
+    level = np.int32(0)
+    while frontier.any():
+        active = frontier[src_arr]
+        cand_parent = np.full(v, INF_DIST, dtype=np.int32)
+        np.minimum.at(cand_parent, dst_arr[active], src_arr[active])
+        improved = (cand_parent != INF_DIST) & (dist == INF_DIST)
+        dist[improved] = level + 1
+        parent[improved] = cand_parent[improved]
+        frontier = improved
+        level += 1
+    return dist, parent
+
+
+def has_path_to(dist: np.ndarray, v: int) -> bool:
+    """BreadthFirstPaths.java:139-141 parity."""
+    return bool(np.asarray(dist)[v] != INF_DIST)
+
+
+def dist_to(dist: np.ndarray, v: int) -> int:
+    """BreadthFirstPaths.java:149-151 parity."""
+    return int(np.asarray(dist)[v])
+
+
+def check(
+    graph: Graph,
+    dist: np.ndarray,
+    parent: np.ndarray,
+    sources: int | Sequence[int] = 0,
+) -> list[str]:
+    """BFS optimality verifier; returns a list of violations (empty = OK).
+
+    Port of ``BreadthFirstPaths.check`` (BreadthFirstPaths.java:172-221):
+      1. every source has distance 0;
+      2. for every edge v-w: reachability agrees and |dist difference| <= 1
+         (checked one-directionally per directed edge: dist[w] <= dist[v]+1);
+      3. for every reached non-source w: dist[w] == dist[parent[w]] + 1 and
+         the tree edge (parent[w], w) exists in the graph.
+    Vectorised over edges instead of the oracle's per-edge loop.
+    """
+    dist = np.asarray(dist)[: graph.num_vertices].astype(np.int64)
+    parent = np.asarray(parent)[: graph.num_vertices].astype(np.int64)
+    srcs = _sources_array(sources, graph.num_vertices)
+    violations: list[str] = []
+
+    bad_src = srcs[dist[srcs] != 0]
+    for s in bad_src:
+        violations.append(f"distance of source {s} to itself = {dist[s]}, not 0")
+
+    sv, dv = graph.src.astype(np.int64), graph.dst.astype(np.int64)
+    reach_s, reach_d = dist[sv] != INF_DIST, dist[dv] != INF_DIST
+    # Directional: a reachable source endpoint forces a reachable destination
+    # (one relaxation away).  Checking per directed edge keeps this correct
+    # for directed graphs too; on bi-directed inputs it is equivalent to the
+    # oracle's undirected mismatch test.
+    mismatch = reach_s & ~reach_d
+    for i in np.flatnonzero(mismatch)[:5]:
+        violations.append(
+            f"edge {sv[i]}->{dv[i]}: source reachable but destination is not"
+        )
+    both = reach_s & reach_d
+    tri = both & (dist[dv] > dist[sv] + 1)
+    for i in np.flatnonzero(tri)[:5]:
+        violations.append(
+            f"edge {sv[i]}-{dv[i]}: dist[{dv[i]}]={dist[dv[i]]} > dist[{sv[i]}]+1={dist[sv[i]] + 1}"
+        )
+
+    reached = np.flatnonzero(dist != INF_DIST)
+    non_src = reached[~np.isin(reached, srcs)]
+    p = parent[non_src]
+    if (p == NO_PARENT).any():
+        for w in non_src[p == NO_PARENT][:5]:
+            violations.append(f"reached vertex {w} has no parent")
+        non_src = non_src[p != NO_PARENT]
+        p = parent[non_src]
+    bad_tree = dist[non_src] != dist[p] + 1
+    for idx in np.flatnonzero(bad_tree)[:5]:
+        w = non_src[idx]
+        violations.append(
+            f"tree edge {parent[w]}->{w}: dist[{w}]={dist[w]} != dist[{parent[w]}]+1"
+        )
+    # Tree edges must exist in the graph.
+    edge_set = set(zip(sv.tolist(), dv.tolist()))
+    for w in non_src.tolist():
+        if (int(parent[w]), int(w)) not in edge_set:
+            violations.append(f"tree edge {parent[w]}->{w} is not a graph edge")
+            if len(violations) > 20:
+                break
+    return violations

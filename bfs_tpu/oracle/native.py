@@ -1,0 +1,138 @@
+"""ctypes bindings for the native C++ oracle (native/oracle_bfs.cpp).
+
+The reference's serial baseline runs on the JVM (algs4 jar); ours is a small
+C++ CSR BFS built on demand with the system compiler and loaded via ctypes
+(pybind11 is not in the image).  Falls back cleanly: callers should guard
+with :func:`native_available` and use the pure-Python oracle otherwise.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from collections.abc import Sequence
+
+import numpy as np
+
+from ..graph.csr import Graph
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "oracle_bfs.cpp")
+_SO = os.path.join(_REPO_ROOT, "native", "build", "liboracle_bfs.so")
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_load_failed = False
+
+
+def _build() -> bool:
+    os.makedirs(os.path.dirname(_SO), exist_ok=True)
+    cmd = [
+        os.environ.get("CXX", "g++"),
+        "-O3", "-march=native", "-std=c++17", "-fPIC", "-shared",
+        "-o", _SO, _SRC,
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError):
+        return False
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            if not _build():
+                _load_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            _load_failed = True
+            return None
+        lib.bfs_csr.restype = ctypes.c_int32
+        lib.bfs_csr.argtypes = [
+            ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            ctypes.c_int32,
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            ctypes.c_int32,
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        ]
+        lib.bfs_check.restype = ctypes.c_int32
+        lib.bfs_check.argtypes = [
+            ctypes.c_int64,
+            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            ctypes.c_int32,
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        ]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def native_bfs(
+    graph: Graph,
+    sources: int | Sequence[int] = 0,
+    *,
+    policy: str = "queue",
+):
+    """Run the C++ oracle.  ``policy='queue'`` = algs4 first-discovery
+    parents; ``policy='canonical'`` = min-parent (engine-compatible).
+    Returns ``(dist, parent, num_levels)``; raises if the native lib is
+    unavailable (check :func:`native_available`)."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native oracle unavailable (compiler or load failure)")
+    indptr, indices = graph.csr()
+    srcs = np.atleast_1d(np.asarray(sources, dtype=np.int32))
+    dist = np.empty(graph.num_vertices, dtype=np.int32)
+    parent = np.empty(graph.num_vertices, dtype=np.int32)
+    pol = {"queue": 0, "canonical": 1}[policy]
+    indices32 = np.ascontiguousarray(indices, dtype=np.int32)
+    levels = lib.bfs_csr(
+        graph.num_vertices,
+        np.ascontiguousarray(indptr, dtype=np.int64),
+        indices32,
+        np.int32(srcs.size),
+        np.ascontiguousarray(srcs),
+        pol,
+        dist,
+        parent,
+    )
+    if levels < 0:
+        raise ValueError("native oracle rejected input")
+    return dist, parent, int(levels)
+
+
+def native_check(graph: Graph, dist, parent, sources=0) -> int:
+    """Invariant bitmask from the native verifier; 0 = OK."""
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native oracle unavailable")
+    indptr, indices = graph.csr()
+    srcs = np.atleast_1d(np.asarray(sources, dtype=np.int32))
+    return int(
+        lib.bfs_check(
+            graph.num_vertices,
+            np.ascontiguousarray(indptr, dtype=np.int64),
+            np.ascontiguousarray(indices, dtype=np.int32),
+            np.int32(srcs.size),
+            np.ascontiguousarray(srcs),
+            np.ascontiguousarray(dist, dtype=np.int32),
+            np.ascontiguousarray(parent, dtype=np.int32),
+        )
+    )

@@ -1,0 +1,1 @@
+from .sharded import make_mesh, bfs_sharded, bfs_sharded_multi, GRAPH_AXIS, BATCH_AXIS  # noqa: F401

@@ -1,0 +1,211 @@
+"""Mesh-sharded BFS: `shard_map` over edge shards with ICI all-reduce merge.
+
+TPU-native re-design of the reference's only parallelism strategy — Spark
+data-parallel map/shuffle over hash-partitioned Vertex records
+(BfsSpark.java:66-108, SURVEY.md §2.4/§2.5):
+
+  * Spark's hash-partitioned RDD blocks  ->  balanced dst-sorted edge shards,
+    one per device along the mesh's ``graph`` axis (csr.build_device_graph).
+  * The shuffle (`reduceByKey`) + driver collect (`collectAsMap`)  ->  one
+    ``lax.pmin`` all-reduce of the per-destination candidate-parent array per
+    superstep, riding ICI.  No host round-trip: the whole superstep loop is
+    a single compiled program, and dist/parent/frontier stay replicated
+    device-resident.
+  * The driver's file-based termination scan (BfsSpark.java:117)  ->  an
+    on-device replicated scalar.
+
+A second mesh axis ``batch`` shards the sources axis of batched multi-source
+BFS (data parallelism); ``graph`` is the model/context-parallel analogue.
+This is the scaling design for graphs that exceed one chip's HBM: per-device
+edge memory is E/n while V-sized state is replicated (SURVEY.md §5
+long-context row: graph sharding is this workload's context parallelism).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from jax.sharding import Mesh, PartitionSpec as P
+
+try:  # JAX >= 0.6 exposes shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from ..graph.csr import DeviceGraph, Graph, build_device_graph
+from ..models.bfs import BfsResult, check_sources
+from ..models.multisource import MultiBfsResult
+from ..ops.relax import (
+    BfsState,
+    init_batched_state,
+    init_state,
+    relax_superstep,
+    relax_superstep_batched,
+)
+
+GRAPH_AXIS = "graph"
+BATCH_AXIS = "batch"
+
+
+def make_mesh(
+    graph: int | None = None,
+    batch: int = 1,
+    *,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a ``(batch, graph)`` mesh.  ``graph=None`` uses all remaining
+    devices.  Single-host multi-device or multi-host both work — the mesh is
+    the cluster-bootstrap analogue of the Spark master/worker setup
+    (service.properties ip/port + README.md:27-31), minus the processes."""
+    devices = list(devices if devices is not None else jax.devices())
+    if graph is None:
+        graph = len(devices) // batch
+    if batch * graph > len(devices):
+        raise ValueError(f"mesh {batch}x{graph} needs {batch * graph} devices, have {len(devices)}")
+    arr = np.asarray(devices[: batch * graph]).reshape(batch, graph)
+    return Mesh(arr, (BATCH_AXIS, GRAPH_AXIS))
+
+
+def _graph_shards(mesh: Mesh) -> int:
+    return mesh.shape[GRAPH_AXIS]
+
+
+def _prepare(graph: Graph | DeviceGraph, mesh: Mesh, block: int) -> DeviceGraph:
+    n = _graph_shards(mesh)
+    if isinstance(graph, DeviceGraph):
+        if graph.num_shards != n:
+            raise ValueError(
+                f"DeviceGraph has {graph.num_shards} shards but mesh axis "
+                f"'{GRAPH_AXIS}' has {n}; rebuild with build_device_graph(num_shards={n})"
+            )
+        return graph
+    return build_device_graph(graph, num_shards=n, block=block)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "num_vertices", "max_levels")
+)
+def _bfs_sharded_fused(src, dst, source, *, mesh, num_vertices, max_levels):
+    def inner(src_blk, dst_blk, source):
+        src_e = src_blk.reshape(-1)
+        dst_e = dst_blk.reshape(-1)
+        state = init_state(num_vertices, source)
+
+        def cond(s: BfsState):
+            return s.changed & (s.level < max_levels)
+
+        def body(s: BfsState):
+            return relax_superstep(s, src_e, dst_e, axis_name=GRAPH_AXIS)
+
+        return jax.lax.while_loop(cond, body, state)
+
+    fn = _shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(P(GRAPH_AXIS, None), P(GRAPH_AXIS, None), P()),
+        out_specs=BfsState(P(), P(), P(), P(), P()),
+        axis_names={GRAPH_AXIS},
+    )
+    return fn(src, dst, source)
+
+
+def bfs_sharded(
+    graph: Graph | DeviceGraph,
+    source: int = 0,
+    *,
+    mesh: Mesh | None = None,
+    max_levels: int | None = None,
+    block: int = 1024,
+) -> BfsResult:
+    """Single-source BFS with edges sharded over the mesh's ``graph`` axis."""
+    mesh = mesh if mesh is not None else make_mesh()
+    dg = _prepare(graph, mesh, block)
+    check_sources(dg.num_vertices, source)
+    max_levels = int(max_levels) if max_levels is not None else dg.num_vertices
+    state = _bfs_sharded_fused(
+        jnp.asarray(dg.src).reshape(dg.num_shards, -1),
+        jnp.asarray(dg.dst).reshape(dg.num_shards, -1),
+        jnp.int32(source),
+        mesh=mesh,
+        num_vertices=dg.num_vertices,
+        max_levels=max_levels,
+    )
+    state = jax.device_get(state)
+    return BfsResult(
+        dist=np.asarray(state.dist[: dg.num_vertices]),
+        parent=np.asarray(state.parent[: dg.num_vertices]),
+        num_levels=int(state.level),
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "num_vertices", "max_levels")
+)
+def _bfs_sharded_multi_fused(src, dst, sources, *, mesh, num_vertices, max_levels):
+    def inner(src_blk, dst_blk, sources_blk):
+        src_e = src_blk.reshape(-1)
+        dst_e = dst_blk.reshape(-1)
+        state = init_batched_state(num_vertices, sources_blk)
+
+        def cond(s: BfsState):
+            return s.changed & (s.level < max_levels)
+
+        def body(s: BfsState):
+            return relax_superstep_batched(
+                s, src_e, dst_e, axis_name=GRAPH_AXIS, batch_axis_name=BATCH_AXIS
+            )
+
+        return jax.lax.while_loop(cond, body, state)
+
+    fn = _shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(P(GRAPH_AXIS, None), P(GRAPH_AXIS, None), P(BATCH_AXIS)),
+        out_specs=BfsState(
+            P(BATCH_AXIS, None), P(BATCH_AXIS, None), P(BATCH_AXIS, None), P(), P()
+        ),
+        axis_names={GRAPH_AXIS, BATCH_AXIS},
+    )
+    return fn(src, dst, sources)
+
+
+def bfs_sharded_multi(
+    graph: Graph | DeviceGraph,
+    sources,
+    *,
+    mesh: Mesh | None = None,
+    max_levels: int | None = None,
+    block: int = 1024,
+) -> MultiBfsResult:
+    """Batched multi-source BFS: sources sharded over ``batch`` (DP), edges
+    over ``graph`` (the context-parallel analogue).  Sources count must be a
+    multiple of the batch axis size."""
+    mesh = mesh if mesh is not None else make_mesh()
+    dg = _prepare(graph, mesh, block)
+    sources = np.atleast_1d(np.asarray(sources, dtype=np.int32))
+    check_sources(dg.num_vertices, sources)
+    nb = mesh.shape[BATCH_AXIS]
+    if sources.shape[0] % nb != 0:
+        raise ValueError(f"{sources.shape[0]} sources not divisible by batch axis {nb}")
+    max_levels = int(max_levels) if max_levels is not None else dg.num_vertices
+    state = _bfs_sharded_multi_fused(
+        jnp.asarray(dg.src).reshape(dg.num_shards, -1),
+        jnp.asarray(dg.dst).reshape(dg.num_shards, -1),
+        jnp.asarray(sources),
+        mesh=mesh,
+        num_vertices=dg.num_vertices,
+        max_levels=max_levels,
+    )
+    state = jax.device_get(state)
+    v = dg.num_vertices
+    return MultiBfsResult(
+        sources=sources,
+        dist=np.asarray(state.dist[:, :v]),
+        parent=np.asarray(state.parent[:, :v]),
+        num_levels=int(state.level),
+    )

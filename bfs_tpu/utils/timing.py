@@ -1,0 +1,63 @@
+"""Wall-clock stopwatch, parity with Guava ``Stopwatch`` as used by the
+reference for per-superstep timing (BfsSpark.java:59,63,111-112) and oracle
+timing (SequentialTest.java:25-27).
+
+The reference's methodology — time only the map/reduce stage, accumulate
+across supersteps, exclude startup and graph construction (paper §1.5) — is
+reproduced by the runners via ``start``/``stop`` around each superstep.
+JAX note: callers must block on device results (``block_until_ready``)
+before ``stop`` or the async dispatch makes timings meaningless.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+class Stopwatch:
+    """start/stop accumulate; ``elapsed_s`` is total accumulated seconds."""
+
+    def __init__(self):
+        self._acc = 0.0
+        self._started_at: float | None = None
+
+    @classmethod
+    def create_started(cls) -> "Stopwatch":
+        sw = cls()
+        sw.start()
+        return sw
+
+    def start(self) -> "Stopwatch":
+        if self._started_at is not None:
+            raise RuntimeError("stopwatch already running")
+        self._started_at = time.perf_counter()
+        return self
+
+    def stop(self) -> "Stopwatch":
+        if self._started_at is None:
+            raise RuntimeError("stopwatch not running")
+        self._acc += time.perf_counter() - self._started_at
+        self._started_at = None
+        return self
+
+    def reset(self) -> "Stopwatch":
+        self._acc = 0.0
+        self._started_at = None
+        return self
+
+    @property
+    def running(self) -> bool:
+        return self._started_at is not None
+
+    @property
+    def elapsed_s(self) -> float:
+        extra = time.perf_counter() - self._started_at if self.running else 0.0
+        return self._acc + extra
+
+    def __str__(self) -> str:  # human form like Guava's "342.8 ms"
+        s = self.elapsed_s
+        if s >= 1.0:
+            return f"{s:.3f} s"
+        if s >= 1e-3:
+            return f"{s * 1e3:.3f} ms"
+        return f"{s * 1e6:.1f} us"

@@ -1,0 +1,131 @@
+// Native sequential BFS oracle: CSR adjacency + ring-buffer queue.
+//
+// Plays the role of the reference's vendored algs4 oracle
+// (sequential-libs/algs4.jar!/BreadthFirstPaths.java:93-132): the serial
+// baseline the parallel engine is benchmarked against ("serial version"
+// column of docs/BigData_Project.pdf §1.5 Table 7).  Re-implemented from
+// behavior — FIFO queue, dist/parent arrays, multi-source seeding — not
+// translated.  Exposed via a C ABI for ctypes (no pybind11 in the image).
+//
+// Two parent policies:
+//   policy=0  first-discovery (enqueue order over sorted adjacency) —
+//             algs4 edgeTo semantics.
+//   policy=1  canonical min-parent per level (level-synchronous) — the rule
+//             the TPU engine uses, for bit-exact differential testing.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+namespace {
+constexpr int32_t kInf = INT32_MAX;
+constexpr int32_t kNoParent = -1;
+}  // namespace
+
+extern "C" {
+
+// indptr: int64[V+1]; indices: int32[E]; sources: int32[num_sources];
+// dist/parent: int32[V] (outputs).  Returns the number of BFS levels
+// (max finite distance), or -1 on bad input.
+int32_t bfs_csr(int64_t num_vertices, const int64_t* indptr,
+                const int32_t* indices, int32_t num_sources,
+                const int32_t* sources, int32_t policy, int32_t* dist,
+                int32_t* parent) {
+  if (num_vertices < 0 || num_sources <= 0) return -1;
+  const int64_t v = num_vertices;
+  for (int64_t i = 0; i < v; ++i) {
+    dist[i] = kInf;
+    parent[i] = kNoParent;
+  }
+  std::vector<int32_t> queue(static_cast<size_t>(v));
+  int64_t head = 0, tail = 0;
+  for (int32_t i = 0; i < num_sources; ++i) {
+    const int32_t s = sources[i];
+    if (s < 0 || s >= v) return -1;
+    if (dist[s] != 0) {
+      dist[s] = 0;
+      parent[s] = s;
+      queue[tail++] = s;
+    }
+  }
+  int32_t max_level = 0;
+  if (policy == 0) {
+    while (head < tail) {
+      const int32_t u = queue[head++];
+      const int32_t du = dist[u];
+      for (int64_t e = indptr[u]; e < indptr[u + 1]; ++e) {
+        const int32_t w = indices[e];
+        if (parent[w] == kNoParent) {
+          parent[w] = u;
+          dist[w] = du + 1;
+          if (dist[w] > max_level) max_level = dist[w];
+          queue[tail++] = w;
+        }
+      }
+    }
+  } else {
+    // Level-synchronous with min-parent: process the queue level by level;
+    // within a level, a vertex discovered twice keeps the smaller parent.
+    while (head < tail) {
+      const int64_t level_end = tail;
+      while (head < level_end) {
+        const int32_t u = queue[head++];
+        const int32_t du = dist[u];
+        for (int64_t e = indptr[u]; e < indptr[u + 1]; ++e) {
+          const int32_t w = indices[e];
+          if (dist[w] == kInf) {
+            dist[w] = du + 1;
+            parent[w] = u;
+            if (dist[w] > max_level) max_level = dist[w];
+            queue[tail++] = w;
+          } else if (dist[w] == du + 1 && u < parent[w] && parent[w] != w) {
+            parent[w] = u;
+          }
+        }
+      }
+    }
+  }
+  return max_level;
+}
+
+// Optimality verifier, port of BreadthFirstPaths.check semantics
+// (BreadthFirstPaths.java:172-221).  Returns 0 if all invariants hold,
+// otherwise a bitmask: 1 = source distance != 0; 2 = edge crosses the
+// reachable boundary or violates the triangle inequality; 4 = tree-edge
+// distance property violated.
+int32_t bfs_check(int64_t num_vertices, const int64_t* indptr,
+                  const int32_t* indices, int32_t num_sources,
+                  const int32_t* sources, const int32_t* dist,
+                  const int32_t* parent) {
+  int32_t bad = 0;
+  for (int32_t i = 0; i < num_sources; ++i) {
+    if (dist[sources[i]] != 0) bad |= 1;
+  }
+  for (int64_t u = 0; u < num_vertices; ++u) {
+    const bool ru = dist[u] != kInf;
+    for (int64_t e = indptr[u]; e < indptr[u + 1]; ++e) {
+      const int32_t w = indices[e];
+      const bool rw = dist[w] != kInf;
+      // Directional (correct for directed CSR too): reachable source endpoint
+      // forces a reachable destination.
+      if (ru && !rw) bad |= 2;
+      if (ru && rw && dist[w] > dist[u] + 1) bad |= 2;
+    }
+  }
+  for (int64_t w = 0; w < num_vertices; ++w) {
+    if (dist[w] == kInf || dist[w] == 0) continue;
+    const int32_t p = parent[w];
+    if (p == kNoParent || dist[w] != dist[p] + 1) {
+      bad |= 4;
+      continue;
+    }
+    bool found = false;  // tree edge must exist: scan p's adjacency
+    for (int64_t e = indptr[p]; e < indptr[p + 1] && !found; ++e) {
+      found = indices[e] == w;
+    }
+    if (!found) bad |= 4;
+  }
+  return bad;
+}
+
+}  // extern "C"

@@ -1,0 +1,50 @@
+"""Test harness config: force an 8-device virtual CPU platform BEFORE jax
+imports — the single-host multi-device methodology mirroring the reference
+benchmark's "master + N workers on one machine" setup
+(docs/BigData_Project.pdf §1.5, SURVEY.md §4)."""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+# The axon TPU plugin (sitecustomize) force-sets jax_platforms="axon,cpu",
+# overriding the env var; pin CPU back explicitly for the test suite.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np
+import pytest
+
+from bfs_tpu.graph.csr import Graph
+
+# tinyCG.txt contents (reference test-sets/tinyCG.txt; the paper's worked
+# example, docs/BigData_Project.pdf §1.2 Table 1): 6 vertices, 8 edges.
+TINY_V = 6
+TINY_EDGES = [(0, 5), (2, 4), (2, 3), (1, 2), (0, 1), (3, 4), (3, 5), (0, 2)]
+TINY_TEXT = "6\n8\n" + "\n".join(f"{u} {v}" for u, v in TINY_EDGES) + "\n"
+
+REFERENCE_TEST_SETS = "/root/reference/test-sets"
+
+
+@pytest.fixture
+def tiny_graph() -> Graph:
+    return Graph.from_undirected_edges(TINY_V, np.array(TINY_EDGES))
+
+
+@pytest.fixture
+def medium_graph() -> Graph:
+    path = os.path.join(REFERENCE_TEST_SETS, "mediumG.txt")
+    if not os.path.exists(path):
+        pytest.skip("reference mediumG.txt not available")
+    from bfs_tpu.graph.io import read_sedgewick
+
+    return read_sedgewick(path)

@@ -1,0 +1,97 @@
+"""Checkpoint/resume and config-layer tests (SURVEY.md §5 rows
+checkpoint/resume + config; ServiceConfiguration.java:30-63 parity)."""
+
+import numpy as np
+import pytest
+
+from bfs_tpu.config import ServiceConfiguration, parse_properties
+from bfs_tpu.graph.generators import gnm_graph
+from bfs_tpu.graph.vertex import parse_state, serialize_state
+from bfs_tpu.models.bfs import SuperstepRunner, bfs
+from bfs_tpu.utils.checkpoint import load_checkpoint, save_checkpoint, state_from_arrays
+from bfs_tpu.utils.metrics import RunMetrics
+from bfs_tpu.utils.timing import Stopwatch
+
+
+def test_npz_checkpoint_resume(tmp_path):
+    g = gnm_graph(120, 360, seed=4)
+    runner = SuperstepRunner(g)
+    state = runner.init(0)
+    state = runner.step(state)
+    state = runner.step(state)
+    ckpt = tmp_path / "step2.npz"
+    save_checkpoint(ckpt, state)
+
+    resumed = load_checkpoint(ckpt)
+    assert int(resumed.level) == 2
+    while bool(resumed.changed):
+        resumed = runner.step(resumed)
+
+    full = bfs(g, 0)
+    np.testing.assert_array_equal(np.asarray(resumed.dist[:120]), full.dist)
+    np.testing.assert_array_equal(np.asarray(resumed.parent[:120]), full.parent)
+
+
+def test_text_dump_resume(tiny_graph):
+    # Resume from a problemFile_i-style text dump: the reference's de-facto
+    # checkpoint mechanism (BfsSpark.java:62,115-116).
+    runner = SuperstepRunner(tiny_graph)
+    state = runner.step(runner.init(0))
+    text = serialize_state(tiny_graph, state.dist, state.parent, state.frontier)
+    dist, parent, frontier = parse_state(text, 6)
+    resumed = state_from_arrays(dist, parent, frontier, level=int(state.level))
+    while bool(resumed.changed):
+        resumed = runner.step(resumed)
+    full = bfs(tiny_graph, 0)
+    np.testing.assert_array_equal(np.asarray(resumed.dist[:6]), full.dist)
+    np.testing.assert_array_equal(np.asarray(resumed.parent[:6]), full.parent)
+
+
+def test_parse_properties():
+    props = parse_properties(
+        "# comment\napp-name = X\nproblemFiles = a.txt, b.txt\n\n! bang comment\n"
+    )
+    assert props == {"app-name": "X", "problemFiles": "a.txt, b.txt"}
+    with pytest.raises(ValueError):
+        parse_properties("no equals sign here")
+
+
+def test_service_configuration_load(tmp_path):
+    p = tmp_path / "service.properties"
+    p.write_text(
+        "app-name = BFS TPU\nproblemFiles = tiny.txt, medium.txt\n"
+        "source = 2\nmesh-graph = 4\ndump-supersteps = true\n"
+    )
+    cfg = ServiceConfiguration.load(p)
+    assert cfg.app_name == "BFS TPU"
+    assert cfg.problem_files == ("tiny.txt", "medium.txt")
+    assert cfg.source == 2 and cfg.mesh_graph == 4 and cfg.dump_supersteps
+
+
+def test_config_missing_file_raises():
+    # Deliberate divergence: the reference swallows config errors into null
+    # getters (ServiceConfiguration.java:40-42); we fail fast.
+    with pytest.raises(OSError):
+        ServiceConfiguration.load("/nonexistent/service.properties")
+
+
+def test_metrics_teps():
+    m = RunMetrics(num_vertices=10, num_edges=1000)
+    m.record(1, 5, 0.001)
+    m.record(2, 0, 0.001)
+    assert m.total_seconds == pytest.approx(0.002)
+    assert m.teps() == pytest.approx(500_000)
+    assert m.num_levels == 2
+    assert any("Elapsed time [1]" in line for line in m.log_lines())
+
+
+def test_stopwatch():
+    sw = Stopwatch.create_started()
+    assert sw.running
+    sw.stop()
+    t1 = sw.elapsed_s
+    sw.start()
+    sw.stop()
+    assert sw.elapsed_s >= t1
+    with pytest.raises(RuntimeError):
+        sw.stop()

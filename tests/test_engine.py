@@ -1,0 +1,101 @@
+"""Differential tests: TPU engine vs sequential oracle (the reference's
+verification methodology, SURVEY.md §4: same problem files, outputs must
+agree; here automated and bit-exact)."""
+
+import numpy as np
+import pytest
+
+from bfs_tpu.graph.csr import Graph, INF_DIST, build_device_graph
+from bfs_tpu.graph.generators import gnm_graph, path_graph, rmat_graph
+from bfs_tpu.models.bfs import SuperstepRunner, bfs
+from bfs_tpu.oracle.bfs import canonical_bfs, check, queue_bfs
+
+
+def assert_matches_oracle(graph, result, source=0):
+    d, _ = queue_bfs(graph, source)
+    np.testing.assert_array_equal(result.dist, d)  # distances: bit-exact
+    _, p = canonical_bfs(graph, source)
+    np.testing.assert_array_equal(result.parent, p)  # canonical parents
+    assert check(graph, result.dist, result.parent, source) == []
+
+
+def test_tiny_fused(tiny_graph):
+    res = bfs(tiny_graph, 0)
+    assert res.dist.tolist() == [0, 1, 1, 2, 2, 1]
+    assert res.parent.tolist() == [0, 0, 0, 2, 2, 0]
+    # 3 supersteps, matching the paper's parallel iteration count
+    # (docs/BigData_Project.pdf §1.3).
+    assert res.num_levels == 3
+    assert res.path_to(3) == [0, 2, 3]
+    assert res.dist_to(4) == 2 and res.has_path_to(4)
+
+
+def test_tiny_from_other_sources(tiny_graph):
+    for s in range(6):
+        assert_matches_oracle(tiny_graph, bfs(tiny_graph, s), s)
+
+
+def test_medium(medium_graph):
+    assert_matches_oracle(medium_graph, bfs(medium_graph, 0))
+
+
+def test_random_graphs():
+    for seed in range(4):
+        g = gnm_graph(300, 700, seed=seed)  # typically disconnected
+        assert_matches_oracle(g, bfs(g, 0))
+
+
+def test_rmat():
+    g = rmat_graph(8, 8, seed=3)
+    assert_matches_oracle(g, bfs(g, 0))
+
+
+def test_deep_path_graph():
+    g = path_graph(50)  # worst-case diameter: 50 supersteps
+    res = bfs(g, 0)
+    assert res.dist.tolist() == list(range(50))
+    assert_matches_oracle(g, res)
+
+
+def test_isolated_source():
+    g = Graph.from_undirected_edges(4, np.array([[1, 2]]))
+    res = bfs(g, 0)
+    assert res.dist[0] == 0 and (res.dist[1:] == INF_DIST).all()
+    assert res.num_levels == 1  # one superstep that finds nothing
+
+
+def test_max_levels_cutoff():
+    g = path_graph(10)
+    res = bfs(g, 0, max_levels=3)
+    assert res.dist[3] == 3 and res.dist[4] == INF_DIST
+
+
+def test_stepped_equals_fused(tiny_graph):
+    runner = SuperstepRunner(tiny_graph)
+    stepped = runner.run(0)
+    fused = bfs(tiny_graph, 0)
+    np.testing.assert_array_equal(stepped.dist, fused.dist)
+    np.testing.assert_array_equal(stepped.parent, fused.parent)
+    assert stepped.num_levels == fused.num_levels
+
+
+def test_stepped_observer_frontier_sizes(tiny_graph):
+    runner = SuperstepRunner(tiny_graph)
+    sizes = []
+    runner.run(0, observer=lambda lvl, s: sizes.append(runner.frontier_size(s)))
+    # Frontiers: {1,2,5} then {3,4} then {} (paper Tables 3-6 progression).
+    assert sizes == [3, 2, 0]
+
+
+def test_self_loops_and_multi_edges():
+    g = Graph.from_undirected_edges(3, np.array([[0, 0], [0, 1], [0, 1], [1, 2]]))
+    assert_matches_oracle(g, bfs(g, 0))
+
+
+def test_out_of_range_source_rejected(tiny_graph):
+    # XLA's .at[].set clips out-of-range indices into the sentinel slot;
+    # without host-side validation that silently returns "all unreachable".
+    with pytest.raises(ValueError):
+        bfs(tiny_graph, 99)
+    with pytest.raises(ValueError):
+        SuperstepRunner(tiny_graph).init(-1)

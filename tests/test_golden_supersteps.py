@@ -1,0 +1,68 @@
+"""Golden per-superstep state tests on tinyCG — the automated version of the
+paper's hand-verified iteration tables (docs/BigData_Project.pdf §1.4
+Tables 3-6, which are literally the reference's problemFile_i files)."""
+
+from bfs_tpu.graph.vertex import initial_state_vertices, state_to_vertices
+from bfs_tpu.models.bfs import SuperstepRunner
+
+# Expected problemFile_i contents for tinyCG, source 0, canonical min-parent
+# paths.  Neighbour sets are sorted (Java HashSet order is unspecified; any
+# order parses identically).
+GOLDEN = {
+    0: [
+        "0|[1, 2, 5]|[0]|0|GRAY",
+        "1|[0, 2]|[0]|2147483647|WHITE",
+        "2|[0, 1, 3, 4]|[0]|2147483647|WHITE",
+        "3|[2, 4, 5]|[0]|2147483647|WHITE",
+        "4|[2, 3]|[0]|2147483647|WHITE",
+        "5|[0, 3]|[0]|2147483647|WHITE",
+    ],
+    1: [
+        "0|[1, 2, 5]|[0]|0|BLACK",
+        "1|[0, 2]|[0, 1]|1|GRAY",
+        "2|[0, 1, 3, 4]|[0, 2]|1|GRAY",
+        "3|[2, 4, 5]|[0]|2147483647|WHITE",
+        "4|[2, 3]|[0]|2147483647|WHITE",
+        "5|[0, 3]|[0, 5]|1|GRAY",
+    ],
+    2: [
+        "0|[1, 2, 5]|[0]|0|BLACK",
+        "1|[0, 2]|[0, 1]|1|BLACK",
+        "2|[0, 1, 3, 4]|[0, 2]|1|BLACK",
+        "3|[2, 4, 5]|[0, 2, 3]|2|GRAY",
+        "4|[2, 3]|[0, 2, 4]|2|GRAY",
+        "5|[0, 3]|[0, 5]|1|BLACK",
+    ],
+    3: [
+        "0|[1, 2, 5]|[0]|0|BLACK",
+        "1|[0, 2]|[0, 1]|1|BLACK",
+        "2|[0, 1, 3, 4]|[0, 2]|1|BLACK",
+        "3|[2, 4, 5]|[0, 2, 3]|2|BLACK",
+        "4|[2, 3]|[0, 2, 4]|2|BLACK",
+        "5|[0, 3]|[0, 5]|1|BLACK",
+    ],
+}
+
+
+def test_golden_superstep_states(tiny_graph):
+    assert [
+        v.serialize() for v in initial_state_vertices(tiny_graph, 0)
+    ] == GOLDEN[0]
+
+    runner = SuperstepRunner(tiny_graph)
+    state = runner.init(0)
+    level = 0
+    while bool(state.changed):
+        state = runner.step(state)
+        level = int(state.level)
+        got = [
+            v.serialize()
+            for v in state_to_vertices(
+                tiny_graph, state.dist, state.parent, state.frontier, source=0
+            )
+        ]
+        assert got == GOLDEN[level], f"superstep {level} state mismatch"
+    # Terminates after 3 supersteps with no GRAY left — the reference's
+    # contains("GRAY") test goes false (BfsSpark.java:117).
+    assert level == 3
+    assert all("GRAY" not in line for line in GOLDEN[3])

@@ -1,0 +1,120 @@
+"""Ingest layer tests: Sedgewick parsing, bi-directing, CSR, device padding.
+
+Covers GraphFileUtil.convert behavior (GraphFileUtil.java:45-69) and algs4
+Graph construction (Graph.java:85-94,145-172)."""
+
+import numpy as np
+import pytest
+
+from bfs_tpu.graph.csr import Graph, build_device_graph, reshard
+from bfs_tpu.graph.generators import gnm_graph, path_graph, rmat_graph
+from bfs_tpu.graph.io import parse_sedgewick, read_snap_edge_list, write_sedgewick
+
+from conftest import TINY_TEXT, TINY_V, TINY_EDGES
+
+
+def test_parse_sedgewick_tiny(tiny_graph):
+    g = parse_sedgewick(TINY_TEXT)
+    assert g.num_vertices == TINY_V
+    # Undirected input is bi-directed: every edge twice (GraphFileUtil.java:64-65).
+    assert g.num_edges == 2 * len(TINY_EDGES)
+    np.testing.assert_array_equal(g.src, tiny_graph.src)
+    np.testing.assert_array_equal(g.dst, tiny_graph.dst)
+
+
+def test_adjacency_and_degree(tiny_graph):
+    # Sorted adjacency view (Graph.adj / Graph.degree parity).
+    assert list(tiny_graph.adj(0)) == [1, 2, 5]
+    assert list(tiny_graph.adj(3)) == [2, 4, 5]
+    assert tiny_graph.degree(2) == 4
+    assert tiny_graph.degree(4) == 2
+
+
+def test_csr_roundtrip_counts(tiny_graph):
+    indptr, indices = tiny_graph.csr()
+    assert indptr[-1] == tiny_graph.num_edges
+    assert indices.shape[0] == tiny_graph.num_edges
+
+
+def test_parse_rejects_truncated():
+    with pytest.raises(ValueError):
+        parse_sedgewick("6\n8\n0 5\n")
+
+
+def test_write_read_roundtrip(tmp_path, tiny_graph):
+    p = tmp_path / "g.txt"
+    write_sedgewick(tiny_graph, p)
+    g2 = parse_sedgewick(p.read_text())
+    assert g2.num_vertices == tiny_graph.num_vertices
+    assert sorted(zip(g2.src.tolist(), g2.dst.tolist())) == sorted(
+        zip(tiny_graph.src.tolist(), tiny_graph.dst.tolist())
+    )
+
+
+def test_snap_reader(tmp_path):
+    p = tmp_path / "snap.txt"
+    p.write_text("# comment\n0\t1\n1\t2\n")
+    g = read_snap_edge_list(p)
+    assert g.num_vertices == 3
+    assert g.num_edges == 4  # bi-directed
+
+
+def test_device_graph_padding(tiny_graph):
+    dg = build_device_graph(tiny_graph, block=64)
+    assert dg.padded_edges % 64 == 0
+    assert dg.num_edges == tiny_graph.num_edges
+    pad = dg.src[dg.num_edges :]
+    assert (pad == dg.sentinel).all()
+    # dst-sorted for indices_are_sorted segment reductions.
+    assert (np.diff(dg.dst) >= 0).all()
+
+
+def test_device_graph_sharded(tiny_graph):
+    dg = build_device_graph(tiny_graph, num_shards=4, block=8)
+    assert dg.src.shape[0] == 4
+    flat = dg.src.reshape(-1)
+    assert (flat != dg.sentinel).sum() == tiny_graph.num_edges
+    for s in range(4):
+        assert (np.diff(dg.dst[s]) >= 0).all()
+    dg2 = reshard(dg, 2, block=8)
+    assert dg2.num_shards == 2
+    assert (dg2.src.reshape(-1) != dg2.sentinel).sum() == tiny_graph.num_edges
+
+
+def test_generators_shapes():
+    g = rmat_graph(6, 4, seed=1)
+    assert g.num_vertices == 64
+    assert g.num_edges == 2 * 4 * 64
+    g2 = gnm_graph(100, 300, seed=2)
+    assert g2.num_edges == 600
+    p = path_graph(10)
+    assert p.num_edges == 18
+
+
+def test_edge_out_of_range_rejected():
+    with pytest.raises(ValueError):
+        Graph.from_undirected_edges(3, np.array([[0, 3]]))
+
+
+def test_write_read_preserves_multigraph():
+    # Parallel edges and self-loops must round-trip exactly (multigraph
+    # fidelity; algs4 Graph keeps multi-edges in its Bag).
+    g = Graph.from_undirected_edges(3, np.array([[0, 1], [0, 1], [2, 2]]))
+    import io as _io, tempfile, os as _os
+
+    fd, p = tempfile.mkstemp()
+    _os.close(fd)
+    try:
+        write_sedgewick(g, p)
+        g2 = parse_sedgewick(open(p).read())
+    finally:
+        _os.unlink(p)
+    assert g2.num_edges == g.num_edges
+    assert sorted(zip(g2.src.tolist(), g2.dst.tolist())) == sorted(
+        zip(g.src.tolist(), g.dst.tolist())
+    )
+
+
+def test_negative_edge_endpoint_rejected():
+    with pytest.raises(ValueError):
+        Graph.from_directed_edges(3, np.array([[0, -1]]))

@@ -1,0 +1,52 @@
+"""Batched multi-source BFS tests (BreadthFirstPaths.java:114-132 semantics
+via collapse; per-source trees via the batch axis)."""
+
+import numpy as np
+
+from bfs_tpu.graph.generators import gnm_graph, path_graph
+from bfs_tpu.models.bfs import bfs
+from bfs_tpu.models.multisource import bfs_multi, collapse_multi_source
+from bfs_tpu.oracle.bfs import check, queue_bfs
+
+
+def test_batched_rows_match_single_runs(tiny_graph):
+    sources = [0, 3, 5]
+    res = bfs_multi(tiny_graph, sources)
+    for i, s in enumerate(sources):
+        single = bfs(tiny_graph, s)
+        np.testing.assert_array_equal(res.dist[i], single.dist)
+        np.testing.assert_array_equal(res.parent[i], single.parent)
+
+
+def test_collapse_matches_oracle_multisource():
+    g = path_graph(12)
+    res = bfs_multi(g, [0, 11])
+    dist, parent = collapse_multi_source(res)
+    od, _ = queue_bfs(g, [0, 11])
+    np.testing.assert_array_equal(dist, od)
+    assert check(g, dist, parent, [0, 11]) == []
+
+
+def test_collapse_random():
+    for seed in range(3):
+        g = gnm_graph(150, 400, seed=seed)
+        srcs = [3, 77, 140]
+        res = bfs_multi(g, srcs)
+        dist, parent = collapse_multi_source(res)
+        od, _ = queue_bfs(g, srcs)
+        np.testing.assert_array_equal(dist, od)
+        assert check(g, dist, parent, srcs) == []
+
+
+def test_num_levels_is_max_over_sources():
+    g = path_graph(10)
+    res = bfs_multi(g, [0, 9])
+    # Source 0 and 9 both need 9 relaxing supersteps + 1 empty terminator.
+    assert res.num_levels == 10
+
+
+def test_out_of_range_sources_rejected(tiny_graph):
+    import pytest
+
+    with pytest.raises(ValueError):
+        bfs_multi(tiny_graph, [0, 6])

@@ -1,0 +1,120 @@
+"""Sequential oracle tests: distances/parents/paths on the paper's worked
+example (docs/BigData_Project.pdf §1.2 Table 2), check() invariants
+(BreadthFirstPaths.java:172-221 semantics), multi-source, native parity."""
+
+import numpy as np
+import pytest
+
+from bfs_tpu.graph.csr import Graph, INF_DIST, NO_PARENT
+from bfs_tpu.graph.generators import gnm_graph, path_graph, rmat_graph
+from bfs_tpu.graph.vertex import path_to
+from bfs_tpu.oracle.bfs import canonical_bfs, check, dist_to, has_path_to, queue_bfs
+from bfs_tpu.oracle.native import native_available, native_bfs, native_check
+
+TINY_DIST = [0, 1, 1, 2, 2, 1]
+TINY_PARENT = [0, 0, 0, 2, 2, 0]  # canonical min-parent
+
+
+def test_queue_bfs_tiny(tiny_graph):
+    dist, parent = queue_bfs(tiny_graph, 0)
+    assert dist.tolist() == TINY_DIST
+    assert parent.tolist() == TINY_PARENT  # sorted adjacency makes these agree
+    assert check(tiny_graph, dist, parent, 0) == []
+
+
+def test_canonical_bfs_tiny(tiny_graph):
+    dist, parent = canonical_bfs(tiny_graph, 0)
+    assert dist.tolist() == TINY_DIST
+    assert parent.tolist() == TINY_PARENT
+    # Paper Table 2: path to 3 is "0,5,3 or 0,2,3 depending on the order";
+    # the canonical min-parent rule makes it deterministically 0-2-3.
+    assert path_to(parent, 3) == [0, 2, 3]
+    assert path_to(parent, 4) == [0, 2, 4]
+
+
+def test_query_api(tiny_graph):
+    dist, parent = queue_bfs(tiny_graph, 0)
+    assert has_path_to(dist, 3)
+    assert dist_to(dist, 3) == 2
+    assert path_to(parent, 0) == [0]
+
+
+def test_disconnected():
+    g = Graph.from_undirected_edges(5, np.array([[0, 1], [2, 3]]))
+    dist, parent = queue_bfs(g, 0)
+    assert dist[2] == INF_DIST and dist[4] == INF_DIST
+    assert parent[2] == NO_PARENT
+    assert not has_path_to(dist, 4)
+    assert path_to(parent, 4) == []
+    # check() must flag nothing: unreached vertices are legal (Color.java:13-16).
+    assert check(g, dist, parent, 0) == []
+
+
+def test_multi_source():
+    g = path_graph(10)
+    dist, parent = queue_bfs(g, [0, 9])
+    # BreadthFirstPaths multi-source semantics: dist to the NEAREST source.
+    assert dist.tolist() == [0, 1, 2, 3, 4, 4, 3, 2, 1, 0]
+    assert check(g, dist, parent, [0, 9]) == []
+
+
+def test_canonical_vs_queue_distances_agree():
+    for seed in range(5):
+        g = gnm_graph(200, 500, seed=seed)
+        d1, p1 = queue_bfs(g, 0)
+        d2, p2 = canonical_bfs(g, 0)
+        np.testing.assert_array_equal(d1, d2)
+        assert check(g, d2, p2, 0) == []
+
+
+def test_check_catches_corruption(tiny_graph):
+    dist, parent = queue_bfs(tiny_graph, 0)
+    bad = dist.copy()
+    bad[3] = 7  # violates triangle inequality
+    assert check(tiny_graph, bad, parent, 0) != []
+    bad2 = dist.copy()
+    bad2[0] = 1  # source distance must be 0
+    assert check(tiny_graph, bad2, parent, 0) != []
+    badp = parent.copy()
+    badp[3] = 1  # 1-3 is not an edge / wrong level
+    assert check(tiny_graph, dist, badp, 0) != []
+
+
+@pytest.mark.skipif(not native_available(), reason="no C++ toolchain")
+class TestNativeOracle:
+    def test_native_matches_python_queue(self, tiny_graph):
+        dist, parent, levels = native_bfs(tiny_graph, 0, policy="queue")
+        d, p = queue_bfs(tiny_graph, 0)
+        np.testing.assert_array_equal(dist, d)
+        np.testing.assert_array_equal(parent, p)
+        assert levels == 2
+
+    def test_native_canonical_matches(self):
+        for seed in range(3):
+            g = rmat_graph(7, 4, seed=seed)
+            dist, parent, _ = native_bfs(g, 0, policy="canonical")
+            d, p = canonical_bfs(g, 0)
+            np.testing.assert_array_equal(dist, d)
+            np.testing.assert_array_equal(parent, p)
+
+    def test_native_check(self, tiny_graph):
+        dist, parent, _ = native_bfs(tiny_graph, 0)
+        assert native_check(tiny_graph, dist, parent, 0) == 0
+        bad = dist.copy()
+        bad[0] = 5
+        assert native_check(tiny_graph, bad, parent, 0) != 0
+
+    def test_native_multi_source(self):
+        g = path_graph(10)
+        dist, _, levels = native_bfs(g, [0, 9])
+        assert dist.tolist() == [0, 1, 2, 3, 4, 4, 3, 2, 1, 0]
+        assert levels == 4
+
+
+def test_check_directed_graph_no_false_positive():
+    # A correct BFS over a directed graph must not trip the reachability
+    # check: unreachable->reachable directed edges are legal.
+    g = Graph.from_directed_edges(3, np.array([[0, 1], [2, 1]]))
+    dist, parent = queue_bfs(g, 0)
+    assert dist.tolist() == [0, 1, INF_DIST]
+    assert check(g, dist, parent, 0) == []

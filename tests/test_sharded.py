@@ -1,0 +1,74 @@
+"""Mesh-sharded engine tests on the 8-device virtual CPU platform — the
+"N workers, one machine" methodology of the reference benchmark
+(docs/BigData_Project.pdf §1.5), with shard counts 1/2/8 standing in for the
+paper's 1/2/10 workers."""
+
+import jax
+import numpy as np
+import pytest
+
+from bfs_tpu.graph.csr import build_device_graph
+from bfs_tpu.graph.generators import gnm_graph, rmat_graph
+from bfs_tpu.models.bfs import bfs
+from bfs_tpu.models.multisource import bfs_multi
+from bfs_tpu.oracle.bfs import canonical_bfs, check, queue_bfs
+from bfs_tpu.parallel.sharded import bfs_sharded, bfs_sharded_multi, make_mesh
+
+
+def test_virtual_device_count():
+    assert len(jax.devices()) == 8
+
+
+@pytest.mark.parametrize("num_shards", [1, 2, 8])
+def test_sharded_matches_single_chip(tiny_graph, num_shards):
+    mesh = make_mesh(graph=num_shards)
+    res = bfs_sharded(tiny_graph, 0, mesh=mesh, block=8)
+    single = bfs(tiny_graph, 0)
+    np.testing.assert_array_equal(res.dist, single.dist)
+    np.testing.assert_array_equal(res.parent, single.parent)
+    assert res.num_levels == single.num_levels
+
+
+def test_sharded_random_graphs():
+    mesh = make_mesh(graph=8)
+    for seed in range(3):
+        g = gnm_graph(300, 900, seed=seed)
+        res = bfs_sharded(g, 0, mesh=mesh, block=16)
+        d, _ = queue_bfs(g, 0)
+        _, p = canonical_bfs(g, 0)
+        np.testing.assert_array_equal(res.dist, d)
+        np.testing.assert_array_equal(res.parent, p)
+        assert check(g, res.dist, res.parent, 0) == []
+
+
+def test_sharded_rmat_prebuilt_device_graph():
+    mesh = make_mesh(graph=4)
+    g = rmat_graph(7, 4, seed=5)
+    dg = build_device_graph(g, num_shards=4, block=32)
+    res = bfs_sharded(dg, 0, mesh=mesh)
+    d, _ = queue_bfs(g, 0)
+    np.testing.assert_array_equal(res.dist, d)
+
+
+def test_sharded_wrong_shard_count_rejected(tiny_graph):
+    mesh = make_mesh(graph=4)
+    dg = build_device_graph(tiny_graph, num_shards=2, block=8)
+    with pytest.raises(ValueError):
+        bfs_sharded(dg, 0, mesh=mesh)
+
+
+@pytest.mark.parametrize("batch,graph_shards", [(1, 8), (2, 4), (4, 2), (8, 1)])
+def test_sharded_multi_source_2d_mesh(batch, graph_shards):
+    g = gnm_graph(200, 600, seed=9)
+    mesh = make_mesh(graph=graph_shards, batch=batch)
+    sources = list(range(8))  # divisible by every batch size used here
+    res = bfs_sharded_multi(g, sources, mesh=mesh, block=16)
+    ref = bfs_multi(g, sources)
+    np.testing.assert_array_equal(res.dist, ref.dist)
+    np.testing.assert_array_equal(res.parent, ref.parent)
+
+
+def test_sharded_multi_source_divisibility(tiny_graph):
+    mesh = make_mesh(graph=2, batch=2)
+    with pytest.raises(ValueError):
+        bfs_sharded_multi(tiny_graph, [0, 1, 2], mesh=mesh, block=8)

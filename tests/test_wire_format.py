@@ -1,0 +1,95 @@
+"""Vertex/Color wire-format tests: parity with ``id|[n]|[p]|dist|COLOR``
+(Vertex.java:51-64,122-125) and the GraphFileUtil iteration-0 file
+(GraphFileUtil.java:50-56)."""
+
+import numpy as np
+import pytest
+
+from bfs_tpu.graph.csr import INF_DIST
+from bfs_tpu.graph.vertex import (
+    Color,
+    Vertex,
+    colors_from_state,
+    initial_state_vertices,
+    parse_state,
+    path_to,
+    serialize_state,
+    state_to_vertices,
+)
+
+
+def test_color_ordinals_locked():
+    # Ordinal order is load-bearing (Color.java:6 "DO NOT RE-ORDER",
+    # BfsSpark.java:103 darkest-color merge).
+    assert [c.value for c in (Color.WHITE, Color.GRAY, Color.BLACK)] == [0, 1, 2]
+    assert max(Color.GRAY, Color.BLACK) == Color.BLACK
+
+
+def test_serialize_format_exact():
+    v = Vertex(2, (0, 1, 3, 4), (0, 2), 1, Color.GRAY)
+    assert v.serialize() == "2|[0, 1, 3, 4]|[0, 2]|1|GRAY"
+    w = Vertex(4, (), (0,), INF_DIST, Color.WHITE)
+    assert w.serialize() == "4|[]|[0]|2147483647|WHITE"
+
+
+def test_parse_roundtrip():
+    line = "3|[2, 4, 5]|[0, 2, 3]|2|BLACK"
+    v = Vertex.parse(line)
+    assert v.id == 3 and v.distance == 2 and v.color is Color.BLACK
+    assert v.neighbours == (2, 4, 5) and v.path == (0, 2, 3)
+    assert v.serialize() == line
+
+
+def test_parse_tolerates_no_spaces_and_empty():
+    v = Vertex.parse("7|[1,2]|[]|2147483647|WHITE")
+    assert v.neighbours == (1, 2) and v.path == ()
+
+
+def test_parse_rejects_malformed():
+    with pytest.raises(ValueError):
+        Vertex.parse("1|[2]|[0]|3")  # missing color field
+    with pytest.raises(ValueError):
+        Vertex.parse("1|2|[0]|3|GRAY")  # unbracketed list
+    with pytest.raises(KeyError):
+        Vertex.parse("1|[2]|[0]|3|PURPLE")
+
+
+def test_with_color():
+    v = Vertex(1, (0,), (0, 1), 1, Color.GRAY)
+    assert v.with_color(Color.BLACK).color is Color.BLACK
+
+
+def test_initial_state_vertices(tiny_graph):
+    lines = [v.serialize() for v in initial_state_vertices(tiny_graph, 0)]
+    # GraphFileUtil.java:50-56: source GRAY/0/path [0]; others WHITE/MAX
+    # with the shared [0] path quirk (GraphFileUtil.java:55).
+    assert lines[0] == "0|[1, 2, 5]|[0]|0|GRAY"
+    assert lines[4] == "4|[2, 3]|[0]|2147483647|WHITE"
+
+
+def test_colors_from_state():
+    dist = np.array([0, 1, INF_DIST])
+    frontier = np.array([False, True, False])
+    assert colors_from_state(dist, frontier).tolist() == [
+        int(Color.BLACK),
+        int(Color.GRAY),
+        int(Color.WHITE),
+    ]
+
+
+def test_path_to_walks_parents():
+    parent = np.array([0, 0, 1, 2])
+    assert path_to(parent, 3) == [0, 1, 2, 3]
+    assert path_to(np.array([0, -1]), 1) == []
+
+
+def test_state_roundtrip(tiny_graph):
+    from bfs_tpu.models.bfs import bfs
+
+    res = bfs(tiny_graph, 0)
+    frontier = np.zeros(6, dtype=bool)
+    text = serialize_state(tiny_graph, res.dist, res.parent, frontier, source=0)
+    dist, parent, fr = parse_state(text, 6)
+    np.testing.assert_array_equal(dist, res.dist)
+    np.testing.assert_array_equal(parent, res.parent)
+    assert not fr.any()

@@ -1,0 +1,75 @@
+#!/usr/bin/env python
+"""Generate the repo's test-sets/ datasets (Sedgewick text format).
+
+  * tinyCG.txt   — the 6-vertex/8-edge worked example from the reference
+    paper (docs/BigData_Project.pdf §1.2 Table 1; also Sedgewick &
+    Wayne, Algorithms 4th ed.).  Written from the embedded edge list.
+  * randomG.txt  — a generated stand-in for the reference's mediumG.txt
+    (same V=250 / E=1273 shape, seeded G(n,m)).
+  * largeG.txt   — optional (--large): V=1e6 / E≈7.6e6 G(n,m), the shape of
+    the reference's gitignored largeG (paper §1.5).
+
+Usage: python tools/gen_datasets.py [--large] [--out test-sets]
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+TINY_EDGES = [(0, 5), (2, 4), (2, 3), (1, 2), (0, 1), (3, 4), (3, 5), (0, 2)]
+
+
+def write_edges(path: str, num_vertices: int, edges) -> None:
+    with open(path, "w") as f:
+        f.write(f"{num_vertices}\n{len(edges)}\n")
+        for u, v in edges:
+            f.write(f"{u} {v}\n")
+
+
+def gnm_unique_edges(num_vertices: int, num_edges: int, seed: int) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    seen = set()
+    out = []
+    while len(out) < num_edges:
+        u, v = rng.integers(0, num_vertices, size=2)
+        if u == v:
+            continue
+        key = (min(u, v), max(u, v))
+        if key in seen:
+            continue
+        seen.add(key)
+        out.append((int(u), int(v)))
+    return np.asarray(out)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "test-sets"))
+    ap.add_argument("--large", action="store_true")
+    args = ap.parse_args()
+    os.makedirs(args.out, exist_ok=True)
+
+    write_edges(os.path.join(args.out, "tinyCG.txt"), 6, TINY_EDGES)
+    write_edges(
+        os.path.join(args.out, "randomG.txt"), 250, gnm_unique_edges(250, 1273, seed=7)
+    )
+    if args.large:
+        from bfs_tpu.graph.generators import gnm_graph  # fast non-unique variant
+
+        g = gnm_graph(1_000_000, 7_586_063, seed=7)
+        mask = g.src < g.dst
+        write_edges(
+            os.path.join(args.out, "largeG.txt"),
+            1_000_000,
+            np.stack([g.src[mask], g.dst[mask]], axis=1).tolist(),
+        )
+    print(f"datasets written to {args.out}")
+
+
+if __name__ == "__main__":
+    main()
