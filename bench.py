@@ -25,11 +25,65 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from bfs_tpu.graph.csr import build_device_graph
+from bfs_tpu.graph.csr import Graph, build_device_graph, DeviceGraph
 from bfs_tpu.graph.generators import rmat_graph
 from bfs_tpu.models.bfs import _bfs_fused
 
 BASELINE_TEPS = 15_172_126 / 1.170  # ≈ 13.0 M TEPS (BASELINE.md derived floor)
+
+
+def load_or_build(scale: int, edge_factor: int, seed: int, block: int):
+    """Device-ready R-MAT arrays, cached on disk: host-side generation +
+    dst-sorting of ~10^8 edges takes minutes in NumPy, so the prepared
+    DeviceGraph (and the chosen source) is built once per config.  Uses the
+    native generator/sorter (native/graph_gen.cpp) when available."""
+    try:
+        from bfs_tpu.graph.native_gen import native_available, rmat_edges_native
+
+        use_native = native_available()
+    except Exception:
+        use_native = False
+    backend = "native" if use_native else "numpy"
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench_cache")
+    key = f"rmat_{backend}_s{scale}_ef{edge_factor}_seed{seed}_block{block}"
+    path = os.path.join(cache_dir, key + ".npz")
+    if os.path.exists(path):
+        try:
+            with np.load(path) as z:
+                return (
+                    DeviceGraph(
+                        num_vertices=int(z["num_vertices"]),
+                        num_edges=int(z["num_edges"]),
+                        src=z["src"],
+                        dst=z["dst"],
+                    ),
+                    int(z["source"]),
+                )
+        except Exception:
+            os.remove(path)  # corrupt cache entry: rebuild below
+    if use_native:
+        u, v = rmat_edges_native(scale, edge_factor, seed=seed)
+        graph = Graph(
+            1 << scale, np.concatenate([u, v]), np.concatenate([v, u])
+        )  # bi-directed (GraphFileUtil.java:64-65 parity)
+    else:
+        graph = rmat_graph(scale, edge_factor, seed=seed)
+    dg = build_device_graph(graph, block=block)
+    # Deterministic source inside the giant component: the max-degree vertex.
+    degrees = np.bincount(graph.src, minlength=graph.num_vertices)
+    source = int(np.argmax(degrees))
+    os.makedirs(cache_dir, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}.npz"  # unique per process: no interleaving
+    np.savez(
+        tmp,
+        num_vertices=dg.num_vertices,
+        num_edges=dg.num_edges,
+        src=dg.src,
+        dst=dg.dst,
+        source=source,
+    )
+    os.replace(tmp, path)
+    return dg, source
 
 
 def main():
@@ -37,11 +91,7 @@ def main():
     edge_factor = int(os.environ.get("BENCH_EDGE_FACTOR", "16"))
     repeats = int(os.environ.get("BENCH_REPEATS", "5"))
 
-    graph = rmat_graph(scale, edge_factor, seed=42)
-    dg = build_device_graph(graph, block=8 * 1024)
-    # Deterministic source inside the giant component: the max-degree vertex.
-    degrees = np.bincount(graph.src, minlength=graph.num_vertices)
-    source = int(np.argmax(degrees))
+    dg, source = load_or_build(scale, edge_factor, seed=42, block=8 * 1024)
 
     src = jnp.asarray(dg.src)
     dst = jnp.asarray(dg.dst)
@@ -58,7 +108,7 @@ def main():
         jax.block_until_ready(_bfs_fused(*args))
         times.append(time.perf_counter() - t0)
     t = float(np.median(times))
-    teps = graph.num_edges / t
+    teps = dg.num_edges / t
 
     print(
         json.dumps(
@@ -69,8 +119,8 @@ def main():
                 "vs_baseline": teps / BASELINE_TEPS,
                 "details": {
                     "device": str(jax.devices()[0]),
-                    "num_vertices": graph.num_vertices,
-                    "num_directed_edges": graph.num_edges,
+                    "num_vertices": dg.num_vertices,
+                    "num_directed_edges": dg.num_edges,
                     "source": source,
                     "supersteps": levels,
                     "vertices_reached": reached,

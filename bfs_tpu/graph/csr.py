@@ -79,8 +79,8 @@ class Graph:
         """Return ``(indptr int64[V+1], indices int32[E])`` with each vertex's
         neighbours sorted ascending (deterministic, unlike algs4's Bag order)."""
         if not hasattr(self, "_csr_cache"):
-            order = np.lexsort((self.dst, self.src))
-            indices = self.dst[order]
+            # (src, dst) order == _sorted_by_dst with the roles swapped.
+            indices, _ = _sorted_by_dst(self.dst, self.src)
             counts = np.bincount(self.src, minlength=self.num_vertices)
             indptr = np.zeros(self.num_vertices + 1, dtype=np.int64)
             np.cumsum(counts, out=indptr[1:])
@@ -131,6 +131,21 @@ def pad_to_multiple(n: int, multiple: int) -> int:
     return ((n + multiple - 1) // multiple) * multiple
 
 
+def _sorted_by_dst(src: np.ndarray, dst: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Edges sorted by (dst, src).  Uses the native radix sort
+    (native/graph_gen.cpp) when available — ~20x faster than np.lexsort on
+    10^8 edges — with identical output (both are stable (dst, src) orders)."""
+    try:
+        from .native_gen import native_available, sort_edges_by_dst_native
+
+        if native_available() and src.size > 100_000:
+            return sort_edges_by_dst_native(src.copy(), dst.copy())
+    except Exception:
+        pass
+    order = np.lexsort((src, dst))
+    return src[order], dst[order]
+
+
 def build_device_graph(
     graph: Graph, *, num_shards: int = 1, block: int = 1024
 ) -> DeviceGraph:
@@ -143,9 +158,7 @@ def build_device_graph(
     """
     if num_shards < 1:
         raise ValueError("num_shards must be >= 1")
-    order = np.lexsort((graph.src, graph.dst))
-    src = graph.src[order]
-    dst = graph.dst[order]
+    src, dst = _sorted_by_dst(graph.src, graph.dst)
     sentinel = np.int32(graph.num_vertices)
     e = graph.num_edges
     per_shard = pad_to_multiple(max(pad_to_multiple(e, num_shards) // num_shards, 1), block)

@@ -22,7 +22,22 @@ from .csr import Graph
 
 def read_sedgewick(path: str | os.PathLike, *, directed: bool = False) -> Graph:
     """Read a Sedgewick-format graph file: line 1 = V, line 2 = E, then E
-    lines ``v w``.  Undirected by default; every edge inserted both ways."""
+    lines ``v w``.  Undirected by default; every edge inserted both ways.
+
+    Uses the native parser (native/graph_gen.cpp) for large files when
+    available; identical results via the Python path otherwise."""
+    path = os.fspath(path)
+    try:
+        from .native_gen import native_available, read_sedgewick_native
+
+        if native_available() and os.path.getsize(path) > 1 << 20:
+            v, src, dst = read_sedgewick_native(path)
+            pairs = np.stack([src, dst], axis=1)
+            if directed:
+                return Graph.from_directed_edges(v, pairs)
+            return Graph.from_undirected_edges(v, pairs)
+    except (ImportError, RuntimeError):
+        pass
     with open(path, "r") as f:
         return parse_sedgewick(f.read(), directed=directed)
 

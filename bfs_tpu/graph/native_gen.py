@@ -1,0 +1,109 @@
+"""ctypes bindings for the native data-loader (native/graph_gen.cpp).
+
+Accelerated host-side graph plumbing: R-MAT generation, destination-major
+edge sorting (what :func:`bfs_tpu.graph.csr.build_device_graph` needs), and
+Sedgewick text parsing (GraphFileUtil.java:45-69 / Graph.java:85-94 parity).
+Each entry point has a NumPy fallback in :mod:`bfs_tpu.graph.generators` /
+:mod:`bfs_tpu.graph.io`; callers guard with :func:`native_available`.
+
+NOTE: the native R-MAT uses its own counter-based PRNG, so for a given seed
+it produces a *different* (statistically equivalent) graph than the NumPy
+generator.  Within one backend, results are deterministic by seed.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from ..utils.native_loader import NativeLib
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_I32 = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+_I64 = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+
+
+def _register(lib: ctypes.CDLL) -> None:
+    lib.rmat_edges.restype = None
+    lib.rmat_edges.argtypes = [
+        ctypes.c_int32, ctypes.c_int64,
+        ctypes.c_double, ctypes.c_double, ctypes.c_double,
+        ctypes.c_uint64, ctypes.c_int32, _I32, _I32,
+    ]
+    lib.sort_edges_by_dst.restype = None
+    lib.sort_edges_by_dst.argtypes = [ctypes.c_int64, _I32, _I32]
+    lib.sedgewick_header.restype = ctypes.c_int64
+    lib.sedgewick_header.argtypes = [ctypes.c_char_p, _I64, _I64]
+    lib.sedgewick_edges.restype = ctypes.c_int64
+    lib.sedgewick_edges.argtypes = [
+        ctypes.c_char_p, ctypes.c_int64, ctypes.c_int64, _I32, _I32,
+    ]
+
+
+_LIB = NativeLib(
+    src=os.path.join(_REPO_ROOT, "native", "graph_gen.cpp"),
+    so=os.path.join(_REPO_ROOT, "native", "build", "libgraph_gen.so"),
+    register=_register,
+)
+
+
+def native_available() -> bool:
+    return _LIB.available()
+
+
+def rmat_edges_native(
+    scale: int,
+    edge_factor: int = 16,
+    *,
+    a: float = 0.57,
+    b: float = 0.19,
+    c: float = 0.19,
+    seed: int = 1,
+    permute_labels: bool = True,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Native R-MAT: returns ``(src, dst)`` int32 arrays of the undirected
+    endpoint pairs (same contract as generators.rmat_edges, columnar)."""
+    lib = _LIB.load()
+    if lib is None:
+        raise RuntimeError("native graph_gen unavailable")
+    m = edge_factor << scale
+    src = np.empty(m, dtype=np.int32)
+    dst = np.empty(m, dtype=np.int32)
+    lib.rmat_edges(scale, m, a, b, c, seed, int(permute_labels), src, dst)
+    return src, dst
+
+
+def sort_edges_by_dst_native(
+    src: np.ndarray, dst: np.ndarray
+) -> tuple[np.ndarray, np.ndarray]:
+    """Stable sort of the (src, dst) pair arrays by (dst, src); returns the
+    sorted arrays (in-place when the inputs are already contiguous int32)."""
+    lib = _LIB.load()
+    if lib is None:
+        raise RuntimeError("native graph_gen unavailable")
+    src = np.ascontiguousarray(src, dtype=np.int32)
+    dst = np.ascontiguousarray(dst, dtype=np.int32)
+    lib.sort_edges_by_dst(src.shape[0], src, dst)
+    return src, dst
+
+
+def read_sedgewick_native(path: str) -> tuple[int, np.ndarray, np.ndarray]:
+    """Parse a Sedgewick graph file natively.  Returns ``(V, src, dst)`` with
+    the E *undirected* pairs (caller bi-directs, GraphFileUtil.java:64-65)."""
+    lib = _LIB.load()
+    if lib is None:
+        raise RuntimeError("native graph_gen unavailable")
+    v = np.zeros(1, dtype=np.int64)
+    e = np.zeros(1, dtype=np.int64)
+    if lib.sedgewick_header(path.encode(), v, e) != 0:
+        raise ValueError(f"malformed Sedgewick header in {path!r}")
+    num_v, num_e = int(v[0]), int(e[0])
+    src = np.empty(num_e, dtype=np.int32)
+    dst = np.empty(num_e, dtype=np.int32)
+    got = lib.sedgewick_edges(path.encode(), num_v, num_e, src, dst)
+    if got != num_e:
+        raise ValueError(f"malformed Sedgewick edge list in {path!r}")
+    return num_v, src, dst

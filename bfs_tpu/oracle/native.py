@@ -10,78 +10,44 @@ from __future__ import annotations
 
 import ctypes
 import os
-import subprocess
-import threading
 from collections.abc import Sequence
 
 import numpy as np
 
 from ..graph.csr import Graph
+from ..utils.native_loader import NativeLib
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-_SRC = os.path.join(_REPO_ROOT, "native", "oracle_bfs.cpp")
-_SO = os.path.join(_REPO_ROOT, "native", "build", "liboracle_bfs.so")
 
-_lock = threading.Lock()
-_lib: ctypes.CDLL | None = None
-_load_failed = False
+_I32 = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
+_I64 = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
 
 
-def _build() -> bool:
-    os.makedirs(os.path.dirname(_SO), exist_ok=True)
-    cmd = [
-        os.environ.get("CXX", "g++"),
-        "-O3", "-march=native", "-std=c++17", "-fPIC", "-shared",
-        "-o", _SO, _SRC,
+def _register(lib: ctypes.CDLL) -> None:
+    lib.bfs_csr.restype = ctypes.c_int32
+    lib.bfs_csr.argtypes = [
+        ctypes.c_int64, _I64, _I32, ctypes.c_int32, _I32, ctypes.c_int32,
+        _I32, _I32,
     ]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        return True
-    except (subprocess.SubprocessError, FileNotFoundError):
-        return False
+    lib.bfs_check.restype = ctypes.c_int32
+    lib.bfs_check.argtypes = [
+        ctypes.c_int64, _I64, _I32, ctypes.c_int32, _I32, _I32, _I32,
+    ]
+
+
+_LIB = NativeLib(
+    src=os.path.join(_REPO_ROOT, "native", "oracle_bfs.cpp"),
+    so=os.path.join(_REPO_ROOT, "native", "build", "liboracle_bfs.so"),
+    register=_register,
+)
 
 
 def _load() -> ctypes.CDLL | None:
-    global _lib, _load_failed
-    with _lock:
-        if _lib is not None or _load_failed:
-            return _lib
-        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
-            if not _build():
-                _load_failed = True
-                return None
-        try:
-            lib = ctypes.CDLL(_SO)
-        except OSError:
-            _load_failed = True
-            return None
-        lib.bfs_csr.restype = ctypes.c_int32
-        lib.bfs_csr.argtypes = [
-            ctypes.c_int64,
-            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
-            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
-            ctypes.c_int32,
-            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
-            ctypes.c_int32,
-            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
-            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
-        ]
-        lib.bfs_check.restype = ctypes.c_int32
-        lib.bfs_check.argtypes = [
-            ctypes.c_int64,
-            np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
-            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
-            ctypes.c_int32,
-            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
-            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
-            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
-        ]
-        _lib = lib
-        return _lib
+    return _LIB.load()
 
 
 def native_available() -> bool:
-    return _load() is not None
+    return _LIB.available()
 
 
 def native_bfs(

@@ -1,0 +1,78 @@
+"""Shared loader for the repo's native C++ libraries (native/*.cpp).
+
+One place for the build-on-demand + ctypes-load + failure-latch logic used by
+the oracle (:mod:`bfs_tpu.oracle.native`) and the data loader
+(:mod:`bfs_tpu.graph.native_gen`).  pybind11 is not in the image, so the
+native layer is plain C ABI + ctypes.
+
+Loading never raises: any compile/IO failure latches the library as
+unavailable and callers fall back to their NumPy/Python paths.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from collections.abc import Callable
+
+
+class NativeLib:
+    """Lazily built, lazily loaded shared library.
+
+    ``register`` is called once with the loaded CDLL to set
+    restype/argtypes; if it raises, the library is latched unavailable.
+    """
+
+    def __init__(self, src: str, so: str, register: Callable[[ctypes.CDLL], None]):
+        self._src = src
+        self._so = so
+        self._register = register
+        self._lock = threading.Lock()
+        self._lib: ctypes.CDLL | None = None
+        self._failed = False
+
+    def _needs_build(self) -> bool:
+        if not os.path.exists(self._so):
+            return True
+        try:
+            return os.path.getmtime(self._so) < os.path.getmtime(self._src)
+        except OSError:
+            # Source missing (installed package without native/): use the
+            # prebuilt .so as-is.
+            return False
+
+    def _build(self) -> bool:
+        if not os.path.exists(self._src):
+            return False
+        os.makedirs(os.path.dirname(self._so), exist_ok=True)
+        cmd = [
+            os.environ.get("CXX", "g++"),
+            "-O3", "-march=native", "-std=c++17", "-fPIC", "-shared",
+            "-o", self._so, self._src,
+        ]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            return True
+        except (subprocess.SubprocessError, FileNotFoundError, OSError):
+            return False
+
+    def load(self) -> ctypes.CDLL | None:
+        with self._lock:
+            if self._lib is not None or self._failed:
+                return self._lib
+            if self._needs_build() and not self._build():
+                self._failed = True
+                return None
+            try:
+                lib = ctypes.CDLL(self._so)
+                self._register(lib)
+            except Exception:
+                self._failed = True
+                return None
+            self._lib = lib
+            return self._lib
+
+    def available(self) -> bool:
+        return self.load() is not None
