@@ -26,8 +26,9 @@ import jax.numpy as jnp
 import numpy as np
 
 from bfs_tpu.graph.csr import Graph, build_device_graph, DeviceGraph
+from bfs_tpu.graph.ell import build_pull_graph
 from bfs_tpu.graph.generators import rmat_graph
-from bfs_tpu.models.bfs import _bfs_fused
+from bfs_tpu.models.bfs import _bfs_fused, _bfs_pull_fused
 
 BASELINE_TEPS = 15_172_126 / 1.170  # ≈ 13.0 M TEPS (BASELINE.md derived floor)
 
@@ -86,26 +87,71 @@ def load_or_build(scale: int, edge_factor: int, seed: int, block: int):
     return dg, source
 
 
+def load_or_build_pull(dg, scale: int, edge_factor: int):
+    """ELL pull layout, cached next to the DeviceGraph cache (the _group_rows
+    packing re-walks all E edges in NumPy — minutes at scale 22)."""
+    from bfs_tpu.graph.ell import DEFAULT_K, PullGraph
+
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench_cache")
+    path = os.path.join(cache_dir, f"pull_s{scale}_ef{edge_factor}_k{DEFAULT_K}.npz")
+    if os.path.exists(path):
+        try:
+            with np.load(path) as z:
+                nf = int(z["num_folds"])
+                return PullGraph(
+                    num_vertices=int(z["num_vertices"]),
+                    num_edges=int(z["num_edges"]),
+                    ell0=z["ell0"],
+                    folds=tuple(z[f"fold{i}"] for i in range(nf)),
+                )
+        except Exception:
+            os.remove(path)
+    pg = build_pull_graph(dg)
+    os.makedirs(cache_dir, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}.npz"
+    np.savez(
+        tmp,
+        num_vertices=pg.num_vertices,
+        num_edges=pg.num_edges,
+        ell0=pg.ell0,
+        num_folds=len(pg.folds),
+        **{f"fold{i}": f for i, f in enumerate(pg.folds)},
+    )
+    os.replace(tmp, path)
+    return pg
+
+
 def main():
     scale = int(os.environ.get("BENCH_SCALE", "22"))
     edge_factor = int(os.environ.get("BENCH_EDGE_FACTOR", "16"))
     repeats = int(os.environ.get("BENCH_REPEATS", "5"))
+    engine = os.environ.get("BENCH_ENGINE", "pull")
 
     dg, source = load_or_build(scale, edge_factor, seed=42, block=8 * 1024)
 
-    src = jnp.asarray(dg.src)
-    dst = jnp.asarray(dg.dst)
-    args = (src, dst, jnp.int32(source), dg.num_vertices, dg.num_vertices)
+    if engine == "pull":
+        pg = load_or_build_pull(dg, scale, edge_factor)
+        ell0 = jnp.asarray(pg.ell0)
+        folds = tuple(jnp.asarray(f) for f in pg.folds)
+        run = lambda: _bfs_pull_fused(  # noqa: E731
+            ell0, folds, jnp.int32(source), pg.num_vertices, pg.num_vertices
+        )
+    else:
+        src = jnp.asarray(dg.src)
+        dst = jnp.asarray(dg.dst)
+        run = lambda: _bfs_fused(  # noqa: E731
+            src, dst, jnp.int32(source), dg.num_vertices, dg.num_vertices
+        )
 
-    state = _bfs_fused(*args)  # warm-up: compile + first run
-    jax.block_until_ready(state)
-    levels = int(state.level)
+    state = run()  # warm-up: compile + first run
+    levels = int(state.level)  # forces a real sync (block_until_ready can
+    # return early through remote-device tunnels; value reads cannot)
     reached = int((np.asarray(state.dist[: dg.num_vertices]) != np.iinfo(np.int32).max).sum())
 
     times = []
     for _ in range(repeats):
         t0 = time.perf_counter()
-        jax.block_until_ready(_bfs_fused(*args))
+        _ = int(run().level)
         times.append(time.perf_counter() - t0)
     t = float(np.median(times))
     teps = dg.num_edges / t
@@ -119,6 +165,7 @@ def main():
                 "vs_baseline": teps / BASELINE_TEPS,
                 "details": {
                     "device": str(jax.devices()[0]),
+                    "engine": engine,
                     "num_vertices": dg.num_vertices,
                     "num_directed_edges": dg.num_edges,
                     "source": source,

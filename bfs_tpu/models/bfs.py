@@ -25,6 +25,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..graph.csr import DeviceGraph, Graph, build_device_graph
+from ..graph.ell import PullGraph, build_pull_graph
+from ..ops.pull import relax_pull_superstep
 from ..ops.relax import BfsState, init_state, relax_superstep, frontier_size
 
 
@@ -85,30 +87,74 @@ class BfsResult:
         return path_to(self.parent, v)
 
 
+@functools.partial(jax.jit, static_argnames=("num_vertices", "max_levels"))
+def _bfs_pull_fused(
+    ell0: jax.Array,
+    folds: tuple,
+    source: jax.Array,
+    num_vertices: int,
+    max_levels: int,
+) -> BfsState:
+    state = init_state(num_vertices, source)
+
+    def cond(s: BfsState):
+        return s.changed & (s.level < max_levels)
+
+    def body(s: BfsState):
+        return relax_pull_superstep(s, ell0, folds)
+
+    return jax.lax.while_loop(cond, body, state)
+
+
 def bfs(
-    graph: Graph | DeviceGraph,
+    graph: Graph | DeviceGraph | PullGraph,
     source: int = 0,
     *,
+    engine: str = "pull",
     max_levels: int | None = None,
     block: int = 1024,
 ) -> BfsResult:
-    """Run single-source BFS fully on-device and return host results."""
-    dg = graph if isinstance(graph, DeviceGraph) else build_device_graph(graph, block=block)
-    if dg.num_shards != 1:
-        raise ValueError("sharded DeviceGraph requires the parallel engine")
-    check_sources(dg.num_vertices, source)
-    max_levels = int(max_levels) if max_levels is not None else dg.num_vertices
-    state = _bfs_fused(
-        jnp.asarray(dg.src),
-        jnp.asarray(dg.dst),
-        jnp.int32(source),
-        dg.num_vertices,
-        max_levels,
-    )
+    """Run single-source BFS fully on-device and return host results.
+
+    ``engine='pull'`` (default) uses the scatter-free ELL gather/row-min
+    formulation (fast on TPU); ``engine='push'`` uses the segment_min
+    push formulation (closest analogue of the reference's map/reduce).
+    Passing a prebuilt :class:`PullGraph`/:class:`DeviceGraph` skips layout.
+    """
+    if engine not in ("pull", "push"):
+        raise ValueError(f"unknown engine {engine!r}; use 'pull' or 'push'")
+    if isinstance(graph, PullGraph) and engine != "pull":
+        raise ValueError("a prebuilt PullGraph only runs on engine='pull'")
+    if engine == "pull":
+        pg = graph if isinstance(graph, PullGraph) else build_pull_graph(graph)
+        check_sources(pg.num_vertices, source)
+        max_levels = int(max_levels) if max_levels is not None else pg.num_vertices
+        state = _bfs_pull_fused(
+            jnp.asarray(pg.ell0),
+            tuple(jnp.asarray(f) for f in pg.folds),
+            jnp.int32(source),
+            pg.num_vertices,
+            max_levels,
+        )
+        num_vertices = pg.num_vertices
+    else:
+        dg = graph if isinstance(graph, DeviceGraph) else build_device_graph(graph, block=block)
+        if dg.num_shards != 1:
+            raise ValueError("sharded DeviceGraph requires the parallel engine")
+        check_sources(dg.num_vertices, source)
+        max_levels = int(max_levels) if max_levels is not None else dg.num_vertices
+        state = _bfs_fused(
+            jnp.asarray(dg.src),
+            jnp.asarray(dg.dst),
+            jnp.int32(source),
+            dg.num_vertices,
+            max_levels,
+        )
+        num_vertices = dg.num_vertices
     state = jax.device_get(state)
     return BfsResult(
-        dist=np.asarray(state.dist[: dg.num_vertices]),
-        parent=np.asarray(state.parent[: dg.num_vertices]),
+        dist=np.asarray(state.dist[:num_vertices]),
+        parent=np.asarray(state.parent[:num_vertices]),
         num_levels=int(state.level),
     )
 

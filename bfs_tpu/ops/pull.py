@@ -1,0 +1,73 @@
+"""Pull-mode frontier relaxation: gather + row-min over ELL levels.
+
+The scatter-free superstep (see :mod:`bfs_tpu.graph.ell` for the layout and
+the measured rationale).  Semantics are identical to
+:func:`bfs_tpu.ops.relax.relax_superstep` — per destination vertex, the
+candidate parent is the minimum-id active in-neighbour, the deterministic
+tie-break shared with the oracle's ``canonical_bfs`` — but the reduction is
+dense: one 2-D gather from the frontier table and a row-min per ELL level,
+instead of ``segment_min`` (which XLA lowers to a serial scatter loop on
+TPU, ~0.1 Gedges/s vs near-bandwidth for gather+rowmin).
+
+The frontier table ``F[u] = u if frontier[u] else INF`` folds the activity
+test and the parent id into a single gathered value, so each edge costs one
+int32 gather lane-op and one min.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .relax import INT32_MAX, BfsState
+
+
+def frontier_table(state: BfsState) -> jax.Array:
+    """``F[u] = u`` if u is on the frontier else INF — int32[V+1]."""
+    n = state.dist.shape[-1]
+    ids = jnp.arange(n, dtype=jnp.int32)
+    return jnp.where(state.frontier, ids, INT32_MAX)
+
+
+def pull_candidates(frontier_tab: jax.Array, ell0: jax.Array, folds) -> jax.Array:
+    """Min active in-neighbour id per vertex: int32[V+1] (slot V = INF).
+
+    ``frontier_tab`` may be [V+1] or batched [..., V+1]; ELL gathers
+    broadcast over leading axes.
+    """
+    num_vertices = frontier_tab.shape[-1] - 1
+    cand = jnp.min(jnp.take(frontier_tab, ell0, axis=-1), axis=-1)
+    for fold in folds:
+        inf = jnp.full(cand.shape[:-1] + (1,), INT32_MAX, dtype=jnp.int32)
+        cand_ext = jnp.concatenate([cand, inf], axis=-1)
+        cand = jnp.min(jnp.take(cand_ext, fold, axis=-1), axis=-1)
+    inf = jnp.full(cand.shape[:-1] + (1,), INT32_MAX, dtype=jnp.int32)
+    return jnp.concatenate([cand[..., :num_vertices], inf], axis=-1)
+
+
+def relax_pull_superstep(
+    state: BfsState,
+    ell0: jax.Array,
+    folds,
+    *,
+    axis_name: str | None = None,
+    batch_axis_name: str | None = None,
+) -> BfsState:
+    """One level-synchronous superstep in pull mode.
+
+    With ``axis_name``, ``ell0``/``folds`` describe this device's edge shard
+    and candidates are merged across the mesh with ``lax.pmin`` (the ICI
+    all-reduce replacing the Spark shuffle, SURVEY.md §2.5), after which all
+    devices apply identical updates to the replicated state.
+    """
+    cand_parent = pull_candidates(frontier_table(state), ell0, folds)
+    if axis_name is not None:
+        cand_parent = jax.lax.pmin(cand_parent, axis_name)
+    improved = (cand_parent != INT32_MAX) & (state.dist == INT32_MAX)
+    new_level = state.level + 1
+    dist = jnp.where(improved, new_level, state.dist)
+    parent = jnp.where(improved, cand_parent, state.parent)
+    changed = improved.any()
+    if batch_axis_name is not None:
+        changed = jax.lax.pmax(changed.astype(jnp.int32), batch_axis_name) > 0
+    return BfsState(dist, parent, improved, new_level, changed)

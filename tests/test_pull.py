@@ -1,0 +1,86 @@
+"""Pull-mode (ELL gather/row-min) engine vs the oracle and the push engine."""
+
+import numpy as np
+import pytest
+
+from bfs_tpu.graph.csr import Graph, INF_DIST, build_device_graph
+from bfs_tpu.graph.ell import build_pull_graph
+from bfs_tpu.graph.generators import gnm_graph, path_graph, rmat_graph
+from bfs_tpu.models.bfs import bfs
+from bfs_tpu.oracle.bfs import canonical_bfs, check, queue_bfs
+
+
+def _assert_matches_oracle(graph, source=0, **kwargs):
+    result = bfs(graph, source, engine="pull", **kwargs)
+    dist, parent = canonical_bfs(graph, source)
+    np.testing.assert_array_equal(result.dist, dist)
+    np.testing.assert_array_equal(result.parent, parent)
+    assert check(graph, result.dist, result.parent, source) == []
+
+
+def test_tiny_pull(tiny_graph):
+    result = bfs(tiny_graph, 0, engine="pull")
+    assert result.dist.tolist() == [0, 1, 1, 2, 2, 1]
+    assert result.parent.tolist() == [0, 0, 0, 2, 2, 0]
+    assert result.num_levels == 3
+
+
+def test_pull_matches_push_and_oracle(tiny_graph):
+    for seed in range(3):
+        g = gnm_graph(200, 600, seed=seed)
+        pull = bfs(g, 0, engine="pull")
+        push = bfs(g, 0, engine="push")
+        np.testing.assert_array_equal(pull.dist, push.dist)
+        np.testing.assert_array_equal(pull.parent, push.parent)
+        _assert_matches_oracle(g, 0)
+
+
+def test_pull_rmat_with_hubs():
+    # R-MAT is skewed: exercises multi-level folds.
+    g = rmat_graph(9, 16, seed=5)
+    pg = build_pull_graph(g, k=4)  # tiny k forces deep fold recursion
+    assert len(pg.folds) >= 2
+    result = bfs(pg, 0)
+    dist, parent = canonical_bfs(g, 0)
+    np.testing.assert_array_equal(result.dist, dist)
+    np.testing.assert_array_equal(result.parent, parent)
+
+
+def test_pull_path_graph_high_diameter():
+    g = path_graph(50)
+    _assert_matches_oracle(g, 0)
+    r = bfs(g, 49, engine="pull")
+    assert r.dist[0] == 49
+
+
+def test_pull_disconnected():
+    g = Graph.from_undirected_edges(5, np.array([[0, 1], [2, 3]]))
+    r = bfs(g, 0, engine="pull")
+    assert r.dist.tolist()[:2] == [0, 1]
+    assert r.dist[2] == INF_DIST and r.dist[4] == INF_DIST
+    assert r.parent[2] == -1
+
+
+def test_pull_from_device_graph(tiny_graph):
+    dg = build_device_graph(tiny_graph, block=16)
+    result = bfs(dg, 0, engine="pull")
+    assert result.dist.tolist() == [0, 1, 1, 2, 2, 1]
+
+
+def test_pull_zero_edges():
+    g = Graph.from_directed_edges(4, np.zeros((0, 2), dtype=np.int32))
+    r = bfs(g, 2, engine="pull")
+    assert r.dist[2] == 0
+    assert all(r.dist[i] == INF_DIST for i in (0, 1, 3))
+
+
+def test_pull_self_loops_and_multi_edges():
+    g = Graph.from_undirected_edges(4, np.array([[0, 0], [0, 1], [0, 1], [1, 2]]))
+    _assert_matches_oracle(g, 0)
+
+
+def test_pull_queue_bfs_distances_agree():
+    g = gnm_graph(300, 900, seed=9)
+    r = bfs(g, 7, engine="pull")
+    dist, _ = queue_bfs(g, 7)
+    np.testing.assert_array_equal(r.dist, dist)

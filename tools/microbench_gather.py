@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Decisive XLA gather speed test on the current backend (slope method)."""
+
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+LO, HI = 4, 16
+E = 1 << 25  # 33.5M
+V = 1 << 20
+
+
+def slope(label, fn, *args, items=E):
+    f_lo = jax.jit(partial(fn, iters=LO))
+    f_hi = jax.jit(partial(fn, iters=HI))
+    jax.block_until_ready(f_lo(*args))
+    jax.block_until_ready(f_hi(*args))
+    t_lo = min(_t(f_lo, *args) for _ in range(3))
+    t_hi = min(_t(f_hi, *args) for _ in range(3))
+    per = max((t_hi - t_lo) / (HI - LO), 1e-9)
+    print(f"{label:44s} {per * 1e3:9.3f} ms/iter  {items / per / 1e9:8.2f} G/s"
+          f"   [raw lo={t_lo * 1e3:.2f}ms hi={t_hi * 1e3:.2f}ms]",
+          flush=True)
+
+
+def _t(fn, *args):
+    t0 = time.perf_counter()
+    jax.block_until_ready(fn(*args))
+    return time.perf_counter() - t0
+
+
+def chained(op):
+    # acc folds a full min of each output; input xored with i (loop-variant).
+    def run(x, *args, iters):
+        def body(i, acc):
+            return acc + (op(x ^ i, *args).min() & 3)
+
+        return jax.lax.fori_loop(0, iters, body, jnp.int32(0), unroll=False)
+
+    return run
+
+
+def main():
+    rng = np.random.default_rng(0)
+    idx = jnp.asarray(rng.integers(0, V, size=E, dtype=np.int32))
+    tab = jnp.asarray(rng.integers(0, 1 << 30, size=V, dtype=np.int32))
+
+    slope("reduce-min over E int32 (calibration)", chained(lambda x: x), idx)
+    slope("1D gather tab[idx^i] (random)",
+          chained(lambda x, t: t[x & (V - 1)]), idx, tab)
+    slope("2D-idx gather tab[idx2d] [E/32,32]",
+          chained(lambda x, t: t[(x & (V - 1)).reshape(-1, 32)]), idx, tab)
+    slope("1D gather + reshape rowmin",
+          chained(lambda x, t: jnp.min(t[x & (V - 1)].reshape(-1, 32), axis=1)),
+          idx, tab)
+
+
+if __name__ == "__main__":
+    main()

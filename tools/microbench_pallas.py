@@ -1,0 +1,141 @@
+#!/usr/bin/env python
+"""Measure Pallas TPU primitives for the BFS kernel design.
+
+Times tpu.dynamic_gather (per-lane table lookup) and calibrating elementwise
+kernels, using the slope method (N vs 4N chained iterations inside jit).
+"""
+
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LO, HI = 8, 64
+
+
+def slope_time(label, fn, *args, items=None):
+    f_lo = jax.jit(partial(fn, iters=LO))
+    f_hi = jax.jit(partial(fn, iters=HI))
+    jax.block_until_ready(f_lo(*args))
+    jax.block_until_ready(f_hi(*args))
+    t_lo = min(_t(f_lo, *args) for _ in range(3))
+    t_hi = min(_t(f_hi, *args) for _ in range(3))
+    per = max((t_hi - t_lo) / (HI - LO), 1e-9)
+    rate = f"  {items / per / 1e9:8.2f} Gitems/s" if items else ""
+    print(f"{label:46s} {per * 1e3:9.3f} ms/iter{rate}", flush=True)
+
+
+def _t(fn, *args):
+    t0 = time.perf_counter()
+    jax.block_until_ready(fn(*args))
+    return time.perf_counter() - t0
+
+
+# ---- kernels ----------------------------------------------------------------
+
+def gather_kernel(table_ref, idx_ref, out_ref):
+    # out[i, j] = table[idx[i, j], j]  — per-lane sublane gather.
+    out_ref[:] = jnp.take_along_axis(
+        table_ref[:], idx_ref[:], axis=0, mode="promise_in_bounds"
+    )
+
+
+def gather_min_kernel(table_ref, idx_ref, out_ref):
+    g = jnp.take_along_axis(table_ref[:], idx_ref[:], axis=0, mode="promise_in_bounds")
+    out_ref[:] = jnp.min(g, axis=1, keepdims=True)
+
+
+def ew_kernel(x_ref, out_ref):
+    out_ref[:] = x_ref[:] * 3 + 1
+
+
+def main():
+    rows_tab = int(os.environ.get("TAB_ROWS", str(8192)))          # table rows
+    rows_idx = rows_tab  # dynamic_gather requires idx.shape == table.shape
+    lanes = 128
+    rng = np.random.default_rng(0)
+    table = jnp.asarray(rng.integers(0, 1 << 30, size=(rows_tab, lanes), dtype=np.int32))
+    idx = jnp.asarray(rng.integers(0, rows_tab, size=(rows_idx, lanes), dtype=np.int32))
+    x = jnp.asarray(rng.integers(0, 100, size=(rows_idx, lanes), dtype=np.int32))
+    print(f"table [{rows_tab},{lanes}] ({rows_tab * lanes * 4 / 1e6:.1f} MB)  "
+          f"idx [{rows_idx},{lanes}] = {rows_idx * lanes / 1e6:.1f} M lookups/call  "
+          f"device={jax.devices()[0]}")
+
+    gather = pl.pallas_call(
+        gather_kernel,
+        out_shape=jax.ShapeDtypeStruct((rows_idx, lanes), jnp.int32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+    )
+
+    def chained_gather(table, idx, *, iters):
+        def body(i, t):
+            out = gather(t, idx)
+            # fold output back: new table row 0 ^= out.min() (forces dependency)
+            return t.at[0, 0].min(out.min() + i * 0)
+
+        # keep a dependency chain through the table argument
+        def body2(i, carry):
+            t, acc = carry
+            out = gather(t, idx)
+            m = out.min()
+            return (t.at[0, 0].set(m % 7), acc + m)
+
+        t, acc = jax.lax.fori_loop(0, iters, body2, (table, jnp.int32(0)))
+        return acc
+
+    slope_time("pallas dynamic_gather (sublane, per-lane)",
+               chained_gather, table, idx, items=rows_idx * lanes)
+
+    gather_min = pl.pallas_call(
+        gather_min_kernel,
+        out_shape=jax.ShapeDtypeStruct((rows_idx, 1), jnp.int32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+    )
+
+    def chained_gather_min(table, idx, *, iters):
+        def body(i, carry):
+            t, acc = carry
+            out = gather_min(t, idx)
+            m = out.min()
+            return (t.at[0, 0].set(m % 7), acc + m)
+
+        _, acc = jax.lax.fori_loop(0, iters, body, (table, jnp.int32(0)))
+        return acc
+
+    slope_time("pallas gather + lane-min fused",
+               chained_gather_min, table, idx, items=rows_idx * lanes)
+
+    ew = pl.pallas_call(
+        ew_kernel,
+        out_shape=jax.ShapeDtypeStruct((rows_idx, lanes), jnp.int32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+    )
+
+    def chained_ew(x, *, iters):
+        def body(i, carry):
+            x, acc = carry
+            out = ew(x)
+            return (x.at[0, 0].set(out.min() % 5), acc + out.min())
+
+        _, acc = jax.lax.fori_loop(0, iters, body, (x, jnp.int32(0)))
+        return acc
+
+    slope_time("pallas elementwise (calibration)",
+               chained_ew, x, items=rows_idx * lanes)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Microbenchmark the pieces of the relax superstep on the current backend.
+
+Methodology: each op runs N times inside one jitted fori_loop, XOR-perturbed
+by the loop counter (loop-variant, not separable through min/gather/sort)
+with a full output reduction folded into the carry (defeats DCE).  Per-op
+time is the SLOPE between N=LO and N=HI total wall times, which cancels
+dispatch latency, tunnel RTT, and any constant overhead.
+
+Run on the real TPU: `python tools/microbench_relax.py [scale]`.
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bfs_tpu.graph.csr import build_device_graph
+from bfs_tpu.graph.generators import rmat_graph
+from bfs_tpu.ops.relax import INT32_MAX
+
+LO, HI = 16, 128
+
+
+def make_loop(op, *extras):
+    def run(x, iters):
+        def body(i, acc):
+            out = op(x ^ i, *extras)
+            return acc + (out.min() & 3)
+
+        return jax.lax.fori_loop(0, iters, body, jnp.int32(0), unroll=False)
+
+    return jax.jit(run, static_argnames=("iters",))
+
+
+def timeit(label, op, x, *extras, edges=None):
+    fn = make_loop(op, *extras)
+    totals = {}
+    for iters in (LO, HI):
+        jax.block_until_ready(fn(x, iters))  # compile
+        best = min(
+            _timed(fn, x, iters) for _ in range(3)
+        )
+        totals[iters] = best
+    t = (totals[HI] - totals[LO]) / (HI - LO)
+    t = max(t, 1e-9)
+    rate = f"  {edges / t / 1e9:8.2f} Gedges/s" if edges else ""
+    print(f"{label:46s} {t * 1e3:9.3f} ms/iter{rate}", flush=True)
+    return t
+
+
+def _timed(fn, x, iters):
+    t0 = time.perf_counter()
+    jax.block_until_ready(fn(x, iters))
+    return time.perf_counter() - t0
+
+
+def main():
+    scale = int(sys.argv[1]) if len(sys.argv) > 1 else 20
+    graph = rmat_graph(scale, 16, seed=42)
+    dg = build_device_graph(graph, block=8 * 1024)
+    v = dg.num_vertices
+    e = dg.padded_edges
+    print(f"V={v} padded_E={e} device={jax.devices()[0]} slope {LO}->{HI}")
+
+    src = jnp.asarray(dg.src)
+    dst = jnp.asarray(dg.dst)
+    rng = np.random.default_rng(0)
+    frontier_i32 = jnp.asarray((rng.random(v + 1) < 0.1).astype(np.int32))
+    vals = jnp.asarray(rng.integers(0, v, size=e, dtype=np.int32))
+
+    n = v + 1
+
+    timeit("reduce-min over E (bandwidth floor)", lambda x: x, vals, edges=e)
+    timeit("gather i32 table[x & mask] (E gathers)",
+           lambda x, t: t[x & (n - 2)], vals, frontier_i32, edges=e)
+    timeit("gather 2D [E/128,128] rows table[x&m]",
+           lambda x, t: t[(x & (n - 2)).reshape(-1, 128)], vals, frontier_i32,
+           edges=e)
+    timeit("segment_min sorted",
+           lambda x, d: jax.ops.segment_min(
+               x, d, num_segments=n, indices_are_sorted=True), vals, dst,
+           edges=e)
+    timeit("scatter-min .at[dst].min",
+           lambda x, d: jnp.full(n, INT32_MAX, jnp.int32).at[d].min(x), vals,
+           dst, edges=e)
+    timeit("full relax superstep (gather+where+segmin)",
+           lambda f, s, d: jax.ops.segment_min(
+               jnp.where(f[s] > 0, s, INT32_MAX), d,
+               num_segments=n, indices_are_sorted=True),
+           frontier_i32, src, dst, edges=e)
+    timeit("ELL rowmin only [E/32, 32] axis=1",
+           lambda x: jnp.min(x.reshape(-1, 32), axis=1), vals, edges=e)
+    timeit("ELL gather+where+rowmin [E/32, 32]",
+           lambda x, t: jnp.min(
+               jnp.where(t[(x & (n - 2)).reshape(-1, 32)] > 0,
+                         x.reshape(-1, 32), INT32_MAX), axis=1),
+           vals, frontier_i32, edges=e)
+    timeit("sort i32[E]", lambda x: jax.lax.sort(x), vals, edges=e)
+
+
+if __name__ == "__main__":
+    main()
