@@ -20,8 +20,16 @@ import sys
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+# Persistent XLA compile cache: the relay engine's ~100-stage programs take
+# minutes to compile through the remote compile service; cache across runs.
+os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench_cache", "xla"),
+)
 
 import jax
+
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
 import jax.numpy as jnp
 import numpy as np
 
@@ -33,92 +41,158 @@ from bfs_tpu.models.bfs import _bfs_fused, _bfs_pull_fused
 BASELINE_TEPS = 15_172_126 / 1.170  # ≈ 13.0 M TEPS (BASELINE.md derived floor)
 
 
-def load_or_build(scale: int, edge_factor: int, seed: int, block: int):
+_CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench_cache")
+
+
+def _cached(key: str, unpack, build):
+    """Load-or-rebuild an npz cache entry.  ``unpack(npz) -> obj``;
+    ``build() -> (obj, dict_of_arrays)``.  Corrupt entries are treated as
+    misses; writes are atomic and per-process to survive concurrent runs."""
+    path = os.path.join(_CACHE_DIR, key + ".npz")
+    if os.path.exists(path):
+        try:
+            with np.load(path) as z:
+                return unpack(z)
+        except Exception:
+            os.remove(path)
+    obj, arrays = build()
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    tmp = f"{path}.tmp.{os.getpid()}.npz"
+    np.savez(tmp, **arrays)
+    os.replace(tmp, path)
+    return obj
+
+
+def _generator_backend() -> str:
+    try:
+        from bfs_tpu.graph.native_gen import native_available
+
+        return "native" if native_available() else "numpy"
+    except Exception:
+        return "numpy"
+
+
+def load_or_build(scale: int, edge_factor: int, seed: int, block: int, backend: str):
     """Device-ready R-MAT arrays, cached on disk: host-side generation +
     dst-sorting of ~10^8 edges takes minutes in NumPy, so the prepared
     DeviceGraph (and the chosen source) is built once per config.  Uses the
     native generator/sorter (native/graph_gen.cpp) when available."""
-    try:
-        from bfs_tpu.graph.native_gen import native_available, rmat_edges_native
 
-        use_native = native_available()
-    except Exception:
-        use_native = False
-    backend = "native" if use_native else "numpy"
-    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench_cache")
-    key = f"rmat_{backend}_s{scale}_ef{edge_factor}_seed{seed}_block{block}"
-    path = os.path.join(cache_dir, key + ".npz")
-    if os.path.exists(path):
-        try:
-            with np.load(path) as z:
-                return (
-                    DeviceGraph(
-                        num_vertices=int(z["num_vertices"]),
-                        num_edges=int(z["num_edges"]),
-                        src=z["src"],
-                        dst=z["dst"],
-                    ),
-                    int(z["source"]),
-                )
-        except Exception:
-            os.remove(path)  # corrupt cache entry: rebuild below
-    if use_native:
-        u, v = rmat_edges_native(scale, edge_factor, seed=seed)
-        graph = Graph(
-            1 << scale, np.concatenate([u, v]), np.concatenate([v, u])
-        )  # bi-directed (GraphFileUtil.java:64-65 parity)
-    else:
-        graph = rmat_graph(scale, edge_factor, seed=seed)
-    dg = build_device_graph(graph, block=block)
-    # Deterministic source inside the giant component: the max-degree vertex.
-    degrees = np.bincount(graph.src, minlength=graph.num_vertices)
-    source = int(np.argmax(degrees))
-    os.makedirs(cache_dir, exist_ok=True)
-    tmp = f"{path}.tmp.{os.getpid()}.npz"  # unique per process: no interleaving
-    np.savez(
-        tmp,
-        num_vertices=dg.num_vertices,
-        num_edges=dg.num_edges,
-        src=dg.src,
-        dst=dg.dst,
-        source=source,
+    def unpack(z):
+        return (
+            DeviceGraph(
+                num_vertices=int(z["num_vertices"]),
+                num_edges=int(z["num_edges"]),
+                src=z["src"],
+                dst=z["dst"],
+            ),
+            int(z["source"]),
+        )
+
+    def build():
+        if backend == "native":
+            from bfs_tpu.graph.native_gen import rmat_edges_native
+
+            u, v = rmat_edges_native(scale, edge_factor, seed=seed)
+            graph = Graph(
+                1 << scale, np.concatenate([u, v]), np.concatenate([v, u])
+            )  # bi-directed (GraphFileUtil.java:64-65 parity)
+        else:
+            graph = rmat_graph(scale, edge_factor, seed=seed)
+        dg = build_device_graph(graph, block=block)
+        # Deterministic source in the giant component: the max-degree vertex.
+        degrees = np.bincount(graph.src, minlength=graph.num_vertices)
+        source = int(np.argmax(degrees))
+        arrays = dict(
+            num_vertices=dg.num_vertices,
+            num_edges=dg.num_edges,
+            src=dg.src,
+            dst=dg.dst,
+            source=source,
+        )
+        return (dg, source), arrays
+
+    return _cached(
+        f"rmat_{backend}_s{scale}_ef{edge_factor}_seed{seed}_block{block}",
+        unpack,
+        build,
     )
-    os.replace(tmp, path)
-    return dg, source
 
 
-def load_or_build_pull(dg, scale: int, edge_factor: int):
+def load_or_build_pull(dg, key: str):
     """ELL pull layout, cached next to the DeviceGraph cache (the _group_rows
     packing re-walks all E edges in NumPy — minutes at scale 22)."""
     from bfs_tpu.graph.ell import DEFAULT_K, PullGraph
 
-    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench_cache")
-    path = os.path.join(cache_dir, f"pull_s{scale}_ef{edge_factor}_k{DEFAULT_K}.npz")
-    if os.path.exists(path):
-        try:
-            with np.load(path) as z:
-                nf = int(z["num_folds"])
-                return PullGraph(
-                    num_vertices=int(z["num_vertices"]),
-                    num_edges=int(z["num_edges"]),
-                    ell0=z["ell0"],
-                    folds=tuple(z[f"fold{i}"] for i in range(nf)),
-                )
-        except Exception:
-            os.remove(path)
-    pg = build_pull_graph(dg)
-    os.makedirs(cache_dir, exist_ok=True)
-    tmp = f"{path}.tmp.{os.getpid()}.npz"
-    np.savez(
-        tmp,
-        num_vertices=pg.num_vertices,
-        num_edges=pg.num_edges,
-        ell0=pg.ell0,
-        num_folds=len(pg.folds),
-        **{f"fold{i}": f for i, f in enumerate(pg.folds)},
-    )
-    os.replace(tmp, path)
-    return pg
+    def unpack(z):
+        nf = int(z["num_folds"])
+        return PullGraph(
+            num_vertices=int(z["num_vertices"]),
+            num_edges=int(z["num_edges"]),
+            ell0=z["ell0"],
+            folds=tuple(z[f"fold{i}"] for i in range(nf)),
+        )
+
+    def build():
+        pg = build_pull_graph(dg)
+        arrays = dict(
+            num_vertices=pg.num_vertices,
+            num_edges=pg.num_edges,
+            ell0=pg.ell0,
+            num_folds=len(pg.folds),
+            **{f"fold{i}": f for i, f in enumerate(pg.folds)},
+        )
+        return pg, arrays
+
+    return _cached(f"pull_{key}_k{DEFAULT_K}", unpack, build)
+
+
+def load_or_build_relay(dg, key: str):
+    """Relay layout (relabeling + Beneš networks), cached on disk — the
+    router walks ~N log N pointers host-side (minutes at scale 22, once)."""
+    from bfs_tpu.graph.relay import ClassSlice, RelayGraph, build_relay_graph
+
+    def unpack(z):
+        return RelayGraph(
+            num_vertices=int(z["num_vertices"]),
+            num_edges=int(z["num_edges"]),
+            new2old=z["new2old"],
+            old2new=z["old2new"],
+            vperm_masks=z["vperm_masks"],
+            vperm_size=int(z["vperm_size"]),
+            out_classes=tuple(ClassSlice(*row) for row in z["out_classes"].tolist()),
+            net_masks=z["net_masks"],
+            net_size=int(z["net_size"]),
+            m2=int(z["m2"]),
+            in_classes=tuple(ClassSlice(*row) for row in z["in_classes"].tolist()),
+            src_l1=z["src_l1"],
+        )
+
+    def build():
+        rg = build_relay_graph(dg)
+        arrays = dict(
+            num_vertices=rg.num_vertices,
+            num_edges=rg.num_edges,
+            new2old=rg.new2old,
+            old2new=rg.old2new,
+            vperm_masks=rg.vperm_masks,
+            vperm_size=rg.vperm_size,
+            out_classes=np.array(
+                [[c.width, c.va, c.vb, c.sa, c.sb] for c in rg.out_classes],
+                dtype=np.int64,
+            ),
+            net_masks=rg.net_masks,
+            net_size=rg.net_size,
+            m2=rg.m2,
+            in_classes=np.array(
+                [[c.width, c.va, c.vb, c.sa, c.sb] for c in rg.in_classes],
+                dtype=np.int64,
+            ),
+            src_l1=rg.src_l1,
+        )
+        return rg, arrays
+
+    return _cached(f"relay_{key}", unpack, build)
 
 
 def main():
@@ -126,11 +200,23 @@ def main():
     edge_factor = int(os.environ.get("BENCH_EDGE_FACTOR", "16"))
     repeats = int(os.environ.get("BENCH_REPEATS", "5"))
     engine = os.environ.get("BENCH_ENGINE", "pull")
+    if engine not in ("relay", "pull", "push"):
+        raise SystemExit(f"unknown BENCH_ENGINE {engine!r}; use relay/pull/push")
 
-    dg, source = load_or_build(scale, edge_factor, seed=42, block=8 * 1024)
+    backend = _generator_backend()
+    seed, block = 42, 8 * 1024
+    graph_key = f"{backend}_s{scale}_ef{edge_factor}_seed{seed}_block{block}"
+    dg, source = load_or_build(scale, edge_factor, seed, block, backend)
 
-    if engine == "pull":
-        pg = load_or_build_pull(dg, scale, edge_factor)
+    if engine == "relay":
+        from bfs_tpu.models.bfs import RelayEngine
+
+        rg = load_or_build_relay(dg, graph_key)
+        eng = RelayEngine(rg)
+        source_new = jnp.int32(int(rg.old2new[source]))
+        run = lambda: eng._fused(source_new, rg.num_vertices)  # noqa: E731
+    elif engine == "pull":
+        pg = load_or_build_pull(dg, graph_key)
         ell0 = jnp.asarray(pg.ell0)
         folds = tuple(jnp.asarray(f) for f in pg.folds)
         run = lambda: _bfs_pull_fused(  # noqa: E731

@@ -1,0 +1,118 @@
+"""Beneš routing networks: compile a static permutation to butterfly masks.
+
+The relay engine (see :mod:`bfs_tpu.graph.relay`) moves per-edge frontier
+bits from src-grouped to dst-grouped order every superstep.  That move is a
+fixed permutation, so it is compiled ONCE into a Beneš network — 2·log2(N)-1
+stages of conditional pair swaps — whose control masks are computed by the
+native router (native/benes.cpp) and applied on device as pure elementwise
+ops over bit-packed int32 words (:func:`bfs_tpu.ops.relay.apply_benes`).
+
+Conventions shared with the C++ router and the XLA applier:
+  * stage ``s`` of a size-``N=2^k`` network has pair distance
+    ``N >> (s+1)`` for ``s < k`` and ``N >> (2k-1-s)`` after;
+  * a stage swaps ``x[i] <-> x[i+d]`` iff mask bit ``i`` is set, mask bits
+    stored only at the lower index of each pair;
+  * bits pack little-endian into uint32 words;
+  * the network computes ``y[j] = x[perm[j]]``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from ..utils.native_loader import NativeLib
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _register(lib: ctypes.CDLL) -> None:
+    lib.benes_route.restype = ctypes.c_int32
+    lib.benes_route.argtypes = [
+        ctypes.c_int64,
+        np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS"),
+    ]
+
+
+_LIB = NativeLib(
+    src=os.path.join(_REPO_ROOT, "native", "benes.cpp"),
+    so=os.path.join(_REPO_ROOT, "native", "build", "libbenes.so"),
+    register=_register,
+)
+
+
+def native_available() -> bool:
+    return _LIB.available()
+
+
+def num_stages(n: int) -> int:
+    return 2 * (int(n).bit_length() - 1) - 1
+
+
+def stage_distance(n: int, s: int) -> int:
+    k = int(n).bit_length() - 1
+    return n >> (s + 1) if s < k else n >> (2 * k - 1 - s)
+
+
+def route(perm: np.ndarray) -> np.ndarray:
+    """Compute Beneš masks for ``perm`` (``y[j] = x[perm[j]]``).
+
+    ``len(perm)`` must be a power of two >= 2.  Returns
+    ``uint32[num_stages, n/32]`` packed masks (``n//32`` >= 1).
+    """
+    lib = _LIB.load()
+    if lib is None:
+        raise RuntimeError("native benes router unavailable")
+    perm = np.ascontiguousarray(perm, dtype=np.int64)
+    n = int(perm.shape[0])
+    if n < 2 or n & (n - 1):
+        raise ValueError(f"network size {n} is not a power of two >= 2")
+    words = max(n // 32, 1)
+    masks = np.zeros(num_stages(n) * words, dtype=np.uint32)
+    if lib.benes_route(n, perm, masks) != 0:
+        raise ValueError("perm is not a bijection")
+    return masks.reshape(num_stages(n), words)
+
+
+def pad_perm(perm_partial: np.ndarray, n: int, used_inputs: np.ndarray) -> np.ndarray:
+    """Complete a partial mapping to a bijection on ``n`` slots.
+
+    ``perm_partial``: int64[n] with -1 at outputs that do not care;
+    ``used_inputs``: bool[n] marking inputs already consumed.  Unassigned
+    outputs are matched to unused inputs in order.
+    """
+    perm = np.asarray(perm_partial, dtype=np.int64).copy()
+    free_outputs = np.flatnonzero(perm < 0)
+    free_inputs = np.flatnonzero(~np.asarray(used_inputs, dtype=bool))
+    if free_outputs.shape[0] != free_inputs.shape[0]:
+        raise ValueError("partial permutation is not completable")
+    perm[free_outputs] = free_inputs
+    return perm
+
+
+def apply_network_numpy(masks: np.ndarray, x: np.ndarray) -> np.ndarray:
+    """Reference applier on an element array (testing / fallback)."""
+    n = x.shape[0]
+    x = x.copy()
+    for s in range(masks.shape[0]):
+        d = stage_distance(n, s)
+        i = np.arange(n)
+        bits = (masks[s, i >> 5] >> (i & 31)) & 1
+        swap = ((i & d) == 0) & (bits == 1)
+        idx = i[swap]
+        x[idx], x[idx + d] = x[idx + d].copy(), x[idx].copy()
+    return x
+
+
+def pack_bits(bits: np.ndarray) -> np.ndarray:
+    """bool/int8[n] -> uint32[n/32] little-endian (n must be a multiple of 32)."""
+    b = np.asarray(bits, dtype=np.uint8).reshape(-1, 32).astype(np.uint32)
+    return (b << np.arange(32, dtype=np.uint32)).sum(axis=1, dtype=np.uint32)
+
+
+def unpack_bits(words: np.ndarray) -> np.ndarray:
+    w = np.asarray(words, dtype=np.uint32)
+    return ((w[:, None] >> np.arange(32, dtype=np.uint32)) & 1).astype(np.uint8).reshape(-1)

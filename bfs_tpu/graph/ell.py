@@ -126,7 +126,11 @@ def build_pull_graph(
     folds: list[np.ndarray] = []
     level_rows = rows_per_v  # per-vertex row count at the current level
     prev_padded = r0_padded  # padded row count of the current level
+    prev_max = int(level_rows.max()) + 1
     while int(level_rows.max()) > 1:
+        if int(level_rows.max()) >= prev_max:  # k >= 2 strictly shrinks rows
+            raise RuntimeError("ELL fold recursion failed to converge")
+        prev_max = int(level_rows.max())
         row_of, col_of, next_rows = _group_rows(level_rows, k)
         r_next = int(next_rows.sum())
         r_next_padded = pad_to_multiple(r_next, row_multiple)
@@ -138,7 +142,5 @@ def build_pull_graph(
         folds.append(fold)
         level_rows = next_rows
         prev_padded = r_next_padded
-        if len(folds) > 12:
-            raise RuntimeError("ELL fold recursion failed to converge")
 
     return PullGraph(num_vertices=v, num_edges=e, ell0=ell0, folds=tuple(folds))

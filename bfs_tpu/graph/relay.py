@@ -1,0 +1,205 @@
+"""Relay layout: degree-class dense adjacency + Beneš-routed bit shuffle.
+
+The fully gather-free BFS data layout.  Measured reality on TPU v5e
+(tools/microbench_gather.py): dense vector ops run at ~200 Gint32/s while
+every XLA gather/scatter runs at ~0.12 G/s, so the engine may not index by
+edge at runtime AT ALL.  Everything data-dependent becomes dense math over
+static layouts:
+
+  * **src side (broadcast)** — vertices bucketed by power-of-two OUT-degree
+    class; a vertex's frontier bit is broadcast to its out-edge slots by a
+    dense ``[Nc, 1] -> [Nc, Wc]`` tile per class (the mapper emitting a
+    candidate per neighbour, BfsSpark.java:73-79, as pure broadcast).
+  * **the shuffle** — per-edge bits move from src-grouped to dst-grouped
+    slot order through a bit-packed Beneš network (2·log2 N - 1 dense
+    butterfly stages, masks precomputed by native/benes.cpp).  This is the
+    reference's `reduceByKey` shuffle (BfsSpark.java:90) compiled into a
+    routing circuit.
+  * **dst side (reduce)** — vertices bucketed by IN-degree class and
+    RELABELED so classes are contiguous in vertex-id space; the reducer's
+    min-merge becomes ``min(where(bit, src_id, INF), axis=1)`` per class —
+    a dense row-min.  ``src_id`` tables store ORIGINAL ids so the canonical
+    min-parent tie-break is preserved across relabeling.
+
+A small second Beneš network reorders the [V] frontier bit-vector from
+(relabeled) vertex order to out-class order before broadcasting.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from . import benes
+from .csr import DeviceGraph, Graph, INF_DIST
+
+
+def _next_pow2(x: np.ndarray) -> np.ndarray:
+    x = np.maximum(np.asarray(x, dtype=np.int64), 1)
+    return np.int64(1) << np.int64(np.ceil(np.log2(x.astype(np.float64)))).astype(np.int64)
+
+
+def _pow2_at_least(n: int) -> int:
+    n = max(int(n), 32)
+    return 1 << (n - 1).bit_length()
+
+
+@dataclass(frozen=True)
+class ClassSlice:
+    """One degree class: vertices [va, vb) own slots [sa, sb), width w."""
+
+    width: int
+    va: int
+    vb: int
+    sa: int
+    sb: int
+
+
+@dataclass(frozen=True)
+class RelayGraph:
+    """Static relay layout for one graph (single shard).
+
+    All vertex-indexed engine state lives in the RELABELED id space
+    (``new2old``/``old2new``); parent VALUES stay original ids.
+    """
+
+    num_vertices: int
+    num_edges: int
+    new2old: np.ndarray  # int32[V]
+    old2new: np.ndarray  # int32[V]
+    # src side
+    vperm_masks: np.ndarray  # uint32[stages, Vp/32] — vertex-order -> out-order bits
+    vperm_size: int
+    out_classes: tuple[ClassSlice, ...]  # over out-order positions
+    # shuffle
+    net_masks: np.ndarray  # uint32[stages, N/32]
+    net_size: int
+    m2: int  # L2 (broadcast) slots actually used
+    # dst side
+    in_classes: tuple[ClassSlice, ...]  # over new-id vertex space
+    src_l1: np.ndarray  # int32[M1] — ORIGINAL src id per L1 slot, INF padding
+
+
+def _class_slices(widths_sorted: np.ndarray) -> list[ClassSlice]:
+    """Contiguous runs of equal width -> ClassSlice list (slot offsets by
+    cumulative width)."""
+    slices = []
+    slot = 0
+    va = 0
+    n = widths_sorted.shape[0]
+    boundaries = np.flatnonzero(np.diff(widths_sorted)) + 1
+    for vb in list(boundaries) + [n]:
+        w = int(widths_sorted[va])
+        sb = slot + (vb - va) * w
+        slices.append(ClassSlice(width=w, va=int(va), vb=int(vb), sa=int(slot), sb=int(sb)))
+        slot = sb
+        va = vb
+    return slices
+
+
+def _rank_within_groups(group_sorted: np.ndarray) -> np.ndarray:
+    """For a sorted group-id array, the rank of each element within its
+    group (0-based)."""
+    n = group_sorted.shape[0]
+    if n == 0:
+        return np.zeros(0, dtype=np.int64)
+    starts = np.flatnonzero(np.concatenate([[True], group_sorted[1:] != group_sorted[:-1]]))
+    start_of = starts[np.searchsorted(starts, np.arange(n), side="right") - 1]
+    return np.arange(n, dtype=np.int64) - start_of
+
+
+def build_relay_graph(graph: Graph | DeviceGraph) -> RelayGraph:
+    """Build the full relay layout (host side, once per graph).
+
+    Requires the native Beneš router; raises RuntimeError when unavailable.
+    """
+    if not benes.native_available():
+        raise RuntimeError("relay engine requires the native benes router")
+    if isinstance(graph, DeviceGraph):
+        if graph.num_shards != 1:
+            raise ValueError("build_relay_graph expects a single-shard graph")
+        flat_src = graph.src.reshape(-1)
+        flat_dst = graph.dst.reshape(-1)
+        keep = flat_dst != graph.sentinel
+        src, dst = flat_src[keep].astype(np.int64), flat_dst[keep].astype(np.int64)
+        v = graph.num_vertices
+    else:
+        src, dst = graph.src.astype(np.int64), graph.dst.astype(np.int64)
+        v = graph.num_vertices
+    e = int(src.shape[0])
+
+    indeg = np.bincount(dst, minlength=v)
+    outdeg = np.bincount(src, minlength=v)
+    in_w = _next_pow2(indeg)  # zero-indeg vertices get one INF slot
+    out_w = _next_pow2(outdeg)
+
+    # ---- relabel by (in-class width, old id): in-classes contiguous -------
+    new2old = np.lexsort((np.arange(v), in_w)).astype(np.int64)
+    old2new = np.empty(v, dtype=np.int64)
+    old2new[new2old] = np.arange(v)
+
+    # ---- dst side (L1): slots per new-vertex, classes contiguous ----------
+    in_w_new = in_w[new2old]
+    in_classes = _class_slices(in_w_new)
+    slot_start = np.zeros(v + 1, dtype=np.int64)
+    np.cumsum(in_w_new, out=slot_start[1:])
+    m1 = int(slot_start[v])
+
+    dstn = old2new[dst]
+    ord1 = np.lexsort((src, dstn))
+    rank1 = _rank_within_groups(dstn[ord1])
+    l1_pos = np.empty(e, dtype=np.int64)
+    l1_pos[ord1] = slot_start[dstn[ord1]] + rank1
+
+    src_l1 = np.full(m1, INF_DIST, dtype=np.int32)
+    src_l1[l1_pos] = src.astype(np.int32)  # ORIGINAL ids: canonical min-parent
+
+    # ---- src side (L2): out-class order over new ids ----------------------
+    out_w_new = out_w[new2old]
+    outorder2new = np.lexsort((np.arange(v), out_w_new)).astype(np.int64)
+    new2outpos = np.empty(v, dtype=np.int64)
+    new2outpos[outorder2new] = np.arange(v)
+    out_classes = _class_slices(out_w_new[outorder2new])
+    slot2_start = np.zeros(v + 1, dtype=np.int64)
+    np.cumsum(out_w_new[outorder2new], out=slot2_start[1:])
+    m2 = int(slot2_start[v])
+
+    srcpos = new2outpos[old2new[src]]
+    ord2 = np.lexsort((dst, srcpos))
+    rank2 = _rank_within_groups(srcpos[ord2])
+    l2_pos = np.empty(e, dtype=np.int64)
+    l2_pos[ord2] = slot2_start[srcpos[ord2]] + rank2
+
+    # ---- small network: vertex-order bits -> out-order bits ---------------
+    vp = _pow2_at_least(v)
+    vperm = np.full(vp, -1, dtype=np.int64)
+    vperm[:v] = outorder2new  # output j (out-order) <- input new-id
+    used = np.zeros(vp, dtype=bool)
+    used[outorder2new] = True
+    vperm = benes.pad_perm(vperm, vp, used)
+    vperm_masks = benes.route(vperm)
+
+    # ---- big network: L2 slot -> L1 slot ----------------------------------
+    n = _pow2_at_least(max(m1, m2))
+    net = np.full(n, -1, dtype=np.int64)
+    net[l1_pos] = l2_pos
+    used = np.zeros(n, dtype=bool)
+    used[l2_pos] = True
+    net = benes.pad_perm(net, n, used)
+    net_masks = benes.route(net)
+
+    return RelayGraph(
+        num_vertices=v,
+        num_edges=e,
+        new2old=new2old.astype(np.int32),
+        old2new=old2new.astype(np.int32),
+        vperm_masks=vperm_masks,
+        vperm_size=vp,
+        out_classes=tuple(out_classes),
+        net_masks=net_masks,
+        net_size=n,
+        m2=m2,
+        in_classes=tuple(in_classes),
+        src_l1=src_l1,
+    )

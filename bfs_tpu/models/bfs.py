@@ -106,6 +106,81 @@ def _bfs_pull_fused(
     return jax.lax.while_loop(cond, body, state)
 
 
+class RelayEngine:
+    """Device-resident relay layout + fused BFS loop (engine='relay').
+
+    Build once per graph; call :meth:`run` per source.  The whole superstep
+    loop is one XLA program of dense ops — see graph/relay.py.
+    """
+
+    def __init__(self, graph):
+        from ..graph.relay import RelayGraph, build_relay_graph
+        from ..ops.relay import relay_candidates, relay_superstep
+
+        rg = graph if isinstance(graph, RelayGraph) else build_relay_graph(graph)
+        self.relay_graph = rg
+        v = rg.num_vertices
+        # Device-resident layout tensors are passed as jit ARGUMENTS — a
+        # closed-over concrete array is baked into the program as a constant,
+        # and the routing masks are hundreds of MB at scale >= 20.
+        self._tensors = (
+            jnp.asarray(rg.vperm_masks),
+            jnp.asarray(rg.net_masks),
+            tuple(
+                jnp.asarray(
+                    rg.src_l1[cs.sa : cs.sb].reshape(cs.vb - cs.va, cs.width)
+                )
+                for cs in rg.in_classes
+            ),
+        )
+
+        @functools.partial(jax.jit, static_argnames=("max_levels",))
+        def fused(source_new, vperm_masks, net_masks, src_l1_parts, max_levels):
+            def cand_fn(frontier):
+                return relay_candidates(
+                    frontier,
+                    num_vertices=v,
+                    vperm_masks=vperm_masks,
+                    vperm_size=rg.vperm_size,
+                    out_classes=rg.out_classes,
+                    net_masks=net_masks,
+                    net_size=rg.net_size,
+                    m2=rg.m2,
+                    in_classes=rg.in_classes,
+                    src_l1_parts=src_l1_parts,
+                )
+
+            state = init_state(v, source_new)
+
+            def cond(s: BfsState):
+                return s.changed & (s.level < max_levels)
+
+            def body(s: BfsState):
+                return relay_superstep(s, cand_fn)
+
+            return jax.lax.while_loop(cond, body, state)
+
+        self._raw_fused = fused
+
+    def _fused(self, source_new, max_levels):
+        return self._raw_fused(source_new, *self._tensors, max_levels=max_levels)
+
+    def run(self, source: int = 0, *, max_levels: int | None = None) -> BfsResult:
+        rg = self.relay_graph
+        check_sources(rg.num_vertices, source)
+        max_levels = int(max_levels) if max_levels is not None else rg.num_vertices
+        source_new = int(rg.old2new[source])
+        state = jax.device_get(self._fused(jnp.int32(source_new), max_levels))
+        # Engine state lives in relabeled space with original-id parent
+        # VALUES; map the index space back (host, once per run).
+        dist_new = np.asarray(state.dist[: rg.num_vertices])
+        parent_new = np.asarray(state.parent[: rg.num_vertices])
+        dist = dist_new[rg.old2new]
+        parent = parent_new[rg.old2new]
+        parent[source] = source  # init wrote the relabeled id at the source
+        return BfsResult(dist=dist, parent=parent, num_levels=int(state.level))
+
+
 def bfs(
     graph: Graph | DeviceGraph | PullGraph,
     source: int = 0,
@@ -116,15 +191,25 @@ def bfs(
 ) -> BfsResult:
     """Run single-source BFS fully on-device and return host results.
 
-    ``engine='pull'`` (default) uses the scatter-free ELL gather/row-min
-    formulation (fast on TPU); ``engine='push'`` uses the segment_min
-    push formulation (closest analogue of the reference's map/reduce).
+    Engines (same math, different layouts):
+      * ``'relay'`` — gather-free degree-class + Beneš bit-routing layout;
+        the fast path on real TPUs (requires the native router).
+      * ``'pull'`` (default) — ELL gather/row-min formulation.
+      * ``'push'`` — segment_min push formulation, the closest analogue of
+        the reference's map/shuffle/reduce (BfsSpark.java:66-108).
     Passing a prebuilt :class:`PullGraph`/:class:`DeviceGraph` skips layout.
     """
-    if engine not in ("pull", "push"):
-        raise ValueError(f"unknown engine {engine!r}; use 'pull' or 'push'")
+    from ..graph.relay import RelayGraph
+
+    if engine not in ("pull", "push", "relay"):
+        raise ValueError(f"unknown engine {engine!r}; use 'relay', 'pull' or 'push'")
     if isinstance(graph, PullGraph) and engine != "pull":
         raise ValueError("a prebuilt PullGraph only runs on engine='pull'")
+    if isinstance(graph, RelayGraph) and engine != "relay":
+        raise ValueError("a prebuilt RelayGraph only runs on engine='relay'")
+    if engine == "relay":
+        eng = RelayEngine(graph)
+        return eng.run(source, max_levels=max_levels)
     if engine == "pull":
         pg = graph if isinstance(graph, PullGraph) else build_pull_graph(graph)
         check_sources(pg.num_vertices, source)

@@ -19,7 +19,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .relax import INT32_MAX, BfsState
+from .relax import INT32_MAX, BfsState, apply_candidates
 
 
 def frontier_table(state: BfsState) -> jax.Array:
@@ -63,11 +63,4 @@ def relax_pull_superstep(
     cand_parent = pull_candidates(frontier_table(state), ell0, folds)
     if axis_name is not None:
         cand_parent = jax.lax.pmin(cand_parent, axis_name)
-    improved = (cand_parent != INT32_MAX) & (state.dist == INT32_MAX)
-    new_level = state.level + 1
-    dist = jnp.where(improved, new_level, state.dist)
-    parent = jnp.where(improved, cand_parent, state.parent)
-    changed = improved.any()
-    if batch_axis_name is not None:
-        changed = jax.lax.pmax(changed.astype(jnp.int32), batch_axis_name) > 0
-    return BfsState(dist, parent, improved, new_level, changed)
+    return apply_candidates(state, cand_parent, batch_axis_name=batch_axis_name)

@@ -58,6 +58,27 @@ def init_state(num_vertices: int, source) -> BfsState:
     return BfsState(dist, parent, frontier, jnp.int32(0), jnp.bool_(True))
 
 
+def apply_candidates(
+    state: BfsState,
+    cand_parent: jax.Array,
+    *,
+    batch_axis_name: str | None = None,
+) -> BfsState:
+    """Merge per-vertex candidate parents into the carry: the shared tail of
+    every engine's superstep (the reducer's min-merge outcome applied to
+    state, BfsSpark.java:90-108).  ``cand_parent`` is INT32_MAX where no
+    active in-edge exists; only unreached vertices improve (level-synchronous
+    BFS discovers each vertex exactly once)."""
+    improved = (cand_parent != INT32_MAX) & (state.dist == INT32_MAX)
+    new_level = state.level + 1
+    dist = jnp.where(improved, new_level, state.dist)
+    parent = jnp.where(improved, cand_parent, state.parent)
+    changed = improved.any()
+    if batch_axis_name is not None:
+        changed = jax.lax.pmax(changed.astype(jnp.int32), batch_axis_name) > 0
+    return BfsState(dist, parent, improved, new_level, changed)
+
+
 def relax_superstep(
     state: BfsState,
     src: jax.Array,
@@ -84,11 +105,7 @@ def relax_superstep(
     )
     if axis_name is not None:
         cand_parent = jax.lax.pmin(cand_parent, axis_name)
-    improved = (cand_parent != INT32_MAX) & (state.dist == INT32_MAX)
-    new_level = state.level + 1
-    dist = jnp.where(improved, new_level, state.dist)
-    parent = jnp.where(improved, cand_parent, state.parent)
-    return BfsState(dist, parent, improved, new_level, improved.any())
+    return apply_candidates(state, cand_parent)
 
 
 def init_batched_state(num_vertices: int, sources: jax.Array) -> BfsState:
@@ -132,14 +149,7 @@ def relax_superstep_batched(
     cand_parent = jax.vmap(seg)(jnp.where(active, src, INT32_MAX))
     if axis_name is not None:
         cand_parent = jax.lax.pmin(cand_parent, axis_name)
-    improved = (cand_parent != INT32_MAX) & (state.dist == INT32_MAX)
-    new_level = state.level + 1
-    dist = jnp.where(improved, new_level, state.dist)
-    parent = jnp.where(improved, cand_parent, state.parent)
-    changed = improved.any()
-    if batch_axis_name is not None:
-        changed = jax.lax.pmax(changed.astype(jnp.int32), batch_axis_name) > 0
-    return BfsState(dist, parent, improved, new_level, changed)
+    return apply_candidates(state, cand_parent, batch_axis_name=batch_axis_name)
 
 
 def frontier_size(state: BfsState) -> jax.Array:

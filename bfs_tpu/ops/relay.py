@@ -1,0 +1,114 @@
+"""Relay superstep: broadcast -> Beneš bit routing -> class row-min.
+
+The gather-free BFS superstep over a :class:`~bfs_tpu.graph.relay.RelayGraph`
+layout.  Every op here is dense (elementwise / reshape / broadcast / reduce)
+— the only data-dependent values are the bits themselves, never an index.
+See graph/relay.py for the measured rationale and the layout; conventions of
+the butterfly stages are shared with native/benes.cpp.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .relax import INT32_MAX, BfsState, apply_candidates
+
+
+def pack_bits(bits: jax.Array) -> jax.Array:
+    """uint8/bool[n] -> uint32[n/32] little-endian (n a multiple of 32)."""
+    b = bits.reshape(-1, 32).astype(jnp.uint32)
+    return (b << jnp.arange(32, dtype=jnp.uint32)).sum(axis=1, dtype=jnp.uint32)
+
+
+def unpack_bits(words: jax.Array) -> jax.Array:
+    """uint32[n/32] -> uint8[n]."""
+    return (
+        ((words[:, None] >> jnp.arange(32, dtype=jnp.uint32)) & 1)
+        .astype(jnp.uint8)
+        .reshape(-1)
+    )
+
+
+def apply_benes(words: jax.Array, masks: jax.Array, n: int) -> jax.Array:
+    """Apply a routed Beneš network to bit-packed words.
+
+    ``words``: uint32[n/32]; ``masks``: uint32[stages, n/32] from
+    :func:`bfs_tpu.graph.benes.route`.  Stage ``s`` swaps bit pairs at
+    distance ``d_s``; for ``d >= 32`` that is a word-block swap, for
+    ``d < 32`` an intra-word butterfly — all elementwise, ~3 ops per word
+    per stage.
+    """
+    k = int(n).bit_length() - 1
+    x = words
+    for s in range(2 * k - 1):
+        d = n >> (s + 1) if s < k else n >> (2 * k - 1 - s)
+        m = masks[s]
+        if d >= 32:
+            dw = d // 32
+            xr = x.reshape(-1, 2, dw)
+            lo = xr[:, 0, :]
+            hi = xr[:, 1, :]
+            mlo = m.reshape(-1, 2, dw)[:, 0, :]
+            t = (lo ^ hi) & mlo
+            x = jnp.stack([lo ^ t, hi ^ t], axis=1).reshape(-1)
+        else:
+            t = (x ^ (x >> jnp.uint32(d))) & m
+            x = x ^ t ^ (t << jnp.uint32(d))
+    return x
+
+
+def relay_candidates(
+    frontier: jax.Array,
+    *,
+    num_vertices: int,
+    vperm_masks: jax.Array,
+    vperm_size: int,
+    out_classes,
+    net_masks: jax.Array,
+    net_size: int,
+    m2: int,
+    in_classes,
+    src_l1_parts,
+) -> jax.Array:
+    """Min active ORIGINAL-id in-neighbour per (relabeled) vertex: int32[V].
+
+    ``frontier``: bool[V+1] in relabeled vertex order (sentinel slot
+    ignored).  ``src_l1_parts``: per-in-class int32[Nc, Wc] original-id
+    tables with INF padding.
+    """
+    v = num_vertices
+    fbits = frontier[:v].astype(jnp.uint8)
+    fbits = jnp.concatenate(
+        [fbits, jnp.zeros(vperm_size - v, dtype=jnp.uint8)]
+    )
+    fout = unpack_bits(apply_benes(pack_bits(fbits), vperm_masks, vperm_size))
+
+    parts = []
+    for cs in out_classes:
+        blk = fout[cs.va : cs.vb]
+        parts.append(
+            jnp.broadcast_to(blk[:, None], (cs.vb - cs.va, cs.width)).reshape(-1)
+        )
+    parts.append(jnp.zeros(net_size - m2, dtype=jnp.uint8))
+    l2 = jnp.concatenate(parts)
+
+    l1bits = unpack_bits(apply_benes(pack_bits(l2), net_masks, net_size))
+
+    cands = []
+    for cs, src_tab in zip(in_classes, src_l1_parts):
+        bits = l1bits[cs.sa : cs.sb].reshape(-1, cs.width)
+        cands.append(jnp.min(jnp.where(bits != 0, src_tab, INT32_MAX), axis=1))
+    return jnp.concatenate(cands)
+
+
+def relay_superstep(state: BfsState, cand_fn) -> BfsState:
+    """One superstep given ``cand_fn(frontier) -> int32[V]`` candidates.
+
+    NOTE: ``state`` lives in the RELABELED vertex space; ``cand`` VALUES are
+    original ids (the canonical min-parent), which the loop never indexes
+    with — only the engine wrapper maps spaces at the end.
+    """
+    cand = cand_fn(state.frontier)
+    cand = jnp.concatenate([cand, jnp.full((1,), INT32_MAX, jnp.int32)])
+    return apply_candidates(state, cand)

@@ -1,0 +1,109 @@
+"""Relay engine (degree classes + Beneš bit routing) vs oracle and engines."""
+
+import numpy as np
+import pytest
+
+from bfs_tpu.graph import benes
+from bfs_tpu.graph.csr import Graph, INF_DIST
+from bfs_tpu.graph.generators import gnm_graph, path_graph, rmat_graph
+from bfs_tpu.models.bfs import RelayEngine, bfs
+from bfs_tpu.oracle.bfs import canonical_bfs, check
+
+pytestmark = pytest.mark.skipif(
+    not benes.native_available(), reason="native benes router unavailable"
+)
+
+
+# ---- Beneš building blocks --------------------------------------------------
+
+def test_route_random_perms():
+    rng = np.random.default_rng(1)
+    for _ in range(30):
+        n = 1 << int(rng.integers(5, 12))
+        perm = rng.permutation(n).astype(np.int64)
+        masks = benes.route(perm)
+        x = rng.integers(0, 2, size=n).astype(np.uint8)
+        np.testing.assert_array_equal(benes.apply_network_numpy(masks, x), x[perm])
+
+
+def test_route_rejects_non_bijection():
+    with pytest.raises(ValueError):
+        benes.route(np.zeros(8, dtype=np.int64))
+    with pytest.raises(ValueError):
+        benes.route(np.arange(6, dtype=np.int64))  # not a power of two
+
+
+def test_pack_unpack_roundtrip():
+    rng = np.random.default_rng(2)
+    bits = rng.integers(0, 2, size=256).astype(np.uint8)
+    np.testing.assert_array_equal(benes.unpack_bits(benes.pack_bits(bits)), bits)
+
+
+def test_xla_applier_matches_numpy():
+    import jax.numpy as jnp
+
+    from bfs_tpu.ops.relay import apply_benes, pack_bits, unpack_bits
+
+    rng = np.random.default_rng(3)
+    for n in (32, 64, 256, 2048):
+        perm = rng.permutation(n).astype(np.int64)
+        masks = benes.route(perm)
+        bits = rng.integers(0, 2, size=n).astype(np.uint8)
+        want = bits[perm]
+        got = np.asarray(
+            unpack_bits(apply_benes(pack_bits(jnp.asarray(bits)), jnp.asarray(masks), n))
+        )
+        np.testing.assert_array_equal(got, want)
+
+
+# ---- end-to-end relay BFS ---------------------------------------------------
+
+def _assert_relay_matches(graph, source=0):
+    result = bfs(graph, source, engine="relay")
+    dist, parent = canonical_bfs(graph, source)
+    np.testing.assert_array_equal(result.dist, dist)
+    np.testing.assert_array_equal(result.parent, parent)
+    assert check(graph, result.dist, result.parent, source) == []
+
+
+def test_tiny_relay(tiny_graph):
+    result = bfs(tiny_graph, 0, engine="relay")
+    assert result.dist.tolist() == [0, 1, 1, 2, 2, 1]
+    assert result.parent.tolist() == [0, 0, 0, 2, 2, 0]
+    assert result.num_levels == 3
+
+
+def test_relay_random_graphs(tiny_graph):
+    for seed in range(4):
+        g = gnm_graph(150, 500, seed=seed)
+        _assert_relay_matches(g, seed % 150)
+
+
+def test_relay_rmat_skewed():
+    g = rmat_graph(9, 8, seed=7)
+    _assert_relay_matches(g, 0)
+
+
+def test_relay_path_and_disconnected():
+    _assert_relay_matches(path_graph(70), 0)
+    g = Graph.from_undirected_edges(6, np.array([[0, 1], [3, 4]]))
+    r = bfs(g, 0, engine="relay")
+    assert r.dist[1] == 1 and r.dist[3] == INF_DIST and r.parent[4] == -1
+
+
+def test_relay_engine_reuse_multiple_sources():
+    g = gnm_graph(120, 400, seed=11)
+    eng = RelayEngine(g)
+    for s in (0, 5, 77):
+        r = eng.run(s)
+        dist, parent = canonical_bfs(g, s)
+        np.testing.assert_array_equal(r.dist, dist)
+        np.testing.assert_array_equal(r.parent, parent)
+
+
+def test_relay_matches_pull_engine():
+    g = gnm_graph(200, 700, seed=3)
+    a = bfs(g, 4, engine="relay")
+    b = bfs(g, 4, engine="pull")
+    np.testing.assert_array_equal(a.dist, b.dist)
+    np.testing.assert_array_equal(a.parent, b.parent)

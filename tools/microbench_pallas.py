@@ -78,19 +78,14 @@ def main():
     )
 
     def chained_gather(table, idx, *, iters):
-        def body(i, t):
-            out = gather(t, idx)
-            # fold output back: new table row 0 ^= out.min() (forces dependency)
-            return t.at[0, 0].min(out.min() + i * 0)
-
-        # keep a dependency chain through the table argument
-        def body2(i, carry):
+        # Dependency chain through the table argument defeats hoisting.
+        def body(i, carry):
             t, acc = carry
             out = gather(t, idx)
             m = out.min()
             return (t.at[0, 0].set(m % 7), acc + m)
 
-        t, acc = jax.lax.fori_loop(0, iters, body2, (table, jnp.int32(0)))
+        t, acc = jax.lax.fori_loop(0, iters, body, (table, jnp.int32(0)))
         return acc
 
     slope_time("pallas dynamic_gather (sublane, per-lane)",
