@@ -54,7 +54,12 @@ def _cached(key: str, unpack, build):
             with np.load(path) as z:
                 return unpack(z)
         except Exception:
-            os.remove(path)
+            # Corrupt/stale entry: treat as a miss.  A concurrent process
+            # may have removed it first; that's fine.
+            try:
+                os.remove(path)
+            except FileNotFoundError:
+                pass
     obj, arrays = build()
     os.makedirs(_CACHE_DIR, exist_ok=True)
     tmp = f"{path}.tmp.{os.getpid()}.npz"
@@ -160,11 +165,17 @@ def load_or_build_relay(dg, key: str):
             old2new=z["old2new"],
             vperm_masks=z["vperm_masks"],
             vperm_size=int(z["vperm_size"]),
-            out_classes=tuple(ClassSlice(*row) for row in z["out_classes"].tolist()),
+            out_classes=tuple(
+                ClassSlice(*row[:5], vertex_major=bool(row[5]))
+                for row in z["out_classes"].tolist()
+            ),
             net_masks=z["net_masks"],
             net_size=int(z["net_size"]),
             m2=int(z["m2"]),
-            in_classes=tuple(ClassSlice(*row) for row in z["in_classes"].tolist()),
+            in_classes=tuple(
+                ClassSlice(*row[:5], vertex_major=bool(row[5]))
+                for row in z["in_classes"].tolist()
+            ),
             src_l1=z["src_l1"],
         )
 
@@ -178,28 +189,32 @@ def load_or_build_relay(dg, key: str):
             vperm_masks=rg.vperm_masks,
             vperm_size=rg.vperm_size,
             out_classes=np.array(
-                [[c.width, c.va, c.vb, c.sa, c.sb] for c in rg.out_classes],
+                [[c.width, c.va, c.vb, c.sa, c.sb, int(c.vertex_major)]
+                 for c in rg.out_classes],
                 dtype=np.int64,
             ),
             net_masks=rg.net_masks,
             net_size=rg.net_size,
             m2=rg.m2,
             in_classes=np.array(
-                [[c.width, c.va, c.vb, c.sa, c.sb] for c in rg.in_classes],
+                [[c.width, c.va, c.vb, c.sa, c.sb, int(c.vertex_major)]
+                 for c in rg.in_classes],
                 dtype=np.int64,
             ),
             src_l1=rg.src_l1,
         )
         return rg, arrays
 
-    return _cached(f"relay_{key}", unpack, build)
+    from bfs_tpu.graph.relay import LAYOUT_VERSION
+
+    return _cached(f"relay_v{LAYOUT_VERSION}_{key}", unpack, build)
 
 
 def main():
     scale = int(os.environ.get("BENCH_SCALE", "22"))
     edge_factor = int(os.environ.get("BENCH_EDGE_FACTOR", "16"))
     repeats = int(os.environ.get("BENCH_REPEATS", "5"))
-    engine = os.environ.get("BENCH_ENGINE", "pull")
+    engine = os.environ.get("BENCH_ENGINE", "relay")
     if engine not in ("relay", "pull", "push"):
         raise SystemExit(f"unknown BENCH_ENGINE {engine!r}; use relay/pull/push")
 

@@ -34,6 +34,7 @@ def _register(lib: ctypes.CDLL) -> None:
         ctypes.c_int64,
         np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS"),
         np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS"),
+        ctypes.c_int32,
     ]
 
 
@@ -57,11 +58,14 @@ def stage_distance(n: int, s: int) -> int:
     return n >> (s + 1) if s < k else n >> (2 * k - 1 - s)
 
 
-def route(perm: np.ndarray) -> np.ndarray:
+def route(perm: np.ndarray, *, bit_major: bool = False) -> np.ndarray:
     """Compute Beneš masks for ``perm`` (``y[j] = x[perm[j]]``).
 
     ``len(perm)`` must be a power of two >= 2.  Returns
     ``uint32[num_stages, n/32]`` packed masks (``n//32`` >= 1).
+    ``bit_major`` packs mask element e at (word ``e % nw``, bit ``e // nw``)
+    — the layout :func:`bfs_tpu.ops.relay.apply_benes` consumes; the default
+    word-major layout matches :func:`apply_network_numpy`'s default.
     """
     lib = _LIB.load()
     if lib is None:
@@ -72,7 +76,7 @@ def route(perm: np.ndarray) -> np.ndarray:
         raise ValueError(f"network size {n} is not a power of two >= 2")
     words = max(n // 32, 1)
     masks = np.zeros(num_stages(n) * words, dtype=np.uint32)
-    if lib.benes_route(n, perm, masks) != 0:
+    if lib.benes_route(n, perm, masks, int(bit_major)) != 0:
         raise ValueError("perm is not a bijection")
     return masks.reshape(num_stages(n), words)
 
@@ -93,14 +97,20 @@ def pad_perm(perm_partial: np.ndarray, n: int, used_inputs: np.ndarray) -> np.nd
     return perm
 
 
-def apply_network_numpy(masks: np.ndarray, x: np.ndarray) -> np.ndarray:
+def apply_network_numpy(
+    masks: np.ndarray, x: np.ndarray, *, bit_major: bool = False
+) -> np.ndarray:
     """Reference applier on an element array (testing / fallback)."""
     n = x.shape[0]
+    nw = max(n // 32, 1)
     x = x.copy()
     for s in range(masks.shape[0]):
         d = stage_distance(n, s)
         i = np.arange(n)
-        bits = (masks[s, i >> 5] >> (i & 31)) & 1
+        if bit_major:
+            bits = (masks[s, i % nw] >> (i // nw)) & 1
+        else:
+            bits = (masks[s, i >> 5] >> (i & 31)) & 1
         swap = ((i & d) == 0) & (bits == 1)
         idx = i[swap]
         x[idx], x[idx + d] = x[idx + d].copy(), x[idx].copy()

@@ -34,6 +34,10 @@ import numpy as np
 from . import benes
 from .csr import DeviceGraph, Graph, INF_DIST
 
+#: Bump when the slot ordering / mask layout changes; layout caches
+#: (bench.py .bench_cache) key on it.
+LAYOUT_VERSION = 2
+
 
 def _next_pow2(x: np.ndarray) -> np.ndarray:
     x = np.maximum(np.asarray(x, dtype=np.int64), 1)
@@ -47,13 +51,27 @@ def _pow2_at_least(n: int) -> int:
 
 @dataclass(frozen=True)
 class ClassSlice:
-    """One degree class: vertices [va, vb) own slots [sa, sb), width w."""
+    """One degree class: vertices [va, vb) own slots [sa, sb), width w.
+
+    ``vertex_major`` picks the slot ordering inside the class — chosen so
+    the on-device 2-D view always has a LARGE trailing dimension (TPU
+    (8,128) tiling makes small trailing dims pad ~100x):
+      * vertex-major (slot = sa + p*w + r): view [Nc, w], reduce axis 1 —
+        used when w >= Nc;
+      * rank-major (slot = sa + r*Nc + p): view [w, Nc], reduce axis 0 —
+        used when Nc > w (the common many-small-vertices classes).
+    """
 
     width: int
     va: int
     vb: int
     sa: int
     sb: int
+    vertex_major: bool = True
+
+    @property
+    def count(self) -> int:
+        return self.vb - self.va
 
 
 @dataclass(frozen=True)
@@ -83,7 +101,7 @@ class RelayGraph:
 
 def _class_slices(widths_sorted: np.ndarray) -> list[ClassSlice]:
     """Contiguous runs of equal width -> ClassSlice list (slot offsets by
-    cumulative width)."""
+    cumulative width); orientation per class by the larger dimension."""
     slices = []
     slot = 0
     va = 0
@@ -91,11 +109,34 @@ def _class_slices(widths_sorted: np.ndarray) -> list[ClassSlice]:
     boundaries = np.flatnonzero(np.diff(widths_sorted)) + 1
     for vb in list(boundaries) + [n]:
         w = int(widths_sorted[va])
-        sb = slot + (vb - va) * w
-        slices.append(ClassSlice(width=w, va=int(va), vb=int(vb), sa=int(slot), sb=int(sb)))
+        nc = vb - va
+        sb = slot + nc * w
+        slices.append(
+            ClassSlice(
+                width=w, va=int(va), vb=int(vb), sa=int(slot), sb=int(sb),
+                vertex_major=w >= nc,
+            )
+        )
         slot = sb
         va = vb
     return slices
+
+
+def _slot_of(cs: ClassSlice, vertex_pos: np.ndarray, rank: np.ndarray) -> np.ndarray:
+    """Slot id for (class-relative vertex position, within-vertex rank)."""
+    if cs.vertex_major:
+        return cs.sa + vertex_pos * cs.width + rank
+    return cs.sa + rank * cs.count + vertex_pos
+
+
+def _edge_slots(classes, pos_sorted, rank_sorted):
+    """Slot ids for edges: ``pos_sorted`` is each edge's vertex position in
+    class ordering; ``rank_sorted`` its within-vertex rank."""
+    out = np.empty(pos_sorted.shape[0], dtype=np.int64)
+    for cs in classes:
+        sel = (pos_sorted >= cs.va) & (pos_sorted < cs.vb)
+        out[sel] = _slot_of(cs, pos_sorted[sel] - cs.va, rank_sorted[sel])
+    return out
 
 
 def _rank_within_groups(group_sorted: np.ndarray) -> np.ndarray:
@@ -150,7 +191,7 @@ def build_relay_graph(graph: Graph | DeviceGraph) -> RelayGraph:
     ord1 = np.lexsort((src, dstn))
     rank1 = _rank_within_groups(dstn[ord1])
     l1_pos = np.empty(e, dtype=np.int64)
-    l1_pos[ord1] = slot_start[dstn[ord1]] + rank1
+    l1_pos[ord1] = _edge_slots(in_classes, dstn[ord1], rank1)
 
     src_l1 = np.full(m1, INF_DIST, dtype=np.int32)
     src_l1[l1_pos] = src.astype(np.int32)  # ORIGINAL ids: canonical min-parent
@@ -169,7 +210,7 @@ def build_relay_graph(graph: Graph | DeviceGraph) -> RelayGraph:
     ord2 = np.lexsort((dst, srcpos))
     rank2 = _rank_within_groups(srcpos[ord2])
     l2_pos = np.empty(e, dtype=np.int64)
-    l2_pos[ord2] = slot2_start[srcpos[ord2]] + rank2
+    l2_pos[ord2] = _edge_slots(out_classes, srcpos[ord2], rank2)
 
     # ---- small network: vertex-order bits -> out-order bits ---------------
     vp = _pow2_at_least(v)
@@ -178,7 +219,7 @@ def build_relay_graph(graph: Graph | DeviceGraph) -> RelayGraph:
     used = np.zeros(vp, dtype=bool)
     used[outorder2new] = True
     vperm = benes.pad_perm(vperm, vp, used)
-    vperm_masks = benes.route(vperm)
+    vperm_masks = benes.route(vperm, bit_major=True)
 
     # ---- big network: L2 slot -> L1 slot ----------------------------------
     n = _pow2_at_least(max(m1, m2))
@@ -187,7 +228,7 @@ def build_relay_graph(graph: Graph | DeviceGraph) -> RelayGraph:
     used = np.zeros(n, dtype=bool)
     used[l2_pos] = True
     net = benes.pad_perm(net, n, used)
-    net_masks = benes.route(net)
+    net_masks = benes.route(net, bit_major=True)
 
     return RelayGraph(
         num_vertices=v,

@@ -128,7 +128,10 @@ class RelayEngine:
             jnp.asarray(rg.net_masks),
             tuple(
                 jnp.asarray(
-                    rg.src_l1[cs.sa : cs.sb].reshape(cs.vb - cs.va, cs.width)
+                    rg.src_l1[cs.sa : cs.sb].reshape(
+                        (cs.count, cs.width) if cs.vertex_major
+                        else (cs.width, cs.count)
+                    )
                 )
                 for cs in rg.in_classes
             ),

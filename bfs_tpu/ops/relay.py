@@ -3,8 +3,22 @@
 The gather-free BFS superstep over a :class:`~bfs_tpu.graph.relay.RelayGraph`
 layout.  Every op here is dense (elementwise / reshape / broadcast / reduce)
 — the only data-dependent values are the bits themselves, never an index.
-See graph/relay.py for the measured rationale and the layout; conventions of
-the butterfly stages are shared with native/benes.cpp.
+See graph/relay.py for the measured rationale and the layout.
+
+TPU layout discipline (the whole point of this module): every 2-D view
+keeps a LARGE trailing dimension, because (8,128) tiling pads small
+trailing dims ~100x (measured ~50x slowdown on naive reshapes):
+
+  * bits pack **bit-major**: element ``e`` lives at (word ``e % nw``, bit
+    ``e // nw``), so pack/unpack are a 32-way reduce/concat over full-size
+    word arrays — never a ``[nw, 32]`` view.  native/benes.cpp emits masks
+    in the same layout (``route(..., bit_major=True)``).
+  * butterfly stages run on a fixed ``[R, 128]`` word view: intra-word
+    shifts for bit-level pairs, lane-rolls for word distance < 128, and
+    sublane-preserving row-block reshapes above that.
+  * degree-class phases choose vertex-major or rank-major slot order per
+    class (ClassSlice.vertex_major) so broadcast/reduce views are
+    ``[small, large]``.
 """
 
 from __future__ import annotations
@@ -14,48 +28,86 @@ import jax.numpy as jnp
 
 from .relax import INT32_MAX, BfsState, apply_candidates
 
-
-def pack_bits(bits: jax.Array) -> jax.Array:
-    """uint8/bool[n] -> uint32[n/32] little-endian (n a multiple of 32)."""
-    b = bits.reshape(-1, 32).astype(jnp.uint32)
-    return (b << jnp.arange(32, dtype=jnp.uint32)).sum(axis=1, dtype=jnp.uint32)
+LANES = 128
+#: Networks smaller than this run the simple unpacked element path.
+MIN_PACKED_BITS = 32 * LANES * 2
 
 
-def unpack_bits(words: jax.Array) -> jax.Array:
-    """uint32[n/32] -> uint8[n]."""
-    return (
-        ((words[:, None] >> jnp.arange(32, dtype=jnp.uint32)) & 1)
-        .astype(jnp.uint8)
-        .reshape(-1)
-    )
+def pack_bits(bits: jax.Array, n: int) -> jax.Array:
+    """uint8/bool[n] -> uint32[n/32], bit-major (element e -> word e % nw)."""
+    nw = max(n // 32, 1)
+    if n <= 32:
+        b = bits.astype(jnp.uint32)
+        return (b << jnp.arange(n, dtype=jnp.uint32)).sum(dtype=jnp.uint32)[None]
+    b = bits.reshape(32, nw).astype(jnp.uint32)
+    shifts = jnp.arange(32, dtype=jnp.uint32)[:, None]
+    return (b << shifts).sum(axis=0, dtype=jnp.uint32)
+
+
+def unpack_bits(words: jax.Array, n: int) -> jax.Array:
+    """uint32[n/32] -> uint8[n], bit-major."""
+    if n <= 32:
+        return ((words[0] >> jnp.arange(n, dtype=jnp.uint32)) & 1).astype(jnp.uint8)
+    shifts = jnp.arange(32, dtype=jnp.uint32)[:, None]
+    return ((words[None, :] >> shifts) & 1).astype(jnp.uint8).reshape(-1)
+
+
+def _apply_benes_small(words: jax.Array, masks: jax.Array, n: int) -> jax.Array:
+    """Unpacked element-space applier for tiny networks (test graphs)."""
+    k = int(n).bit_length() - 1
+    x = unpack_bits(words, n)
+    for s in range(2 * k - 1):
+        d = n >> (s + 1) if s < k else n >> (2 * k - 1 - s)
+        me = unpack_bits(masks[s], n).reshape(-1, 2, d)[:, 0, :]
+        xr = x.reshape(-1, 2, d)
+        lo, hi = xr[:, 0, :], xr[:, 1, :]
+        t = (lo ^ hi) & me
+        x = jnp.stack([lo ^ t, hi ^ t], axis=1).reshape(-1)
+    return pack_bits(x, n)
 
 
 def apply_benes(words: jax.Array, masks: jax.Array, n: int) -> jax.Array:
-    """Apply a routed Beneš network to bit-packed words.
+    """Apply a routed Beneš network to bit-major packed words.
 
     ``words``: uint32[n/32]; ``masks``: uint32[stages, n/32] from
-    :func:`bfs_tpu.graph.benes.route`.  Stage ``s`` swaps bit pairs at
-    distance ``d_s``; for ``d >= 32`` that is a word-block swap, for
-    ``d < 32`` an intra-word butterfly — all elementwise, ~3 ops per word
-    per stage.
+    ``benes.route(perm, bit_major=True)``.  Stage ``s`` swaps element pairs
+    at distance ``d_s``; in the bit-major layout an element distance ``d``
+    means a word-index distance ``d`` when ``d < nw`` and a bit-position
+    distance ``d // nw`` otherwise.
     """
     k = int(n).bit_length() - 1
-    x = words
+    nw = n // 32
+    if n < MIN_PACKED_BITS:
+        return _apply_benes_small(words, masks, n)
+
+    r = nw // LANES
+    x = words.reshape(r, LANES)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
     for s in range(2 * k - 1):
         d = n >> (s + 1) if s < k else n >> (2 * k - 1 - s)
-        m = masks[s]
-        if d >= 32:
-            dw = d // 32
-            xr = x.reshape(-1, 2, dw)
-            lo = xr[:, 0, :]
-            hi = xr[:, 1, :]
-            mlo = m.reshape(-1, 2, dw)[:, 0, :]
-            t = (lo ^ hi) & mlo
-            x = jnp.stack([lo ^ t, hi ^ t], axis=1).reshape(-1)
+        m = masks[s].reshape(r, LANES)
+        if d >= nw:
+            sh = jnp.uint32(d // nw)  # bit-position butterfly, elementwise
+            t = (x ^ (x >> sh)) & m
+            x = x ^ t ^ (t << sh)
+        elif d < LANES:
+            # Word pairs in the same 128-lane row: partner lane = lane ^ d.
+            has_bit = (lane & d) != 0
+            partner = jnp.where(
+                has_bit, jnp.roll(x, d, axis=1), jnp.roll(x, -d, axis=1)
+            )
+            # Mask bits sit at the lower lane of each pair; mirror them onto
+            # the upper lane so one xor fixes both sides.
+            m_both = jnp.where(has_bit, jnp.roll(m, d, axis=1), m)
+            x = x ^ ((x ^ partner) & m_both)
         else:
-            t = (x ^ (x >> jnp.uint32(d))) & m
-            x = x ^ t ^ (t << jnp.uint32(d))
-    return x
+            br = d // LANES  # row-block swap; trailing lane dim unchanged
+            xr = x.reshape(-1, 2, br, LANES)
+            lo, hi = xr[:, 0], xr[:, 1]
+            mlo = m.reshape(-1, 2, br, LANES)[:, 0]
+            t = (lo ^ hi) & mlo
+            x = jnp.stack([lo ^ t, hi ^ t], axis=1).reshape(r, LANES)
+    return x.reshape(-1)
 
 
 def relay_candidates(
@@ -74,31 +126,44 @@ def relay_candidates(
     """Min active ORIGINAL-id in-neighbour per (relabeled) vertex: int32[V].
 
     ``frontier``: bool[V+1] in relabeled vertex order (sentinel slot
-    ignored).  ``src_l1_parts``: per-in-class int32[Nc, Wc] original-id
-    tables with INF padding.
+    ignored).  ``src_l1_parts``: per-in-class int32 tables, shaped
+    ``[Nc, w]`` (vertex-major) or ``[w, Nc]`` (rank-major), INF padding.
     """
     v = num_vertices
     fbits = frontier[:v].astype(jnp.uint8)
-    fbits = jnp.concatenate(
-        [fbits, jnp.zeros(vperm_size - v, dtype=jnp.uint8)]
+    fbits = jnp.concatenate([fbits, jnp.zeros(vperm_size - v, dtype=jnp.uint8)])
+    fout = unpack_bits(
+        apply_benes(pack_bits(fbits, vperm_size), vperm_masks, vperm_size),
+        vperm_size,
     )
-    fout = unpack_bits(apply_benes(pack_bits(fbits), vperm_masks, vperm_size))
 
     parts = []
     for cs in out_classes:
         blk = fout[cs.va : cs.vb]
-        parts.append(
-            jnp.broadcast_to(blk[:, None], (cs.vb - cs.va, cs.width)).reshape(-1)
-        )
+        if cs.vertex_major:  # slot = p*w + r -> view [Nc, w]
+            parts.append(
+                jnp.broadcast_to(blk[:, None], (cs.count, cs.width)).reshape(-1)
+            )
+        else:  # slot = r*Nc + p -> view [w, Nc]
+            parts.append(
+                jnp.broadcast_to(blk[None, :], (cs.width, cs.count)).reshape(-1)
+            )
     parts.append(jnp.zeros(net_size - m2, dtype=jnp.uint8))
     l2 = jnp.concatenate(parts)
 
-    l1bits = unpack_bits(apply_benes(pack_bits(l2), net_masks, net_size))
+    l1bits = unpack_bits(
+        apply_benes(pack_bits(l2, net_size), net_masks, net_size), net_size
+    )
 
     cands = []
     for cs, src_tab in zip(in_classes, src_l1_parts):
-        bits = l1bits[cs.sa : cs.sb].reshape(-1, cs.width)
-        cands.append(jnp.min(jnp.where(bits != 0, src_tab, INT32_MAX), axis=1))
+        seg = l1bits[cs.sa : cs.sb]
+        if cs.vertex_major:
+            bits = seg.reshape(cs.count, cs.width)
+            cands.append(jnp.min(jnp.where(bits != 0, src_tab, INT32_MAX), axis=1))
+        else:
+            bits = seg.reshape(cs.width, cs.count)
+            cands.append(jnp.min(jnp.where(bits != 0, src_tab, INT32_MAX), axis=0))
     return jnp.concatenate(cands)
 
 

@@ -37,10 +37,17 @@ struct Router {
   int32_t k;  // log2(n_total)
   uint32_t* masks;         // [num_stages][n_total/32] packed words
   int64_t words_per_stage;
+  bool bit_major;  // element e -> (word e % nw, bit e / nw) instead of
+                   // (word e / 32, bit e % 32); see bfs_tpu/ops/relay.py
 
   void set_bit(int32_t stage, int64_t pos) {
-    masks[stage * words_per_stage + (pos >> 5)] |=
-        (uint32_t{1} << (pos & 31));
+    if (bit_major) {
+      masks[stage * words_per_stage + (pos % words_per_stage)] |=
+          (uint32_t{1} << (pos / words_per_stage));
+    } else {
+      masks[stage * words_per_stage + (pos >> 5)] |=
+          (uint32_t{1} << (pos & 31));
+    }
   }
 
   void route(int64_t base, int64_t n, int32_t level,
@@ -118,9 +125,11 @@ extern "C" {
 
 // perm: int64[n] with perm[j] = source index for output j (a bijection).
 // masks_out: uint32[(2k-1) * (n/32)] zero-initialised by the caller.
-// Returns 0 on success, -1 on invalid input (n not a power of two >= 2,
-// or perm not a bijection).
-int32_t benes_route(int64_t n, const int64_t* perm, uint32_t* masks_out) {
+// bit_major != 0 packs mask element e at (word e % nw, bit e / nw) — the
+// transpose-free layout the XLA applier uses.  Returns 0 on success, -1 on
+// invalid input (n not a power of two >= 2, or perm not a bijection).
+int32_t benes_route(int64_t n, const int64_t* perm, uint32_t* masks_out,
+                    int32_t bit_major) {
   if (n < 2 || (n & (n - 1)) != 0) return -1;
   int32_t k = 0;
   while ((int64_t{1} << k) < n) ++k;
@@ -137,6 +146,7 @@ int32_t benes_route(int64_t n, const int64_t* perm, uint32_t* masks_out) {
   r.k = k;
   r.masks = masks_out;
   r.words_per_stage = n / 32 > 0 ? n / 32 : 1;
+  r.bit_major = bit_major != 0;
   std::vector<int64_t> p(perm, perm + n);
   r.route(0, n, 0, p);
   return 0;

@@ -42,18 +42,34 @@ def test_pack_unpack_roundtrip():
 def test_xla_applier_matches_numpy():
     import jax.numpy as jnp
 
-    from bfs_tpu.ops.relay import apply_benes, pack_bits, unpack_bits
+    from bfs_tpu.ops.relay import MIN_PACKED_BITS, apply_benes, pack_bits, unpack_bits
 
     rng = np.random.default_rng(3)
-    for n in (32, 64, 256, 2048):
+    # Covers the unpacked small path, the packed path's word/lane stages,
+    # and (at 2^21) row-block stages.
+    for n in (32, 64, 2048, MIN_PACKED_BITS, 1 << 17, 1 << 21):
         perm = rng.permutation(n).astype(np.int64)
-        masks = benes.route(perm)
+        masks = benes.route(perm, bit_major=True)
         bits = rng.integers(0, 2, size=n).astype(np.uint8)
         want = bits[perm]
         got = np.asarray(
-            unpack_bits(apply_benes(pack_bits(jnp.asarray(bits)), jnp.asarray(masks), n))
+            unpack_bits(
+                apply_benes(pack_bits(jnp.asarray(bits), n), jnp.asarray(masks), n),
+                n,
+            )
         )
         np.testing.assert_array_equal(got, want)
+
+
+def test_route_bit_major_matches_numpy_applier():
+    rng = np.random.default_rng(4)
+    for n in (64, 1024):
+        perm = rng.permutation(n).astype(np.int64)
+        masks = benes.route(perm, bit_major=True)
+        x = rng.integers(0, 100, size=n)
+        np.testing.assert_array_equal(
+            benes.apply_network_numpy(masks, x, bit_major=True), x[perm]
+        )
 
 
 # ---- end-to-end relay BFS ---------------------------------------------------
@@ -73,7 +89,7 @@ def test_tiny_relay(tiny_graph):
     assert result.num_levels == 3
 
 
-def test_relay_random_graphs(tiny_graph):
+def test_relay_random_graphs():
     for seed in range(4):
         g = gnm_graph(150, 500, seed=seed)
         _assert_relay_matches(g, seed % 150)
