@@ -83,6 +83,7 @@ def apply_benes(words: jax.Array, masks: jax.Array, n: int) -> jax.Array:
     r = nw // LANES
     x = words.reshape(r, LANES)
     lane = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
+    row = jax.lax.broadcasted_iota(jnp.int32, (r, 1), 0)
     for s in range(2 * k - 1):
         d = n >> (s + 1) if s < k else n >> (2 * k - 1 - s)
         m = masks[s].reshape(r, LANES)
@@ -101,12 +102,13 @@ def apply_benes(words: jax.Array, masks: jax.Array, n: int) -> jax.Array:
             m_both = jnp.where(has_bit, jnp.roll(m, d, axis=1), m)
             x = x ^ ((x ^ partner) & m_both)
         else:
-            br = d // LANES  # row-block swap; trailing lane dim unchanged
-            xr = x.reshape(-1, 2, br, LANES)
-            lo, hi = xr[:, 0], xr[:, 1]
-            mlo = m.reshape(-1, 2, br, LANES)[:, 0]
-            t = (lo ^ hi) & mlo
-            x = jnp.stack([lo ^ t, hi ^ t], axis=1).reshape(r, LANES)
+            br = d // LANES  # partner row = row ^ br; same roll+select form
+            has_bit = (row & br) != 0
+            partner = jnp.where(
+                has_bit, jnp.roll(x, br, axis=0), jnp.roll(x, -br, axis=0)
+            )
+            m_both = jnp.where(has_bit, jnp.roll(m, br, axis=0), m)
+            x = x ^ ((x ^ partner) & m_both)
     return x.reshape(-1)
 
 
