@@ -1,284 +1,12 @@
 #!/usr/bin/env python
-"""Headline benchmark: single-source BFS TEPS on an R-MAT graph (TPU).
+"""Headline benchmark entry point — delegates to :mod:`bfs_tpu.bench`.
 
-Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": "TEPS", "vs_baseline": N}
-
-Baseline: the reference's best serial number — largeG 15.2M directed edges /
-1.170 s ≈ 13 M TEPS (BASELINE.md, derived from docs/BigData_Project.pdf §1.5
-Table 7; the reference's own parallel version never beat it, OOMing on
-largeG).  TEPS here = directed edge count / median fused-BFS wall time,
-loop fully on-device (compile excluded, like the paper excludes Spark
-startup).
-
-Env knobs: BENCH_SCALE (default 22), BENCH_EDGE_FACTOR (16), BENCH_REPEATS (5).
+Run as ``python bench.py`` from the repo root (sys.path[0] is then the repo
+root, so no path manipulation is needed) or via the installed
+``bfs-tpu-bench`` console script (pyproject.toml).
 """
 
-import json
-import os
-import sys
-import time
-
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-# Persistent XLA compile cache: the relay engine's ~100-stage programs take
-# minutes to compile through the remote compile service; cache across runs.
-os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR",
-    os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench_cache", "xla"),
-)
-
-import jax
-
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
-import jax.numpy as jnp
-import numpy as np
-
-from bfs_tpu.graph.csr import Graph, build_device_graph, DeviceGraph
-from bfs_tpu.graph.ell import build_pull_graph
-from bfs_tpu.graph.generators import rmat_graph
-from bfs_tpu.models.bfs import _bfs_fused, _bfs_pull_fused
-
-BASELINE_TEPS = 15_172_126 / 1.170  # ≈ 13.0 M TEPS (BASELINE.md derived floor)
-
-
-_CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), ".bench_cache")
-
-
-def _cached(key: str, unpack, build):
-    """Load-or-rebuild an npz cache entry.  ``unpack(npz) -> obj``;
-    ``build() -> (obj, dict_of_arrays)``.  Corrupt entries are treated as
-    misses; writes are atomic and per-process to survive concurrent runs."""
-    path = os.path.join(_CACHE_DIR, key + ".npz")
-    if os.path.exists(path):
-        try:
-            with np.load(path) as z:
-                return unpack(z)
-        except Exception:
-            # Corrupt/stale entry: treat as a miss.  A concurrent process
-            # may have removed it first; that's fine.
-            try:
-                os.remove(path)
-            except FileNotFoundError:
-                pass
-    obj, arrays = build()
-    os.makedirs(_CACHE_DIR, exist_ok=True)
-    tmp = f"{path}.tmp.{os.getpid()}.npz"
-    np.savez(tmp, **arrays)
-    os.replace(tmp, path)
-    return obj
-
-
-def _generator_backend() -> str:
-    try:
-        from bfs_tpu.graph.native_gen import native_available
-
-        return "native" if native_available() else "numpy"
-    except Exception:
-        return "numpy"
-
-
-def load_or_build(scale: int, edge_factor: int, seed: int, block: int, backend: str):
-    """Device-ready R-MAT arrays, cached on disk: host-side generation +
-    dst-sorting of ~10^8 edges takes minutes in NumPy, so the prepared
-    DeviceGraph (and the chosen source) is built once per config.  Uses the
-    native generator/sorter (native/graph_gen.cpp) when available."""
-
-    def unpack(z):
-        return (
-            DeviceGraph(
-                num_vertices=int(z["num_vertices"]),
-                num_edges=int(z["num_edges"]),
-                src=z["src"],
-                dst=z["dst"],
-            ),
-            int(z["source"]),
-        )
-
-    def build():
-        if backend == "native":
-            from bfs_tpu.graph.native_gen import rmat_edges_native
-
-            u, v = rmat_edges_native(scale, edge_factor, seed=seed)
-            graph = Graph(
-                1 << scale, np.concatenate([u, v]), np.concatenate([v, u])
-            )  # bi-directed (GraphFileUtil.java:64-65 parity)
-        else:
-            graph = rmat_graph(scale, edge_factor, seed=seed)
-        dg = build_device_graph(graph, block=block)
-        # Deterministic source in the giant component: the max-degree vertex.
-        degrees = np.bincount(graph.src, minlength=graph.num_vertices)
-        source = int(np.argmax(degrees))
-        arrays = dict(
-            num_vertices=dg.num_vertices,
-            num_edges=dg.num_edges,
-            src=dg.src,
-            dst=dg.dst,
-            source=source,
-        )
-        return (dg, source), arrays
-
-    return _cached(
-        f"rmat_{backend}_s{scale}_ef{edge_factor}_seed{seed}_block{block}",
-        unpack,
-        build,
-    )
-
-
-def load_or_build_pull(dg, key: str):
-    """ELL pull layout, cached next to the DeviceGraph cache (the _group_rows
-    packing re-walks all E edges in NumPy — minutes at scale 22)."""
-    from bfs_tpu.graph.ell import DEFAULT_K, PullGraph
-
-    def unpack(z):
-        nf = int(z["num_folds"])
-        return PullGraph(
-            num_vertices=int(z["num_vertices"]),
-            num_edges=int(z["num_edges"]),
-            ell0=z["ell0"],
-            folds=tuple(z[f"fold{i}"] for i in range(nf)),
-        )
-
-    def build():
-        pg = build_pull_graph(dg)
-        arrays = dict(
-            num_vertices=pg.num_vertices,
-            num_edges=pg.num_edges,
-            ell0=pg.ell0,
-            num_folds=len(pg.folds),
-            **{f"fold{i}": f for i, f in enumerate(pg.folds)},
-        )
-        return pg, arrays
-
-    return _cached(f"pull_{key}_k{DEFAULT_K}", unpack, build)
-
-
-def load_or_build_relay(dg, key: str):
-    """Relay layout (relabeling + Beneš networks), cached on disk — the
-    router walks ~N log N pointers host-side (minutes at scale 22, once)."""
-    from bfs_tpu.graph.relay import ClassSlice, RelayGraph, build_relay_graph
-
-    def unpack(z):
-        return RelayGraph(
-            num_vertices=int(z["num_vertices"]),
-            num_edges=int(z["num_edges"]),
-            new2old=z["new2old"],
-            old2new=z["old2new"],
-            vperm_masks=z["vperm_masks"],
-            vperm_size=int(z["vperm_size"]),
-            out_classes=tuple(
-                ClassSlice(*row[:5], vertex_major=bool(row[5]))
-                for row in z["out_classes"].tolist()
-            ),
-            net_masks=z["net_masks"],
-            net_size=int(z["net_size"]),
-            m2=int(z["m2"]),
-            in_classes=tuple(
-                ClassSlice(*row[:5], vertex_major=bool(row[5]))
-                for row in z["in_classes"].tolist()
-            ),
-            src_l1=z["src_l1"],
-        )
-
-    def build():
-        rg = build_relay_graph(dg)
-        arrays = dict(
-            num_vertices=rg.num_vertices,
-            num_edges=rg.num_edges,
-            new2old=rg.new2old,
-            old2new=rg.old2new,
-            vperm_masks=rg.vperm_masks,
-            vperm_size=rg.vperm_size,
-            out_classes=np.array(
-                [[c.width, c.va, c.vb, c.sa, c.sb, int(c.vertex_major)]
-                 for c in rg.out_classes],
-                dtype=np.int64,
-            ),
-            net_masks=rg.net_masks,
-            net_size=rg.net_size,
-            m2=rg.m2,
-            in_classes=np.array(
-                [[c.width, c.va, c.vb, c.sa, c.sb, int(c.vertex_major)]
-                 for c in rg.in_classes],
-                dtype=np.int64,
-            ),
-            src_l1=rg.src_l1,
-        )
-        return rg, arrays
-
-    from bfs_tpu.graph.relay import LAYOUT_VERSION
-
-    return _cached(f"relay_v{LAYOUT_VERSION}_{key}", unpack, build)
-
-
-def main():
-    scale = int(os.environ.get("BENCH_SCALE", "22"))
-    edge_factor = int(os.environ.get("BENCH_EDGE_FACTOR", "16"))
-    repeats = int(os.environ.get("BENCH_REPEATS", "5"))
-    engine = os.environ.get("BENCH_ENGINE", "relay")
-    if engine not in ("relay", "pull", "push"):
-        raise SystemExit(f"unknown BENCH_ENGINE {engine!r}; use relay/pull/push")
-
-    backend = _generator_backend()
-    seed, block = 42, 8 * 1024
-    graph_key = f"{backend}_s{scale}_ef{edge_factor}_seed{seed}_block{block}"
-    dg, source = load_or_build(scale, edge_factor, seed, block, backend)
-
-    if engine == "relay":
-        from bfs_tpu.models.bfs import RelayEngine
-
-        rg = load_or_build_relay(dg, graph_key)
-        eng = RelayEngine(rg)
-        source_new = jnp.int32(int(rg.old2new[source]))
-        run = lambda: eng._fused(source_new, rg.num_vertices)  # noqa: E731
-    elif engine == "pull":
-        pg = load_or_build_pull(dg, graph_key)
-        ell0 = jnp.asarray(pg.ell0)
-        folds = tuple(jnp.asarray(f) for f in pg.folds)
-        run = lambda: _bfs_pull_fused(  # noqa: E731
-            ell0, folds, jnp.int32(source), pg.num_vertices, pg.num_vertices
-        )
-    else:
-        src = jnp.asarray(dg.src)
-        dst = jnp.asarray(dg.dst)
-        run = lambda: _bfs_fused(  # noqa: E731
-            src, dst, jnp.int32(source), dg.num_vertices, dg.num_vertices
-        )
-
-    state = run()  # warm-up: compile + first run
-    levels = int(state.level)  # forces a real sync (block_until_ready can
-    # return early through remote-device tunnels; value reads cannot)
-    reached = int((np.asarray(state.dist[: dg.num_vertices]) != np.iinfo(np.int32).max).sum())
-
-    times = []
-    for _ in range(repeats):
-        t0 = time.perf_counter()
-        _ = int(run().level)
-        times.append(time.perf_counter() - t0)
-    t = float(np.median(times))
-    teps = dg.num_edges / t
-
-    print(
-        json.dumps(
-            {
-                "metric": f"rmat{scale}_ssbfs_teps",
-                "value": teps,
-                "unit": "TEPS",
-                "vs_baseline": teps / BASELINE_TEPS,
-                "details": {
-                    "device": str(jax.devices()[0]),
-                    "engine": engine,
-                    "num_vertices": dg.num_vertices,
-                    "num_directed_edges": dg.num_edges,
-                    "source": source,
-                    "supersteps": levels,
-                    "vertices_reached": reached,
-                    "median_seconds": t,
-                    "times": times,
-                },
-            }
-        )
-    )
-
+from bfs_tpu.bench import main
 
 if __name__ == "__main__":
     main()

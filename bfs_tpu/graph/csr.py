@@ -186,10 +186,17 @@ def build_device_graph(
     )
 
 
-def reshard(dg: DeviceGraph, num_shards: int, *, block: int = 1024) -> DeviceGraph:
-    """Re-partition an existing DeviceGraph into a new shard count."""
+def unpad_edges(dg: DeviceGraph) -> tuple[np.ndarray, np.ndarray]:
+    """Strip sentinel padding from a DeviceGraph of any shard count: the real
+    ``(src, dst)`` host arrays, in stored (per-shard dst-sorted) order."""
     flat_src = dg.src.reshape(-1)
     flat_dst = dg.dst.reshape(-1)
-    keep = flat_src != dg.sentinel
-    g = Graph(dg.num_vertices, flat_src[keep], flat_dst[keep])
+    keep = flat_dst != dg.sentinel
+    return flat_src[keep], flat_dst[keep]
+
+
+def reshard(dg: DeviceGraph, num_shards: int, *, block: int = 1024) -> DeviceGraph:
+    """Re-partition an existing DeviceGraph into a new shard count."""
+    src, dst = unpad_edges(dg)
+    g = Graph(dg.num_vertices, src, dst)
     return build_device_graph(g, num_shards=num_shards, block=block)

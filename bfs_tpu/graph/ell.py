@@ -65,6 +65,49 @@ class PullGraph:
         return int(self.ell0.size) + sum(int(f.size) for f in self.folds)
 
 
+@dataclass(frozen=True)
+class ShardedPullGraph:
+    """ELL pull layout partitioned by destination vertex over mesh shards.
+
+    The multi-device layout for the TPU-fast pull engine: shard ``s`` owns
+    the contiguous vertex block ``[s*block, (s+1)*block)`` and holds the ELL
+    in-adjacency of exactly those destinations, with GLOBAL source-vertex
+    ids.  Per superstep each device gathers from a replicated global
+    frontier table and produces candidates for its own block only; the new
+    frontier is exchanged as a bit-packed bitmap all-gather (1 bit/vertex
+    over ICI) — the TPU-first replacement for the reference's Spark shuffle
+    of Vertex records (BfsSpark.java:90-110) that scales per-chip edge
+    memory as E/n (SURVEY.md §5 long-context row).
+
+    All shards share identical shapes (stacked on axis 0) so the engine is
+    one `shard_map` program:
+      * ``ell0``: int32[n, R0, K] — global src ids, sentinel-padded
+        (sentinel = ``n*block``, the one always-inactive frontier slot).
+      * ``folds``: tuple of int32[n, R_i, K] — same fold recursion as
+        :class:`PullGraph`, per-shard, padded to common depth (shards that
+        converge early get identity folds) and common row counts.  Fold
+        padding entries index the INF slot appended at the previous level's
+        padded row count.
+    After the last fold, rows ``0..block-1`` of shard ``s`` are its owned
+    vertices in id order.
+    """
+
+    num_vertices: int  # real V (unpadded)
+    num_edges: int  # real directed edges across all shards
+    num_shards: int
+    block: int  # owned vertices per shard, padded; multiple of 32
+    ell0: np.ndarray
+    folds: tuple[np.ndarray, ...] = field(default_factory=tuple)
+
+    @property
+    def k(self) -> int:
+        return int(self.ell0.shape[2])
+
+    @property
+    def padded_vertices(self) -> int:
+        return self.num_shards * self.block
+
+
 def _group_rows(counts: np.ndarray, k: int):
     """Pack per-group items (stored contiguously, group-major) into rows of
     width ``k``: every group gets ``max(ceil(count/k), 1)`` rows, numbered
@@ -144,3 +187,106 @@ def build_pull_graph(
         prev_padded = r_next_padded
 
     return PullGraph(num_vertices=v, num_edges=e, ell0=ell0, folds=tuple(folds))
+
+
+def _shard_levels(src_global: np.ndarray, dst_local: np.ndarray, block: int, k: int):
+    """One shard's unpadded ELL recursion.  Returns ``[level0, fold1, ...]``
+    as int64 matrices with natural row counts; ``-1`` marks INF/sentinel
+    entries (resolved to the unified padded indices by the caller)."""
+    counts = (
+        np.bincount(dst_local, minlength=block).astype(np.int64)
+        if dst_local.size
+        else np.zeros(block, np.int64)
+    )
+    row_of, col_of, rows_per = _group_rows(counts, k)
+    lvl0 = np.full((int(rows_per.sum()), k), -1, dtype=np.int64)
+    lvl0[row_of, col_of] = src_global
+    levels = [lvl0]
+    level_rows = rows_per
+    while int(level_rows.max()) > 1:
+        prev_real = int(level_rows.sum())
+        row_of, col_of, next_rows = _group_rows(level_rows, k)
+        fold = np.full((int(next_rows.sum()), k), -1, dtype=np.int64)
+        fold[row_of, col_of] = np.arange(prev_real, dtype=np.int64)
+        levels.append(fold)
+        level_rows = next_rows
+    return levels
+
+
+def build_sharded_pull_graph(
+    graph: Graph | DeviceGraph,
+    num_shards: int,
+    *,
+    k: int = DEFAULT_K,
+    block_multiple: int = 1024,
+    row_multiple: int = 64,
+) -> ShardedPullGraph:
+    """Partition a graph's in-adjacency into per-destination-block ELL shards
+    with uniform stacked shapes (see :class:`ShardedPullGraph`).
+
+    ``block_multiple`` keeps the per-shard vertex block a multiple of 32 (for
+    bit-packing) and of the (8,128) tile lane count."""
+    if k < 2:
+        raise ValueError("ELL width k must be >= 2")
+    if num_shards < 1:
+        raise ValueError("num_shards must be >= 1")
+    if block_multiple % 32 != 0:
+        raise ValueError("block_multiple must be a multiple of 32")
+    from .csr import _sorted_by_dst, unpad_edges
+
+    if isinstance(graph, DeviceGraph):
+        # Any shard count: strip sentinel padding and re-sort globally (a
+        # multi-shard DeviceGraph is only dst-sorted per shard).
+        src, dst = _sorted_by_dst(*unpad_edges(graph))
+    else:
+        src, dst = _sorted_by_dst(graph.src, graph.dst)
+    v = graph.num_vertices
+    e = int(src.shape[0])
+    block = pad_to_multiple(max((v + num_shards - 1) // num_shards, 1), block_multiple)
+    sentinel = np.int64(num_shards * block)
+
+    # Edges are dst-sorted: shard boundaries are one searchsorted.
+    bounds = np.searchsorted(dst, np.arange(num_shards + 1, dtype=np.int64) * block)
+    shard_levels = [
+        _shard_levels(
+            src[bounds[s] : bounds[s + 1]].astype(np.int64),
+            dst[bounds[s] : bounds[s + 1]].astype(np.int64) - s * block,
+            block,
+            k,
+        )
+        for s in range(num_shards)
+    ]
+
+    # Unify fold depth: shards that converged early get identity folds
+    # (each of the block's final vertex rows folds just itself).
+    depth = max(len(lv) for lv in shard_levels)
+    ident = np.full((block, k), -1, dtype=np.int64)
+    ident[:, 0] = np.arange(block, dtype=np.int64)
+    for lv in shard_levels:
+        while len(lv) < depth:
+            lv.append(ident)
+
+    # Unify row counts per level, then resolve -1 markers: level 0 sentinels
+    # point at the always-inactive frontier slot; fold sentinels point at the
+    # INF slot appended after the previous level's PADDED rows.
+    stacked = []
+    prev_rows = None
+    for i in range(depth):
+        rows = pad_to_multiple(max(lv[i].shape[0] for lv in shard_levels), row_multiple)
+        fill = sentinel if i == 0 else np.int64(prev_rows)
+        level = np.full((num_shards, rows, k), fill, dtype=np.int64)
+        for s, lv in enumerate(shard_levels):
+            m = lv[i].copy()
+            m[m < 0] = fill
+            level[s, : m.shape[0]] = m
+        stacked.append(level.astype(np.int32))
+        prev_rows = rows
+
+    return ShardedPullGraph(
+        num_vertices=v,
+        num_edges=e,
+        num_shards=num_shards,
+        block=block,
+        ell0=stacked[0],
+        folds=tuple(stacked[1:]),
+    )

@@ -27,7 +27,15 @@ import numpy as np
 from ..graph.csr import DeviceGraph, Graph, build_device_graph
 from ..graph.ell import PullGraph, build_pull_graph
 from ..ops.pull import relax_pull_superstep
-from ..ops.relax import BfsState, init_state, relax_superstep, frontier_size
+from ..ops.relax import (
+    INT32_MAX,
+    BfsState,
+    apply_candidates,
+    frontier_size,
+    init_batched_state,
+    init_state,
+    relax_superstep,
+)
 
 
 def check_sources(num_vertices: int, sources) -> None:
@@ -106,6 +114,98 @@ def _bfs_pull_fused(
     return jax.lax.while_loop(cond, body, state)
 
 
+@functools.lru_cache(maxsize=16)
+def _relay_fused_program(
+    num_vertices: int,
+    vperm_size: int,
+    out_classes: tuple,
+    net_size: int,
+    m2: int,
+    in_classes: tuple,
+):
+    """Jitted relay BFS loop, cached per static layout shape so two
+    :class:`RelayEngine` instances over the same graph (or two graphs with
+    identical class structure) share one compiled ~100-stage program instead
+    of recompiling from scratch."""
+    from ..ops.relay import relay_candidates, relay_superstep
+
+    @functools.partial(jax.jit, static_argnames=("max_levels",))
+    def fused(source_new, vperm_masks, net_masks, src_l1_parts, max_levels):
+        def cand_fn(frontier):
+            return relay_candidates(
+                frontier,
+                num_vertices=num_vertices,
+                vperm_masks=vperm_masks,
+                vperm_size=vperm_size,
+                out_classes=out_classes,
+                net_masks=net_masks,
+                net_size=net_size,
+                m2=m2,
+                in_classes=in_classes,
+                src_l1_parts=src_l1_parts,
+            )
+
+        state = init_state(num_vertices, source_new)
+
+        def cond(s: BfsState):
+            return s.changed & (s.level < max_levels)
+
+        def body(s: BfsState):
+            return relay_superstep(s, cand_fn)
+
+        return jax.lax.while_loop(cond, body, state)
+
+    return fused
+
+
+@functools.lru_cache(maxsize=16)
+def _relay_multi_fused_program(
+    num_vertices: int,
+    vperm_size: int,
+    out_classes: tuple,
+    net_size: int,
+    m2: int,
+    in_classes: tuple,
+):
+    """Batched (multi-source) relay loop: ``vmap`` lifts the gather-free
+    candidate pipeline over a leading sources axis — every stage is dense
+    elementwise/reshape math, so batching is mechanical — while all trees
+    share one lock-step ``while_loop`` (BASELINE.json config 5 semantics,
+    matching the other engines' batched mode)."""
+    from ..ops.relay import relay_candidates
+
+    @functools.partial(jax.jit, static_argnames=("max_levels",))
+    def fused(sources_new, vperm_masks, net_masks, src_l1_parts, max_levels):
+        def cand_fn(frontier):
+            return relay_candidates(
+                frontier,
+                num_vertices=num_vertices,
+                vperm_masks=vperm_masks,
+                vperm_size=vperm_size,
+                out_classes=out_classes,
+                net_masks=net_masks,
+                net_size=net_size,
+                m2=m2,
+                in_classes=in_classes,
+                src_l1_parts=src_l1_parts,
+            )
+
+        cand_batched = jax.vmap(cand_fn)
+        state = init_batched_state(num_vertices, sources_new)
+
+        def cond(s: BfsState):
+            return s.changed & (s.level < max_levels)
+
+        def body(s: BfsState):
+            cand = cand_batched(s.frontier)
+            inf = jnp.full(cand.shape[:-1] + (1,), INT32_MAX, dtype=jnp.int32)
+            return apply_candidates(s, jnp.concatenate([cand, inf], axis=-1))
+
+        return jax.lax.while_loop(cond, body, state)
+
+    return fused
+
+
 class RelayEngine:
     """Device-resident relay layout + fused BFS loop (engine='relay').
 
@@ -115,11 +215,9 @@ class RelayEngine:
 
     def __init__(self, graph):
         from ..graph.relay import RelayGraph, build_relay_graph
-        from ..ops.relay import relay_candidates, relay_superstep
 
         rg = graph if isinstance(graph, RelayGraph) else build_relay_graph(graph)
         self.relay_graph = rg
-        v = rg.num_vertices
         # Device-resident layout tensors are passed as jit ARGUMENTS — a
         # closed-over concrete array is baked into the program as a constant,
         # and the routing masks are hundreds of MB at scale >= 20.
@@ -136,34 +234,14 @@ class RelayEngine:
                 for cs in rg.in_classes
             ),
         )
-
-        @functools.partial(jax.jit, static_argnames=("max_levels",))
-        def fused(source_new, vperm_masks, net_masks, src_l1_parts, max_levels):
-            def cand_fn(frontier):
-                return relay_candidates(
-                    frontier,
-                    num_vertices=v,
-                    vperm_masks=vperm_masks,
-                    vperm_size=rg.vperm_size,
-                    out_classes=rg.out_classes,
-                    net_masks=net_masks,
-                    net_size=rg.net_size,
-                    m2=rg.m2,
-                    in_classes=rg.in_classes,
-                    src_l1_parts=src_l1_parts,
-                )
-
-            state = init_state(v, source_new)
-
-            def cond(s: BfsState):
-                return s.changed & (s.level < max_levels)
-
-            def body(s: BfsState):
-                return relay_superstep(s, cand_fn)
-
-            return jax.lax.while_loop(cond, body, state)
-
-        self._raw_fused = fused
+        self._raw_fused = _relay_fused_program(
+            rg.num_vertices,
+            rg.vperm_size,
+            rg.out_classes,
+            rg.net_size,
+            rg.m2,
+            rg.in_classes,
+        )
 
     def _fused(self, source_new, max_levels):
         return self._raw_fused(source_new, *self._tensors, max_levels=max_levels)
@@ -182,6 +260,41 @@ class RelayEngine:
         parent = parent_new[rg.old2new]
         parent[source] = source  # init wrote the relabeled id at the source
         return BfsResult(dist=dist, parent=parent, num_levels=int(state.level))
+
+    def run_multi(self, sources, *, max_levels: int | None = None):
+        """Batched multi-source BFS on the relay layout; returns a
+        :class:`~bfs_tpu.models.multisource.MultiBfsResult` in original-id
+        space (bit-exact with the other engines' batched modes)."""
+        from .multisource import MultiBfsResult
+
+        rg = self.relay_graph
+        sources = np.atleast_1d(np.asarray(sources, dtype=np.int32))
+        check_sources(rg.num_vertices, sources)
+        max_levels = int(max_levels) if max_levels is not None else rg.num_vertices
+        fused = _relay_multi_fused_program(
+            rg.num_vertices,
+            rg.vperm_size,
+            rg.out_classes,
+            rg.net_size,
+            rg.m2,
+            rg.in_classes,
+        )
+        sources_new = jnp.asarray(rg.old2new[sources])
+        state = jax.device_get(
+            fused(sources_new, *self._tensors, max_levels=max_levels)
+        )
+        dist_new = np.asarray(state.dist[:, : rg.num_vertices])
+        parent_new = np.asarray(state.parent[:, : rg.num_vertices])
+        dist = dist_new[:, rg.old2new]
+        parent = parent_new[:, rg.old2new]
+        rows = np.arange(sources.shape[0])
+        parent[rows, sources] = sources  # init wrote relabeled ids at sources
+        return MultiBfsResult(
+            sources=sources,
+            dist=dist,
+            parent=parent,
+            num_levels=int(state.level),
+        )
 
 
 def bfs(

@@ -19,6 +19,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..graph.csr import DeviceGraph, Graph, build_device_graph, INF_DIST, NO_PARENT
+from ..graph.ell import PullGraph, build_pull_graph
+from ..ops.pull import relax_pull_superstep
 from ..ops.relax import BfsState, init_batched_state, relax_superstep_batched
 
 
@@ -35,6 +37,24 @@ def _bfs_multi_fused(src, dst, sources, num_vertices: int, max_levels: int) -> B
     return jax.lax.while_loop(cond, body, state)
 
 
+@functools.partial(jax.jit, static_argnames=("num_vertices", "max_levels"))
+def _bfs_multi_pull_fused(
+    ell0, folds, sources, num_vertices: int, max_levels: int
+) -> BfsState:
+    """Batched pull: the frontier table carries a leading sources axis and
+    the ELL gathers broadcast over it (ops/pull.py pull_candidates), so all
+    S trees advance in lock-step supersteps of one compiled loop."""
+    state = init_batched_state(num_vertices, sources)
+
+    def cond(s: BfsState):
+        return s.changed & (s.level < max_levels)
+
+    def body(s: BfsState):
+        return relax_pull_superstep(s, ell0, folds)
+
+    return jax.lax.while_loop(cond, body, state)
+
+
 @dataclass
 class MultiBfsResult:
     """Per-source BFS trees: ``dist``/``parent`` are int32[S, V]."""
@@ -46,26 +66,50 @@ class MultiBfsResult:
 
 
 def bfs_multi(
-    graph: Graph | DeviceGraph,
+    graph: Graph | DeviceGraph | PullGraph,
     sources,
     *,
+    engine: str = "pull",
     max_levels: int | None = None,
     block: int = 1024,
 ) -> MultiBfsResult:
-    dg = graph if isinstance(graph, DeviceGraph) else build_device_graph(graph, block=block)
-    if dg.num_shards != 1:
-        raise ValueError("sharded DeviceGraph requires the parallel engine")
+    """Batched multi-source BFS on one chip.  Engines as in
+    :func:`bfs_tpu.models.bfs.bfs` — ``'pull'`` (default), ``'push'``, or
+    ``'relay'`` (via :meth:`RelayEngine.run_multi`); all produce bit-exact
+    dist AND parent (canonical min-parent)."""
     sources = np.atleast_1d(np.asarray(sources, dtype=np.int32))
     from .bfs import check_sources
 
-    check_sources(dg.num_vertices, sources)
-    max_levels = int(max_levels) if max_levels is not None else dg.num_vertices
-    state = _bfs_multi_fused(
-        jnp.asarray(dg.src), jnp.asarray(dg.dst), jnp.asarray(sources),
-        dg.num_vertices, max_levels,
-    )
+    if engine == "relay":
+        from .bfs import RelayEngine
+
+        return RelayEngine(graph).run_multi(sources, max_levels=max_levels)
+    if engine == "pull":
+        pg = graph if isinstance(graph, PullGraph) else build_pull_graph(graph)
+        check_sources(pg.num_vertices, sources)
+        max_levels = int(max_levels) if max_levels is not None else pg.num_vertices
+        state = _bfs_multi_pull_fused(
+            jnp.asarray(pg.ell0),
+            tuple(jnp.asarray(f) for f in pg.folds),
+            jnp.asarray(sources),
+            pg.num_vertices,
+            max_levels,
+        )
+        v = pg.num_vertices
+    elif engine == "push":
+        dg = graph if isinstance(graph, DeviceGraph) else build_device_graph(graph, block=block)
+        if dg.num_shards != 1:
+            raise ValueError("sharded DeviceGraph requires the parallel engine")
+        check_sources(dg.num_vertices, sources)
+        max_levels = int(max_levels) if max_levels is not None else dg.num_vertices
+        state = _bfs_multi_fused(
+            jnp.asarray(dg.src), jnp.asarray(dg.dst), jnp.asarray(sources),
+            dg.num_vertices, max_levels,
+        )
+        v = dg.num_vertices
+    else:
+        raise ValueError(f"unknown engine {engine!r}; use 'relay', 'pull' or 'push'")
     state = jax.device_get(state)
-    v = dg.num_vertices
     return MultiBfsResult(
         sources=sources,
         dist=np.asarray(state.dist[:, :v]),

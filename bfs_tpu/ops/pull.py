@@ -45,6 +45,45 @@ def pull_candidates(frontier_tab: jax.Array, ell0: jax.Array, folds) -> jax.Arra
     return jnp.concatenate([cand[..., :num_vertices], inf], axis=-1)
 
 
+def pull_candidates_rows(
+    frontier_tab_ext: jax.Array, ell0: jax.Array, folds, num_rows: int
+) -> jax.Array:
+    """Shard-local variant of :func:`pull_candidates`: ``frontier_tab_ext``
+    already carries its trailing INF slot (size = table + 1) and the result
+    is the first ``num_rows`` row-mins (one per owned vertex), with no slot
+    appended.  Broadcasts over leading axes of ``frontier_tab_ext``."""
+    cand = jnp.min(jnp.take(frontier_tab_ext, ell0, axis=-1), axis=-1)
+    for fold in folds:
+        inf = jnp.full(cand.shape[:-1] + (1,), INT32_MAX, dtype=jnp.int32)
+        cand_ext = jnp.concatenate([cand, inf], axis=-1)
+        cand = jnp.min(jnp.take(cand_ext, fold, axis=-1), axis=-1)
+    return cand[..., :num_rows]
+
+
+def pack_frontier_block(bits: jax.Array, num_words: int) -> jax.Array:
+    """bool[..., B] -> uint32[..., B/32], bit-major within the block
+    (element ``e`` -> word ``e % num_words``, bit ``e // num_words``) — the
+    same convention as :func:`bfs_tpu.ops.relay.pack_bits`, kept so pack and
+    unpack are full-width vector ops, never a ``[nw, 32]`` view that TPU
+    (8,128) tiling would pad ~100x."""
+    lead = bits.shape[:-1]
+    b = bits.reshape(*lead, 32, num_words).astype(jnp.uint32)
+    shifts = jnp.arange(32, dtype=jnp.uint32)[:, None]
+    return (b << shifts).sum(axis=-2, dtype=jnp.uint32)
+
+
+def unpack_frontier_blocks(
+    words: jax.Array, num_blocks: int, num_words: int
+) -> jax.Array:
+    """uint32[..., n*B/32] -> bool[..., n*B] for an all-gathered frontier:
+    ``n`` per-shard blocks, each bit-major within itself."""
+    lead = words.shape[:-1]
+    w = words.reshape(*lead, num_blocks, 1, num_words)
+    shifts = jnp.arange(32, dtype=jnp.uint32)[None, :, None]
+    bits = (w >> shifts) & jnp.uint32(1)
+    return bits.reshape(*lead, num_blocks * 32 * num_words) != 0
+
+
 def relax_pull_superstep(
     state: BfsState,
     ell0: jax.Array,

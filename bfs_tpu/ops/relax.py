@@ -27,8 +27,12 @@ from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-INT32_MAX = jnp.int32(2**31 - 1)
+# NumPy (not jnp) scalar: a module-level jnp constant would initialize the
+# JAX backend at import time, locking the platform before callers (tests,
+# dryrun) can pin CPU.  Weak-typed at trace time exactly like jnp.int32.
+INT32_MAX = np.int32(2**31 - 1)
 
 
 class BfsState(NamedTuple):

@@ -47,15 +47,25 @@ class NativeLib:
         if not os.path.exists(self._src):
             return False
         os.makedirs(os.path.dirname(self._so), exist_ok=True)
+        # Compile to a per-process temp path and publish atomically: the
+        # in-process lock does not cover concurrent Python processes (pytest
+        # alongside bench.py), and CDLL-loading a half-written .so would
+        # latch the library unavailable.
+        tmp = f"{self._so}.tmp.{os.getpid()}"
         cmd = [
             os.environ.get("CXX", "g++"),
             "-O3", "-march=native", "-std=c++17", "-fPIC", "-shared",
-            "-o", self._so, self._src,
+            "-o", tmp, self._src,
         ]
         try:
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp, self._so)
             return True
         except (subprocess.SubprocessError, FileNotFoundError, OSError):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
             return False
 
     def load(self) -> ctypes.CDLL | None:

@@ -134,7 +134,10 @@ int64_t sedgewick_edges(const char* path, int64_t num_vertices,
   FILE* f = std::fopen(path, "rb");
   if (!f) return -1;
   std::fseek(f, 0, SEEK_END);
-  const long size = std::ftell(f);
+  // ftell returns long (32-bit on LLP64), capping files at 2 GiB there;
+  // ftello's off_t is 64-bit wherever this builds.  Fail cleanly on error.
+  const int64_t size = static_cast<int64_t>(ftello(f));
+  if (size < 0) { std::fclose(f); return -1; }
   std::fseek(f, 0, SEEK_SET);
   std::vector<char> buf(static_cast<size_t>(size) + 1);
   const size_t rd = std::fread(buf.data(), 1, static_cast<size_t>(size), f);

@@ -50,3 +50,23 @@ def test_out_of_range_sources_rejected(tiny_graph):
 
     with pytest.raises(ValueError):
         bfs_multi(tiny_graph, [0, 6])
+
+
+def test_multi_engines_bit_exact():
+    """pull/push batched modes agree on dist AND parent; relay too when the
+    native router is available (it maps relabeled results back)."""
+    from bfs_tpu.graph.benes import native_available
+    from bfs_tpu.graph.generators import rmat_graph
+
+    g = rmat_graph(8, 6, seed=17)
+    srcs = [0, 9, 33, 100]
+    pull = bfs_multi(g, srcs, engine="pull")
+    push = bfs_multi(g, srcs, engine="push")
+    np.testing.assert_array_equal(pull.dist, push.dist)
+    np.testing.assert_array_equal(pull.parent, push.parent)
+    assert pull.num_levels == push.num_levels
+    if native_available():
+        relay = bfs_multi(g, srcs, engine="relay")
+        np.testing.assert_array_equal(relay.dist, push.dist)
+        np.testing.assert_array_equal(relay.parent, push.parent)
+        assert relay.num_levels == push.num_levels

@@ -19,21 +19,27 @@ def test_virtual_device_count():
     assert len(jax.devices()) == 8
 
 
+@pytest.mark.parametrize("engine", ["push", "pull"])
 @pytest.mark.parametrize("num_shards", [1, 2, 8])
-def test_sharded_matches_single_chip(tiny_graph, num_shards):
+def test_sharded_matches_single_chip(tiny_graph, num_shards, engine):
     mesh = make_mesh(graph=num_shards)
-    res = bfs_sharded(tiny_graph, 0, mesh=mesh, block=8)
+    res = bfs_sharded(
+        tiny_graph, 0, mesh=mesh, engine=engine, block=8, vertex_block_multiple=32
+    )
     single = bfs(tiny_graph, 0)
     np.testing.assert_array_equal(res.dist, single.dist)
     np.testing.assert_array_equal(res.parent, single.parent)
     assert res.num_levels == single.num_levels
 
 
-def test_sharded_random_graphs():
+@pytest.mark.parametrize("engine", ["push", "pull"])
+def test_sharded_random_graphs(engine):
     mesh = make_mesh(graph=8)
     for seed in range(3):
         g = gnm_graph(300, 900, seed=seed)
-        res = bfs_sharded(g, 0, mesh=mesh, block=16)
+        res = bfs_sharded(
+            g, 0, mesh=mesh, engine=engine, block=16, vertex_block_multiple=32
+        )
         d, _ = queue_bfs(g, 0)
         _, p = canonical_bfs(g, 0)
         np.testing.assert_array_equal(res.dist, d)
@@ -45,7 +51,7 @@ def test_sharded_rmat_prebuilt_device_graph():
     mesh = make_mesh(graph=4)
     g = rmat_graph(7, 4, seed=5)
     dg = build_device_graph(g, num_shards=4, block=32)
-    res = bfs_sharded(dg, 0, mesh=mesh)
+    res = bfs_sharded(dg, 0, mesh=mesh, engine="push")
     d, _ = queue_bfs(g, 0)
     np.testing.assert_array_equal(res.dist, d)
 
@@ -54,15 +60,18 @@ def test_sharded_wrong_shard_count_rejected(tiny_graph):
     mesh = make_mesh(graph=4)
     dg = build_device_graph(tiny_graph, num_shards=2, block=8)
     with pytest.raises(ValueError):
-        bfs_sharded(dg, 0, mesh=mesh)
+        bfs_sharded(dg, 0, mesh=mesh, engine="push")
 
 
+@pytest.mark.parametrize("engine", ["push", "pull"])
 @pytest.mark.parametrize("batch,graph_shards", [(1, 8), (2, 4), (4, 2), (8, 1)])
-def test_sharded_multi_source_2d_mesh(batch, graph_shards):
+def test_sharded_multi_source_2d_mesh(batch, graph_shards, engine):
     g = gnm_graph(200, 600, seed=9)
     mesh = make_mesh(graph=graph_shards, batch=batch)
     sources = list(range(8))  # divisible by every batch size used here
-    res = bfs_sharded_multi(g, sources, mesh=mesh, block=16)
+    res = bfs_sharded_multi(
+        g, sources, mesh=mesh, engine=engine, block=16, vertex_block_multiple=32
+    )
     ref = bfs_multi(g, sources)
     np.testing.assert_array_equal(res.dist, ref.dist)
     np.testing.assert_array_equal(res.parent, ref.parent)
