@@ -1,0 +1,192 @@
+"""Stage-level timing of the relay superstep on the real TPU.
+
+Loads the cached relay layout for a bench config and times each phase of
+relay_candidates in isolation (pack/unpack, vperm route, class broadcast,
+big Beneš route, class row-min) plus the fused whole, to locate the gap
+between the measured superstep cost and the HBM-bandwidth floor.
+
+Usage: BENCH_SCALE=24 BENCH_EDGE_FACTOR=8 python tools/microbench_relay_stages.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bfs_tpu.bench import _generator_backend, load_or_build, load_or_build_relay
+from bfs_tpu.ops.relay import (
+    INT32_MAX,
+    apply_benes,
+    pack_bits,
+    relay_candidates,
+    unpack_bits,
+)
+
+
+def timeit(name, fn, *args, repeats=5):
+    fn_j = jax.jit(fn)
+    out = jax.block_until_ready(fn_j(*args))  # compile
+    times = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        out = fn_j(*args)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    t = float(np.median(times))
+    print(f"{name:35s} {t * 1e3:9.2f} ms")
+    return t
+
+
+def main():
+    scale = int(os.environ.get("BENCH_SCALE", "24"))
+    ef = int(os.environ.get("BENCH_EDGE_FACTOR", "8"))
+    backend = _generator_backend()
+    key = f"{backend}_s{scale}_ef{ef}_seed42_block8192"
+    dg, source = load_or_build(scale, ef, 42, 8 * 1024, backend)
+    rg, _ = load_or_build_relay(dg, key)
+    v = rg.num_vertices
+    print(f"V={v} E={rg.num_edges} vperm={rg.vperm_size} net={rg.net_size} "
+          f"m2={rg.m2} out_classes={len(rg.out_classes)} in_classes={len(rg.in_classes)}")
+
+    vperm_masks = jnp.asarray(rg.vperm_masks)
+    net_masks = jnp.asarray(rg.net_masks)
+    src_parts = tuple(
+        jnp.asarray(
+            rg.src_l1[cs.sa : cs.sb].reshape(
+                (cs.count, cs.width) if cs.vertex_major else (cs.width, cs.count)
+            )
+        )
+        for cs in rg.in_classes
+    )
+    rng = np.random.default_rng(0)
+    frontier = jnp.asarray(rng.random(v + 1) < 0.3)
+
+    # Whole candidate pipeline
+    def whole(frontier):
+        return relay_candidates(
+            frontier, num_vertices=v, vperm_masks=vperm_masks,
+            vperm_size=rg.vperm_size, out_classes=rg.out_classes,
+            net_masks=net_masks, net_size=rg.net_size, m2=rg.m2,
+            in_classes=rg.in_classes, src_l1_parts=src_parts,
+        )
+
+    timeit("relay_candidates (whole)", whole, frontier)
+
+    # Phase 1: frontier -> out-order bits (vperm route)
+    def phase_vperm(frontier):
+        fbits = frontier[:v].astype(jnp.uint8)
+        fbits = jnp.concatenate(
+            [fbits, jnp.zeros(rg.vperm_size - v, dtype=jnp.uint8)]
+        )
+        return unpack_bits(
+            apply_benes(pack_bits(fbits, rg.vperm_size), vperm_masks, rg.vperm_size),
+            rg.vperm_size,
+        )
+
+    fout = jax.jit(phase_vperm)(frontier)
+    timeit("  vperm (pack+route+unpack)", phase_vperm, frontier)
+
+    # Phase 2: class broadcast -> l2 bits
+    def phase_broadcast(fout):
+        parts = []
+        for cs in rg.out_classes:
+            blk = fout[cs.va : cs.vb]
+            if cs.vertex_major:
+                parts.append(
+                    jnp.broadcast_to(blk[:, None], (cs.count, cs.width)).reshape(-1)
+                )
+            else:
+                parts.append(
+                    jnp.broadcast_to(blk[None, :], (cs.width, cs.count)).reshape(-1)
+                )
+        parts.append(jnp.zeros(rg.net_size - rg.m2, dtype=jnp.uint8))
+        return jnp.concatenate(parts)
+
+    l2 = jax.jit(phase_broadcast)(fout)
+    timeit("  broadcast (l2 build)", phase_broadcast, fout)
+
+    # Phase 3: big network
+    def phase_pack(l2):
+        return pack_bits(l2, rg.net_size)
+
+    l2w = jax.jit(phase_pack)(l2)
+    timeit("  pack_bits(l2)", phase_pack, l2)
+
+    def phase_net(l2w):
+        return apply_benes(l2w, net_masks, rg.net_size)
+
+    l1w = jax.jit(phase_net)(l2w)
+    timeit("  apply_benes(net)", phase_net, l2w)
+
+    def phase_unpack(l1w):
+        return unpack_bits(l1w, rg.net_size)
+
+    l1bits = jax.jit(phase_unpack)(l1w)
+    timeit("  unpack_bits(l1)", phase_unpack, l1w)
+
+    # Phase 4: class row-min
+    def phase_rowmin(l1bits):
+        cands = []
+        for cs, tab in zip(rg.in_classes, src_parts):
+            seg = l1bits[cs.sa : cs.sb]
+            if cs.vertex_major:
+                bits = seg.reshape(cs.count, cs.width)
+                cands.append(jnp.min(jnp.where(bits != 0, tab, INT32_MAX), axis=1))
+            else:
+                bits = seg.reshape(cs.width, cs.count)
+                cands.append(jnp.min(jnp.where(bits != 0, tab, INT32_MAX), axis=0))
+        return jnp.concatenate(cands)
+
+    timeit("  rowmin", phase_rowmin, l1bits)
+
+    # Single-stage butterfly costs at the three distance regimes
+    nw = rg.net_size // 32
+    words = l1w
+    m = net_masks[0]
+
+    def bf_bit(words):  # d >= nw: bit-position butterfly
+        sh = jnp.uint32(4)
+        t = (words ^ (words >> sh)) & m
+        return words ^ t ^ (t << sh)
+
+    timeit("  one bitpos stage (elementwise)", bf_bit, words)
+
+    r = nw // 128
+    def bf_lane(words):  # d < 128 lane roll
+        x = words.reshape(r, 128)
+        mm = m.reshape(r, 128)
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, 128), 1)
+        has = (lane & 8) != 0
+        partner = jnp.where(has, jnp.roll(x, 8, axis=1), jnp.roll(x, -8, axis=1))
+        mb = jnp.where(has, jnp.roll(mm, 8, axis=1), mm)
+        return (x ^ ((x ^ partner) & mb)).reshape(-1)
+
+    timeit("  one lane-roll stage", bf_lane, words)
+
+    def bf_row(words):  # 128 <= d < nw: row-block roll
+        x = words.reshape(r, 128)
+        mm = m.reshape(r, 128)
+        row = jax.lax.broadcasted_iota(jnp.int32, (r, 1), 0)
+        has = (row & 64) != 0
+        partner = jnp.where(has, jnp.roll(x, 64, axis=0), jnp.roll(x, -64, axis=0))
+        mb = jnp.where(has, jnp.roll(mm, 64, axis=0), mm)
+        return (x ^ ((x ^ partner) & mb)).reshape(-1)
+
+    timeit("  one row-roll stage", bf_row, words)
+
+    # Bandwidth reference: same-size elementwise xor
+    big = jnp.asarray(rng.integers(0, 2**32, size=nw, dtype=np.uint32))
+
+    def xor2(a, b):
+        return a ^ b
+
+    timeit("  ref: xor of two uint32[nw]", xor2, big, words)
+
+
+if __name__ == "__main__":
+    main()
