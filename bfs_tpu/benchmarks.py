@@ -160,8 +160,9 @@ def run_cell(spec: dict) -> dict:
                 dist, _ = queue_bfs(graph, source)
             times.append(time.perf_counter() - t0)
         sec = float(np.median(times))
+        reached = dist[dist != np.iinfo(np.int32).max]
         return {**out, "seconds": sec, "teps": _teps(dg, dist, sec),
-                "supersteps": int(dist.max(initial=0))}
+                "supersteps": int(reached.max(initial=0))}
 
     import jax
 
@@ -205,22 +206,24 @@ def run_cell(spec: dict) -> dict:
         sec = float(np.median(times))
         dist = np.asarray(state.dist[: dg.num_vertices])
         if mode == "relay":
-            dist = dist[np.asarray(__import__("numpy").asarray(0))] if False else dist
             # relay state lives in relabeled space; distances permute back
-            rg_old2new = eng.relay_graph.old2new
-            dist = dist[rg_old2new]
+            dist = dist[eng.relay_graph.old2new]
         return {**out, "seconds": sec, "teps": _teps(dg, dist, sec),
                 "supersteps": levels}
 
     if mode.startswith("sharded-pull-"):
         shards = int(mode.rsplit("-", 1)[1])
+        from .graph.ell import build_sharded_pull_graph
         from .parallel.sharded import bfs_sharded, make_mesh
 
         if len(jax.devices()) < shards:
             return {**out, "error": f"need {shards} devices, have {len(jax.devices())}"}
         mesh = make_mesh(graph=shards, batch=1)
-        run = lambda: bfs_sharded(dg, source, mesh=mesh, engine="pull")  # noqa: E731
-        res = run()  # includes layout build + compile (excluded below)
+        # Layout built ONCE outside the timed repeats (the methodology
+        # excludes construction; only the compiled traversal is measured).
+        spg = build_sharded_pull_graph(dg, shards)
+        run = lambda: bfs_sharded(spg, source, mesh=mesh, engine="pull")  # noqa: E731
+        res = run()  # warm-up/compile
         times = []
         for _ in range(repeats):
             t0 = time.perf_counter()
@@ -237,11 +240,28 @@ def run_cell(spec: dict) -> dict:
 
         rng = np.random.default_rng(12345)
         sources = rng.choice(dg.num_vertices, size=num_sources, replace=False).astype(np.int32)
-        res = bfs_multi(dg, sources, engine=engine)  # warm-up/compile
+        # Prebuild the engine layout once (cached on disk for the big
+        # graphs) so repeats time only the compiled batched traversal.
+        key = _graph_key(dataset, scale)
+        if engine == "relay":
+            from .bench import load_or_build_relay
+            from .models.bfs import RelayEngine
+
+            rg, _ = load_or_build_relay(dg, key)
+            eng = RelayEngine(rg)
+            run = lambda: eng.run_multi(sources)  # noqa: E731
+        elif engine == "pull":
+            from .bench import load_or_build_pull
+
+            pg = load_or_build_pull(dg, key)
+            run = lambda: bfs_multi(pg, sources, engine="pull")  # noqa: E731
+        else:
+            run = lambda: bfs_multi(dg, sources, engine=engine)  # noqa: E731
+        res = run()  # warm-up/compile
         times = []
         for _ in range(repeats):
             t0 = time.perf_counter()
-            res = bfs_multi(dg, sources, engine=engine)
+            res = run()
             times.append(time.perf_counter() - t0)
         sec = float(np.median(times))
         from .graph.csr import unpad_edges
@@ -265,6 +285,11 @@ def _child_env(virtual_devices: int | None) -> dict:
     env = dict(os.environ)
     if virtual_devices:
         env["JAX_PLATFORMS"] = "cpu"
+        # The axon TPU plugin registers itself from sitecustomize whenever
+        # PALLAS_AXON_POOL_IPS is set and force-pins jax_platforms="axon,cpu",
+        # overriding the env var — clear it so the child really gets the
+        # virtual CPU platform.
+        env.pop("PALLAS_AXON_POOL_IPS", None)
         flags = env.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
             env["XLA_FLAGS"] = (

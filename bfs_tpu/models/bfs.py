@@ -159,6 +159,41 @@ def _relay_fused_program(
 
 
 @functools.lru_cache(maxsize=16)
+def _relay_step_program(
+    num_vertices: int,
+    vperm_size: int,
+    out_classes: tuple,
+    net_size: int,
+    m2: int,
+    in_classes: tuple,
+):
+    """One jitted relay superstep (the stepped / observable path): same math
+    as one iteration of :func:`_relay_fused_program`, with the layout tensors
+    as arguments so they are not baked into the program as constants."""
+    from ..ops.relay import relay_candidates, relay_superstep
+
+    @jax.jit
+    def step(state, vperm_masks, net_masks, src_l1_parts):
+        def cand_fn(frontier):
+            return relay_candidates(
+                frontier,
+                num_vertices=num_vertices,
+                vperm_masks=vperm_masks,
+                vperm_size=vperm_size,
+                out_classes=out_classes,
+                net_masks=net_masks,
+                net_size=net_size,
+                m2=m2,
+                in_classes=in_classes,
+                src_l1_parts=src_l1_parts,
+            )
+
+        return relay_superstep(state, cand_fn)
+
+    return step
+
+
+@functools.lru_cache(maxsize=16)
 def _relay_multi_fused_program(
     num_vertices: int,
     vperm_size: int,
@@ -245,6 +280,19 @@ class RelayEngine:
 
     def _fused(self, source_new, max_levels):
         return self._raw_fused(source_new, *self._tensors, max_levels=max_levels)
+
+    def step(self, state: BfsState) -> BfsState:
+        """One compiled relay superstep (state in RELABELED space)."""
+        rg = self.relay_graph
+        step = _relay_step_program(
+            rg.num_vertices,
+            rg.vperm_size,
+            rg.out_classes,
+            rg.net_size,
+            rg.m2,
+            rg.in_classes,
+        )
+        return step(state, *self._tensors)
 
     def run(self, source: int = 0, *, max_levels: int | None = None) -> BfsResult:
         rg = self.relay_graph
@@ -361,29 +409,69 @@ def bfs(
 
 
 class SuperstepRunner:
-    """Stepped execution: one compiled superstep per call.
+    """Stepped execution: one compiled superstep per call, any engine.
 
     This is the observable path — per-superstep wall time (Stopwatch parity,
     BfsSpark.java:59,63,111-112), frontier sizes, state dumps and
     checkpoint/resume hooks — while each superstep itself stays a single
-    fused XLA computation.
+    fused XLA computation.  ``engine`` selects the same layouts as
+    :func:`bfs`: ``'push'`` (default, the reference's map/shuffle/reduce
+    analogue), ``'pull'`` (ELL), or ``'relay'`` (the TPU-fast Beneš layout).
+
+    For the relay engine the on-device state lives in the RELABELED vertex
+    space; :meth:`to_original` maps any state's ``(dist, parent, frontier)``
+    into original-id host arrays for dumps/checkpoints, and is the identity
+    for push/pull.  Frontier sizes and levels are permutation-invariant.
     """
 
-    def __init__(self, graph: Graph | DeviceGraph, *, block: int = 1024):
-        self.device_graph = (
-            graph if isinstance(graph, DeviceGraph) else build_device_graph(graph, block=block)
-        )
-        if self.device_graph.num_shards != 1:
-            raise ValueError("sharded DeviceGraph requires the parallel engine")
-        self._src = jnp.asarray(self.device_graph.src)
-        self._dst = jnp.asarray(self.device_graph.dst)
-        self._step = jax.jit(lambda s: relax_superstep(s, self._src, self._dst))
-        self._init = jax.jit(
-            functools.partial(init_state, self.device_graph.num_vertices)
-        )
+    def __init__(
+        self,
+        graph: Graph | DeviceGraph | PullGraph,
+        *,
+        engine: str = "push",
+        block: int = 1024,
+    ):
+        from ..graph.relay import RelayGraph
+
+        self.engine = engine
+        self.device_graph = None
+        self._old2new = None  # relabeling (relay only)
+        if engine == "push":
+            if isinstance(graph, (PullGraph, RelayGraph)):
+                raise ValueError("engine='push' needs a Graph or DeviceGraph")
+            self.device_graph = (
+                graph
+                if isinstance(graph, DeviceGraph)
+                else build_device_graph(graph, block=block)
+            )
+            if self.device_graph.num_shards != 1:
+                raise ValueError("sharded DeviceGraph requires the parallel engine")
+            self.num_vertices = self.device_graph.num_vertices
+            src = jnp.asarray(self.device_graph.src)
+            dst = jnp.asarray(self.device_graph.dst)
+            self._step = jax.jit(lambda s: relax_superstep(s, src, dst))
+        elif engine == "pull":
+            pg = graph if isinstance(graph, PullGraph) else build_pull_graph(graph)
+            self.num_vertices = pg.num_vertices
+            ell0 = jnp.asarray(pg.ell0)
+            folds = tuple(jnp.asarray(f) for f in pg.folds)
+            self._step = jax.jit(lambda s: relax_pull_superstep(s, ell0, folds))
+        elif engine == "relay":
+            eng = RelayEngine(graph)
+            self._relay = eng
+            self.num_vertices = eng.relay_graph.num_vertices
+            self._old2new = eng.relay_graph.old2new
+            self._step = eng.step
+        else:
+            raise ValueError(
+                f"unknown engine {engine!r}; use 'push', 'pull' or 'relay'"
+            )
+        self._init = jax.jit(functools.partial(init_state, self.num_vertices))
 
     def init(self, source: int = 0) -> BfsState:
-        check_sources(self.device_graph.num_vertices, source)
+        check_sources(self.num_vertices, source)
+        if self._old2new is not None:
+            source = int(self._old2new[source])
         return self._init(jnp.int32(source))
 
     def step(self, state: BfsState) -> BfsState:
@@ -392,20 +480,33 @@ class SuperstepRunner:
     def frontier_size(self, state: BfsState) -> int:
         return int(frontier_size(state))
 
+    def to_original(self, state: BfsState, *, source: int | None = None):
+        """Host ``(dist, parent, frontier)`` in ORIGINAL vertex-id space.
+
+        ``source`` (original id) fixes the relay engine's self-parent entry,
+        which init writes in relabeled space."""
+        state = jax.device_get(state)
+        v = self.num_vertices
+        dist = np.asarray(state.dist[:v])
+        parent = np.asarray(state.parent[:v])
+        frontier = np.asarray(state.frontier[:v])
+        if self._old2new is not None:
+            dist = dist[self._old2new]
+            parent = parent[self._old2new]
+            frontier = frontier[self._old2new]
+            if source is not None:
+                parent[source] = source
+        return dist, parent, frontier
+
     def run(self, source: int = 0, *, max_levels: int | None = None, observer=None):
         """Run to termination; ``observer(level, state)`` is called after each
         superstep (metrics/dump/checkpoint hook)."""
         state = self.init(source)
-        limit = max_levels if max_levels is not None else self.device_graph.num_vertices
+        limit = max_levels if max_levels is not None else self.num_vertices
         while bool(state.changed) and int(state.level) < limit:
             state = self.step(state)
             if observer is not None:
                 observer(int(state.level), state)
-        v = self.device_graph.num_vertices
         num_levels = int(state.level)
-        state = jax.device_get(state)
-        return BfsResult(
-            dist=np.asarray(state.dist[:v]),
-            parent=np.asarray(state.parent[:v]),
-            num_levels=num_levels,
-        )
+        dist, parent, _ = self.to_original(state, source=source)
+        return BfsResult(dist=dist, parent=parent, num_levels=num_levels)

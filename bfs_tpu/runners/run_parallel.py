@@ -36,15 +36,16 @@ def run_problem_file(
     path: str,
     *,
     source: int = 0,
+    engine: str = "push",
     dump: bool = False,
     checkpoint_every: int = 0,
     work_dir: str = ".",
 ) -> RunMetrics:
     """Stepped run over one problem file with full observability."""
-    logger.info("Processing problem file: %s", path)
+    logger.info("Processing problem file: %s (engine=%s)", path, engine)
     graph = read_sedgewick(path)
     metrics = RunMetrics(num_vertices=graph.num_vertices, num_edges=graph.num_edges)
-    runner = SuperstepRunner(graph)
+    runner = SuperstepRunner(graph, engine=engine)
     base = os.path.join(work_dir, os.path.basename(path))
 
     if dump:
@@ -61,11 +62,10 @@ def run_problem_file(
         level = int(state.level)
         metrics.record(level, runner.frontier_size(state), sw.elapsed_s)
         if dump:
+            dist, parent, frontier = runner.to_original(state, source=source)
             with open(f"{base}_{level}", "w") as f:
                 f.write(
-                    serialize_state(
-                        graph, state.dist, state.parent, state.frontier, source=source
-                    )
+                    serialize_state(graph, dist, parent, frontier, source=source)
                 )
         if checkpoint_every and level % checkpoint_every == 0:
             save_checkpoint(f"{base}.ckpt_{level}.npz", state)
@@ -79,10 +79,7 @@ def run_problem_file(
         metrics.total_seconds * 1e3,
         metrics.teps() / 1e6,
     )
-    import numpy as np
-
-    dist = np.asarray(state.dist[: graph.num_vertices])
-    parent = np.asarray(state.parent[: graph.num_vertices])
+    dist, parent, _ = runner.to_original(state, source=source)
     violations = check(graph, dist, parent, source)
     if violations:
         for v in violations[:10]:
@@ -95,6 +92,11 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("config", nargs="?", default="service.properties")
     ap.add_argument("--fused", action="store_true", help="one while_loop, no per-superstep observability")
+    ap.add_argument(
+        "--engine", default=None, choices=("push", "pull", "relay"),
+        help="superstep layout; default: 'pull' for --fused (bfs()'s default),"
+        " 'push' for the stepped mode (historical default)",
+    )
     ap.add_argument("--sharded", action="store_true", help="use the mesh-sharded engine")
     ap.add_argument("--mesh-graph", type=int, default=None)
     ap.add_argument("--mesh-batch", type=int, default=None)
@@ -123,7 +125,7 @@ def main(argv=None):
                 mesh = make_mesh(graph=mesh_graph, batch=mesh_batch)
                 result = bfs_sharded(graph, source, mesh=mesh)
             else:
-                result = bfs(graph, source)
+                result = bfs(graph, source, engine=args.engine or "pull")
             sw.stop()
             logger.info(
                 "%s: %d supersteps in %s (fused, includes compile)",
@@ -133,6 +135,7 @@ def main(argv=None):
             run_problem_file(
                 path,
                 source=source,
+                engine=args.engine or "push",
                 dump=args.dump or cfg.dump_supersteps,
                 checkpoint_every=cfg.checkpoint_every,
                 work_dir=cfg.work_dir,

@@ -87,6 +87,47 @@ def test_stepped_observer_frontier_sizes(tiny_graph):
     assert sizes == [3, 2, 0]
 
 
+@pytest.mark.parametrize("engine", ["pull", "relay"])
+def test_stepped_fast_engines(tiny_graph, engine):
+    """Observability parity for the TPU-fast layouts: stepped == fused,
+    per-superstep frontier sizes visible, dumps in original-id space."""
+    if engine == "relay":
+        from bfs_tpu.graph.benes import native_available
+
+        if not native_available():
+            pytest.skip("native benes router unavailable")
+    runner = SuperstepRunner(tiny_graph, engine=engine)
+    sizes = []
+    stepped = runner.run(0, observer=lambda lvl, s: sizes.append(runner.frontier_size(s)))
+    fused = bfs(tiny_graph, 0)
+    np.testing.assert_array_equal(stepped.dist, fused.dist)
+    np.testing.assert_array_equal(stepped.parent, fused.parent)
+    assert stepped.num_levels == fused.num_levels
+    assert sizes == [3, 2, 0]  # paper Tables 3-6 progression
+
+
+@pytest.mark.parametrize("engine", ["pull", "relay"])
+def test_stepped_to_original_midrun(engine):
+    """to_original maps mid-run state back to original ids (relay relabels)."""
+    if engine == "relay":
+        from bfs_tpu.graph.benes import native_available
+
+        if not native_available():
+            pytest.skip("native benes router unavailable")
+    g = rmat_graph(7, 8, seed=5)
+    runner = SuperstepRunner(g, engine=engine)
+    state = runner.init(0)
+    state = runner.step(state)
+    dist, parent, frontier = runner.to_original(state, source=0)
+    d, _ = queue_bfs(g, 0)
+    lvl1 = d == 1
+    np.testing.assert_array_equal(dist == 1, lvl1)
+    np.testing.assert_array_equal(frontier.astype(bool)[: g.num_vertices], lvl1)
+    assert dist[0] == 0 and parent[0] == 0
+    final = runner.run(0)
+    assert_matches_oracle(g, final, 0)
+
+
 def test_self_loops_and_multi_edges():
     g = Graph.from_undirected_edges(3, np.array([[0, 0], [0, 1], [0, 1], [1, 2]]))
     assert_matches_oracle(g, bfs(g, 0))
