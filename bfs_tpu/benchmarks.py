@@ -46,6 +46,9 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SHARD_COUNTS = (1, 2, 8)
 ENGINES = ("push", "pull", "relay")
 LARGEG_V, LARGEG_E = 1_000_000, 7_586_063  # paper §1.5 / service.properties:9
+#: soc-Pokec's exact shape (SNAP): BASELINE.json config 4, synthesized with
+#: R-MAT skew and shipped through the SNAP text format end-to-end.
+POKEC_V, POKEC_E = 1_632_803, 30_622_564
 
 #: Reference Table 7 (docs/BigData_Project.pdf §1.5), normalized to seconds;
 #: None = OOM.  Keyed (dataset, column) for the side-by-side report.
@@ -103,6 +106,47 @@ def _load_dataset(name: str, scale: int):
 
         dg = _cached(f"largeG_gnm_v{LARGEG_V}_e{LARGEG_E}_seed1", unpack, build)
         return None, dg, 0, f"largeG-shape ({LARGEG_V} V)"
+    if name == "pokec":
+        from .bench import _CACHE_DIR
+
+        def unpack(z):
+            return (
+                DeviceGraph(
+                    num_vertices=int(z["num_vertices"]),
+                    num_edges=int(z["num_edges"]),
+                    src=z["src"],
+                    dst=z["dst"],
+                ),
+                int(z["source"]),
+            )
+
+        def build():
+            from .graph.generators import snap_shape_edges
+            from .graph.io import read_snap_edge_list, write_snap_edge_list
+
+            # Full SNAP ingest path, end-to-end: synthesize the directed
+            # edge list at soc-Pokec's exact shape, WRITE it as a real SNAP
+            # text file, then parse it back through the public reader.
+            txt = os.path.join(_CACHE_DIR, "soc-pokec-shape.txt")
+            if not os.path.exists(txt):
+                pairs = snap_shape_edges(POKEC_V, POKEC_E, seed=4)
+                tmp = f"{txt}.tmp.{os.getpid()}"
+                write_snap_edge_list(
+                    pairs, tmp, name="soc-pokec-shape (synthetic, R-MAT skew)",
+                    num_vertices=POKEC_V,
+                )
+                os.replace(tmp, txt)
+            g = read_snap_edge_list(txt, num_vertices=POKEC_V)
+            dg = build_device_graph(g, block=8 * 1024)
+            degrees = np.bincount(g.src, minlength=g.num_vertices)
+            source = int(np.argmax(degrees))
+            return (dg, source), dict(
+                num_vertices=dg.num_vertices, num_edges=dg.num_edges,
+                src=dg.src, dst=dg.dst, source=source,
+            )
+
+        (dg, source) = _cached(f"pokec_snap_v{POKEC_V}_e{POKEC_E}_seed4", unpack, build)
+        return None, dg, source, f"soc-Pokec-shape SNAP ({POKEC_V} V)"
     if name == "rmat":
         backend = _generator_backend()
         dg, source = load_or_build(scale, 16, 42, 8 * 1024, backend)
@@ -346,7 +390,7 @@ def _cell_str(r: dict) -> str:
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--cell", help="JSON cell spec (child-process mode)")
-    ap.add_argument("--datasets", default="tinyCG,randomG,largeG,rmat")
+    ap.add_argument("--datasets", default="tinyCG,randomG,largeG,pokec,rmat")
     ap.add_argument("--repeats", type=int, default=3)
     ap.add_argument("--timeout", type=int, default=3600)
     ap.add_argument("--skip-multi", action="store_true")

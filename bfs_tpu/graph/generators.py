@@ -53,6 +53,20 @@ def rmat_graph(scale: int, edge_factor: int = 16, **kwargs) -> Graph:
     return Graph.from_undirected_edges(1 << scale, edges.astype(np.int32))
 
 
+def snap_shape_edges(
+    num_vertices: int, num_edges: int, *, seed: int = 0
+) -> np.ndarray:
+    """R-MAT-skewed directed edge list with an ARBITRARY (non-power-of-two)
+    vertex count — the shape of real SNAP social graphs (BASELINE.json
+    config 4: LiveJournal / soc-Pokec).  Edges are drawn in the enclosing
+    power-of-two id space for the heavy-tailed degree distribution, then
+    folded into ``[0, V)``; label permutation spreads the hubs."""
+    scale = max(int(num_vertices - 1).bit_length(), 1)
+    per = num_edges // (1 << scale) + 1  # per * 2^scale >= num_edges always
+    edges = rmat_edges(scale, per, seed=seed)[:num_edges]
+    return edges % num_vertices
+
+
 def gnm_graph(num_vertices: int, num_edges: int, *, seed: int = 0) -> Graph:
     """Uniform random undirected multigraph with ``num_edges`` edges."""
     rng = np.random.default_rng(seed)

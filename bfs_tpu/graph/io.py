@@ -88,16 +88,17 @@ def read_snap_edge_list(
 
     Vertex ids are used as-is; ``num_vertices`` defaults to max id + 1.
     ``undirected=True`` bi-directs edges like the Sedgewick loader.
+
+    Real SNAP graphs run to tens of millions of lines (soc-LiveJournal: 69M),
+    so the hot path is NumPy's C tokenizer (``np.loadtxt``, ~7M lines/s) —
+    not a per-line Python loop.
     """
-    rows = []
-    with open(path, "r") as f:
-        for line in f:
-            line = line.strip()
-            if not line or line.startswith("#") or line.startswith("%"):
-                continue
-            parts = line.split()
-            rows.append((int(parts[0]), int(parts[1])))
-    pairs = np.asarray(rows, dtype=np.int64).reshape(-1, 2)
+    data = np.loadtxt(path, dtype=np.int64, comments=["#", "%"], ndmin=2)
+    if data.size and data.shape[1] != 2:
+        raise ValueError(
+            f"expected u-v edge lines, got {data.shape[1]} columns"
+        )
+    pairs = data.reshape(-1, 2)
     v = int(pairs.max()) + 1 if pairs.size else 0
     if num_vertices is not None:
         v = max(v, num_vertices)
@@ -105,3 +106,24 @@ def read_snap_edge_list(
     if undirected:
         return Graph.from_undirected_edges(v, pairs)
     return Graph.from_directed_edges(v, pairs)
+
+
+def write_snap_edge_list(
+    pairs: np.ndarray,
+    path: str | os.PathLike,
+    *,
+    name: str = "synthetic",
+    num_vertices: int | None = None,
+) -> None:
+    """Write a directed edge list in SNAP's format: ``# Directed graph`` -style
+    comment header, then tab-separated ``u\\tv`` lines."""
+    pairs = np.asarray(pairs)
+    header = (
+        f"# Directed graph (each unordered pair of nodes is saved once): {name}\n"
+        f"# Nodes: {num_vertices if num_vertices is not None else int(pairs.max()) + 1}"
+        f" Edges: {pairs.shape[0]}\n"
+        "# FromNodeId\tToNodeId\n"
+    )
+    with open(path, "w") as f:
+        f.write(header)
+        np.savetxt(f, pairs, fmt="%d", delimiter="\t")

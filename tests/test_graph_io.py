@@ -59,6 +59,40 @@ def test_snap_reader(tmp_path):
     assert g.num_edges == 4  # bi-directed
 
 
+def test_snap_round_trip(tmp_path):
+    """SNAP writer -> reader round-trip with real-format comment headers,
+    including the directed and num_vertices-override paths."""
+    from bfs_tpu.graph.io import write_snap_edge_list
+
+    rng = np.random.default_rng(7)
+    pairs = rng.integers(0, 50, size=(200, 2), dtype=np.int64)
+    p = tmp_path / "soc-test.txt"
+    write_snap_edge_list(pairs, p, name="soc-test", num_vertices=60)
+    text = p.read_text()
+    assert text.startswith("#") and "Nodes: 60 Edges: 200" in text
+    g = read_snap_edge_list(p, undirected=False, num_vertices=60)
+    assert g.num_vertices == 60 and g.num_edges == 200
+    got = np.stack([g.src, g.dst], 1)
+    np.testing.assert_array_equal(
+        got[np.lexsort(got.T)], pairs[np.lexsort(pairs.T)].astype(np.int32)
+    )
+
+
+def test_snap_shape_generator_matches_target_shape():
+    """snap_shape_edges hits an arbitrary (non-pow2) V/E shape with R-MAT
+    degree skew (BASELINE.json config 4 synthesis path)."""
+    from bfs_tpu.graph.generators import snap_shape_edges
+
+    v, e = 1000, 12345
+    pairs = snap_shape_edges(v, e, seed=4)
+    assert pairs.shape == (e, 2)
+    assert pairs.min() >= 0 and pairs.max() < v
+    deg = np.bincount(pairs[:, 0], minlength=v)
+    # Heavy tail: the top-1% hubs carry well more than a uniform share.
+    top = np.sort(deg)[-v // 100 :].sum()
+    assert top > 3 * e * 0.01
+
+
 def test_device_graph_padding(tiny_graph):
     dg = build_device_graph(tiny_graph, block=64)
     assert dg.padded_edges % 64 == 0
