@@ -150,6 +150,242 @@ def _rank_within_groups(group_sorted: np.ndarray) -> np.ndarray:
     return np.arange(n, dtype=np.int64) - start_of
 
 
+@dataclass(frozen=True)
+class ShardedRelayGraph:
+    """Per-shard relay layouts with ONE unified class structure.
+
+    The multi-device TPU-fast layout: shard ``s`` owns a contiguous block of
+    the (globally relabeled) vertex space and holds the relay pipeline for
+    exactly its owned destinations — its own vperm network, degree-class
+    broadcast, Beneš edge net and src-id tables — while all shards share the
+    SAME static shapes (class slices, network sizes), so one `shard_map`
+    program runs everywhere and only the mask/table DATA differs per device
+    (stacked on axis 0).  The per-superstep exchange is the bit-packed
+    frontier all-gather of the sharded pull engine (1 bit/vertex over ICI);
+    each shard's vperm network absorbs the packed all-gather layout, so the
+    gathered words feed the butterflies directly with no unpack/repack.
+
+    Unification pads each shard's degree classes to the max count over
+    shards (dummy positions are routed guaranteed-zero inputs) and the
+    owned-vertex block to a common multiple of 32.  ``new2old`` is -1 at
+    dummy vertex slots.
+    """
+
+    num_vertices: int  # real V
+    num_edges: int  # directed edges across all shards
+    num_shards: int
+    block: int  # owned vertex slots per shard (multiple of 32)
+    new2old: np.ndarray  # int32[n*block]; -1 at dummies
+    old2new: np.ndarray  # int32[V]
+    vperm_masks: np.ndarray  # uint32[n, Sv, Vp/32]
+    vperm_size: int
+    out_classes: tuple[ClassSlice, ...]  # unified, over out-order positions
+    net_masks: np.ndarray  # uint32[n, S, N/32]
+    net_size: int
+    m2: int
+    in_classes: tuple[ClassSlice, ...]  # unified, over local [0, block)
+    src_l1: np.ndarray  # int32[n, M1]; ORIGINAL src ids, INF padding
+
+
+def _unified_class_slices(width_count_pairs) -> tuple[list[ClassSlice], int]:
+    """Slices for a (width, count) list sorted by width; returns (slices,
+    total positions)."""
+    slices = []
+    slot = 0
+    va = 0
+    for w, c in width_count_pairs:
+        sb = slot + c * w
+        slices.append(
+            ClassSlice(width=int(w), va=int(va), vb=int(va + c),
+                       sa=int(slot), sb=int(sb), vertex_major=w >= c)
+        )
+        slot = sb
+        va += c
+    return slices, va
+
+
+def build_sharded_relay_graph(
+    graph: Graph | DeviceGraph, num_shards: int
+) -> ShardedRelayGraph:
+    """Build per-shard relay layouts with a unified static structure.
+
+    Vertices are partitioned into ``num_shards`` contiguous original-id
+    ranges (the sharded pull engine's ownership rule), then relabeled within
+    each shard so in-degree classes are contiguous; the global new-id space
+    is the concatenation of shard blocks.
+    """
+    if not benes.native_available():
+        raise RuntimeError("relay engine requires the native benes router")
+    if num_shards < 1:
+        raise ValueError("num_shards must be >= 1")
+    from .csr import _sorted_by_dst, unpad_edges
+
+    if isinstance(graph, DeviceGraph):
+        src, dst = _sorted_by_dst(*unpad_edges(graph))
+    else:
+        src, dst = _sorted_by_dst(graph.src, graph.dst)
+    src = src.astype(np.int64)
+    dst = dst.astype(np.int64)
+    v = graph.num_vertices
+    e = int(src.shape[0])
+    n = num_shards
+    vblock = max((v + n - 1) // n, 1)
+
+    indeg = np.bincount(dst, minlength=v)
+    in_w = _next_pow2(indeg)  # >= 1; zero-indeg vertices get one INF slot
+
+    # ---- unified in-classes: per-width counts maxed over shards ----------
+    shard_of_old = np.minimum(np.arange(v, dtype=np.int64) // vblock, n - 1)
+    widths_all = np.unique(in_w)
+    cin = {}
+    for w in widths_all.tolist():
+        per_shard = np.bincount(shard_of_old[in_w == w], minlength=n)
+        cin[w] = int(per_shard.max())
+    block0 = sum(cin.values())
+    pad = (-block0) % 32
+    if pad:
+        cin[1] = cin.get(1, 0) + pad
+    in_pairs = sorted(cin.items())
+    in_classes, block = _unified_class_slices(in_pairs)
+    m1 = in_classes[-1].sb if in_classes else 0
+
+    # ---- global relabel: shard-major, in-class-major, old-id-minor -------
+    # Shard s's real width-w vertices occupy the first count_s(w) positions
+    # of the unified class; the rest are dummies (-1 in new2old).
+    new2old = np.full(n * block, -1, dtype=np.int64)
+    old2new = np.empty(v, dtype=np.int64)
+    in_widths_arr = np.array([w for w, _ in in_pairs], dtype=np.int64)
+    in_va_arr = np.array([cs.va for cs in in_classes], dtype=np.int64)
+    order = np.lexsort((np.arange(v), in_w, shard_of_old))  # shard, width, id
+    ow = in_w[order]
+    os_ = shard_of_old[order]
+    # rank within each (shard, width) run (keys are sorted by construction)
+    widx = np.searchsorted(in_widths_arr, ow)
+    run_key = os_ * in_widths_arr.shape[0] + widx
+    rank = _rank_within_groups(run_key)
+    pos = os_ * block + in_va_arr[widx] + rank
+    new2old[pos] = order
+    old2new[order] = pos
+
+    # ---- edge shard slices (dst-sorted, contiguous original ownership) ---
+    bounds = np.searchsorted(dst, np.arange(n + 1, dtype=np.int64) * vblock)
+    bounds[-1] = e
+
+    # ---- unified out-classes over per-shard out-degrees ------------------
+    # outdeg_s(u) = edges u -> (dst in shard s); width 0 (no slots) when 0.
+    out_w_per_shard = []
+    cout: dict[int, int] = {}
+    for s in range(n):
+        es, ee = bounds[s], bounds[s + 1]
+        od = np.bincount(old2new[src[es:ee]], minlength=n * block)
+        w = np.where(od > 0, _next_pow2(od), 0)
+        out_w_per_shard.append(w)
+        for wv in np.unique(w[w > 0]).tolist():
+            c = int(np.count_nonzero(w == wv))
+            cout[wv] = max(cout.get(wv, 0), c)
+    out_pairs = sorted(cout.items())
+    out_classes, out_space = _unified_class_slices(out_pairs)
+    m2 = out_classes[-1].sb if out_classes else 0
+
+    # ---- vperm geometry: the all-gathered packed words feed the network --
+    # Packed layout: vertex (shard s', local e) sits at word s'*nw + e%nw,
+    # bit e//nw; as a network element that is (e//nw)*NW + s'*nw + (e%nw)
+    # with NW = Vp/32 >= n*nw (tail words are zero padding).  Dummy class
+    # positions must receive guaranteed-zero inputs, so Vp also covers the
+    # worst-case dummy count.
+    nw = block // 32
+    dmax = 0
+    for s in range(n):
+        w = out_w_per_shard[s]
+        d = sum(
+            c - int(np.count_nonzero(w == wv)) for wv, c in out_pairs
+        )
+        dmax = max(dmax, d)
+    vp = _pow2_at_least(max(n * block, out_space, v + dmax))
+    nww = vp // 32
+    new_ids = np.flatnonzero(new2old >= 0).astype(np.int64)  # real vertices
+    eloc = new_ids % block
+    e_net_real = (eloc // nw) * nww + (new_ids // block) * nw + (eloc % nw)
+    e_net_all = np.full(n * block, -1, dtype=np.int64)
+    e_net_all[new_ids] = e_net_real
+    zero_pool = np.setdiff1d(
+        np.arange(vp, dtype=np.int64), e_net_real, assume_unique=False
+    )
+
+    out_va = {cs.width: cs.va for cs in out_classes}
+    vperm_stages = benes.num_stages(vp)
+    net_size = _pow2_at_least(max(m1, m2))
+    net_stages = benes.num_stages(net_size)
+    vperm_masks = np.zeros((n, vperm_stages, vp // 32), dtype=np.uint32)
+    net_masks = np.zeros((n, net_stages, net_size // 32), dtype=np.uint32)
+    src_l1 = np.full((n, m1), INF_DIST, dtype=np.int32)
+    outpos = np.full(n * block, -1, dtype=np.int64)  # reused per shard
+
+    for s in range(n):
+        w_arr = out_w_per_shard[s]
+        # out-order positions for this shard's width>0 vertices
+        outpos[:] = -1
+        perm = np.full(vp, -1, dtype=np.int64)
+        zp_used = 0
+        for wv, c in out_pairs:
+            ids = np.flatnonzero(w_arr == wv)  # ascending new ids
+            va = out_va[wv]
+            outpos[ids] = va + np.arange(ids.shape[0])
+            perm[va : va + ids.shape[0]] = e_net_all[ids]
+            ndum = c - ids.shape[0]
+            if ndum:
+                perm[va + ids.shape[0] : va + c] = zero_pool[
+                    zp_used : zp_used + ndum
+                ]
+                zp_used += ndum
+        used = np.zeros(vp, dtype=bool)
+        used[perm[perm >= 0]] = True
+        vperm_masks[s] = benes.route(
+            benes.pad_perm(perm, vp, used), bit_major=True
+        )
+
+        # ---- big net: L2 (broadcast slots) -> L1 (dst-grouped slots) -----
+        es, ee = bounds[s], bounds[s + 1]
+        s_src, s_dst = src[es:ee], dst[es:ee]
+        dstn = old2new[s_dst] - s * block  # local new ids in [0, block)
+        ord1 = np.lexsort((s_src, dstn))
+        rank1 = _rank_within_groups(dstn[ord1])
+        l1_pos = np.empty(ee - es, dtype=np.int64)
+        l1_pos[ord1] = _edge_slots(in_classes, dstn[ord1], rank1)
+        src_l1[s, l1_pos] = s_src.astype(np.int32)  # ORIGINAL ids
+
+        srcpos = outpos[old2new[s_src]]
+        ord2 = np.lexsort((s_dst, srcpos))
+        rank2 = _rank_within_groups(srcpos[ord2])
+        l2_pos = np.empty(ee - es, dtype=np.int64)
+        l2_pos[ord2] = _edge_slots(out_classes, srcpos[ord2], rank2)
+
+        net = np.full(net_size, -1, dtype=np.int64)
+        net[l1_pos] = l2_pos
+        used = np.zeros(net_size, dtype=bool)
+        used[l2_pos] = True
+        net_masks[s] = benes.route(
+            benes.pad_perm(net, net_size, used), bit_major=True
+        )
+
+    return ShardedRelayGraph(
+        num_vertices=v,
+        num_edges=e,
+        num_shards=n,
+        block=block,
+        new2old=new2old.astype(np.int32),
+        old2new=old2new.astype(np.int32),
+        vperm_masks=vperm_masks,
+        vperm_size=vp,
+        out_classes=tuple(out_classes),
+        net_masks=net_masks,
+        net_size=net_size,
+        m2=m2,
+        in_classes=tuple(in_classes),
+        src_l1=src_l1,
+    )
+
+
 def build_relay_graph(graph: Graph | DeviceGraph) -> RelayGraph:
     """Build the full relay layout (host side, once per graph).
 

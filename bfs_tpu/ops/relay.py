@@ -134,9 +134,38 @@ def relay_candidates(
     v = num_vertices
     fbits = frontier[:v].astype(jnp.uint8)
     fbits = jnp.concatenate([fbits, jnp.zeros(vperm_size - v, dtype=jnp.uint8)])
+    return relay_candidates_packed(
+        pack_bits(fbits, vperm_size),
+        vperm_masks=vperm_masks,
+        vperm_size=vperm_size,
+        out_classes=out_classes,
+        net_masks=net_masks,
+        net_size=net_size,
+        m2=m2,
+        in_classes=in_classes,
+        src_l1_parts=src_l1_parts,
+    )
+
+
+def relay_candidates_packed(
+    fwords: jax.Array,
+    *,
+    vperm_masks: jax.Array,
+    vperm_size: int,
+    out_classes,
+    net_masks: jax.Array,
+    net_size: int,
+    m2: int,
+    in_classes,
+    src_l1_parts,
+) -> jax.Array:
+    """:func:`relay_candidates` from ALREADY-PACKED frontier words
+    (uint32[vperm_size/32]).  The sharded engine feeds the bit-packed
+    frontier all-gather here directly — the per-shard vperm network's routed
+    permutation absorbs the gathered block layout, so no unpack/repack sits
+    between the ICI exchange and the butterflies."""
     fout = unpack_bits(
-        apply_benes(pack_bits(fbits, vperm_size), vperm_masks, vperm_size),
-        vperm_size,
+        apply_benes(fwords, vperm_masks, vperm_size), vperm_size
     )
 
     parts = []

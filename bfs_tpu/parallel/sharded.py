@@ -82,6 +82,15 @@ def _graph_shards(mesh: Mesh) -> int:
     return mesh.shape[GRAPH_AXIS]
 
 
+def _reject_wrong_layout_for_push(graph) -> None:
+    from ..graph.relay import ShardedRelayGraph
+
+    if isinstance(graph, ShardedPullGraph):
+        raise ValueError("a ShardedPullGraph only runs on engine='pull'")
+    if isinstance(graph, ShardedRelayGraph):
+        raise ValueError("a ShardedRelayGraph only runs on engine='relay'")
+
+
 def _prepare(graph: Graph | DeviceGraph, mesh: Mesh, block: int) -> DeviceGraph:
     n = _graph_shards(mesh)
     if isinstance(graph, DeviceGraph):
@@ -121,6 +130,49 @@ def _bfs_sharded_fused(src, dst, source, *, mesh, num_vertices, max_levels):
     return fn(src, dst, source)
 
 
+def _init_block_state(source, block: int):
+    """Per-device dist/parent init over the owned vertex block (ids are
+    GLOBAL: ``axis_index*block + local``); the source's parent self-entry is
+    in whatever id space ``source`` lives in — host wrappers fix it up."""
+    lo = jax.lax.axis_index(GRAPH_AXIS).astype(jnp.int32) * block
+    ids_local = lo + jnp.arange(block, dtype=jnp.int32)
+    is_src = ids_local == source
+    dist = jnp.where(is_src, jnp.int32(0), INT32_MAX)
+    parent = jnp.where(is_src, source, jnp.int32(-1))
+    return dist, parent
+
+
+def _packed_source_frontier(source, block: int, n: int):
+    """Initial global bit-packed frontier words (bit-major per block) with
+    only the source bit set.  Every device computes it identically (no
+    collective), then `pcast` aligns the carry with the all_gather-refreshed
+    words of the loop body, which are graph-axis-varying."""
+    nw = block // 32
+    eloc = source % block
+    widx = (source // block) * nw + eloc % nw
+    bit = (eloc // nw).astype(jnp.uint32)
+    fwords = (
+        jnp.zeros((n * nw,), jnp.uint32).at[widx].set(jnp.uint32(1) << bit)
+    )
+    return jax.lax.pcast(fwords, (GRAPH_AXIS,), to="varying")
+
+
+def _apply_block_candidates(carry, cand, nw: int):
+    """Shared superstep tail for block-partitioned engines: mark newly
+    reached owned vertices, advance the level, exchange the new frontier as
+    a bit-packed all-gather, and all-reduce the termination flag."""
+    dist, parent, _, level, _ = carry
+    improved = (cand != INT32_MAX) & (dist == INT32_MAX)
+    level = level + 1
+    dist = jnp.where(improved, level, dist)
+    parent = jnp.where(improved, cand, parent)
+    fwords = jax.lax.all_gather(
+        pack_frontier_block(improved, nw), GRAPH_AXIS, tiled=True
+    )
+    changed = jax.lax.pmax(improved.any().astype(jnp.int32), GRAPH_AXIS) > 0
+    return dist, parent, fwords, level, changed
+
+
 @functools.partial(jax.jit, static_argnames=("mesh", "block", "max_levels"))
 def _bfs_sharded_pull_fused(ell0, folds, source, *, mesh, block, max_levels):
     """Vertex-partitioned pull BFS: per-device ELL over owned destinations,
@@ -134,23 +186,8 @@ def _bfs_sharded_pull_fused(ell0, folds, source, *, mesh, block, max_levels):
     def inner(ell0_blk, folds_blk, source):
         ell0_blk = ell0_blk[0]
         folds_blk = tuple(f[0] for f in folds_blk)
-        lo = jax.lax.axis_index(GRAPH_AXIS).astype(jnp.int32) * block
-        ids_local = lo + jnp.arange(block, dtype=jnp.int32)
-        is_src = ids_local == source
-        dist = jnp.where(is_src, jnp.int32(0), INT32_MAX)
-        parent = jnp.where(is_src, source, jnp.int32(-1))
-        # Packed global frontier (bit-major per block) with only the source
-        # bit set; every device computes it identically, no collective.
-        eloc = source % block
-        widx = (source // block) * nw + eloc % nw
-        bit = (eloc // nw).astype(jnp.uint32)
-        fwords = (
-            jnp.zeros((n * nw,), jnp.uint32).at[widx].set(jnp.uint32(1) << bit)
-        )
-        # The initial frontier is computed identically on every device (no
-        # collective), but the loop body refreshes it via all_gather, which
-        # is axis-varying — align the carry's varying-manual-axes type.
-        fwords = jax.lax.pcast(fwords, (GRAPH_AXIS,), to="varying")
+        dist, parent = _init_block_state(source, block)
+        fwords = _packed_source_frontier(source, block, n)
         gids = jnp.arange(vtot, dtype=jnp.int32)
         inf1 = jnp.full((1,), INT32_MAX, dtype=jnp.int32)
 
@@ -159,19 +196,10 @@ def _bfs_sharded_pull_fused(ell0, folds, source, *, mesh, block, max_levels):
             return changed & (level < max_levels)
 
         def body(carry):
-            dist, parent, fwords, level, _ = carry
-            bits = unpack_frontier_blocks(fwords, n, nw)
+            bits = unpack_frontier_blocks(carry[2], n, nw)
             ftab_ext = jnp.concatenate([jnp.where(bits, gids, INT32_MAX), inf1])
             cand = pull_candidates_rows(ftab_ext, ell0_blk, folds_blk, block)
-            improved = (cand != INT32_MAX) & (dist == INT32_MAX)
-            level = level + 1
-            dist = jnp.where(improved, level, dist)
-            parent = jnp.where(improved, cand, parent)
-            fwords = jax.lax.all_gather(
-                pack_frontier_block(improved, nw), GRAPH_AXIS, tiled=True
-            )
-            changed = jax.lax.pmax(improved.any().astype(jnp.int32), GRAPH_AXIS) > 0
-            return dist, parent, fwords, level, changed
+            return _apply_block_candidates(carry, cand, nw)
 
         dist, parent, _, level, _ = jax.lax.while_loop(
             cond, body, (dist, parent, fwords, jnp.int32(0), jnp.bool_(True))
@@ -190,6 +218,107 @@ def _bfs_sharded_pull_fused(ell0, folds, source, *, mesh, block, max_levels):
         axis_names={GRAPH_AXIS},
     )
     return fn(ell0, folds, source)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "mesh", "block", "vperm_size", "out_classes", "net_size", "m2",
+        "in_classes", "max_levels",
+    ),
+)
+def _bfs_sharded_relay_fused(
+    vperm_masks, net_masks, src_l1_parts, source_new, *,
+    mesh, block, vperm_size, out_classes, net_size, m2, in_classes,
+    max_levels,
+):
+    """Vertex-partitioned relay BFS: per-shard Beneš layouts (one unified
+    SPMD program, per-device mask data), frontier exchanged as the same
+    bit-packed all-gather as the sharded pull engine; the all-gathered words
+    feed each shard's vperm network directly (its routed permutation absorbs
+    the block-packed layout).  State lives in the GLOBAL RELABELED space —
+    dist/parent fully distributed, parent VALUES are original ids."""
+    from ..ops.relay import relay_candidates_packed
+
+    n = mesh.shape[GRAPH_AXIS]
+    nw = block // 32
+    nww = vperm_size // 32
+
+    def inner(vperm_blk, net_blk, src_parts_blk, source):
+        vperm_blk = vperm_blk[0]
+        net_blk = net_blk[0]
+        src_parts = tuple(p[0] for p in src_parts_blk)
+        dist, parent = _init_block_state(source, block)
+        fwords = _packed_source_frontier(source, block, n)
+        zpad = jnp.zeros((nww - n * nw,), jnp.uint32)
+
+        def cond(carry):
+            _, _, _, level, changed = carry
+            return changed & (level < max_levels)
+
+        def body(carry):
+            cand = relay_candidates_packed(
+                jnp.concatenate([carry[2], zpad]),
+                vperm_masks=vperm_blk,
+                vperm_size=vperm_size,
+                out_classes=out_classes,
+                net_masks=net_blk,
+                net_size=net_size,
+                m2=m2,
+                in_classes=in_classes,
+                src_l1_parts=src_parts,
+            )
+            return _apply_block_candidates(carry, cand, nw)
+
+        dist, parent, _, level, _ = jax.lax.while_loop(
+            cond, body, (dist, parent, fwords, jnp.int32(0), jnp.bool_(True))
+        )
+        return dist, parent, level
+
+    fn = _shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(
+            P(GRAPH_AXIS, None, None),
+            P(GRAPH_AXIS, None, None),
+            tuple(P(GRAPH_AXIS, None, None) for _ in src_l1_parts),
+            P(),
+        ),
+        out_specs=(P(GRAPH_AXIS), P(GRAPH_AXIS), P()),
+        axis_names={GRAPH_AXIS},
+    )
+    return fn(vperm_masks, net_masks, src_l1_parts, source_new)
+
+
+def _prepare_relay(graph, mesh: Mesh):
+    from ..graph.relay import ShardedRelayGraph, build_sharded_relay_graph
+
+    n = _graph_shards(mesh)
+    if isinstance(graph, ShardedPullGraph):
+        raise ValueError("a ShardedPullGraph only runs on engine='pull'")
+    if isinstance(graph, ShardedRelayGraph):
+        if graph.num_shards != n:
+            raise ValueError(
+                f"ShardedRelayGraph has {graph.num_shards} shards but mesh "
+                f"axis '{GRAPH_AXIS}' has {n}; rebuild with num_shards={n}"
+            )
+        return graph
+    return build_sharded_relay_graph(graph, n)
+
+
+def _relay_src_parts(srg):
+    """Per-in-class src-id tables stacked over shards, viewed [n, Nc, w]
+    (vertex-major) or [n, w, Nc] (rank-major)."""
+    parts = []
+    for cs in srg.in_classes:
+        seg = srg.src_l1[:, cs.sa : cs.sb]
+        shape = (
+            (srg.num_shards, cs.count, cs.width)
+            if cs.vertex_major
+            else (srg.num_shards, cs.width, cs.count)
+        )
+        parts.append(jnp.asarray(seg.reshape(shape)))
+    return tuple(parts)
 
 
 def _prepare_pull(
@@ -219,14 +348,46 @@ def bfs_sharded(
     """Single-source BFS sharded over the mesh's ``graph`` axis.
 
     Engines:
+      * ``'relay'`` — per-shard Beneš relay layouts; the gather-free
+        TPU-fast formulation, multi-chip.
       * ``'pull'`` (default) — vertex-partitioned ELL + bit-packed frontier
-        bitmap all-gather; the TPU-fast multi-chip formulation.
+        bitmap all-gather; portable multi-chip formulation.
       * ``'push'`` — edge-sharded ``segment_min`` + full candidate `pmin`;
         the direct analogue of the reference's map/shuffle/reduce, kept for
         differential testing.
     """
     mesh = mesh if mesh is not None else make_mesh()
+    if engine == "relay":
+        srg = _prepare_relay(graph, mesh)
+        check_sources(srg.num_vertices, source)
+        max_levels = int(max_levels) if max_levels is not None else srg.num_vertices
+        source_new = jnp.int32(int(srg.old2new[source]))
+        dist, parent, level = _bfs_sharded_relay_fused(
+            jnp.asarray(srg.vperm_masks),
+            jnp.asarray(srg.net_masks),
+            _relay_src_parts(srg),
+            source_new,
+            mesh=mesh,
+            block=srg.block,
+            vperm_size=srg.vperm_size,
+            out_classes=srg.out_classes,
+            net_size=srg.net_size,
+            m2=srg.m2,
+            in_classes=srg.in_classes,
+            max_levels=max_levels,
+        )
+        dist = np.asarray(jax.device_get(dist))
+        parent = np.asarray(jax.device_get(parent))
+        # State is in the global relabeled space; map back to original ids.
+        dist = dist[srg.old2new]
+        parent = parent[srg.old2new]
+        parent[source] = source  # init wrote the relabeled id at the source
+        return BfsResult(dist=dist, parent=parent, num_levels=int(level))
     if engine == "pull":
+        from ..graph.relay import ShardedRelayGraph
+
+        if isinstance(graph, ShardedRelayGraph):
+            raise ValueError("a ShardedRelayGraph only runs on engine='relay'")
         spg = _prepare_pull(graph, mesh, vertex_block_multiple)
         check_sources(spg.num_vertices, source)
         max_levels = int(max_levels) if max_levels is not None else spg.num_vertices
@@ -244,9 +405,10 @@ def bfs_sharded(
             num_levels=int(level),
         )
     if engine != "push":
-        raise ValueError(f"unknown engine {engine!r}; use 'pull' or 'push'")
-    if isinstance(graph, ShardedPullGraph):
-        raise ValueError("a ShardedPullGraph only runs on engine='pull'")
+        raise ValueError(
+            f"unknown engine {engine!r}; use 'relay', 'pull' or 'push'"
+        )
+    _reject_wrong_layout_for_push(graph)
     dg = _prepare(graph, mesh, block)
     check_sources(dg.num_vertices, source)
     max_levels = int(max_levels) if max_levels is not None else dg.num_vertices
@@ -413,9 +575,11 @@ def bfs_sharded_multi(
             num_levels=int(level),
         )
     if engine != "push":
-        raise ValueError(f"unknown engine {engine!r}; use 'pull' or 'push'")
-    if isinstance(graph, ShardedPullGraph):
-        raise ValueError("a ShardedPullGraph only runs on engine='pull'")
+        raise ValueError(
+            f"unknown engine {engine!r}; use 'pull' or 'push'"
+            " ('relay' has no batched sharded mode yet)"
+        )
+    _reject_wrong_layout_for_push(graph)
     dg = _prepare(graph, mesh, block)
     check_sources(dg.num_vertices, sources)
     max_levels = int(max_levels) if max_levels is not None else dg.num_vertices
