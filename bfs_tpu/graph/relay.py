@@ -272,17 +272,18 @@ def build_sharded_relay_graph(
     bounds[-1] = e
 
     # ---- unified out-classes over per-shard out-degrees ------------------
-    # outdeg_s(u) = edges u -> (dst in shard s); width 0 (no slots) when 0.
-    out_w_per_shard = []
+    # outdeg_s(u) = edges u -> (dst in shard s); vertices with none get NO
+    # slots.  Kept sparse per shard (only src ids that appear): the dense
+    # form would be O(n^2 * block).
+    out_sparse = []  # per shard: (new ids with >=1 edge, ascending; widths)
     cout: dict[int, int] = {}
     for s in range(n):
         es, ee = bounds[s], bounds[s + 1]
-        od = np.bincount(old2new[src[es:ee]], minlength=n * block)
-        w = np.where(od > 0, _next_pow2(od), 0)
-        out_w_per_shard.append(w)
-        for wv in np.unique(w[w > 0]).tolist():
-            c = int(np.count_nonzero(w == wv))
-            cout[wv] = max(cout.get(wv, 0), c)
+        uids, ucounts = np.unique(old2new[src[es:ee]], return_counts=True)
+        w = _next_pow2(ucounts)
+        out_sparse.append((uids, w))
+        for wv, c in zip(*np.unique(w, return_counts=True)):
+            cout[int(wv)] = max(cout.get(int(wv), 0), int(c))
     out_pairs = sorted(cout.items())
     out_classes, out_space = _unified_class_slices(out_pairs)
     m2 = out_classes[-1].sb if out_classes else 0
@@ -295,11 +296,8 @@ def build_sharded_relay_graph(
     # worst-case dummy count.
     nw = block // 32
     dmax = 0
-    for s in range(n):
-        w = out_w_per_shard[s]
-        d = sum(
-            c - int(np.count_nonzero(w == wv)) for wv, c in out_pairs
-        )
+    for _, uw in out_sparse:
+        d = sum(c - int(np.count_nonzero(uw == wv)) for wv, c in out_pairs)
         dmax = max(dmax, d)
     vp = _pow2_at_least(max(n * block, out_space, v + dmax))
     nww = vp // 32
@@ -322,13 +320,13 @@ def build_sharded_relay_graph(
     outpos = np.full(n * block, -1, dtype=np.int64)  # reused per shard
 
     for s in range(n):
-        w_arr = out_w_per_shard[s]
+        uids_s, uw_s = out_sparse[s]
         # out-order positions for this shard's width>0 vertices
         outpos[:] = -1
         perm = np.full(vp, -1, dtype=np.int64)
         zp_used = 0
         for wv, c in out_pairs:
-            ids = np.flatnonzero(w_arr == wv)  # ascending new ids
+            ids = uids_s[uw_s == wv]  # ascending new ids
             va = out_va[wv]
             outpos[ids] = va + np.arange(ids.shape[0])
             perm[va : va + ids.shape[0]] = e_net_all[ids]

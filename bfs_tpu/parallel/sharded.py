@@ -324,7 +324,11 @@ def _relay_src_parts(srg):
 def _prepare_pull(
     graph: Graph | DeviceGraph | ShardedPullGraph, mesh: Mesh, block_multiple: int
 ) -> ShardedPullGraph:
+    from ..graph.relay import ShardedRelayGraph
+
     n = _graph_shards(mesh)
+    if isinstance(graph, ShardedRelayGraph):
+        raise ValueError("a ShardedRelayGraph only runs on engine='relay'")
     if isinstance(graph, ShardedPullGraph):
         if graph.num_shards != n:
             raise ValueError(
@@ -384,10 +388,6 @@ def bfs_sharded(
         parent[source] = source  # init wrote the relabeled id at the source
         return BfsResult(dist=dist, parent=parent, num_levels=int(level))
     if engine == "pull":
-        from ..graph.relay import ShardedRelayGraph
-
-        if isinstance(graph, ShardedRelayGraph):
-            raise ValueError("a ShardedRelayGraph only runs on engine='relay'")
         spg = _prepare_pull(graph, mesh, vertex_block_multiple)
         check_sources(spg.num_vertices, source)
         max_levels = int(max_levels) if max_levels is not None else spg.num_vertices

@@ -55,7 +55,7 @@ class NativeLib:
         cmd = [
             os.environ.get("CXX", "g++"),
             "-O3", "-march=native", "-std=c++17", "-fPIC", "-shared",
-            "-o", tmp, self._src,
+            "-pthread", "-o", tmp, self._src,
         ]
         try:
             subprocess.run(cmd, check=True, capture_output=True, timeout=120)

@@ -23,6 +23,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -37,17 +38,16 @@ struct Router {
   int32_t k;  // log2(n_total)
   uint32_t* masks;         // [num_stages][n_total/32] packed words
   int64_t words_per_stage;
-  bool bit_major;  // element e -> (word e % nw, bit e / nw) instead of
-                   // (word e / 32, bit e % 32); see bfs_tpu/ops/relay.py
+  int32_t parallel_levels = 3;  // thread fan-out depth (2^d subtrees)
 
+  // Masks are written WORD-MAJOR here regardless of the requested layout:
+  // sibling subtrees cover disjoint pow2-aligned position ranges, which in
+  // word-major packing touch disjoint words — so the threaded fan-out needs
+  // no atomics.  (Bit-major interleaves positions h apart into the same
+  // word.)  benes_route transposes to bit-major afterwards if asked.
   void set_bit(int32_t stage, int64_t pos) {
-    if (bit_major) {
-      masks[stage * words_per_stage + (pos % words_per_stage)] |=
-          (uint32_t{1} << (pos / words_per_stage));
-    } else {
-      masks[stage * words_per_stage + (pos >> 5)] |=
-          (uint32_t{1} << (pos & 31));
-    }
+    masks[stage * words_per_stage + (pos >> 5)] |=
+        (uint32_t{1} << (pos & 31));
   }
 
   void route(int64_t base, int64_t n, int32_t level,
@@ -113,11 +113,50 @@ struct Router {
     std::vector<int64_t>().swap(inv);
     std::vector<int8_t>().swap(color);
     std::vector<int64_t>().swap(perm);
-    route(base, h, level + 1, up);
-    std::vector<int64_t>().swap(up);
-    route(base + h, h, level + 1, lo);
+    // The two subnets are fully independent (disjoint mask bits, disjoint
+    // position ranges): fan out across cores for the first few levels.
+    // Depth 3 -> up to 8 concurrent subtrees; the sequential top-level
+    // coloring walk remains the critical path.
+    if (level < parallel_levels && h >= (int64_t{1} << 20)) {
+      std::thread t([this, base, h, level, &up] {
+        route(base, h, level + 1, up);
+      });
+      route(base + h, h, level + 1, lo);
+      t.join();
+    } else {
+      route(base, h, level + 1, up);
+      std::vector<int64_t>().swap(up);
+      route(base + h, h, level + 1, lo);
+    }
   }
 };
+
+// Word-major -> bit-major stage conversion: output word w, bit b holds
+// element e = b*nw + w.  For nw a multiple of 32 the source bit position is
+// constant (w & 31) and source words stride nw/32, so each output word is 32
+// strided single-bit reads.
+void transpose_stage(const uint32_t* in, uint32_t* out, int64_t n) {
+  const int64_t nw = n / 32;
+  if (nw % 32 == 0) {
+    const int64_t nw32 = nw / 32;
+    for (int64_t w = 0; w < nw; ++w) {
+      const int64_t base_word = w >> 5;
+      const uint32_t src_bit = uint32_t(w & 31);
+      uint32_t acc = 0;
+      for (int64_t b = 0; b < 32; ++b) {
+        acc |= ((in[b * nw32 + base_word] >> src_bit) & 1u) << b;
+      }
+      out[w] = acc;
+    }
+  } else {  // tiny networks: per-element fallback
+    for (int64_t w = 0; w < nw; ++w) out[w] = 0;
+    for (int64_t e = 0; e < n; ++e) {
+      if ((in[e >> 5] >> (e & 31)) & 1u) {
+        out[e % nw] |= uint32_t{1} << (e / nw);
+      }
+    }
+  }
+}
 
 }  // namespace
 
@@ -146,9 +185,27 @@ int32_t benes_route(int64_t n, const int64_t* perm, uint32_t* masks_out,
   r.k = k;
   r.masks = masks_out;
   r.words_per_stage = n / 32 > 0 ? n / 32 : 1;
-  r.bit_major = bit_major != 0;
   std::vector<int64_t> p(perm, perm + n);
   r.route(0, n, 0, p);
+  if (bit_major && n >= 32) {
+    const int32_t num_stages = 2 * k - 1;
+    const int64_t nw = r.words_per_stage;
+    unsigned hw = std::thread::hardware_concurrency();
+    const int32_t workers =
+        int32_t(hw ? (hw < 16u ? hw : 16u) : 4u);
+    std::vector<std::thread> pool;
+    for (int32_t t = 0; t < workers; ++t) {
+      pool.emplace_back([=] {
+        std::vector<uint32_t> tmp(static_cast<size_t>(nw));
+        for (int32_t s = t; s < num_stages; s += workers) {
+          transpose_stage(masks_out + int64_t(s) * nw, tmp.data(), n);
+          std::memcpy(masks_out + int64_t(s) * nw, tmp.data(),
+                      size_t(nw) * sizeof(uint32_t));
+        }
+      });
+    }
+    for (auto& th : pool) th.join();
+  }
   return 0;
 }
 
