@@ -255,18 +255,30 @@ def run_cell(spec: dict) -> dict:
         return {**out, "seconds": sec, "teps": _teps(dg, dist, sec),
                 "supersteps": levels}
 
-    if mode.startswith("sharded-pull-"):
-        shards = int(mode.rsplit("-", 1)[1])
-        from .graph.ell import build_sharded_pull_graph
+    if mode.startswith("sharded-"):
+        eng, shards_s = mode.rsplit("-", 2)[-2:]
+        shards = int(shards_s)
         from .parallel.sharded import bfs_sharded, make_mesh
 
         if len(jax.devices()) < shards:
             return {**out, "error": f"need {shards} devices, have {len(jax.devices())}"}
+        if eng == "relay":
+            from .graph.benes import native_available as benes_ok
+
+            if not benes_ok():
+                return {**out, "error": "native benes router unavailable"}
         mesh = make_mesh(graph=shards, batch=1)
         # Layout built ONCE outside the timed repeats (the methodology
         # excludes construction; only the compiled traversal is measured).
-        spg = build_sharded_pull_graph(dg, shards)
-        run = lambda: bfs_sharded(spg, source, mesh=mesh, engine="pull")  # noqa: E731
+        if eng == "relay":
+            from .graph.relay import build_sharded_relay_graph
+
+            layout = build_sharded_relay_graph(dg, shards)
+        else:
+            from .graph.ell import build_sharded_pull_graph
+
+            layout = build_sharded_pull_graph(dg, shards)
+        run = lambda: bfs_sharded(layout, source, mesh=mesh, engine=eng)  # noqa: E731
         res = run()  # warm-up/compile
         times = []
         for _ in range(repeats):
@@ -425,6 +437,8 @@ def main(argv=None):
             cell(ds, engine)
         for n in SHARD_COUNTS:
             cell(ds, f"sharded-pull-{n}", virtual=max(SHARD_COUNTS))
+        for n in SHARD_COUNTS:
+            cell(ds, f"sharded-relay-{n}", virtual=max(SHARD_COUNTS))
     if not args.skip_multi and "rmat" in datasets:
         for engine in ("pull", "relay"):
             cell("rmat", f"multi-{engine}", num_sources=64)
@@ -471,9 +485,11 @@ def _write_markdown(results: list[dict], scale: int) -> None:
                  "edge factor 16, Graph500 parameters.")
     lines.append("")
     cols = (["serial-native", "serial-python"] + list(ENGINES)
-            + [f"sharded-pull-{n}" for n in SHARD_COUNTS])
+            + [f"sharded-pull-{n}" for n in SHARD_COUNTS]
+            + [f"sharded-relay-{n}" for n in SHARD_COUNTS])
     header = ("| dataset | " + " | ".join(
-        c.replace("sharded-pull-", "pull ×") for c in cols) + " |")
+        c.replace("sharded-pull-", "pull ×").replace("sharded-relay-", "relay ×")
+        for c in cols) + " |")
     lines.append(header)
     lines.append("|" + "---|" * (len(cols) + 1))
     for ds in datasets:
