@@ -18,9 +18,11 @@ Every run is verified: the result must pass the ported algs4 ``check()``
 optimality invariants (BreadthFirstPaths.java:172-221) before the number is
 printed.  Set BENCH_CHECK=0 to skip.
 
-Env knobs: BENCH_SCALE (default 24), BENCH_EDGE_FACTOR (8), BENCH_REPEATS
-(5), BENCH_ENGINE (relay|pull|push), BENCH_CHECK (1), BENCH_PROFILE (path —
-write a jax.profiler trace of one timed run there).
+Env knobs: BENCH_SCALE (default 24), BENCH_EDGE_FACTOR (default 6 — exactly
+the BASELINE.json "100M-edge R-MAT scale-24" config: 2^24 * 6 = 100.7M input
+undirected edges), BENCH_REPEATS (5), BENCH_ENGINE (relay|pull|push),
+BENCH_CHECK (1), BENCH_PROFILE (path — write a jax.profiler trace of one
+timed run there).
 """
 
 from __future__ import annotations
@@ -231,7 +233,7 @@ def load_or_build_relay(dg, key: str):
 
 def main():
     scale = int(os.environ.get("BENCH_SCALE", "24"))
-    edge_factor = int(os.environ.get("BENCH_EDGE_FACTOR", "8"))
+    edge_factor = int(os.environ.get("BENCH_EDGE_FACTOR", "6"))
     repeats = int(os.environ.get("BENCH_REPEATS", "5"))
     engine = os.environ.get("BENCH_ENGINE", "relay")
     do_check = os.environ.get("BENCH_CHECK", "1") != "0"

@@ -61,15 +61,11 @@ def pull_candidates_rows(
 
 
 def pack_frontier_block(bits: jax.Array, num_words: int) -> jax.Array:
-    """bool[..., B] -> uint32[..., B/32], bit-major within the block
-    (element ``e`` -> word ``e % num_words``, bit ``e // num_words``) — the
-    same convention as :func:`bfs_tpu.ops.relay.pack_bits`, kept so pack and
-    unpack are full-width vector ops, never a ``[nw, 32]`` view that TPU
-    (8,128) tiling would pad ~100x."""
-    lead = bits.shape[:-1]
-    b = bits.reshape(*lead, 32, num_words).astype(jnp.uint32)
-    shifts = jnp.arange(32, dtype=jnp.uint32)[:, None]
-    return (b << shifts).sum(axis=-2, dtype=jnp.uint32)
+    """bool[..., B] -> uint32[..., B/32], bit-major within the block:
+    :func:`bfs_tpu.ops.relay.pack_bits` (the one packed-word convention)."""
+    from .relay import pack_bits
+
+    return pack_bits(bits, num_words * 32)
 
 
 def unpack_frontier_blocks(

@@ -5,7 +5,7 @@ relay_candidates in isolation (pack/unpack, vperm route, class broadcast,
 big Beneš route, class row-min) plus the fused whole, to locate the gap
 between the measured superstep cost and the HBM-bandwidth floor.
 
-Usage: BENCH_SCALE=24 BENCH_EDGE_FACTOR=8 python tools/microbench_relay_stages.py
+Usage: BENCH_SCALE=24 BENCH_EDGE_FACTOR=6 python tools/microbench_relay_stages.py
 """
 
 import os
@@ -28,15 +28,28 @@ from bfs_tpu.ops.relay import (
 )
 
 
-def timeit(name, fn, *args, repeats=5):
+def _sync(out):
+    """Force completion: a VALUE read of one element.  block_until_ready can
+    return early through the axon remote-device tunnel (see bfs_tpu.bench),
+    so timing must read data back."""
+    leaf = jax.tree_util.tree_leaves(out)[0]
+    np.asarray(leaf.reshape(-1)[:1])
+
+
+def timeit(name, fn, *args, repeats=5, iters=8):
+    """Median time per call: ``iters`` back-to-back dispatches share ONE
+    value-read sync (device stream executes them serially), amortizing the
+    tunnel round-trip latency out of the per-call number."""
     fn_j = jax.jit(fn)
-    out = jax.block_until_ready(fn_j(*args))  # compile
+    out = fn_j(*args)
+    _sync(out)  # compile + settle
     times = []
     for _ in range(repeats):
         t0 = time.perf_counter()
-        out = fn_j(*args)
-        jax.block_until_ready(out)
-        times.append(time.perf_counter() - t0)
+        for _ in range(iters):
+            out = fn_j(*args)
+        _sync(out)
+        times.append((time.perf_counter() - t0) / iters)
     t = float(np.median(times))
     print(f"{name:35s} {t * 1e3:9.2f} ms")
     return t
@@ -44,7 +57,7 @@ def timeit(name, fn, *args, repeats=5):
 
 def main():
     scale = int(os.environ.get("BENCH_SCALE", "24"))
-    ef = int(os.environ.get("BENCH_EDGE_FACTOR", "8"))
+    ef = int(os.environ.get("BENCH_EDGE_FACTOR", "6"))
     backend = _generator_backend()
     key = f"{backend}_s{scale}_ef{ef}_seed42_block8192"
     dg, source = load_or_build(scale, ef, 42, 8 * 1024, backend)
@@ -66,8 +79,10 @@ def main():
     rng = np.random.default_rng(0)
     frontier = jnp.asarray(rng.random(v + 1) < 0.3)
 
-    # Whole candidate pipeline
-    def whole(frontier):
+    # Whole candidate pipeline.  All device tensors are ARGUMENTS — a
+    # closed-over concrete array would be baked into the program as a
+    # constant (5.5GB at scale 24, breaking the remote compile transport).
+    def whole(frontier, vperm_masks, net_masks, src_parts):
         return relay_candidates(
             frontier, num_vertices=v, vperm_masks=vperm_masks,
             vperm_size=rg.vperm_size, out_classes=rg.out_classes,
@@ -75,10 +90,10 @@ def main():
             in_classes=rg.in_classes, src_l1_parts=src_parts,
         )
 
-    timeit("relay_candidates (whole)", whole, frontier)
+    timeit("relay_candidates (whole)", whole, frontier, vperm_masks, net_masks, src_parts)
 
     # Phase 1: frontier -> out-order bits (vperm route)
-    def phase_vperm(frontier):
+    def phase_vperm(frontier, vperm_masks):
         fbits = frontier[:v].astype(jnp.uint8)
         fbits = jnp.concatenate(
             [fbits, jnp.zeros(rg.vperm_size - v, dtype=jnp.uint8)]
@@ -88,8 +103,8 @@ def main():
             rg.vperm_size,
         )
 
-    fout = jax.jit(phase_vperm)(frontier)
-    timeit("  vperm (pack+route+unpack)", phase_vperm, frontier)
+    fout = jax.jit(phase_vperm)(frontier, vperm_masks)
+    timeit("  vperm (pack+route+unpack)", phase_vperm, frontier, vperm_masks)
 
     # Phase 2: class broadcast -> l2 bits
     def phase_broadcast(fout):
@@ -117,11 +132,11 @@ def main():
     l2w = jax.jit(phase_pack)(l2)
     timeit("  pack_bits(l2)", phase_pack, l2)
 
-    def phase_net(l2w):
+    def phase_net(l2w, net_masks):
         return apply_benes(l2w, net_masks, rg.net_size)
 
-    l1w = jax.jit(phase_net)(l2w)
-    timeit("  apply_benes(net)", phase_net, l2w)
+    l1w = jax.jit(phase_net)(l2w, net_masks)
+    timeit("  apply_benes(net)", phase_net, l2w, net_masks)
 
     def phase_unpack(l1w):
         return unpack_bits(l1w, rg.net_size)
@@ -130,7 +145,7 @@ def main():
     timeit("  unpack_bits(l1)", phase_unpack, l1w)
 
     # Phase 4: class row-min
-    def phase_rowmin(l1bits):
+    def phase_rowmin(l1bits, src_parts):
         cands = []
         for cs, tab in zip(rg.in_classes, src_parts):
             seg = l1bits[cs.sa : cs.sb]
@@ -142,22 +157,22 @@ def main():
                 cands.append(jnp.min(jnp.where(bits != 0, tab, INT32_MAX), axis=0))
         return jnp.concatenate(cands)
 
-    timeit("  rowmin", phase_rowmin, l1bits)
+    timeit("  rowmin", phase_rowmin, l1bits, src_parts)
 
     # Single-stage butterfly costs at the three distance regimes
     nw = rg.net_size // 32
     words = l1w
-    m = net_masks[0]
+    m0 = net_masks[0]
 
-    def bf_bit(words):  # d >= nw: bit-position butterfly
+    def bf_bit(words, m):  # d >= nw: bit-position butterfly
         sh = jnp.uint32(4)
         t = (words ^ (words >> sh)) & m
         return words ^ t ^ (t << sh)
 
-    timeit("  one bitpos stage (elementwise)", bf_bit, words)
+    timeit("  one bitpos stage (elementwise)", bf_bit, words, m0)
 
     r = nw // 128
-    def bf_lane(words):  # d < 128 lane roll
+    def bf_lane(words, m):  # d < 128 lane roll
         x = words.reshape(r, 128)
         mm = m.reshape(r, 128)
         lane = jax.lax.broadcasted_iota(jnp.int32, (1, 128), 1)
@@ -166,9 +181,9 @@ def main():
         mb = jnp.where(has, jnp.roll(mm, 8, axis=1), mm)
         return (x ^ ((x ^ partner) & mb)).reshape(-1)
 
-    timeit("  one lane-roll stage", bf_lane, words)
+    timeit("  one lane-roll stage", bf_lane, words, m0)
 
-    def bf_row(words):  # 128 <= d < nw: row-block roll
+    def bf_row(words, m):  # 128 <= d < nw: row-block roll
         x = words.reshape(r, 128)
         mm = m.reshape(r, 128)
         row = jax.lax.broadcasted_iota(jnp.int32, (r, 1), 0)
@@ -177,7 +192,7 @@ def main():
         mb = jnp.where(has, jnp.roll(mm, 64, axis=0), mm)
         return (x ^ ((x ^ partner) & mb)).reshape(-1)
 
-    timeit("  one row-roll stage", bf_row, words)
+    timeit("  one row-roll stage", bf_row, words, m0)
 
     # Bandwidth reference: same-size elementwise xor
     big = jnp.asarray(rng.integers(0, 2**32, size=nw, dtype=np.uint32))
