@@ -39,6 +39,37 @@ def test_pack_unpack_roundtrip():
     np.testing.assert_array_equal(benes.unpack_bits(benes.pack_bits(bits)), bits)
 
 
+def test_ops_pack_bits_layout_and_batching():
+    """ops.relay.pack_bits agrees with the numpy reference layout (bit-major:
+    element e -> word e % nw, bit e // nw), for bool and uint8 inputs and
+    with leading batch axes (the sharded/batched engines' path)."""
+    import jax.numpy as jnp
+
+    from bfs_tpu.ops.relay import pack_bits, unpack_bits
+
+    rng = np.random.default_rng(9)
+    for n in (64, 4096):
+        nw = n // 32
+        bits = rng.integers(0, 2, size=n).astype(np.uint8)
+        want = np.zeros(nw, dtype=np.uint32)
+        for e in range(n):
+            if bits[e]:
+                want[e % nw] |= np.uint32(1) << (e // nw)
+        got = np.asarray(pack_bits(jnp.asarray(bits), n))
+        np.testing.assert_array_equal(got, want)
+        got_bool = np.asarray(pack_bits(jnp.asarray(bits.astype(bool)), n))
+        np.testing.assert_array_equal(got_bool, want)
+        np.testing.assert_array_equal(
+            np.asarray(unpack_bits(jnp.asarray(want), n)), bits
+        )
+    batched = rng.integers(0, 2, size=(3, 2048)).astype(np.uint8)
+    got = np.asarray(pack_bits(jnp.asarray(batched), 2048))
+    for i in range(3):
+        np.testing.assert_array_equal(
+            got[i], np.asarray(pack_bits(jnp.asarray(batched[i]), 2048))
+        )
+
+
 def test_xla_applier_matches_numpy():
     import jax.numpy as jnp
 
