@@ -292,12 +292,16 @@ def run_cell(spec: dict) -> dict:
     if mode.startswith("multi-"):
         engine = mode.split("-", 1)[1]
         num_sources = int(spec.get("num_sources", 64))
+        chunk = int(spec.get("chunk", 8))
         from .models.multisource import bfs_multi
 
         rng = np.random.default_rng(12345)
         sources = rng.choice(dg.num_vertices, size=num_sources, replace=False).astype(np.int32)
+        chunks = [sources[i : i + chunk] for i in range(0, num_sources, chunk)]
         # Prebuild the engine layout once (cached on disk for the big
-        # graphs) so repeats time only the compiled batched traversal.
+        # graphs) so repeats time only the compiled batched traversal; the
+        # batch runs in device-resident chunks (a 64-wide batch of V-sized
+        # state does not fit HBM at bench scales).
         key = _graph_key(dataset, scale)
         if engine == "relay":
             from .bench import load_or_build_relay
@@ -305,30 +309,35 @@ def run_cell(spec: dict) -> dict:
 
             rg, _ = load_or_build_relay(dg, key)
             eng = RelayEngine(rg)
-            run = lambda: eng.run_multi(sources)  # noqa: E731
+            run = lambda c: eng.run_multi(c)  # noqa: E731
         elif engine == "pull":
             from .bench import load_or_build_pull
 
             pg = load_or_build_pull(dg, key)
-            run = lambda: bfs_multi(pg, sources, engine="pull")  # noqa: E731
+            run = lambda c: bfs_multi(pg, c, engine="pull")  # noqa: E731
         else:
-            run = lambda: bfs_multi(dg, sources, engine=engine)  # noqa: E731
-        res = run()  # warm-up/compile
-        times = []
-        for _ in range(repeats):
-            t0 = time.perf_counter()
-            res = run()
-            times.append(time.perf_counter() - t0)
-        sec = float(np.median(times))
+            run = lambda c: bfs_multi(dg, c, engine=engine)  # noqa: E731
+        run(chunks[0])  # warm-up/compile (all chunks share one shape)
         from .graph.csr import unpad_edges
 
         esrc, _ = unpad_edges(dg)
         inf = np.iinfo(np.int32).max
-        traversed = sum(
-            int(np.count_nonzero((res.dist[i] != inf)[esrc])) for i in range(num_sources)
-        )
+        times = []
+        traversed = 0
+        for r in range(repeats):
+            t0 = time.perf_counter()
+            results = [run(c) for c in chunks]
+            times.append(time.perf_counter() - t0)
+            if r == 0:
+                traversed = sum(
+                    int(np.count_nonzero((res.dist[i] != inf)[esrc]))
+                    for res in results
+                    for i in range(res.dist.shape[0])
+                )
+        sec = float(np.median(times))
         return {**out, "num_sources": num_sources, "seconds": sec,
-                "teps": (traversed / 2) / sec, "supersteps": res.num_levels}
+                "teps": (traversed / 2) / sec,
+                "supersteps": max(res.num_levels for res in results)}
 
     raise ValueError(f"unknown mode {mode!r}")
 
@@ -406,6 +415,16 @@ def main(argv=None):
     ap.add_argument("--repeats", type=int, default=3)
     ap.add_argument("--timeout", type=int, default=3600)
     ap.add_argument("--skip-multi", action="store_true")
+    ap.add_argument(
+        "--merge", action="store_true",
+        help="merge this run's cells into the existing BENCHMARKS.json "
+        "(matching dataset+mode cells replaced) instead of starting fresh",
+    )
+    ap.add_argument(
+        "--modes", default="",
+        help="comma-separated mode filter (e.g. 'multi-relay,multi-pull'); "
+        "empty = all modes",
+    )
     args = ap.parse_args(argv)
 
     if args.cell:
@@ -415,8 +434,16 @@ def main(argv=None):
     scale = int(os.environ.get("BENCHMARKS_SCALE", "20"))
     datasets = [d for d in args.datasets.split(",") if d]
     results: list[dict] = []
+    prior: list[dict] = []
+    if args.merge and os.path.exists(os.path.join(_REPO_ROOT, "BENCHMARKS.json")):
+        with open(os.path.join(_REPO_ROOT, "BENCHMARKS.json")) as f:
+            prior = json.load(f).get("results", [])
+
+    mode_filter = {m for m in args.modes.split(",") if m}
 
     def cell(dataset, mode, virtual=None, **kw):
+        if mode_filter and mode not in mode_filter:
+            return None
         spec = {"dataset": dataset, "mode": mode, "scale": scale,
                 "repeats": args.repeats, **kw}
         t0 = time.time()
@@ -443,6 +470,11 @@ def main(argv=None):
         for engine in ("pull", "relay"):
             cell("rmat", f"multi-{engine}", num_sources=64)
 
+    if prior:
+        done = {(r.get("dataset"), r.get("mode")) for r in results}
+        results = [
+            r for r in prior if (r.get("dataset"), r.get("mode")) not in done
+        ] + results
     payload = {
         "scale": scale,
         "shard_counts": list(SHARD_COUNTS),
