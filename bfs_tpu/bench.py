@@ -286,9 +286,13 @@ def _multi_source_bench(rg, eng, dg, source, *, num_sources, chunk, do_check):
 
     check_status = "skipped"
     if do_check:
+        from .models.bfs import slots_to_parent
+
         st0 = jax.device_get(run_chunk(chunks[0]))
         dist0 = np.asarray(st0.dist[:, : rg.num_vertices])[:, rg.old2new]
-        parent0 = np.asarray(st0.parent[:, : rg.num_vertices])[:, rg.old2new]
+        parent0 = slots_to_parent(
+            np.asarray(st0.parent[:, : rg.num_vertices]), rg.src_l1
+        )[:, rg.old2new]
         host_graph = Graph(dg.num_vertices, esrc, edst)
         for i, s in enumerate(chunks[0]):
             parent0[i, s] = s

@@ -114,6 +114,15 @@ def _bfs_pull_fused(
     return jax.lax.while_loop(cond, body, state)
 
 
+def slots_to_parent(parent_slots: np.ndarray, src_l1: np.ndarray) -> np.ndarray:
+    """Map relay-engine parent values (L1 slot indices; -1 unreached; the
+    source's self-entry is fixed up by callers) to ORIGINAL src ids — the
+    once-per-run host gather that replaces a per-superstep int32 table read
+    on device (ops/relay.relay_candidates)."""
+    slots = np.clip(parent_slots, 0, src_l1.shape[-1] - 1)
+    return np.where(parent_slots >= 0, src_l1[slots], parent_slots).astype(np.int32)
+
+
 @functools.lru_cache(maxsize=16)
 def _relay_fused_program(
     num_vertices: int,
@@ -130,7 +139,7 @@ def _relay_fused_program(
     from ..ops.relay import relay_candidates, relay_superstep
 
     @functools.partial(jax.jit, static_argnames=("max_levels",))
-    def fused(source_new, vperm_masks, net_masks, src_l1_parts, max_levels):
+    def fused(source_new, vperm_masks, net_masks, valid_words, max_levels):
         def cand_fn(frontier):
             return relay_candidates(
                 frontier,
@@ -142,7 +151,7 @@ def _relay_fused_program(
                 net_size=net_size,
                 m2=m2,
                 in_classes=in_classes,
-                src_l1_parts=src_l1_parts,
+                valid_words=valid_words,
             )
 
         state = init_state(num_vertices, source_new)
@@ -173,7 +182,7 @@ def _relay_step_program(
     from ..ops.relay import relay_candidates, relay_superstep
 
     @jax.jit
-    def step(state, vperm_masks, net_masks, src_l1_parts):
+    def step(state, vperm_masks, net_masks, valid_words):
         def cand_fn(frontier):
             return relay_candidates(
                 frontier,
@@ -185,7 +194,7 @@ def _relay_step_program(
                 net_size=net_size,
                 m2=m2,
                 in_classes=in_classes,
-                src_l1_parts=src_l1_parts,
+                valid_words=valid_words,
             )
 
         return relay_superstep(state, cand_fn)
@@ -210,7 +219,7 @@ def _relay_multi_fused_program(
     from ..ops.relay import relay_candidates
 
     @functools.partial(jax.jit, static_argnames=("max_levels",))
-    def fused(sources_new, vperm_masks, net_masks, src_l1_parts, max_levels):
+    def fused(sources_new, vperm_masks, net_masks, valid_words, max_levels):
         def cand_fn(frontier):
             return relay_candidates(
                 frontier,
@@ -222,7 +231,7 @@ def _relay_multi_fused_program(
                 net_size=net_size,
                 m2=m2,
                 in_classes=in_classes,
-                src_l1_parts=src_l1_parts,
+                valid_words=valid_words,
             )
 
         cand_batched = jax.vmap(cand_fn)
@@ -250,24 +259,19 @@ class RelayEngine:
 
     def __init__(self, graph):
         from ..graph.relay import RelayGraph, build_relay_graph
+        from ..ops.relay import valid_slot_words
 
         rg = graph if isinstance(graph, RelayGraph) else build_relay_graph(graph)
         self.relay_graph = rg
         # Device-resident layout tensors are passed as jit ARGUMENTS — a
         # closed-over concrete array is baked into the program as a constant,
-        # and the routing masks are hundreds of MB at scale >= 20.
+        # and the routing masks are hundreds of MB at scale >= 20.  The int32
+        # src table stays HOST-side (candidates are slot indices; see
+        # ops/relay.relay_candidates), freeing ~4 bytes/edge of HBM.
         self._tensors = (
             jnp.asarray(rg.vperm_masks),
             jnp.asarray(rg.net_masks),
-            tuple(
-                jnp.asarray(
-                    rg.src_l1[cs.sa : cs.sb].reshape(
-                        (cs.count, cs.width) if cs.vertex_major
-                        else (cs.width, cs.count)
-                    )
-                )
-                for cs in rg.in_classes
-            ),
+            jnp.asarray(valid_slot_words(rg.src_l1, rg.net_size)),
         )
         self._raw_fused = _relay_fused_program(
             rg.num_vertices,
@@ -300,10 +304,13 @@ class RelayEngine:
         max_levels = int(max_levels) if max_levels is not None else rg.num_vertices
         source_new = int(rg.old2new[source])
         state = jax.device_get(self._fused(jnp.int32(source_new), max_levels))
-        # Engine state lives in relabeled space with original-id parent
-        # VALUES; map the index space back (host, once per run).
+        # Engine state lives in relabeled space with L1-SLOT parent values;
+        # map slots -> original src ids and the index space back (host, once
+        # per run).
         dist_new = np.asarray(state.dist[: rg.num_vertices])
-        parent_new = np.asarray(state.parent[: rg.num_vertices])
+        parent_new = slots_to_parent(
+            np.asarray(state.parent[: rg.num_vertices]), rg.src_l1
+        )
         dist = dist_new[rg.old2new]
         parent = parent_new[rg.old2new]
         parent[source] = source  # init wrote the relabeled id at the source
@@ -332,7 +339,9 @@ class RelayEngine:
             fused(sources_new, *self._tensors, max_levels=max_levels)
         )
         dist_new = np.asarray(state.dist[:, : rg.num_vertices])
-        parent_new = np.asarray(state.parent[:, : rg.num_vertices])
+        parent_new = slots_to_parent(
+            np.asarray(state.parent[:, : rg.num_vertices]), rg.src_l1
+        )
         dist = dist_new[:, rg.old2new]
         parent = parent_new[:, rg.old2new]
         rows = np.arange(sources.shape[0])
@@ -491,6 +500,7 @@ class SuperstepRunner:
         parent = np.asarray(state.parent[:v])
         frontier = np.asarray(state.frontier[:v])
         if self._old2new is not None:
+            parent = slots_to_parent(parent, self._relay.relay_graph.src_l1)
             dist = dist[self._old2new]
             parent = parent[self._old2new]
             frontier = frontier[self._old2new]

@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .relax import INT32_MAX, BfsState, apply_candidates
 
@@ -58,6 +59,24 @@ def pack_bits(bits: jax.Array, n: int) -> jax.Array:
         | (planes[..., 2, :] << 16)
         | (planes[..., 3, :] << 24)
     )
+
+
+def pack_bits_host(bits: np.ndarray, n: int) -> np.ndarray:
+    """NumPy twin of :func:`pack_bits` (same bit-major layout): uint8/bool[n]
+    -> uint32[n/32].  Used host-side to precompute static word masks (e.g.
+    the valid-slot mask) without touching the device."""
+    bits = np.asarray(bits, dtype=np.uint8)
+    if n <= 32:
+        word = np.uint32(0)
+        for b in range(n):
+            word |= np.uint32(bits[b]) << np.uint32(b)
+        return np.array([word], dtype=np.uint32)
+    nw = n // 32
+    planes = bits.reshape(32, nw)
+    words = np.zeros(nw, dtype=np.uint32)
+    for b in range(32):  # 32 cheap passes instead of one 32x-widened temp
+        words |= planes[b].astype(np.uint32) << np.uint32(b)
+    return words
 
 
 def unpack_bits(words: jax.Array, n: int) -> jax.Array:
@@ -128,6 +147,21 @@ def apply_benes(words: jax.Array, masks: jax.Array, n: int) -> jax.Array:
     return x.reshape(-1)
 
 
+def valid_slot_words(src_l1: np.ndarray, net_size: int) -> np.ndarray:
+    """Static valid-slot bitmask for :func:`relay_candidates`:
+    uint32[net_size/32], bit set iff that L1 slot holds a REAL edge.
+
+    The Beneš pad-routing may deliver stray 1-bits to padded row slots
+    (pad_perm wires unused outputs to arbitrary unused inputs, some of which
+    are broadcast copies of live frontier bits).  The old int32 src table
+    made those inert via INF entries; with iota slot candidates the mask
+    must zero them before the row-min instead."""
+    bits = np.zeros(net_size, dtype=np.uint8)
+    m1 = src_l1.shape[0]
+    bits[:m1] = src_l1 != np.int32(INT32_MAX)
+    return pack_bits_host(bits, net_size)
+
+
 def relay_candidates(
     frontier: jax.Array,
     *,
@@ -139,13 +173,18 @@ def relay_candidates(
     net_size: int,
     m2: int,
     in_classes,
-    src_l1_parts,
+    valid_words: jax.Array,
 ) -> jax.Array:
-    """Min active ORIGINAL-id in-neighbour per (relabeled) vertex: int32[V].
+    """Min active in-edge SLOT per (relabeled) vertex: int32[V].
 
     ``frontier``: bool[V+1] in relabeled vertex order (sentinel slot
-    ignored).  ``src_l1_parts``: per-in-class int32 tables, shaped
-    ``[Nc, w]`` (vertex-major) or ``[w, Nc]`` (rank-major), INF padding.
+    ignored).  Candidate VALUES are global L1 slot indices, not src ids:
+    within a dst row, slots are filled in ascending ORIGINAL src-id order
+    (graph/relay.py ord1 lexsort), so min active slot == min active src id —
+    the canonical min-parent tie-break survives, while the hot loop never
+    reads the int32 src table (~4 bytes/edge/superstep saved).  Engines map
+    slot -> original src id once on the host via ``RelayGraph.src_l1``.
+    ``valid_words``: static bitmask from :func:`valid_slot_words`.
     """
     v = num_vertices
     fbits = frontier[:v].astype(jnp.uint8)
@@ -159,8 +198,21 @@ def relay_candidates(
         net_size=net_size,
         m2=m2,
         in_classes=in_classes,
-        src_l1_parts=src_l1_parts,
+        valid_words=valid_words,
     )
+
+
+def _class_slot_iota(cs) -> jax.Array:
+    """Global L1 slot index per position of one in-class view — generated
+    on-chip (broadcasted_iota), zero HBM traffic."""
+    if cs.vertex_major:  # view [Nc, w], slot = sa + p*w + r
+        p = jax.lax.broadcasted_iota(jnp.int32, (cs.count, cs.width), 0)
+        r = jax.lax.broadcasted_iota(jnp.int32, (cs.count, cs.width), 1)
+        return cs.sa + p * cs.width + r
+    # view [w, Nc], slot = sa + r*Nc + p
+    r = jax.lax.broadcasted_iota(jnp.int32, (cs.width, cs.count), 0)
+    p = jax.lax.broadcasted_iota(jnp.int32, (cs.width, cs.count), 1)
+    return cs.sa + r * cs.count + p
 
 
 def relay_candidates_packed(
@@ -173,7 +225,7 @@ def relay_candidates_packed(
     net_size: int,
     m2: int,
     in_classes,
-    src_l1_parts,
+    valid_words: jax.Array,
 ) -> jax.Array:
     """:func:`relay_candidates` from ALREADY-PACKED frontier words
     (uint32[vperm_size/32]).  The sharded engine feeds the bit-packed
@@ -198,19 +250,22 @@ def relay_candidates_packed(
     parts.append(jnp.zeros(net_size - m2, dtype=jnp.uint8))
     l2 = jnp.concatenate(parts)
 
-    l1bits = unpack_bits(
-        apply_benes(pack_bits(l2, net_size), net_masks, net_size), net_size
-    )
+    l1words = apply_benes(pack_bits(l2, net_size), net_masks, net_size)
+    l1bits = unpack_bits(l1words & valid_words, net_size)
 
     cands = []
-    for cs, src_tab in zip(in_classes, src_l1_parts):
+    for cs in in_classes:
         seg = l1bits[cs.sa : cs.sb]
         if cs.vertex_major:
             bits = seg.reshape(cs.count, cs.width)
-            cands.append(jnp.min(jnp.where(bits != 0, src_tab, INT32_MAX), axis=1))
+            cands.append(
+                jnp.min(jnp.where(bits != 0, _class_slot_iota(cs), INT32_MAX), axis=1)
+            )
         else:
             bits = seg.reshape(cs.width, cs.count)
-            cands.append(jnp.min(jnp.where(bits != 0, src_tab, INT32_MAX), axis=0))
+            cands.append(
+                jnp.min(jnp.where(bits != 0, _class_slot_iota(cs), INT32_MAX), axis=0)
+            )
     return jnp.concatenate(cands)
 
 
@@ -218,8 +273,10 @@ def relay_superstep(state: BfsState, cand_fn) -> BfsState:
     """One superstep given ``cand_fn(frontier) -> int32[V]`` candidates.
 
     NOTE: ``state`` lives in the RELABELED vertex space; ``cand`` VALUES are
-    original ids (the canonical min-parent), which the loop never indexes
-    with — only the engine wrapper maps spaces at the end.
+    L1 slot indices (min active slot == canonical min-parent, see
+    :func:`relay_candidates`), which the loop never indexes with — engine
+    wrappers map slot -> original src id at the end (models/bfs.py
+    ``slots_to_parent``).
     """
     cand = cand_fn(state.frontier)
     cand = jnp.concatenate([cand, jnp.full((1,), INT32_MAX, jnp.int32)])

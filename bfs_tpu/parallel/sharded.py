@@ -228,7 +228,7 @@ def _bfs_sharded_pull_fused(ell0, folds, source, *, mesh, block, max_levels):
     ),
 )
 def _bfs_sharded_relay_fused(
-    vperm_masks, net_masks, src_l1_parts, source_new, *,
+    vperm_masks, net_masks, valid_words, source_new, *,
     mesh, block, vperm_size, out_classes, net_size, m2, in_classes,
     max_levels,
 ):
@@ -237,17 +237,18 @@ def _bfs_sharded_relay_fused(
     bit-packed all-gather as the sharded pull engine; the all-gathered words
     feed each shard's vperm network directly (its routed permutation absorbs
     the block-packed layout).  State lives in the GLOBAL RELABELED space —
-    dist/parent fully distributed, parent VALUES are original ids."""
+    dist/parent fully distributed, parent VALUES are per-shard L1 slot
+    indices (converted to original src ids on the host, bfs_sharded)."""
     from ..ops.relay import relay_candidates_packed
 
     n = mesh.shape[GRAPH_AXIS]
     nw = block // 32
     nww = vperm_size // 32
 
-    def inner(vperm_blk, net_blk, src_parts_blk, source):
+    def inner(vperm_blk, net_blk, valid_blk, source):
         vperm_blk = vperm_blk[0]
         net_blk = net_blk[0]
-        src_parts = tuple(p[0] for p in src_parts_blk)
+        valid_blk = valid_blk[0]
         dist, parent = _init_block_state(source, block)
         fwords = _packed_source_frontier(source, block, n)
         zpad = jnp.zeros((nww - n * nw,), jnp.uint32)
@@ -266,7 +267,7 @@ def _bfs_sharded_relay_fused(
                 net_size=net_size,
                 m2=m2,
                 in_classes=in_classes,
-                src_l1_parts=src_parts,
+                valid_words=valid_blk,
             )
             return _apply_block_candidates(carry, cand, nw)
 
@@ -281,13 +282,13 @@ def _bfs_sharded_relay_fused(
         in_specs=(
             P(GRAPH_AXIS, None, None),
             P(GRAPH_AXIS, None, None),
-            tuple(P(GRAPH_AXIS, None, None) for _ in src_l1_parts),
+            P(GRAPH_AXIS, None),
             P(),
         ),
         out_specs=(P(GRAPH_AXIS), P(GRAPH_AXIS), P()),
         axis_names={GRAPH_AXIS},
     )
-    return fn(vperm_masks, net_masks, src_l1_parts, source_new)
+    return fn(vperm_masks, net_masks, valid_words, source_new)
 
 
 def _prepare_relay(graph, mesh: Mesh):
@@ -306,19 +307,17 @@ def _prepare_relay(graph, mesh: Mesh):
     return build_sharded_relay_graph(graph, n)
 
 
-def _relay_src_parts(srg):
-    """Per-in-class src-id tables stacked over shards, viewed [n, Nc, w]
-    (vertex-major) or [n, w, Nc] (rank-major)."""
-    parts = []
-    for cs in srg.in_classes:
-        seg = srg.src_l1[:, cs.sa : cs.sb]
-        shape = (
-            (srg.num_shards, cs.count, cs.width)
-            if cs.vertex_major
-            else (srg.num_shards, cs.width, cs.count)
+def _relay_valid_words(srg):
+    """Per-shard valid-slot bitmasks (ops/relay.valid_slot_words), stacked
+    over shards: uint32[n, net_size/32]."""
+    from ..ops.relay import valid_slot_words
+
+    return jnp.asarray(
+        np.stack(
+            [valid_slot_words(srg.src_l1[s], srg.net_size)
+             for s in range(srg.num_shards)]
         )
-        parts.append(jnp.asarray(seg.reshape(shape)))
-    return tuple(parts)
+    )
 
 
 def _prepare_pull(
@@ -369,7 +368,7 @@ def bfs_sharded(
         dist, parent, level = _bfs_sharded_relay_fused(
             jnp.asarray(srg.vperm_masks),
             jnp.asarray(srg.net_masks),
-            _relay_src_parts(srg),
+            _relay_valid_words(srg),
             source_new,
             mesh=mesh,
             block=srg.block,
@@ -382,6 +381,13 @@ def bfs_sharded(
         )
         dist = np.asarray(jax.device_get(dist))
         parent = np.asarray(jax.device_get(parent))
+        # Parent values are per-shard L1 slot indices; vertex at global new
+        # id g is owned by shard g // block with src table src_l1[shard].
+        shard_of = np.arange(parent.shape[0]) // srg.block
+        slots = np.clip(parent, 0, srg.src_l1.shape[1] - 1)
+        parent = np.where(
+            parent >= 0, srg.src_l1[shard_of, slots], parent
+        ).astype(np.int32)
         # State is in the global relabeled space; map back to original ids.
         dist = dist[srg.old2new]
         parent = parent[srg.old2new]

@@ -66,31 +66,26 @@ def main():
     print(f"V={v} E={rg.num_edges} vperm={rg.vperm_size} net={rg.net_size} "
           f"m2={rg.m2} out_classes={len(rg.out_classes)} in_classes={len(rg.in_classes)}")
 
+    from bfs_tpu.ops.relay import valid_slot_words
+
     vperm_masks = jnp.asarray(rg.vperm_masks)
     net_masks = jnp.asarray(rg.net_masks)
-    src_parts = tuple(
-        jnp.asarray(
-            rg.src_l1[cs.sa : cs.sb].reshape(
-                (cs.count, cs.width) if cs.vertex_major else (cs.width, cs.count)
-            )
-        )
-        for cs in rg.in_classes
-    )
+    valid_words = jnp.asarray(valid_slot_words(rg.src_l1, rg.net_size))
     rng = np.random.default_rng(0)
     frontier = jnp.asarray(rng.random(v + 1) < 0.3)
 
     # Whole candidate pipeline.  All device tensors are ARGUMENTS — a
     # closed-over concrete array would be baked into the program as a
     # constant (5.5GB at scale 24, breaking the remote compile transport).
-    def whole(frontier, vperm_masks, net_masks, src_parts):
+    def whole(frontier, vperm_masks, net_masks, valid_words):
         return relay_candidates(
             frontier, num_vertices=v, vperm_masks=vperm_masks,
             vperm_size=rg.vperm_size, out_classes=rg.out_classes,
             net_masks=net_masks, net_size=rg.net_size, m2=rg.m2,
-            in_classes=rg.in_classes, src_l1_parts=src_parts,
+            in_classes=rg.in_classes, valid_words=valid_words,
         )
 
-    timeit("relay_candidates (whole)", whole, frontier, vperm_masks, net_masks, src_parts)
+    timeit("relay_candidates (whole)", whole, frontier, vperm_masks, net_masks, valid_words)
 
     # Phase 1: frontier -> out-order bits (vperm route)
     def phase_vperm(frontier, vperm_masks):
@@ -144,20 +139,26 @@ def main():
     l1bits = jax.jit(phase_unpack)(l1w)
     timeit("  unpack_bits(l1)", phase_unpack, l1w)
 
-    # Phase 4: class row-min
-    def phase_rowmin(l1bits, src_parts):
+    # Phase 4: class row-min (iota slot candidates; see ops/relay.py)
+    from bfs_tpu.ops.relay import _class_slot_iota
+
+    def phase_rowmin(l1bits):
         cands = []
-        for cs, tab in zip(rg.in_classes, src_parts):
+        for cs in rg.in_classes:
             seg = l1bits[cs.sa : cs.sb]
             if cs.vertex_major:
                 bits = seg.reshape(cs.count, cs.width)
-                cands.append(jnp.min(jnp.where(bits != 0, tab, INT32_MAX), axis=1))
+                cands.append(
+                    jnp.min(jnp.where(bits != 0, _class_slot_iota(cs), INT32_MAX), axis=1)
+                )
             else:
                 bits = seg.reshape(cs.width, cs.count)
-                cands.append(jnp.min(jnp.where(bits != 0, tab, INT32_MAX), axis=0))
+                cands.append(
+                    jnp.min(jnp.where(bits != 0, _class_slot_iota(cs), INT32_MAX), axis=0)
+                )
         return jnp.concatenate(cands)
 
-    timeit("  rowmin", phase_rowmin, l1bits, src_parts)
+    timeit("  rowmin", phase_rowmin, l1bits)
 
     # Single-stage butterfly costs at the three distance regimes
     nw = rg.net_size // 32
