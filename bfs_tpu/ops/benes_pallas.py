@@ -1,0 +1,303 @@
+"""Pallas TPU kernels: the whole Beneš network in three fused passes.
+
+:func:`~bfs_tpu.ops.relay.apply_benes` applies 2·log2(N)-1 butterfly stages
+to the bit-major packed word array.  In plain XLA every stage is its own
+kernel: an HBM round-trip of the word array plus ~0.4 ms of per-kernel
+launch overhead (measured on the bench TPU) — 55 kernels at net 2^28.
+The stages factor into three runs, each closed under a tiling that fits
+VMEM, so the network needs only THREE kernels with x resident in VMEM
+across every stage of a pass and the per-stage masks DMA-streamed from
+HBM with double buffering (the masks are the irreducible traffic):
+
+viewing the words as [R, 128] and an element distance d as
+
+  * a lane distance d                 (d < 128)
+  * a row distance  d // 128          (128 <= d < nw)
+  * a bit-plane distance d // nw      (d >= nw, elementwise)
+
+pick tile rows TR (power of two).  A stage with d < TR*128 is closed under
+aligned contiguous [TR, 128] tiles (row ^ br keeps high row bits for
+br < TR) — and the Beneš schedule descends N/2 → 1 → N/2, so those LOCAL
+stages form one consecutive run in the middle.  The OUTER stages (bit
+planes and row distances >= TR) are closed under the complementary tiling:
+view [B, TR, 128] with B = R/TR and take a (B, tt, 128) block — full outer
+axis, a chunk of the inner rows — since row ^ br for br >= TR only touches
+the outer index (b ^ (br/TR)), elementwise bit stages don't care, and the
+down/up halves put those stages in a prefix and a suffix run.
+
+So: pass A = prefix outer stages, pass B = the local run, pass C = suffix
+outer stages; x traffic is 3 round-trips instead of 55, and kernel count
+drops ~18x.  Verified bit-exact against the per-stage XLA path
+(tests/test_benes_pallas.py) and by the bench's check() invariants.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+
+LANES = 128
+#: Local-pass tile rows: 2048 rows * 128 lanes * 4 B = 1 MB of VMEM for x,
+#: double that for the streamed mask buffers.
+TILE_ROWS = 2048
+#: Outer-pass inner-chunk rows; the block is (B, OUTER_TT, 128).
+OUTER_TT = 64
+
+
+def pallas_enabled() -> bool:
+    """Use the Pallas path only on real TPU backends (the CPU test platform
+    runs the pure-XLA stages).  BFS_TPU_PALLAS=0/1 overrides."""
+    env = os.environ.get("BFS_TPU_PALLAS", "")
+    if env in ("0", "1"):
+        return env == "1"
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:  # pragma: no cover - backend init failure
+        return False
+
+
+def stage_distances(n: int) -> list[int]:
+    """Element distance of every Beneš stage for an n-element network
+    (must match apply_benes / native/benes.cpp stage order)."""
+    k = int(n).bit_length() - 1
+    return [n >> (s + 1) if s < k else n >> (2 * k - 1 - s)
+            for s in range(2 * k - 1)]
+
+
+def local_stage_run(n: int, tile_rows: int = TILE_ROWS) -> tuple[int, int]:
+    """[lo, hi) stage-index range with element distance < tr*128 (tr = the
+    EFFECTIVE tile rows, clamped to the network's row count) — the
+    consecutive middle run pass B fuses."""
+    tr = min(tile_rows, max(n // 32 // LANES, 1))
+    dists = stage_distances(n)
+    local = [s for s, d in enumerate(dists) if d < tr * LANES]
+    if not local:
+        return (0, 0)
+    lo, hi = local[0], local[-1] + 1
+    assert local == list(range(lo, hi)), "local stages must be consecutive"
+    return (lo, hi)
+
+
+def _stage_on_tile(x, m, d, *, nw, rows, lane_axis, row_axis, outer_axis,
+                   outer_span, tr):
+    """One butterfly stage on a VMEM-resident tile.
+
+    ``rows``: size of the row axis inside the tile (pass B); ``outer_span``:
+    size of the outer axis (pass A/C).  Exactly one regime applies per d.
+    """
+    if d >= nw:  # bit-plane butterfly: elementwise on every word
+        sh = jnp.uint32(d // nw)
+        t = (x ^ (x >> sh)) & m
+        return x ^ t ^ (t << sh)
+    if d < LANES:  # lane butterfly inside each 128-word row
+        lane = jax.lax.broadcasted_iota(jnp.int32, x.shape, lane_axis)
+        has = (lane & d) != 0
+        partner = jnp.where(
+            has, jnp.roll(x, d, axis=lane_axis), jnp.roll(x, -d, axis=lane_axis)
+        )
+        m_both = jnp.where(has, jnp.roll(m, d, axis=lane_axis), m)
+        return x ^ ((x ^ partner) & m_both)
+    br = d // LANES
+    if br < tr:  # row butterfly inside the local tile (pass B)
+        idx = jax.lax.broadcasted_iota(jnp.int32, x.shape, row_axis)
+        has = (idx & br) != 0
+        partner = jnp.where(
+            has, jnp.roll(x, br, axis=row_axis), jnp.roll(x, -br, axis=row_axis)
+        )
+        m_both = jnp.where(has, jnp.roll(m, br, axis=row_axis), m)
+        return x ^ ((x ^ partner) & m_both)
+    cb = br // tr  # outer-block butterfly (pass A/C): partner block b ^ cb
+    idx = jax.lax.broadcasted_iota(jnp.int32, x.shape, outer_axis)
+    has = (idx & cb) != 0
+    partner = jnp.where(
+        has, jnp.roll(x, cb, axis=outer_axis), jnp.roll(x, -cb, axis=outer_axis)
+    )
+    m_both = jnp.where(has, jnp.roll(m, cb, axis=outer_axis), m)
+    return x ^ ((x ^ partner) & m_both)
+
+
+def _streamed_pass(x, masks, dists, *, nw, tr, mode, interpret):
+    """One fused pass: all ``dists`` stages with x VMEM-resident, masks
+    DMA-streamed stage-by-stage with double buffering.
+
+    mode 'local': x viewed [R, 128], grid over TR-row tiles.
+    mode 'outer': x viewed [B, TR, 128], grid over tt-chunks of TR.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    r = nw // LANES
+    s_n = len(dists)
+
+    if mode == "local":
+        grid = (r // tr,)
+        x_view = x.reshape(r, LANES)
+        m_view = masks.reshape(s_n, r, LANES)
+        block = (tr, LANES)
+        x_spec = pl.BlockSpec(block, lambda i: (i, 0), memory_space=pltpu.VMEM)
+
+        def dma(m_hbm, mbuf, sem, slot, si):
+            i = pl.program_id(0)
+            return pltpu.make_async_copy(
+                m_hbm.at[si, pl.ds(i * tr, tr), :], mbuf.at[slot], sem.at[slot]
+            )
+
+        def stage(x, m, d):
+            return _stage_on_tile(
+                x, m, d, nw=nw, rows=tr, lane_axis=1, row_axis=0,
+                outer_axis=None, outer_span=None, tr=tr,
+            )
+    else:
+        b = r // tr
+        tt = min(OUTER_TT, tr)
+        grid = (tr // tt,)
+        x_view = x.reshape(b, tr, LANES)
+        m_view = masks.reshape(s_n, b, tr, LANES)
+        block = (b, tt, LANES)
+        x_spec = pl.BlockSpec(block, lambda j: (0, j, 0), memory_space=pltpu.VMEM)
+
+        def dma(m_hbm, mbuf, sem, slot, si):
+            j = pl.program_id(0)
+            return pltpu.make_async_copy(
+                m_hbm.at[si, :, pl.ds(j * tt, tt), :], mbuf.at[slot], sem.at[slot]
+            )
+
+        def stage(x, m, d):
+            return _stage_on_tile(
+                x, m, d, nw=nw, rows=None, lane_axis=2, row_axis=None,
+                outer_axis=0, outer_span=b, tr=tr,
+            )
+
+    def kernel(x_ref, m_hbm, o_ref, mbuf, sem):
+        dma(m_hbm, mbuf, sem, 0, 0).start()
+        x = x_ref[:]
+        for si, d in enumerate(dists):
+            if si + 1 < s_n:
+                dma(m_hbm, mbuf, sem, (si + 1) % 2, si + 1).start()
+            dma(m_hbm, mbuf, sem, si % 2, si).wait()
+            x = stage(x, mbuf[si % 2], d)
+        o_ref[:] = x
+
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[x_spec, pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=x_spec,
+        out_shape=jax.ShapeDtypeStruct(x_view.shape, jnp.uint32),
+        scratch_shapes=[
+            pltpu.VMEM((2,) + block, jnp.uint32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=interpret,
+    )(x_view, m_view)
+    return out.reshape(-1)
+
+
+#: pack/unpack kernels engage above this bit count (and when nw % 128 == 0).
+PACK_KERNEL_MIN_BITS = 1 << 20
+_PACK_CHUNK = 4096  # words per grid step: (32, 4096) uint8 block = 128 KB
+
+
+def pack_kernel_ok(n: int) -> bool:
+    return (
+        pallas_enabled()
+        and n >= PACK_KERNEL_MIN_BITS
+        and (n // 32) % _PACK_CHUNK == 0
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("n", "interpret"))
+def pack_bits_pallas(bits: jax.Array, n: int, interpret: bool = False) -> jax.Array:
+    """Bit-major pack as ONE Pallas kernel: uint8[n] -> uint32[n/32].
+
+    The bit-major layout (word w bit b = element b*nw + w) makes the XLA
+    formulation read the byte array with plane-interleaved strides (measured
+    ~12 GB/s); here each grid step reads a (32, chunk) byte block — 32
+    contiguous plane rows — widens in VMEM and writes or-combined words."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    nw = n // 32
+
+    def kernel(x_ref, o_ref):
+        x = x_ref[:].astype(jnp.uint32)  # (32, chunk)
+        sh = jax.lax.broadcasted_iota(jnp.uint32, (32, 1), 0)
+        o_ref[:] = (x << sh).sum(axis=0, dtype=jnp.uint32)[None, :]
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(nw // _PACK_CHUNK,),
+        in_specs=[
+            pl.BlockSpec((32, _PACK_CHUNK), lambda i: (0, i), memory_space=pltpu.VMEM)
+        ],
+        out_specs=pl.BlockSpec((1, _PACK_CHUNK), lambda i: (0, i), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((1, nw), jnp.uint32),
+        interpret=interpret,
+    )(bits.reshape(32, nw))
+    return out.reshape(-1)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "interpret"))
+def unpack_bits_pallas(words: jax.Array, n: int, interpret: bool = False) -> jax.Array:
+    """Bit-major unpack as ONE Pallas kernel: uint32[n/32] -> uint8[n]."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    nw = n // 32
+
+    def kernel(x_ref, o_ref):
+        w = x_ref[:]  # (1, chunk)
+        sh = jax.lax.broadcasted_iota(jnp.uint32, (32, 1), 0)
+        o_ref[:] = ((w >> sh) & jnp.uint32(1)).astype(jnp.uint8)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(nw // _PACK_CHUNK,),
+        in_specs=[
+            pl.BlockSpec((1, _PACK_CHUNK), lambda i: (0, i), memory_space=pltpu.VMEM)
+        ],
+        out_specs=pl.BlockSpec((32, _PACK_CHUNK), lambda i: (0, i), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((32, nw), jnp.uint8),
+        interpret=interpret,
+    )(words.reshape(1, nw))
+    return out.reshape(-1)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("n", "tile_rows", "interpret")
+)
+def apply_benes_fused(
+    words: jax.Array, masks: jax.Array, *, n: int,
+    tile_rows: int = TILE_ROWS, interpret: bool = False,
+) -> jax.Array:
+    """The full routed Beneš network (all 2·log2(n)-1 stages) in at most
+    three fused Pallas passes.  ``words``: uint32[n/32] bit-major;
+    ``masks``: uint32[stages, n/32] from ``benes.route(..., bit_major=True)``.
+    """
+    nw = n // 32
+    r = nw // LANES
+    tr = min(tile_rows, r)
+    dists = stage_distances(n)
+    lo, hi = local_stage_run(n, tile_rows)
+    assert lo < hi, "no local run — network too small for the fused path"
+
+    x = words
+    if lo > 0:  # pass A: prefix outer stages (bit planes + big row rolls)
+        x = _streamed_pass(
+            x, masks[:lo], dists[:lo], nw=nw, tr=tr, mode="outer",
+            interpret=interpret,
+        )
+    # pass B: the local run
+    x = _streamed_pass(
+        x, masks[lo:hi], dists[lo:hi], nw=nw, tr=tr, mode="local",
+        interpret=interpret,
+    )
+    if hi < len(dists):  # pass C: suffix outer stages
+        x = _streamed_pass(
+            x, masks[hi:], dists[hi:], nw=nw, tr=tr, mode="outer",
+            interpret=interpret,
+        )
+    return x

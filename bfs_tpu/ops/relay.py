@@ -50,6 +50,10 @@ def pack_bits(bits: jax.Array, n: int) -> jax.Array:
         b = bits.astype(jnp.uint32)
         shifts = jnp.arange(n, dtype=jnp.uint32)
         return (b << shifts).sum(axis=-1, dtype=jnp.uint32)[..., None]
+    from .benes_pallas import pack_bits_pallas, pack_kernel_ok
+
+    if not lead and pack_kernel_ok(n):
+        return pack_bits_pallas(bits.astype(jnp.uint8), n)
     b = bits.reshape(*lead, 4, 8, nw).astype(jnp.uint8)
     shifts8 = jnp.arange(8, dtype=jnp.uint8)[:, None]
     planes = (b << shifts8).sum(axis=-2, dtype=jnp.uint8).astype(jnp.uint32)
@@ -83,6 +87,10 @@ def unpack_bits(words: jax.Array, n: int) -> jax.Array:
     """uint32[n/32] -> uint8[n], bit-major."""
     if n <= 32:
         return ((words[0] >> jnp.arange(n, dtype=jnp.uint32)) & 1).astype(jnp.uint8)
+    from .benes_pallas import pack_kernel_ok, unpack_bits_pallas
+
+    if words.ndim == 1 and pack_kernel_ok(n):
+        return unpack_bits_pallas(words, n)
     shifts = jnp.arange(32, dtype=jnp.uint32)[:, None]
     return ((words[None, :] >> shifts) & 1).astype(jnp.uint8).reshape(-1)
 
@@ -114,6 +122,14 @@ def apply_benes(words: jax.Array, masks: jax.Array, n: int) -> jax.Array:
     nw = n // 32
     if n < MIN_PACKED_BITS:
         return _apply_benes_small(words, masks, n)
+
+    from .benes_pallas import apply_benes_fused, pallas_enabled
+
+    if pallas_enabled():
+        # Whole network in <= 3 fused Pallas passes (x VMEM-resident,
+        # masks DMA-streamed); the per-stage loop below is the portable
+        # XLA fallback for CPU platforms.
+        return apply_benes_fused(words, masks, n=n)
 
     r = nw // LANES
     x = words.reshape(r, LANES)

@@ -1,0 +1,94 @@
+"""Fused Beneš Pallas passes vs the per-stage XLA path and an element-space
+NumPy reference.
+
+apply_benes_fused (ops/benes_pallas.py) must be bit-exact with applying the
+same stages one butterfly at a time.  Runs under the Pallas interpreter so
+the CPU test platform covers the kernel math (including the mask DMA
+streaming); the real-TPU compiled path is exercised by bench.py, whose
+result is check()-verified.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from bfs_tpu.ops.benes_pallas import (  # noqa: E402
+    LANES,
+    apply_benes_fused,
+    local_stage_run,
+    stage_distances,
+)
+from bfs_tpu.ops.relay import pack_bits_host  # noqa: E402
+
+
+def _unpack_host(words: np.ndarray, n: int) -> np.ndarray:
+    nw = max(n // 32, 1)
+    out = np.zeros(n, dtype=np.uint8)
+    for b in range(32):
+        out[b * nw : (b + 1) * nw] = (words >> np.uint32(b)) & 1
+    return out
+
+
+def _butterfly_elements(x: np.ndarray, mask_bits: np.ndarray, d: int) -> np.ndarray:
+    """One stage in element space: swap pairs (e, e+d) where the mask bit at
+    the LOWER element is set (matches ops/relay._apply_benes_small)."""
+    x2 = x.reshape(-1, 2, d).copy()
+    m = mask_bits.reshape(-1, 2, d)[:, 0, :].astype(bool)
+    lo, hi = x2[:, 0, :].copy(), x2[:, 1, :].copy()
+    x2[:, 0, :] = np.where(m, hi, lo)
+    x2[:, 1, :] = np.where(m, lo, hi)
+    return x2.reshape(-1)
+
+
+def test_pack_unpack_kernels_roundtrip():
+    from bfs_tpu.ops.benes_pallas import pack_bits_pallas, unpack_bits_pallas
+
+    n = 1 << 20
+    rng = np.random.default_rng(3)
+    bits = rng.integers(0, 2, size=n, dtype=np.uint8)
+    words = pack_bits_host(bits, n)
+    got_w = np.asarray(pack_bits_pallas(jnp.asarray(bits), n, interpret=True))
+    np.testing.assert_array_equal(got_w, words)
+    got_b = np.asarray(unpack_bits_pallas(jnp.asarray(words), n, interpret=True))
+    np.testing.assert_array_equal(got_b, bits)
+
+
+@pytest.mark.parametrize(
+    "n,tile_rows",
+    [
+        (1 << 15, 4),   # r=8: outer passes carry the bit stages + big rolls
+        (1 << 16, 8),   # r=16
+        (1 << 16, 16),  # tr == r: no outer passes, everything local
+    ],
+)
+def test_fused_passes_match_element_reference(n, tile_rows):
+    rng = np.random.default_rng(7)
+    dists = stage_distances(n)
+    # Mask contract (native/benes.cpp): swap bits sit ONLY at the lower
+    # element of each pair — the bit-plane stage formula relies on it.
+    lower = [np.asarray((np.arange(n) & d) == 0, dtype=np.uint8) for d in dists]
+    masks = np.stack(
+        [pack_bits_host(rng.integers(0, 2, size=n, dtype=np.uint8) & lw, n)
+         for lw in lower]
+    )
+    xbits = rng.integers(0, 2, size=n, dtype=np.uint8)
+    xwords = pack_bits_host(xbits, n)
+
+    lo, hi = local_stage_run(n, tile_rows)
+    assert hi > lo
+    if tile_rows < n // 32 // LANES:
+        assert lo > 0 and hi < len(dists)  # all three passes exercised
+
+    got = np.asarray(
+        apply_benes_fused(
+            jnp.asarray(xwords), jnp.asarray(masks), n=n,
+            tile_rows=tile_rows, interpret=True,
+        )
+    )
+
+    ref = xbits.copy()
+    for s, d in enumerate(dists):
+        ref = _butterfly_elements(ref, _unpack_host(masks[s], n), d)
+    np.testing.assert_array_equal(got, pack_bits_host(ref, n))

@@ -1,9 +1,16 @@
 """Stage-level timing of the relay superstep on the real TPU.
 
 Loads the cached relay layout for a bench config and times each phase of
-relay_candidates in isolation (pack/unpack, vperm route, class broadcast,
-big Beneš route, class row-min) plus the fused whole, to locate the gap
-between the measured superstep cost and the HBM-bandwidth floor.
+relay_candidates (vperm route, class broadcast, pack, big Beneš route,
+unpack, class row-min) plus the fused whole, to locate the gap between the
+measured superstep cost and the HBM-bandwidth floor.
+
+Methodology: through the axon remote-device tunnel a PROGRAM DISPATCH costs
+~20 ms — more than most phases — so per-call timing of single ops measures
+the tunnel, not the TPU.  Every phase is therefore run K times inside ONE
+compiled program (`lax.fori_loop` whose carry folds the phase output back
+into its input, defeating DCE/CSE), so dispatch cost amortizes to noise and
+the loop body time is the real per-iteration cost.
 
 Usage: BENCH_SCALE=24 BENCH_EDGE_FACTOR=6 python tools/microbench_relay_stages.py
 """
@@ -21,37 +28,44 @@ import numpy as np
 from bfs_tpu.bench import _generator_backend, load_or_build, load_or_build_relay
 from bfs_tpu.ops.relay import (
     INT32_MAX,
+    _class_slot_iota,
     apply_benes,
     pack_bits,
     relay_candidates,
     unpack_bits,
+    valid_slot_words,
 )
+
+K = int(os.environ.get("MB_ITERS", "8"))
+REPEATS = int(os.environ.get("MB_REPEATS", "3"))
 
 
 def _sync(out):
-    """Force completion: a VALUE read of one element.  block_until_ready can
-    return early through the axon remote-device tunnel (see bfs_tpu.bench),
-    so timing must read data back."""
+    """Force completion: a VALUE read of one element (block_until_ready can
+    return early through the axon tunnel)."""
     leaf = jax.tree_util.tree_leaves(out)[0]
     np.asarray(leaf.reshape(-1)[:1])
 
 
-def timeit(name, fn, *args, repeats=5, iters=8):
-    """Median time per call: ``iters`` back-to-back dispatches share ONE
-    value-read sync (device stream executes them serially), amortizing the
-    tunnel round-trip latency out of the per-call number."""
-    fn_j = jax.jit(fn)
-    out = fn_j(*args)
+def timeit_loop(name, phase, x0, *consts, bytes_per_iter=None):
+    """Median per-iteration time of ``phase(x, *consts) -> x`` run K times
+    inside one jitted fori_loop; reports GB/s when given bytes_per_iter."""
+
+    @jax.jit
+    def looped(x, *consts):
+        return jax.lax.fori_loop(0, K, lambda _, c: phase(c, *consts), x)
+
+    out = looped(x0, *consts)
     _sync(out)  # compile + settle
     times = []
-    for _ in range(repeats):
+    for _ in range(REPEATS):
         t0 = time.perf_counter()
-        for _ in range(iters):
-            out = fn_j(*args)
+        out = looped(x0, *consts)
         _sync(out)
-        times.append((time.perf_counter() - t0) / iters)
+        times.append((time.perf_counter() - t0) / K)
     t = float(np.median(times))
-    print(f"{name:35s} {t * 1e3:9.2f} ms")
+    bw = f"  ({bytes_per_iter / t / 1e9:7.1f} GB/s)" if bytes_per_iter else ""
+    print(f"{name:38s} {t * 1e3:9.2f} ms{bw}")
     return t
 
 
@@ -63,45 +77,57 @@ def main():
     dg, source = load_or_build(scale, ef, 42, 8 * 1024, backend)
     rg, _ = load_or_build_relay(dg, key)
     v = rg.num_vertices
-    print(f"V={v} E={rg.num_edges} vperm={rg.vperm_size} net={rg.net_size} "
-          f"m2={rg.m2} out_classes={len(rg.out_classes)} in_classes={len(rg.in_classes)}")
+    net = rg.net_size
+    nw = net // 32
+    print(
+        f"V={v} E={rg.num_edges} vperm={rg.vperm_size} net={net} "
+        f"m2={rg.m2} out_classes={len(rg.out_classes)} in_classes={len(rg.in_classes)} "
+        f"K={K}"
+    )
+    from bfs_tpu.ops.benes_pallas import local_stage_run, pallas_enabled
 
-    from bfs_tpu.ops.relay import valid_slot_words
+    lo, hi = local_stage_run(net)
+    n_stages = 2 * (int(net).bit_length() - 1) - 1
+    print(f"pallas={pallas_enabled()} local_run=[{lo},{hi}) of {n_stages} stages")
 
     vperm_masks = jnp.asarray(rg.vperm_masks)
     net_masks = jnp.asarray(rg.net_masks)
-    valid_words = jnp.asarray(valid_slot_words(rg.src_l1, rg.net_size))
+    valid_words = jnp.asarray(valid_slot_words(rg.src_l1, net))
     rng = np.random.default_rng(0)
     frontier = jnp.asarray(rng.random(v + 1) < 0.3)
 
-    # Whole candidate pipeline.  All device tensors are ARGUMENTS — a
-    # closed-over concrete array would be baked into the program as a
-    # constant (5.5GB at scale 24, breaking the remote compile transport).
+    # ---- whole candidate pipeline (frontier -> frontier fold) ------------
     def whole(frontier, vperm_masks, net_masks, valid_words):
-        return relay_candidates(
+        cand = relay_candidates(
             frontier, num_vertices=v, vperm_masks=vperm_masks,
             vperm_size=rg.vperm_size, out_classes=rg.out_classes,
             net_masks=net_masks, net_size=rg.net_size, m2=rg.m2,
             in_classes=rg.in_classes, valid_words=valid_words,
         )
+        return frontier.at[:v].set(frontier[:v] ^ (cand != INT32_MAX))
 
-    timeit("relay_candidates (whole)", whole, frontier, vperm_masks, net_masks, valid_words)
+    timeit_loop(
+        "relay_candidates (whole)", whole, frontier,
+        vperm_masks, net_masks, valid_words,
+    )
 
-    # Phase 1: frontier -> out-order bits (vperm route)
-    def phase_vperm(frontier, vperm_masks):
-        fbits = frontier[:v].astype(jnp.uint8)
+    # ---- phase 1: vperm (pack + route + unpack) --------------------------
+    def phase_vperm(fr, vperm_masks):
+        fbits = fr[:v].astype(jnp.uint8)
         fbits = jnp.concatenate(
             [fbits, jnp.zeros(rg.vperm_size - v, dtype=jnp.uint8)]
         )
-        return unpack_bits(
+        fout = unpack_bits(
             apply_benes(pack_bits(fbits, rg.vperm_size), vperm_masks, rg.vperm_size),
             rg.vperm_size,
         )
+        return fr.at[:v].set(fout[:v] != 0)
 
-    fout = jax.jit(phase_vperm)(frontier, vperm_masks)
-    timeit("  vperm (pack+route+unpack)", phase_vperm, frontier, vperm_masks)
+    timeit_loop("  vperm (pack+route+unpack)", phase_vperm, frontier, vperm_masks)
 
-    # Phase 2: class broadcast -> l2 bits
+    fbits = jnp.asarray((rng.random(rg.vperm_size) < 0.3).astype(np.uint8))
+
+    # ---- phase 2: class broadcast (fout -> l2, fold back) ----------------
     def phase_broadcast(fout):
         parts = []
         for cs in rg.out_classes:
@@ -115,32 +141,46 @@ def main():
                     jnp.broadcast_to(blk[None, :], (cs.width, cs.count)).reshape(-1)
                 )
         parts.append(jnp.zeros(rg.net_size - rg.m2, dtype=jnp.uint8))
-        return jnp.concatenate(parts)
+        l2 = jnp.concatenate(parts)
+        return fout ^ l2[: rg.vperm_size]
 
-    l2 = jax.jit(phase_broadcast)(fout)
-    timeit("  broadcast (l2 build)", phase_broadcast, fout)
+    timeit_loop(
+        "  broadcast (l2 build)", phase_broadcast, fbits,
+        bytes_per_iter=net + rg.vperm_size,
+    )
 
-    # Phase 3: big network
+    l2 = jnp.asarray((rng.random(net) < 0.3).astype(np.uint8))
+    words0 = jnp.asarray(rng.integers(0, 2**32, size=nw, dtype=np.uint32))
+
+    # ---- phase 3a: pack_bits(l2) -----------------------------------------
     def phase_pack(l2):
-        return pack_bits(l2, rg.net_size)
+        w = pack_bits(l2, net)
+        return l2.at[:nw].set(l2[:nw] ^ w.astype(jnp.uint8))
 
-    l2w = jax.jit(phase_pack)(l2)
-    timeit("  pack_bits(l2)", phase_pack, l2)
+    timeit_loop("  pack_bits(l2)", phase_pack, l2, bytes_per_iter=net + nw * 4)
 
-    def phase_net(l2w, net_masks):
-        return apply_benes(l2w, net_masks, rg.net_size)
+    # ---- phase 3b: big Beneš network -------------------------------------
+    def phase_net(w, net_masks):
+        return apply_benes(w, net_masks, net)
 
-    l1w = jax.jit(phase_net)(l2w, net_masks)
-    timeit("  apply_benes(net)", phase_net, l2w, net_masks)
+    timeit_loop(
+        "  apply_benes(net)", phase_net, words0, net_masks,
+        bytes_per_iter=net_masks.size * 4 + 2 * nw * 4,
+    )
 
-    def phase_unpack(l1w):
-        return unpack_bits(l1w, rg.net_size)
+    # ---- phase 3c: unpack ------------------------------------------------
+    def phase_unpack(w):
+        bits = unpack_bits(w, net)
+        return w ^ pack_bits(bits, net)  # unpack + pack pair; report half
 
-    l1bits = jax.jit(phase_unpack)(l1w)
-    timeit("  unpack_bits(l1)", phase_unpack, l1w)
+    t_pair = timeit_loop(
+        "  unpack+pack pair", phase_unpack, words0,
+        bytes_per_iter=2 * (net + nw * 4),
+    )
+    print(f"{'  (implied one direction)':38s} {t_pair / 2 * 1e3:9.2f} ms")
 
-    # Phase 4: class row-min (iota slot candidates; see ops/relay.py)
-    from bfs_tpu.ops.relay import _class_slot_iota
+    # ---- phase 4: class row-min (iota slot candidates) -------------------
+    l1bits = jnp.asarray((rng.random(net) < 0.3).astype(np.uint8))
 
     def phase_rowmin(l1bits):
         cands = []
@@ -149,32 +189,39 @@ def main():
             if cs.vertex_major:
                 bits = seg.reshape(cs.count, cs.width)
                 cands.append(
-                    jnp.min(jnp.where(bits != 0, _class_slot_iota(cs), INT32_MAX), axis=1)
+                    jnp.min(
+                        jnp.where(bits != 0, _class_slot_iota(cs), INT32_MAX), axis=1
+                    )
                 )
             else:
                 bits = seg.reshape(cs.width, cs.count)
                 cands.append(
-                    jnp.min(jnp.where(bits != 0, _class_slot_iota(cs), INT32_MAX), axis=0)
+                    jnp.min(
+                        jnp.where(bits != 0, _class_slot_iota(cs), INT32_MAX), axis=0
+                    )
                 )
-        return jnp.concatenate(cands)
+        cand = jnp.concatenate(cands)
+        return l1bits.at[:v].set(l1bits[:v] ^ cand.astype(jnp.uint8))
 
-    timeit("  rowmin", phase_rowmin, l1bits)
+    timeit_loop("  rowmin", phase_rowmin, l1bits, bytes_per_iter=net + v * 4)
 
-    # Single-stage butterfly costs at the three distance regimes
-    nw = rg.net_size // 32
-    words = l1w
+    # ---- single-stage butterfly costs at the three distance regimes ------
     m0 = net_masks[0]
 
-    def bf_bit(words, m):  # d >= nw: bit-position butterfly
+    def bf_bit(w, m):  # d >= nw: bit-position butterfly
         sh = jnp.uint32(4)
-        t = (words ^ (words >> sh)) & m
-        return words ^ t ^ (t << sh)
+        t = (w ^ (w >> sh)) & m
+        return w ^ t ^ (t << sh)
 
-    timeit("  one bitpos stage (elementwise)", bf_bit, words, m0)
+    timeit_loop(
+        "  one bitpos stage (elementwise)", bf_bit, words0, m0,
+        bytes_per_iter=3 * nw * 4,
+    )
 
     r = nw // 128
-    def bf_lane(words, m):  # d < 128 lane roll
-        x = words.reshape(r, 128)
+
+    def bf_lane(w, m):  # d < 128: lane roll
+        x = w.reshape(r, 128)
         mm = m.reshape(r, 128)
         lane = jax.lax.broadcasted_iota(jnp.int32, (1, 128), 1)
         has = (lane & 8) != 0
@@ -182,10 +229,10 @@ def main():
         mb = jnp.where(has, jnp.roll(mm, 8, axis=1), mm)
         return (x ^ ((x ^ partner) & mb)).reshape(-1)
 
-    timeit("  one lane-roll stage", bf_lane, words, m0)
+    timeit_loop("  one lane-roll stage", bf_lane, words0, m0, bytes_per_iter=3 * nw * 4)
 
-    def bf_row(words, m):  # 128 <= d < nw: row-block roll
-        x = words.reshape(r, 128)
+    def bf_row(w, m):  # 128 <= d < nw: row-block roll
+        x = w.reshape(r, 128)
         mm = m.reshape(r, 128)
         row = jax.lax.broadcasted_iota(jnp.int32, (r, 1), 0)
         has = (row & 64) != 0
@@ -193,15 +240,17 @@ def main():
         mb = jnp.where(has, jnp.roll(mm, 64, axis=0), mm)
         return (x ^ ((x ^ partner) & mb)).reshape(-1)
 
-    timeit("  one row-roll stage", bf_row, words, m0)
+    timeit_loop("  one row-roll stage", bf_row, words0, m0, bytes_per_iter=3 * nw * 4)
 
-    # Bandwidth reference: same-size elementwise xor
+    # ---- bandwidth reference: same-size elementwise xor ------------------
     big = jnp.asarray(rng.integers(0, 2**32, size=nw, dtype=np.uint32))
 
     def xor2(a, b):
         return a ^ b
 
-    timeit("  ref: xor of two uint32[nw]", xor2, big, words)
+    timeit_loop(
+        "  ref: xor of two uint32[nw]", xor2, words0, big, bytes_per_iter=3 * nw * 4
+    )
 
 
 if __name__ == "__main__":
