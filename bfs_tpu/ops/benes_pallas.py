@@ -60,11 +60,12 @@ def pallas_enabled() -> bool:
 
 
 def stage_distances(n: int) -> list[int]:
-    """Element distance of every Beneš stage for an n-element network
-    (must match apply_benes / native/benes.cpp stage order)."""
-    k = int(n).bit_length() - 1
-    return [n >> (s + 1) if s < k else n >> (2 * k - 1 - s)
-            for s in range(2 * k - 1)]
+    """Element distance of every Beneš stage for an n-element network —
+    delegates to graph/benes.py so there is one source of truth for the
+    stage schedule shared with the native router."""
+    from ..graph import benes
+
+    return [benes.stage_distance(n, s) for s in range(benes.num_stages(n))]
 
 
 def local_stage_run(n: int, tile_rows: int = TILE_ROWS) -> tuple[int, int]:
@@ -81,6 +82,16 @@ def local_stage_run(n: int, tile_rows: int = TILE_ROWS) -> tuple[int, int]:
     return (lo, hi)
 
 
+def _kroll(x, shift: int, axis: int):
+    """In-kernel roll by a STATIC shift (normalized positive).  Uses
+    pltpu.roll — jnp.roll's closed_call lowering hits an MLIR cache bug
+    when several Pallas kernels in one program contain same-shaped rolls."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    size = x.shape[axis]
+    return pltpu.roll(x, shift % size, axis)
+
+
 def _stage_on_tile(x, m, d, *, nw, rows, lane_axis, row_axis, outer_axis,
                    outer_span, tr):
     """One butterfly stage on a VMEM-resident tile.
@@ -93,29 +104,17 @@ def _stage_on_tile(x, m, d, *, nw, rows, lane_axis, row_axis, outer_axis,
         t = (x ^ (x >> sh)) & m
         return x ^ t ^ (t << sh)
     if d < LANES:  # lane butterfly inside each 128-word row
-        lane = jax.lax.broadcasted_iota(jnp.int32, x.shape, lane_axis)
-        has = (lane & d) != 0
-        partner = jnp.where(
-            has, jnp.roll(x, d, axis=lane_axis), jnp.roll(x, -d, axis=lane_axis)
-        )
-        m_both = jnp.where(has, jnp.roll(m, d, axis=lane_axis), m)
-        return x ^ ((x ^ partner) & m_both)
-    br = d // LANES
-    if br < tr:  # row butterfly inside the local tile (pass B)
-        idx = jax.lax.broadcasted_iota(jnp.int32, x.shape, row_axis)
-        has = (idx & br) != 0
-        partner = jnp.where(
-            has, jnp.roll(x, br, axis=row_axis), jnp.roll(x, -br, axis=row_axis)
-        )
-        m_both = jnp.where(has, jnp.roll(m, br, axis=row_axis), m)
-        return x ^ ((x ^ partner) & m_both)
-    cb = br // tr  # outer-block butterfly (pass A/C): partner block b ^ cb
-    idx = jax.lax.broadcasted_iota(jnp.int32, x.shape, outer_axis)
-    has = (idx & cb) != 0
+        axis, dist = lane_axis, d
+    elif d // LANES < tr:  # row butterfly inside the local tile (pass B)
+        axis, dist = row_axis, d // LANES
+    else:  # outer-block butterfly (pass A/C): partner block b ^ cb
+        axis, dist = outer_axis, d // LANES // tr
+    idx = jax.lax.broadcasted_iota(jnp.int32, x.shape, axis)
+    has = (idx & dist) != 0
     partner = jnp.where(
-        has, jnp.roll(x, cb, axis=outer_axis), jnp.roll(x, -cb, axis=outer_axis)
+        has, _kroll(x, dist, axis), _kroll(x, -dist, axis)
     )
-    m_both = jnp.where(has, jnp.roll(m, cb, axis=outer_axis), m)
+    m_both = jnp.where(has, _kroll(m, dist, axis), m)
     return x ^ ((x ^ partner) & m_both)
 
 
@@ -184,7 +183,7 @@ def _streamed_pass(x, masks, dists, *, nw, tr, mode, interpret):
     out = pl.pallas_call(
         kernel,
         grid=grid,
-        in_specs=[x_spec, pl.BlockSpec(memory_space=pltpu.ANY)],
+        in_specs=[x_spec, pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=x_spec,
         out_shape=jax.ShapeDtypeStruct(x_view.shape, jnp.uint32),
         scratch_shapes=[
@@ -196,9 +195,13 @@ def _streamed_pass(x, masks, dists, *, nw, tr, mode, interpret):
     return out.reshape(-1)
 
 
-#: pack/unpack kernels engage above this bit count (and when nw % 128 == 0).
+#: pack/unpack kernels engage above this bit count AND when the word count
+#: divides evenly into _PACK_CHUNK-word grid steps (nw % 32768 == 0, i.e.
+#: n % 2^20 == 0); other shapes take the XLA fallback.
 PACK_KERNEL_MIN_BITS = 1 << 20
-_PACK_CHUNK = 4096  # words per grid step: (32, 4096) uint8 block = 128 KB
+_PACK_CHUNK = 32768  # words per grid step: (32, 32768) uint8 block = 1 MB
+# (plane rows sit nw bytes apart in HBM; 32 KB per row per step keeps the
+# strided DMA in large transfers — 4 KB rows measured only ~15 GB/s)
 
 
 def pack_kernel_ok(n: int) -> bool:
@@ -213,56 +216,88 @@ def pack_kernel_ok(n: int) -> bool:
 def pack_bits_pallas(bits: jax.Array, n: int, interpret: bool = False) -> jax.Array:
     """Bit-major pack as ONE Pallas kernel: uint8[n] -> uint32[n/32].
 
-    The bit-major layout (word w bit b = element b*nw + w) makes the XLA
-    formulation read the byte array with plane-interleaved strides (measured
-    ~12 GB/s); here each grid step reads a (32, chunk) byte block — 32
-    contiguous plane rows — widens in VMEM and writes or-combined words."""
+    Bit-major means word w bit b = element b*nw + w, i.e. plane b is the
+    CONTIGUOUS byte range [b*nw, (b+1)*nw).  A (32, chunk)-block formulation
+    reads 32 plane rows nw bytes apart — strided HBM traffic measured at
+    only ~14 GB/s however large the chunk.  Instead the grid is
+    (chunks, 32) with the plane index fastest: each step reads ONE
+    contiguous plane chunk and ORs it (shifted) into the output word block,
+    which Pallas keeps VMEM-resident across the 32 revisits (its block
+    index only depends on the slow grid axis)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     nw = n // 32
+    rows = _PACK_CHUNK // LANES  # block = (rows, 128), tile-aligned
+    nblk = nw // _PACK_CHUNK
 
     def kernel(x_ref, o_ref):
-        x = x_ref[:].astype(jnp.uint32)  # (32, chunk)
-        sh = jax.lax.broadcasted_iota(jnp.uint32, (32, 1), 0)
-        o_ref[:] = (x << sh).sum(axis=0, dtype=jnp.uint32)[None, :]
+        b = pl.program_id(1)
+        term = x_ref[:].astype(jnp.uint32) << b.astype(jnp.uint32)
+
+        @pl.when(b == 0)
+        def _():
+            o_ref[:] = term
+
+        @pl.when(b != 0)
+        def _():
+            o_ref[:] = o_ref[:] | term
 
     out = pl.pallas_call(
         kernel,
-        grid=(nw // _PACK_CHUNK,),
+        grid=(nblk, 32),
         in_specs=[
-            pl.BlockSpec((32, _PACK_CHUNK), lambda i: (0, i), memory_space=pltpu.VMEM)
+            pl.BlockSpec(
+                (rows, LANES),
+                lambda i, b: (b * nblk + i, 0),
+                memory_space=pltpu.VMEM,
+            )
         ],
-        out_specs=pl.BlockSpec((1, _PACK_CHUNK), lambda i: (0, i), memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((1, nw), jnp.uint32),
+        out_specs=pl.BlockSpec(
+            (rows, LANES), lambda i, b: (i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((nw // LANES, LANES), jnp.uint32),
         interpret=interpret,
-    )(bits.reshape(32, nw))
+    )(bits.reshape(32 * nw // LANES, LANES))
     return out.reshape(-1)
 
 
 @functools.partial(jax.jit, static_argnames=("n", "interpret"))
 def unpack_bits_pallas(words: jax.Array, n: int, interpret: bool = False) -> jax.Array:
-    """Bit-major unpack as ONE Pallas kernel: uint32[n/32] -> uint8[n]."""
+    """Bit-major unpack as ONE Pallas kernel: uint32[n/32] -> uint8[n].
+
+    Mirror of :func:`pack_bits_pallas`: grid (chunks, 32), plane fastest;
+    the word block is fetched once per chunk (its index ignores the plane
+    axis) and each step writes one contiguous plane chunk."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     nw = n // 32
 
     def kernel(x_ref, o_ref):
-        w = x_ref[:]  # (1, chunk)
-        sh = jax.lax.broadcasted_iota(jnp.uint32, (32, 1), 0)
-        o_ref[:] = ((w >> sh) & jnp.uint32(1)).astype(jnp.uint8)
+        b = pl.program_id(1)
+        o_ref[:] = ((x_ref[:] >> b.astype(jnp.uint32)) & jnp.uint32(1)).astype(
+            jnp.uint8
+        )
 
+    rows = _PACK_CHUNK // LANES
+    nblk = nw // _PACK_CHUNK
     out = pl.pallas_call(
         kernel,
-        grid=(nw // _PACK_CHUNK,),
+        grid=(nblk, 32),
         in_specs=[
-            pl.BlockSpec((1, _PACK_CHUNK), lambda i: (0, i), memory_space=pltpu.VMEM)
+            # Index ignores the plane axis -> the word block is fetched once
+            # per chunk and reused for all 32 plane writes.
+            pl.BlockSpec(
+                (rows, LANES), lambda i, b: (i, 0), memory_space=pltpu.VMEM
+            )
         ],
-        out_specs=pl.BlockSpec((32, _PACK_CHUNK), lambda i: (0, i), memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((32, nw), jnp.uint8),
+        out_specs=pl.BlockSpec(
+            (rows, LANES), lambda i, b: (b * nblk + i, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((32 * nw // LANES, LANES), jnp.uint8),
         interpret=interpret,
-    )(words.reshape(1, nw))
+    )(words.reshape(nw // LANES, LANES))
     return out.reshape(-1)
 
 

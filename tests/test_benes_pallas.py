@@ -60,7 +60,7 @@ def test_pack_unpack_kernels_roundtrip():
     [
         (1 << 15, 4),   # r=8: outer passes carry the bit stages + big rolls
         (1 << 16, 8),   # r=16
-        (1 << 16, 16),  # tr == r: no outer passes, everything local
+        (1 << 16, 16),  # tr == r: outer passes carry ONLY bit-plane stages
     ],
 )
 def test_fused_passes_match_element_reference(n, tile_rows):
