@@ -24,9 +24,11 @@ undirected edges), BENCH_REPEATS (5), BENCH_ENGINE (relay|pull|push),
 BENCH_CHECK (1), BENCH_PROFILE (path — write a jax.profiler trace of one
 timed run there), BENCH_SOURCES (default 1 — >1 runs the BASELINE.json
 config-5 batched multi-source benchmark: that many independent BFS trees in
-device-resident chunks of BENCH_MULTI_CHUNK (8), reporting AGGREGATE TEPS;
-the routing masks amortize across the batch, so per-tree cost drops well
-below the single-source number).
+device-resident chunks of BENCH_MULTI_CHUNK (8; 16 exhausts HBM at scale 24
+— the vmapped pipeline materializes ~1 GB of per-tree intermediates),
+reporting AGGREGATE TEPS.  The routing masks amortize across a chunk, but
+per-tree byte-array traffic does not, so per-tree time lands near the
+single-source number; lock-step chunks also run max-eccentricity supersteps).
 """
 
 from __future__ import annotations
@@ -246,9 +248,6 @@ def _multi_source_bench(rg, eng, dg, source, *, num_sources, chunk, do_check):
     any source inside a component reaches exactly that component, so each
     tree traverses the same input edge set (verified on the first chunk,
     which also runs the full ``check()`` invariants per tree)."""
-    import jax.numpy as jnp
-
-    from .models.bfs import _relay_multi_fused_program
     from .oracle.bfs import check
 
     # Reference tree (untimed): component mask + per-tree edge numerator.
@@ -265,14 +264,8 @@ def _multi_source_bench(rg, eng, dg, source, *, num_sources, chunk, do_check):
         pad = chunk - len(chunks[-1])
         chunks[-1] = np.concatenate([chunks[-1], chunks[-1][:1].repeat(pad)])
 
-    fused = _relay_multi_fused_program(
-        rg.num_vertices, rg.vperm_size, rg.out_classes, rg.net_size, rg.m2,
-        rg.in_classes,
-    )
-
     def run_chunk(srcs):
-        s_new = jnp.asarray(rg.old2new[srcs])
-        return fused(s_new, *eng._tensors, max_levels=rg.num_vertices)
+        return eng.run_multi_device(srcs)
 
     state = run_chunk(chunks[0])
     _ = int(state.level)  # compile + sync (value read; see below)

@@ -316,12 +316,12 @@ class RelayEngine:
         parent[source] = source  # init wrote the relabeled id at the source
         return BfsResult(dist=dist, parent=parent, num_levels=int(state.level))
 
-    def run_multi(self, sources, *, max_levels: int | None = None):
-        """Batched multi-source BFS on the relay layout; returns a
-        :class:`~bfs_tpu.models.multisource.MultiBfsResult` in original-id
-        space (bit-exact with the other engines' batched modes)."""
-        from .multisource import MultiBfsResult
-
+    def run_multi_device(self, sources, *, max_levels: int | None = None) -> BfsState:
+        """Batched multi-source BFS, DEVICE-resident result: the raw batched
+        :class:`BfsState` in the relabeled space with slot-index parents.
+        No host transfer — reading ``int(state.level)`` is the cheap sync
+        (benchmark timing path; through a remote-device tunnel the full
+        state pull costs several times the traversal itself)."""
         rg = self.relay_graph
         sources = np.atleast_1d(np.asarray(sources, dtype=np.int32))
         check_sources(rg.num_vertices, sources)
@@ -335,8 +335,18 @@ class RelayEngine:
             rg.in_classes,
         )
         sources_new = jnp.asarray(rg.old2new[sources])
+        return fused(sources_new, *self._tensors, max_levels=max_levels)
+
+    def run_multi(self, sources, *, max_levels: int | None = None):
+        """Batched multi-source BFS on the relay layout; returns a
+        :class:`~bfs_tpu.models.multisource.MultiBfsResult` in original-id
+        space (bit-exact with the other engines' batched modes)."""
+        from .multisource import MultiBfsResult
+
+        rg = self.relay_graph
+        sources = np.atleast_1d(np.asarray(sources, dtype=np.int32))
         state = jax.device_get(
-            fused(sources_new, *self._tensors, max_levels=max_levels)
+            self.run_multi_device(sources, max_levels=max_levels)
         )
         dist_new = np.asarray(state.dist[:, : rg.num_vertices])
         parent_new = slots_to_parent(

@@ -65,6 +65,47 @@ class MultiBfsResult:
     num_levels: int
 
 
+def bfs_multi_device(
+    graph: Graph | DeviceGraph | PullGraph,
+    sources,
+    *,
+    engine: str = "pull",
+    max_levels: int | None = None,
+    block: int = 1024,
+):
+    """DEVICE-resident half of :func:`bfs_multi` for pull/push: returns the
+    raw batched BfsState without any host transfer (``int(state.level)`` is
+    the cheap sync — the benchmark timing path).  The relay analogue is
+    :meth:`RelayEngine.run_multi_device`."""
+    sources = np.atleast_1d(np.asarray(sources, dtype=np.int32))
+    from .bfs import check_sources
+
+    if engine == "pull":
+        pg = graph if isinstance(graph, PullGraph) else build_pull_graph(graph)
+        check_sources(pg.num_vertices, sources)
+        max_levels = int(max_levels) if max_levels is not None else pg.num_vertices
+        state = _bfs_multi_pull_fused(
+            jnp.asarray(pg.ell0),
+            tuple(jnp.asarray(f) for f in pg.folds),
+            jnp.asarray(sources),
+            pg.num_vertices,
+            max_levels,
+        )
+        return state, pg.num_vertices
+    if engine != "push":
+        raise ValueError(f"unknown engine {engine!r}; use 'pull' or 'push'")
+    dg = graph if isinstance(graph, DeviceGraph) else build_device_graph(graph, block=block)
+    if dg.num_shards != 1:
+        raise ValueError("sharded DeviceGraph requires the parallel engine")
+    check_sources(dg.num_vertices, sources)
+    max_levels = int(max_levels) if max_levels is not None else dg.num_vertices
+    state = _bfs_multi_fused(
+        jnp.asarray(dg.src), jnp.asarray(dg.dst), jnp.asarray(sources),
+        dg.num_vertices, max_levels,
+    )
+    return state, dg.num_vertices
+
+
 def bfs_multi(
     graph: Graph | DeviceGraph | PullGraph,
     sources,
@@ -78,37 +119,13 @@ def bfs_multi(
     ``'relay'`` (via :meth:`RelayEngine.run_multi`); all produce bit-exact
     dist AND parent (canonical min-parent)."""
     sources = np.atleast_1d(np.asarray(sources, dtype=np.int32))
-    from .bfs import check_sources
-
     if engine == "relay":
         from .bfs import RelayEngine
 
         return RelayEngine(graph).run_multi(sources, max_levels=max_levels)
-    if engine == "pull":
-        pg = graph if isinstance(graph, PullGraph) else build_pull_graph(graph)
-        check_sources(pg.num_vertices, sources)
-        max_levels = int(max_levels) if max_levels is not None else pg.num_vertices
-        state = _bfs_multi_pull_fused(
-            jnp.asarray(pg.ell0),
-            tuple(jnp.asarray(f) for f in pg.folds),
-            jnp.asarray(sources),
-            pg.num_vertices,
-            max_levels,
-        )
-        v = pg.num_vertices
-    elif engine == "push":
-        dg = graph if isinstance(graph, DeviceGraph) else build_device_graph(graph, block=block)
-        if dg.num_shards != 1:
-            raise ValueError("sharded DeviceGraph requires the parallel engine")
-        check_sources(dg.num_vertices, sources)
-        max_levels = int(max_levels) if max_levels is not None else dg.num_vertices
-        state = _bfs_multi_fused(
-            jnp.asarray(dg.src), jnp.asarray(dg.dst), jnp.asarray(sources),
-            dg.num_vertices, max_levels,
-        )
-        v = dg.num_vertices
-    else:
-        raise ValueError(f"unknown engine {engine!r}; use 'relay', 'pull' or 'push'")
+    state, v = bfs_multi_device(
+        graph, sources, engine=engine, max_levels=max_levels, block=block
+    )
     state = jax.device_get(state)
     return MultiBfsResult(
         sources=sources,
