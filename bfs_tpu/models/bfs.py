@@ -154,7 +154,9 @@ def _relay_fused_program(
                 valid_words=valid_words,
             )
 
-        state = init_state(num_vertices, source_new)
+        # Exact [V] shapes: the relay engine has no padded-edge sentinel to
+        # absorb, and the [V+1] convention costs a concat copy per superstep.
+        state = init_state(num_vertices, source_new, sentinel=False)
 
         def cond(s: BfsState):
             return s.changed & (s.level < max_levels)
@@ -235,15 +237,13 @@ def _relay_multi_fused_program(
             )
 
         cand_batched = jax.vmap(cand_fn)
-        state = init_batched_state(num_vertices, sources_new)
+        state = init_batched_state(num_vertices, sources_new, sentinel=False)
 
         def cond(s: BfsState):
             return s.changed & (s.level < max_levels)
 
         def body(s: BfsState):
-            cand = cand_batched(s.frontier)
-            inf = jnp.full(cand.shape[:-1] + (1,), INT32_MAX, dtype=jnp.int32)
-            return apply_candidates(s, jnp.concatenate([cand, inf], axis=-1))
+            return apply_candidates(s, cand_batched(s.frontier))
 
         return jax.lax.while_loop(cond, body, state)
 

@@ -118,9 +118,13 @@ def _stage_on_tile(x, m, d, *, nw, rows, lane_axis, row_axis, outer_axis,
     return x ^ ((x ^ partner) & m_both)
 
 
-def _streamed_pass(x, masks, dists, *, nw, tr, mode, interpret):
-    """One fused pass: all ``dists`` stages with x VMEM-resident, masks
-    DMA-streamed stage-by-stage with double buffering.
+def _streamed_pass(x, masks, lo, dists, *, nw, tr, mode, interpret):
+    """One fused pass: stages ``dists`` (= schedule[lo:lo+len]) with x
+    VMEM-resident, masks DMA-streamed stage-by-stage with double buffering.
+    ``masks`` is the FULL [all_stages, nw] array — the stage offset is
+    applied inside the DMA index, because an XLA-level ``masks[lo:hi]``
+    slice materializes a copy of hundreds of MB every superstep (profiler:
+    ~10 ms/superstep of slice ops at net 2^28).
 
     mode 'local': x viewed [R, 128], grid over TR-row tiles.
     mode 'outer': x viewed [B, TR, 128], grid over tt-chunks of TR.
@@ -130,18 +134,21 @@ def _streamed_pass(x, masks, dists, *, nw, tr, mode, interpret):
 
     r = nw // LANES
     s_n = len(dists)
+    s_all = masks.shape[0]
 
     if mode == "local":
         grid = (r // tr,)
         x_view = x.reshape(r, LANES)
-        m_view = masks.reshape(s_n, r, LANES)
+        m_view = masks.reshape(s_all, r, LANES)
         block = (tr, LANES)
         x_spec = pl.BlockSpec(block, lambda i: (i, 0), memory_space=pltpu.VMEM)
 
         def dma(m_hbm, mbuf, sem, slot, si):
             i = pl.program_id(0)
             return pltpu.make_async_copy(
-                m_hbm.at[si, pl.ds(i * tr, tr), :], mbuf.at[slot], sem.at[slot]
+                m_hbm.at[lo + si, pl.ds(i * tr, tr), :],
+                mbuf.at[slot],
+                sem.at[slot],
             )
 
         def stage(x, m, d):
@@ -154,14 +161,16 @@ def _streamed_pass(x, masks, dists, *, nw, tr, mode, interpret):
         tt = min(OUTER_TT, tr)
         grid = (tr // tt,)
         x_view = x.reshape(b, tr, LANES)
-        m_view = masks.reshape(s_n, b, tr, LANES)
+        m_view = masks.reshape(s_all, b, tr, LANES)
         block = (b, tt, LANES)
         x_spec = pl.BlockSpec(block, lambda j: (0, j, 0), memory_space=pltpu.VMEM)
 
         def dma(m_hbm, mbuf, sem, slot, si):
             j = pl.program_id(0)
             return pltpu.make_async_copy(
-                m_hbm.at[si, :, pl.ds(j * tt, tt), :], mbuf.at[slot], sem.at[slot]
+                m_hbm.at[lo + si, :, pl.ds(j * tt, tt), :],
+                mbuf.at[slot],
+                sem.at[slot],
             )
 
         def stage(x, m, d):
@@ -322,17 +331,17 @@ def apply_benes_fused(
     x = words
     if lo > 0:  # pass A: prefix outer stages (bit planes + big row rolls)
         x = _streamed_pass(
-            x, masks[:lo], dists[:lo], nw=nw, tr=tr, mode="outer",
+            x, masks, 0, dists[:lo], nw=nw, tr=tr, mode="outer",
             interpret=interpret,
         )
     # pass B: the local run
     x = _streamed_pass(
-        x, masks[lo:hi], dists[lo:hi], nw=nw, tr=tr, mode="local",
+        x, masks, lo, dists[lo:hi], nw=nw, tr=tr, mode="local",
         interpret=interpret,
     )
     if hi < len(dists):  # pass C: suffix outer stages
         x = _streamed_pass(
-            x, masks[hi:], dists[hi:], nw=nw, tr=tr, mode="outer",
+            x, masks, hi, dists[hi:], nw=nw, tr=tr, mode="outer",
             interpret=interpret,
         )
     return x

@@ -51,10 +51,15 @@ class BfsState(NamedTuple):
     changed: jax.Array  # bool scalar: did the last superstep relax anything?
 
 
-def init_state(num_vertices: int, source) -> BfsState:
+def init_state(num_vertices: int, source, *, sentinel: bool = True) -> BfsState:
     """Iteration-0 state (GraphFileUtil.java:50-56 parity): source at
-    distance 0 on the frontier (GRAY), everything else unreached (WHITE)."""
-    n = num_vertices + 1
+    distance 0 on the frontier (GRAY), everything else unreached (WHITE).
+
+    ``sentinel=False`` sizes the arrays exactly ``[V]`` — for engines whose
+    candidates never index through padded edges (relay), where the ``[V+1]``
+    convention would force a 4-byte-per-vertex concatenate copy every
+    superstep just to append the inert slot."""
+    n = num_vertices + (1 if sentinel else 0)
     source = jnp.asarray(source, dtype=jnp.int32)
     dist = jnp.full((n,), INT32_MAX, dtype=jnp.int32).at[source].set(0)
     parent = jnp.full((n,), -1, dtype=jnp.int32).at[source].set(source)
@@ -112,13 +117,16 @@ def relax_superstep(
     return apply_candidates(state, cand_parent)
 
 
-def init_batched_state(num_vertices: int, sources: jax.Array) -> BfsState:
+def init_batched_state(
+    num_vertices: int, sources: jax.Array, *, sentinel: bool = True
+) -> BfsState:
     """Batched multi-source state: fields carry a leading sources axis
     ``[S, V+1]`` while ``level``/``changed`` stay scalar (all sources advance
     in lock-step supersteps).  The oracle's multi-source ctor seeds all
     sources at distance 0 (BreadthFirstPaths.java:114-132); batching them as
-    a tensor axis instead is the vmap analogue (BASELINE.json config 5)."""
-    n = num_vertices + 1
+    a tensor axis instead is the vmap analogue (BASELINE.json config 5).
+    ``sentinel`` as in :func:`init_state`."""
+    n = num_vertices + (1 if sentinel else 0)
     sources = jnp.asarray(sources, dtype=jnp.int32)
     s = sources.shape[0]
     rows = jnp.arange(s)

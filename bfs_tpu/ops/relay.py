@@ -295,5 +295,8 @@ def relay_superstep(state: BfsState, cand_fn) -> BfsState:
     ``slots_to_parent``).
     """
     cand = cand_fn(state.frontier)
-    cand = jnp.concatenate([cand, jnp.full((1,), INT32_MAX, jnp.int32)])
+    if cand.shape[-1] != state.dist.shape[-1]:
+        # [V+1] sentinel-carrying state (stepped runner) pads the inert slot;
+        # the fused engines run exact [V] shapes and skip this copy.
+        cand = jnp.concatenate([cand, jnp.full((1,), INT32_MAX, jnp.int32)])
     return apply_candidates(state, cand)
