@@ -1,0 +1,34 @@
+"""Per-stage sparsity of the cached s24 net masks (bit-major layout)."""
+import numpy as np, time
+z = np.load("/root/repo/.bench_cache/relay_v3_native_s24_ef6_seed42_block8192.npz")
+print({k: (z[k].shape if hasattr(z[k],'shape') and z[k].ndim else int(z[k])) for k in z.files if k not in ('net_masks','vperm_masks','src_l1','new2old','old2new')})
+net_size = int(z["net_size"]); m2=int(z["m2"])
+ic = z["in_classes"]; m1 = int(ic[-1][4])
+print(f"net_size=2^{int(np.log2(net_size))}, m1={m1} ({m1/net_size:.3f}), m2={m2} ({m2/net_size:.3f})")
+print(f"in_classes: {len(ic)} classes, widths {ic[:,0].min()}..{ic[:,0].max()}")
+oc = z["out_classes"]; print(f"out_classes: {len(oc)} classes, widths {oc[:,0].min()}..{oc[:,0].max()}, out_space={int(oc[-1][4])}")
+nm = z["net_masks"]
+S, nw = nm.shape
+print("stages", S, "words/stage", nw)
+SB = 1<<13   # words per chunk -> element blocks of 8192 elems per plane... we analyze chunks of words
+tot_blocks0 = 0; nz_blocks0 = 0
+print("stage | dist | bit_density | zero-bitmajor-word-frac | nz-elem-block-frac(2^13w=2^13e/plane) | elem nonzero range frac")
+k = int(net_size).bit_length()-1
+for s in range(S):
+    d = net_size >> (s+1) if s < k else net_size >> (2*k-1-s)
+    w = nm[s]
+    pc = np.unpackbits(w.view(np.uint8)).sum()
+    zword = float(np.mean(w==0))
+    # element-space blocks: chunk words by SB, OR-reduce, then count set bits over (chunk, plane)
+    orch = np.bitwise_or.reduce(w.reshape(-1, SB), axis=1)  # [nw/SB]
+    nzblocks = np.unpackbits(orch.view(np.uint8)).sum()  # nonzero (plane,chunk) blocks
+    totblocks = orch.shape[0]*32
+    # element-space nonzero contiguous range: element = b*nw + wd; block id in element order = b*(nw/SB)+chunk
+    bits = np.unpackbits(orch.view(np.uint8), bitorder='little').reshape(-1, 32).T.reshape(-1)  # [32, nchunk] -> element-ordered blocks
+    nz = np.flatnonzero(bits)
+    rng = (nz[0], nz[-1]+1) if len(nz) else (0,0)
+    rngfrac = (rng[1]-rng[0])/len(bits)
+    if s < 8 or s > S-8 or s % 5 == 0:
+        print(f"{s:3d} | 2^{int(np.log2(d)):2d} | {pc/net_size:.3f} | {zword:.3f} | {nzblocks/totblocks:.3f} | {rngfrac:.3f}")
+    tot_blocks0 += totblocks; nz_blocks0 += nzblocks
+print(f"TOTAL elem-block(2^13 elems) nonzero fraction: {nz_blocks0/tot_blocks0:.4f}")

@@ -1,0 +1,52 @@
+"""Round-3 measurement script: level profile + mask sparsity of the cached s24 layout."""
+import numpy as np, time, sys
+
+CACHE = "/root/repo/.bench_cache"
+
+# ---- 1. level profile of the bench config ----
+z = np.load(f"{CACHE}/rmat_native_s24_ef6_seed42_block8192.npz")
+source = int(z["source"]); V = int(z["num_vertices"])
+print("source", source, "V", V, flush=True)
+src = z["src"].reshape(-1); dst = z["dst"].reshape(-1)
+sent = V  # sentinel? check
+print("src dtype/shape", src.dtype, src.shape, "max dst", dst.max(), flush=True)
+keep = dst != dst.max() if dst.max() >= V else slice(None)
+# build CSR on host quickly via bincount+argsort of src
+t0=time.time()
+mask = dst < V if dst.max() >= V else np.ones(len(dst), bool)
+s2 = src[mask].astype(np.int64); d2 = dst[mask].astype(np.int64)
+print("edges", len(s2), time.time()-t0, flush=True)
+# level-synchronous BFS with numpy frontier expansion using CSR
+order = np.argsort(s2, kind='stable')
+t0=time.time()
+s_sorted = s2[order]; d_sorted = d2[order]
+indptr = np.zeros(V+1, np.int64); np.cumsum(np.bincount(s_sorted, minlength=V), out=indptr[1:])
+print("csr built", time.time()-t0, flush=True)
+dist = np.full(V, -1, np.int32); dist[source]=0
+frontier = np.array([source], np.int64)
+lvl=0
+prof=[]
+while len(frontier):
+    # gather all out edges of frontier
+    starts = indptr[frontier]; ends = indptr[frontier+1]
+    cnt = ends-starts
+    tot = int(cnt.sum())
+    prof.append((lvl, len(frontier), tot))
+    idx = np.repeat(starts + np.cumsum(cnt) - cnt, 1)  # not needed
+    # flatten ranges
+    flat = np.concatenate([np.arange(a,b) for a,b in zip(starts,ends)]) if len(frontier)<100000 else None
+    if flat is None:
+        # big frontier: do dense: mark neighbors via boolean over all edges
+        fmask = np.zeros(V, bool); fmask[frontier]=True
+        nb = d_sorted[fmask[s_sorted]]
+    else:
+        nb = d_sorted[flat]
+    new = np.unique(nb)
+    new = new[dist[new]<0]
+    dist[new] = lvl+1
+    frontier = new
+    lvl+=1
+print("LEVELS (level, frontier_vertices, frontier_out_edges):")
+for p in prof: print(p, flush=True)
+print("reached", int((dist>=0).sum()))
+np.save(f"{CACHE}/s24_dist_host.npy", dist)

@@ -1,0 +1,47 @@
+"""Do dispatches pipeline through the axon tunnel?"""
+import os, time
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/root/repo/.bench_cache/xla")
+import jax, jax.numpy as jnp, numpy as np
+
+# a program with ~30ms of real device work (streaming ~2GB at 75GB/s)
+big = jnp.zeros((1<<28,), jnp.uint32)  # 1GB
+@jax.jit
+def work(x, s):
+    def body(i, acc):
+        return acc ^ (x + i).sum(dtype=jnp.uint32)
+    r = jax.lax.fori_loop(0, 1, lambda i, a: a ^ (x[i:] .sum(dtype=jnp.uint32)), jnp.uint32(0))
+    return r + s
+
+@jax.jit
+def work2(x, s):
+    return (x + s).sum(dtype=jnp.uint32)  # read 1GB + small
+
+# warm
+v = int(work2(big, jnp.uint32(0)))
+# individual timing
+ts=[]
+for i in range(5):
+    t0=time.perf_counter(); v=int(work2(big, jnp.uint32(i))); ts.append(time.perf_counter()-t0)
+print("individual run:", [f"{t*1000:.0f}" for t in ts], "ms")
+
+# pipelined: dispatch 8, chain results so they're sequential on device, sync once
+t0=time.perf_counter()
+s = jnp.uint32(0)
+outs=[]
+for i in range(8):
+    s = work2(big, s)
+    outs.append(s)
+v = int(s)
+t = time.perf_counter()-t0
+print(f"8 chained dispatches, one sync: total {t*1000:.0f} ms -> {t/8*1000:.0f} ms/run")
+
+# scan-inside-one-program version
+@jax.jit
+def scanned(x):
+    def body(c, i):
+        return c ^ (x + c).sum(dtype=jnp.uint32), c
+    c, _ = jax.lax.scan(body, jnp.uint32(0), jnp.arange(8, dtype=jnp.uint32))
+    return c
+v = int(scanned(big))
+t0=time.perf_counter(); v=int(scanned(big)); t=time.perf_counter()-t0
+print(f"scan(8) in one program: total {t*1000:.0f} ms -> {t/8*1000:.0f} ms/run")
