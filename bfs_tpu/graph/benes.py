@@ -36,6 +36,12 @@ def _register(lib: ctypes.CDLL) -> None:
         np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS"),
         ctypes.c_int32,
     ]
+    lib.benes_route_i32.restype = ctypes.c_int32
+    lib.benes_route_i32.argtypes = [
+        ctypes.c_int64,
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS"),
+    ]
 
 
 _LIB = NativeLib(
@@ -77,6 +83,26 @@ def route(perm: np.ndarray, *, bit_major: bool = False) -> np.ndarray:
     words = max(n // 32, 1)
     masks = np.zeros(num_stages(n) * words, dtype=np.uint32)
     if lib.benes_route(n, perm, masks, int(bit_major)) != 0:
+        raise ValueError("perm is not a bijection")
+    return masks.reshape(num_stages(n), words)
+
+
+def route_std(perm: np.ndarray) -> np.ndarray:
+    """Layout-v4 router: Beneš masks in STANDARD (word-major) packing — mask
+    element ``e`` at word ``e >> 5``, bit ``e & 31`` — via the iterative int32
+    native router (``benes_route_i32``).  This is the packing the v4 device
+    kernels consume directly; no transpose pass.  ``len(perm)`` must be a
+    power of two in [32, 2^30]."""
+    lib = _LIB.load()
+    if lib is None:
+        raise RuntimeError("native benes router unavailable")
+    perm = np.ascontiguousarray(perm, dtype=np.int32)
+    n = int(perm.shape[0])
+    if n < 32 or n & (n - 1):
+        raise ValueError(f"network size {n} is not a power of two >= 32")
+    words = n // 32
+    masks = np.zeros(num_stages(n) * words, dtype=np.uint32)
+    if lib.benes_route_i32(n, perm, masks) != 0:
         raise ValueError("perm is not a bijection")
     return masks.reshape(num_stages(n), words)
 

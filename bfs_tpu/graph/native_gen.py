@@ -35,6 +35,8 @@ def _register(lib: ctypes.CDLL) -> None:
     ]
     lib.sort_edges_by_dst.restype = None
     lib.sort_edges_by_dst.argtypes = [ctypes.c_int64, _I32, _I32]
+    lib.sort_rank_pairs.restype = None
+    lib.sort_rank_pairs.argtypes = [ctypes.c_int64, _I32, _I32, _I32, _I32]
     lib.sedgewick_header.restype = ctypes.c_int64
     lib.sedgewick_header.argtypes = [ctypes.c_char_p, _I64, _I64]
     lib.sedgewick_edges.restype = ctypes.c_int64
@@ -74,6 +76,26 @@ def rmat_edges_native(
     dst = np.empty(m, dtype=np.int32)
     lib.rmat_edges(scale, m, a, b, c, seed, int(permute_labels), src, dst)
     return src, dst
+
+
+def sort_rank_pairs_native(
+    key_hi: np.ndarray, key_lo: np.ndarray
+) -> tuple[np.ndarray, np.ndarray]:
+    """Stable sort by ``(key_hi, key_lo)``: returns ``(order, rank)`` where
+    ``order[i]`` is the original index of the i-th record in sorted order and
+    ``rank[i]`` its position within its run of equal ``key_hi`` values — the
+    native replacement for ``np.lexsort`` + ``_rank_within_groups`` in the
+    relay layout build (minutes -> seconds at 2*10^8 edges)."""
+    lib = _LIB.load()
+    if lib is None:
+        raise RuntimeError("native graph_gen unavailable")
+    key_hi = np.ascontiguousarray(key_hi, dtype=np.int32)
+    key_lo = np.ascontiguousarray(key_lo, dtype=np.int32)
+    n = key_hi.shape[0]
+    order = np.empty(n, dtype=np.int32)
+    rank = np.empty(n, dtype=np.int32)
+    lib.sort_rank_pairs(n, key_hi, key_lo, order, rank)
+    return order, rank
 
 
 def sort_edges_by_dst_native(

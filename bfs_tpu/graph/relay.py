@@ -1,33 +1,46 @@
-"""Relay layout: degree-class dense adjacency + Beneš-routed bit shuffle.
+"""Relay layout v4: degree-class dense adjacency + Beneš-routed bit shuffle.
 
 The fully gather-free BFS data layout.  Measured reality on TPU v5e
-(tools/microbench_gather.py): dense vector ops run at ~200 Gint32/s while
-every XLA gather/scatter runs at ~0.12 G/s, so the engine may not index by
+(tools/microbench_gather.py): dense vector ops run at memory bandwidth while
+every XLA gather/scatter runs at ~0.1 G/s, so the engine may not index by
 edge at runtime AT ALL.  Everything data-dependent becomes dense math over
 static layouts:
 
-  * **src side (broadcast)** — vertices bucketed by power-of-two OUT-degree
-    class; a vertex's frontier bit is broadcast to its out-edge slots by a
-    dense ``[Nc, 1] -> [Nc, Wc]`` tile per class (the mapper emitting a
-    candidate per neighbour, BfsSpark.java:73-79, as pure broadcast).
+  * **src side (broadcast)** — vertices bucketed by OUT-degree class; a
+    vertex's frontier bit is broadcast to its out-edge slots (the mapper
+    emitting a candidate per neighbour, BfsSpark.java:73-79, as pure word
+    replication).
   * **the shuffle** — per-edge bits move from src-grouped to dst-grouped
-    slot order through a bit-packed Beneš network (2·log2 N - 1 dense
+    slot order through a bit-packed Beneš network (2*log2 N - 1 dense
     butterfly stages, masks precomputed by native/benes.cpp).  This is the
     reference's `reduceByKey` shuffle (BfsSpark.java:90) compiled into a
     routing circuit.
   * **dst side (reduce)** — vertices bucketed by IN-degree class and
-    RELABELED so classes are contiguous in vertex-id space; the reducer's
-    min-merge becomes ``min(where(bit, src_id, INF), axis=1)`` per class —
-    a dense row-min.  ``src_id`` tables store ORIGINAL ids so the canonical
-    min-parent tie-break is preserved across relabeling.
+    RELABELED so classes are contiguous; the reducer's min-merge becomes a
+    min-active-slot scan per class.  Within a dst row slots ascend by
+    ORIGINAL src id, so min slot == canonical min-parent.
 
-A small second Beneš network reorders the [V] frontier bit-vector from
-(relabeled) vertex order to out-class order before broadcasting.
+v4 changes vs the round-2 layout (LAYOUT_VERSION 3):
+
+  * **Standard (word-major) packing everywhere**: element ``e`` lives at
+    (word ``e >> 5``, bit ``e & 31``).  This is what the native router
+    emits, so the router's bit-major transpose pass is gone; classes are
+    32-aligned so the broadcast becomes pure word replication and the
+    row-min a word-level scan — the round-2 pack/unpack kernels disappear.
+  * **Pair-compacted masks**: a stage with element distance d only has
+    switch bits at the lower index of each pair ((e & d) == 0), so for
+    d >= 32*128 the mask rows at (row & (d/4096)) != 0 are structurally
+    zero; they are dropped from storage, cutting streamed mask bytes ~29%
+    (tools/mask_sparsity.py measurement round 3).
+  * **Identity tail**: pad slots beyond max(m1, m2) are wired
+    input==output, which the router colors switch-free; each stage stores
+    its nonzero word range so kernels skip the dead tail entirely.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import NamedTuple
 
 import numpy as np
 
@@ -36,24 +49,71 @@ from .csr import DeviceGraph, Graph, INF_DIST
 
 #: Bump when the slot ordering / mask layout changes; layout caches
 #: (bench.py .bench_cache) key on it.
-LAYOUT_VERSION = 3
+LAYOUT_VERSION = 4
+
+#: Stages with element distance >= COMPACT_MIN_D store only the words at the
+#: lower index of each word pair (see StageSpec.compact).  d >= 4096 makes
+#: the word distance >= 128 (a whole 128-lane row), so the compact view is a
+#: contiguous row slice — clean for both XLA reshapes and Pallas DMA.
+COMPACT_MIN_D = 4096
 
 
-def _next_pow2(x: np.ndarray) -> np.ndarray:
-    x = np.maximum(np.asarray(x, dtype=np.int64), 1)
-    return np.int64(1) << np.int64(np.ceil(np.log2(x.astype(np.float64)))).astype(np.int64)
+class StageSpec(NamedTuple):
+    """Static per-stage metadata for a stored Beneš network.
+
+    ``d``: element distance of the butterfly.
+    ``offset``: word offset of this stage's mask data in the flat array.
+    ``nwords``: stored words (n/32 full, n/64 compact).
+    ``compact``: pair-compacted storage (only words at (w & d>>5) == 0).
+    ``lo``/``hi``: [lo, hi) nonzero word range within the stored words —
+    kernels skip blocks outside it (identity-wired tail routes switch-free).
+    """
+
+    d: int
+    offset: int
+    nwords: int
+    compact: bool
+    lo: int
+    hi: int
+
+
+@dataclass(frozen=True)
+class ClassSlice:
+    """One degree class: vertices/positions [va, vb) own slots [sa, sb).
+
+    ``count`` is the PADDED count (multiple of 32 for rank-major classes);
+    ``real`` the real vertex count; ``width`` the padded slot width.
+    Slot layout: rank-major ``slot = sa + r*count + p`` (a word is 32
+    consecutive p at one rank — broadcast replicates whole words, row-min
+    scans words at stride count/32); vertex-major ``slot = sa + p*width + r``
+    (width % 32 == 0 — a row is width/32 consecutive words), used for the few
+    huge-width classes where rank-major padding would explode.
+    """
+
+    width: int
+    va: int
+    vb: int  # va + count
+    sa: int
+    sb: int
+    real: int
+    vertex_major: bool = False
+
+    @property
+    def count(self) -> int:
+        return self.vb - self.va
 
 
 def _class_width(deg: np.ndarray) -> np.ndarray:
     """Degree-class width: degree rounded up to {2^k, 3*2^(k-1)} — one
     mantissa bit instead of pure powers of two.  Worst-case padding stays
-    just under 50% (deg = 2^k + 1 -> width 3*2^(k-1)) vs 100% for pow2, and
-    the average is far lower: on the scale-24 R-MAT net this keeps the slot
-    count m1 ~= 1.13E instead of 1.45E, which decides whether the Benes
-    network fits the next-lower power of two (halving every stage's traffic
-    when it does)."""
-    p2 = _next_pow2(deg)
+    just under 50% vs 100% for pow2; on the scale-24 R-MAT this keeps the
+    slot count ~1.25E, which decides whether the Beneš network fits the next
+    power of two."""
     x = np.maximum(np.asarray(deg, dtype=np.int64), 1)
+    p2 = np.int64(1) << np.int64(
+        np.ceil(np.log2(x.astype(np.float64)))
+    ).astype(np.int64)
+    p2 = np.maximum(p2, 1)
     three_quarter = (p2 // 4) * 3
     return np.where((p2 >= 4) & (x <= three_quarter), three_quarter, p2)
 
@@ -63,339 +123,159 @@ def _pow2_at_least(n: int) -> int:
     return 1 << (n - 1).bit_length()
 
 
-@dataclass(frozen=True)
-class ClassSlice:
-    """One degree class: vertices [va, vb) own slots [sa, sb), width w.
+def _round32(x: int) -> int:
+    return (int(x) + 31) & ~31
 
-    ``vertex_major`` picks the slot ordering inside the class — chosen so
-    the on-device 2-D view always has a LARGE trailing dimension (TPU
-    (8,128) tiling makes small trailing dims pad ~100x):
-      * vertex-major (slot = sa + p*w + r): view [Nc, w], reduce axis 1 —
-        used when w >= Nc;
-      * rank-major (slot = sa + r*Nc + p): view [w, Nc], reduce axis 0 —
-        used when Nc > w (the common many-small-vertices classes).
+
+def _build_classes(widths: np.ndarray, counts: np.ndarray) -> list[ClassSlice]:
+    """Aligned class slices from per-width real counts (widths ascending).
+
+    Vertex-major iff width >= max(count, 32) (few huge-width vertices: pad
+    the width to a multiple of 32); otherwise rank-major (pad the count).
+    Rank-major classes come first so the padded vertex ranges stay 32-aligned
+    even when vertex-major classes have unpadded counts.
     """
+    order = np.argsort(widths, kind="stable")
+    rank_major = [
+        (int(widths[i]), int(counts[i]))
+        for i in order
+        if not widths[i] >= max(counts[i], 32)
+    ]
+    vertex_major = [
+        (int(widths[i]), int(counts[i]))
+        for i in order
+        if widths[i] >= max(counts[i], 32)
+    ]
+    slices: list[ClassSlice] = []
+    va = 0
+    sa = 0
+    for w, c in rank_major:
+        cp = _round32(c)
+        slices.append(
+            ClassSlice(width=w, va=va, vb=va + cp, sa=sa, sb=sa + w * cp,
+                       real=c, vertex_major=False)
+        )
+        va += cp
+        sa += w * cp
+    for w, c in vertex_major:
+        wp = _round32(w)
+        slices.append(
+            ClassSlice(width=wp, va=va, vb=va + c, sa=sa, sb=sa + wp * c,
+                       real=c, vertex_major=True)
+        )
+        va += c
+        sa += wp * c
+    return slices
 
-    width: int
-    va: int
-    vb: int
-    sa: int
-    sb: int
-    vertex_major: bool = True
 
-    @property
-    def count(self) -> int:
-        return self.vb - self.va
+def _sort_rank(key_hi: np.ndarray, key_lo: np.ndarray):
+    """(order, rank-within-hi-runs) sorted by (key_hi, key_lo) — native radix
+    when available, np.lexsort fallback."""
+    try:
+        from .native_gen import native_available, sort_rank_pairs_native
+
+        if native_available():
+            return sort_rank_pairs_native(key_hi, key_lo)
+    except Exception:
+        pass
+    order = np.lexsort((key_lo, key_hi))
+    hs = np.asarray(key_hi)[order]
+    n = hs.shape[0]
+    if n == 0:
+        return order.astype(np.int32), np.zeros(0, np.int32)
+    starts = np.flatnonzero(np.concatenate([[True], hs[1:] != hs[:-1]]))
+    sor = starts[np.searchsorted(starts, np.arange(n), side="right") - 1]
+    return order.astype(np.int32), (np.arange(n) - sor).astype(np.int32)
+
+
+def _vertex_tables(classes: list[ClassSlice], num_ids: int):
+    """Per-(relabeled id / out-position) slot tables: slot(id, r) =
+    base[id] + r * stride[id].  Rank-major: base = sa + p, stride = count;
+    vertex-major: base = sa + p*width, stride = 1."""
+    base = np.zeros(num_ids, dtype=np.int64)
+    stride = np.ones(num_ids, dtype=np.int64)
+    for cs in classes:
+        p = np.arange(cs.count, dtype=np.int64)
+        if cs.vertex_major:
+            base[cs.va : cs.vb] = cs.sa + p * cs.width
+            stride[cs.va : cs.vb] = 1
+        else:
+            base[cs.va : cs.vb] = cs.sa + p
+            stride[cs.va : cs.vb] = cs.count
+    return base, stride
+
+
+def _compact_and_table(
+    masks: np.ndarray, n: int
+) -> tuple[np.ndarray, tuple[StageSpec, ...]]:
+    """Pair-compact the router's word-major masks and build the stage table.
+
+    For each stage with d >= COMPACT_MIN_D, keep only the word rows at
+    (row & (d >> 12)) == 0 (the rest are structurally zero: switch bits live
+    at the lower pair index).  Also records each stage's nonzero word range
+    so appliers can skip the identity-wired tail."""
+    nw = n // 32
+    stages = masks.shape[0]
+    parts = []
+    table = []
+    offset = 0
+    for s in range(stages):
+        d = benes.stage_distance(n, s)
+        w = masks[s]
+        if d >= COMPACT_MIN_D:
+            dw = d >> 5
+            w = w.reshape(-1, 2, dw)[:, 0, :].reshape(-1)
+        nz = np.flatnonzero(
+            w.reshape(-1, 1024).any(axis=1)
+            if w.shape[0] % 1024 == 0
+            else w
+        )
+        if w.shape[0] % 1024 == 0:
+            lo = int(nz[0]) * 1024 if nz.size else 0
+            hi = int(nz[-1] + 1) * 1024 if nz.size else 0
+        else:
+            lo = int(nz[0]) if nz.size else 0
+            hi = int(nz[-1] + 1) if nz.size else 0
+        parts.append(w)
+        table.append(
+            StageSpec(d=d, offset=offset, nwords=int(w.shape[0]),
+                      compact=d >= COMPACT_MIN_D, lo=lo, hi=hi)
+        )
+        offset += int(w.shape[0])
+    return np.concatenate(parts), tuple(table)
 
 
 @dataclass(frozen=True)
 class RelayGraph:
-    """Static relay layout for one graph (single shard).
+    """Static relay layout v4 for one graph (single shard).
 
-    All vertex-indexed engine state lives in the RELABELED id space
-    (``new2old``/``old2new``); parent VALUES stay original ids.
-    """
-
-    num_vertices: int
-    num_edges: int
-    new2old: np.ndarray  # int32[V]
-    old2new: np.ndarray  # int32[V]
-    # src side
-    vperm_masks: np.ndarray  # uint32[stages, Vp/32] — vertex-order -> out-order bits
-    vperm_size: int
-    out_classes: tuple[ClassSlice, ...]  # over out-order positions
-    # shuffle
-    net_masks: np.ndarray  # uint32[stages, N/32]
-    net_size: int
-    m2: int  # L2 (broadcast) slots actually used
-    # dst side
-    in_classes: tuple[ClassSlice, ...]  # over new-id vertex space
-    src_l1: np.ndarray  # int32[M1] — ORIGINAL src id per L1 slot, INF padding
-
-
-def _class_slices(widths_sorted: np.ndarray) -> list[ClassSlice]:
-    """Contiguous runs of equal width -> ClassSlice list (slot offsets by
-    cumulative width); orientation per class by the larger dimension."""
-    slices = []
-    slot = 0
-    va = 0
-    n = widths_sorted.shape[0]
-    boundaries = np.flatnonzero(np.diff(widths_sorted)) + 1
-    for vb in list(boundaries) + [n]:
-        w = int(widths_sorted[va])
-        nc = vb - va
-        sb = slot + nc * w
-        slices.append(
-            ClassSlice(
-                width=w, va=int(va), vb=int(vb), sa=int(slot), sb=int(sb),
-                vertex_major=w >= nc,
-            )
-        )
-        slot = sb
-        va = vb
-    return slices
-
-
-def _slot_of(cs: ClassSlice, vertex_pos: np.ndarray, rank: np.ndarray) -> np.ndarray:
-    """Slot id for (class-relative vertex position, within-vertex rank)."""
-    if cs.vertex_major:
-        return cs.sa + vertex_pos * cs.width + rank
-    return cs.sa + rank * cs.count + vertex_pos
-
-
-def _edge_slots(classes, pos_sorted, rank_sorted):
-    """Slot ids for edges: ``pos_sorted`` is each edge's vertex position in
-    class ordering; ``rank_sorted`` its within-vertex rank."""
-    out = np.empty(pos_sorted.shape[0], dtype=np.int64)
-    for cs in classes:
-        sel = (pos_sorted >= cs.va) & (pos_sorted < cs.vb)
-        out[sel] = _slot_of(cs, pos_sorted[sel] - cs.va, rank_sorted[sel])
-    return out
-
-
-def _rank_within_groups(group_sorted: np.ndarray) -> np.ndarray:
-    """For a sorted group-id array, the rank of each element within its
-    group (0-based)."""
-    n = group_sorted.shape[0]
-    if n == 0:
-        return np.zeros(0, dtype=np.int64)
-    starts = np.flatnonzero(np.concatenate([[True], group_sorted[1:] != group_sorted[:-1]]))
-    start_of = starts[np.searchsorted(starts, np.arange(n), side="right") - 1]
-    return np.arange(n, dtype=np.int64) - start_of
-
-
-@dataclass(frozen=True)
-class ShardedRelayGraph:
-    """Per-shard relay layouts with ONE unified class structure.
-
-    The multi-device TPU-fast layout: shard ``s`` owns a contiguous block of
-    the (globally relabeled) vertex space and holds the relay pipeline for
-    exactly its owned destinations — its own vperm network, degree-class
-    broadcast, Beneš edge net and src-id tables — while all shards share the
-    SAME static shapes (class slices, network sizes), so one `shard_map`
-    program runs everywhere and only the mask/table DATA differs per device
-    (stacked on axis 0).  The per-superstep exchange is the bit-packed
-    frontier all-gather of the sharded pull engine (1 bit/vertex over ICI);
-    each shard's vperm network absorbs the packed all-gather layout, so the
-    gathered words feed the butterflies directly with no unpack/repack.
-
-    Unification pads each shard's degree classes to the max count over
-    shards (dummy positions are routed guaranteed-zero inputs) and the
-    owned-vertex block to a common multiple of 32.  ``new2old`` is -1 at
-    dummy vertex slots.
+    All vertex-indexed engine state lives in the RELABELED id space of size
+    ``vr`` (``new2old``/``old2new``; -1 at padding dummies); parent VALUES
+    are L1 slot indices mapped to original src ids host-side via ``src_l1``.
     """
 
     num_vertices: int  # real V
-    num_edges: int  # directed edges across all shards
-    num_shards: int
-    block: int  # owned vertex slots per shard (multiple of 32)
-    new2old: np.ndarray  # int32[n*block]; -1 at dummies
+    num_edges: int
+    vr: int  # padded relabeled vertex space (multiple of 32)
+    new2old: np.ndarray  # int32[vr]; -1 at dummies
     old2new: np.ndarray  # int32[V]
-    vperm_masks: np.ndarray  # uint32[n, Sv, Vp/32]
+    # src side
+    vperm_masks: np.ndarray  # uint32 flat
+    vperm_table: tuple[StageSpec, ...]
     vperm_size: int
-    out_classes: tuple[ClassSlice, ...]  # unified, over out-order positions
-    net_masks: np.ndarray  # uint32[n, S, N/32]
+    out_classes: tuple[ClassSlice, ...]  # over out-order positions
+    out_space: int  # used out positions (sum of class counts)
+    # shuffle
+    net_masks: np.ndarray  # uint32 flat
+    net_table: tuple[StageSpec, ...]
     net_size: int
+    m1: int
     m2: int
-    in_classes: tuple[ClassSlice, ...]  # unified, over local [0, block)
-    src_l1: np.ndarray  # int32[n, M1]; ORIGINAL src ids, INF padding
-
-
-def _unified_class_slices(width_count_pairs) -> tuple[list[ClassSlice], int]:
-    """Slices for a (width, count) list sorted by width; returns (slices,
-    total positions)."""
-    slices = []
-    slot = 0
-    va = 0
-    for w, c in width_count_pairs:
-        sb = slot + c * w
-        slices.append(
-            ClassSlice(width=int(w), va=int(va), vb=int(va + c),
-                       sa=int(slot), sb=int(sb), vertex_major=w >= c)
-        )
-        slot = sb
-        va += c
-    return slices, va
-
-
-def build_sharded_relay_graph(
-    graph: Graph | DeviceGraph, num_shards: int
-) -> ShardedRelayGraph:
-    """Build per-shard relay layouts with a unified static structure.
-
-    Vertices are partitioned into ``num_shards`` contiguous original-id
-    ranges (the sharded pull engine's ownership rule), then relabeled within
-    each shard so in-degree classes are contiguous; the global new-id space
-    is the concatenation of shard blocks.
-    """
-    if not benes.native_available():
-        raise RuntimeError("relay engine requires the native benes router")
-    if num_shards < 1:
-        raise ValueError("num_shards must be >= 1")
-    from .csr import _sorted_by_dst, unpad_edges
-
-    if isinstance(graph, DeviceGraph):
-        src, dst = _sorted_by_dst(*unpad_edges(graph))
-    else:
-        src, dst = _sorted_by_dst(graph.src, graph.dst)
-    src = src.astype(np.int64)
-    dst = dst.astype(np.int64)
-    v = graph.num_vertices
-    e = int(src.shape[0])
-    n = num_shards
-    vblock = max((v + n - 1) // n, 1)
-
-    indeg = np.bincount(dst, minlength=v)
-    in_w = _class_width(indeg)  # >= 1; zero-indeg vertices get one INF slot
-
-    # ---- unified in-classes: per-width counts maxed over shards ----------
-    shard_of_old = np.minimum(np.arange(v, dtype=np.int64) // vblock, n - 1)
-    widths_all = np.unique(in_w)
-    cin = {}
-    for w in widths_all.tolist():
-        per_shard = np.bincount(shard_of_old[in_w == w], minlength=n)
-        cin[w] = int(per_shard.max())
-    block0 = sum(cin.values())
-    pad = (-block0) % 32
-    if pad:
-        cin[1] = cin.get(1, 0) + pad
-    in_pairs = sorted(cin.items())
-    in_classes, block = _unified_class_slices(in_pairs)
-    m1 = in_classes[-1].sb if in_classes else 0
-
-    # ---- global relabel: shard-major, in-class-major, old-id-minor -------
-    # Shard s's real width-w vertices occupy the first count_s(w) positions
-    # of the unified class; the rest are dummies (-1 in new2old).
-    new2old = np.full(n * block, -1, dtype=np.int64)
-    old2new = np.empty(v, dtype=np.int64)
-    in_widths_arr = np.array([w for w, _ in in_pairs], dtype=np.int64)
-    in_va_arr = np.array([cs.va for cs in in_classes], dtype=np.int64)
-    order = np.lexsort((np.arange(v), in_w, shard_of_old))  # shard, width, id
-    ow = in_w[order]
-    os_ = shard_of_old[order]
-    # rank within each (shard, width) run (keys are sorted by construction)
-    widx = np.searchsorted(in_widths_arr, ow)
-    run_key = os_ * in_widths_arr.shape[0] + widx
-    rank = _rank_within_groups(run_key)
-    pos = os_ * block + in_va_arr[widx] + rank
-    new2old[pos] = order
-    old2new[order] = pos
-
-    # ---- edge shard slices (dst-sorted, contiguous original ownership) ---
-    bounds = np.searchsorted(dst, np.arange(n + 1, dtype=np.int64) * vblock)
-    bounds[-1] = e
-
-    # ---- unified out-classes over per-shard out-degrees ------------------
-    # outdeg_s(u) = edges u -> (dst in shard s); vertices with none get NO
-    # slots.  Kept sparse per shard (only src ids that appear): the dense
-    # form would be O(n^2 * block).
-    out_sparse = []  # per shard: (new ids with >=1 edge, ascending; widths)
-    cout: dict[int, int] = {}
-    for s in range(n):
-        es, ee = bounds[s], bounds[s + 1]
-        uids, ucounts = np.unique(old2new[src[es:ee]], return_counts=True)
-        w = _class_width(ucounts)
-        out_sparse.append((uids, w))
-        for wv, c in zip(*np.unique(w, return_counts=True)):
-            cout[int(wv)] = max(cout.get(int(wv), 0), int(c))
-    out_pairs = sorted(cout.items())
-    out_classes, out_space = _unified_class_slices(out_pairs)
-    m2 = out_classes[-1].sb if out_classes else 0
-
-    # ---- vperm geometry: the all-gathered packed words feed the network --
-    # Packed layout: vertex (shard s', local e) sits at word s'*nw + e%nw,
-    # bit e//nw; as a network element that is (e//nw)*NW + s'*nw + (e%nw)
-    # with NW = Vp/32 >= n*nw (tail words are zero padding).  Dummy class
-    # positions must receive guaranteed-zero inputs, so Vp also covers the
-    # worst-case dummy count.
-    nw = block // 32
-    dmax = 0
-    for _, uw in out_sparse:
-        d = sum(c - int(np.count_nonzero(uw == wv)) for wv, c in out_pairs)
-        dmax = max(dmax, d)
-    vp = _pow2_at_least(max(n * block, out_space, v + dmax))
-    nww = vp // 32
-    new_ids = np.flatnonzero(new2old >= 0).astype(np.int64)  # real vertices
-    eloc = new_ids % block
-    e_net_real = (eloc // nw) * nww + (new_ids // block) * nw + (eloc % nw)
-    e_net_all = np.full(n * block, -1, dtype=np.int64)
-    e_net_all[new_ids] = e_net_real
-    zero_pool = np.setdiff1d(
-        np.arange(vp, dtype=np.int64), e_net_real, assume_unique=False
-    )
-
-    out_va = {cs.width: cs.va for cs in out_classes}
-    vperm_stages = benes.num_stages(vp)
-    net_size = _pow2_at_least(max(m1, m2))
-    net_stages = benes.num_stages(net_size)
-    vperm_masks = np.zeros((n, vperm_stages, vp // 32), dtype=np.uint32)
-    net_masks = np.zeros((n, net_stages, net_size // 32), dtype=np.uint32)
-    src_l1 = np.full((n, m1), INF_DIST, dtype=np.int32)
-    outpos = np.full(n * block, -1, dtype=np.int64)  # reused per shard
-
-    for s in range(n):
-        uids_s, uw_s = out_sparse[s]
-        # out-order positions for this shard's width>0 vertices
-        outpos[:] = -1
-        perm = np.full(vp, -1, dtype=np.int64)
-        zp_used = 0
-        for wv, c in out_pairs:
-            ids = uids_s[uw_s == wv]  # ascending new ids
-            va = out_va[wv]
-            outpos[ids] = va + np.arange(ids.shape[0])
-            perm[va : va + ids.shape[0]] = e_net_all[ids]
-            ndum = c - ids.shape[0]
-            if ndum:
-                perm[va + ids.shape[0] : va + c] = zero_pool[
-                    zp_used : zp_used + ndum
-                ]
-                zp_used += ndum
-        used = np.zeros(vp, dtype=bool)
-        used[perm[perm >= 0]] = True
-        vperm_masks[s] = benes.route(
-            benes.pad_perm(perm, vp, used), bit_major=True
-        )
-
-        # ---- big net: L2 (broadcast slots) -> L1 (dst-grouped slots) -----
-        es, ee = bounds[s], bounds[s + 1]
-        s_src, s_dst = src[es:ee], dst[es:ee]
-        dstn = old2new[s_dst] - s * block  # local new ids in [0, block)
-        ord1 = np.lexsort((s_src, dstn))
-        rank1 = _rank_within_groups(dstn[ord1])
-        l1_pos = np.empty(ee - es, dtype=np.int64)
-        l1_pos[ord1] = _edge_slots(in_classes, dstn[ord1], rank1)
-        src_l1[s, l1_pos] = s_src.astype(np.int32)  # ORIGINAL ids
-
-        srcpos = outpos[old2new[s_src]]
-        ord2 = np.lexsort((s_dst, srcpos))
-        rank2 = _rank_within_groups(srcpos[ord2])
-        l2_pos = np.empty(ee - es, dtype=np.int64)
-        l2_pos[ord2] = _edge_slots(out_classes, srcpos[ord2], rank2)
-
-        net = np.full(net_size, -1, dtype=np.int64)
-        net[l1_pos] = l2_pos
-        used = np.zeros(net_size, dtype=bool)
-        used[l2_pos] = True
-        net_masks[s] = benes.route(
-            benes.pad_perm(net, net_size, used), bit_major=True
-        )
-
-    return ShardedRelayGraph(
-        num_vertices=v,
-        num_edges=e,
-        num_shards=n,
-        block=block,
-        new2old=new2old.astype(np.int32),
-        old2new=old2new.astype(np.int32),
-        vperm_masks=vperm_masks,
-        vperm_size=vp,
-        out_classes=tuple(out_classes),
-        net_masks=net_masks,
-        net_size=net_size,
-        m2=m2,
-        in_classes=tuple(in_classes),
-        src_l1=src_l1,
-    )
+    # dst side
+    in_classes: tuple[ClassSlice, ...]  # over relabeled vertex space
+    src_l1: np.ndarray  # int32[m1] — ORIGINAL src id per L1 slot, INF padding
+    # sparse-path adjacency (relabeled CSR with per-edge L1 slot), built lazily
+    # by engines that want the hybrid small-frontier path.
 
 
 def build_relay_graph(graph: Graph | DeviceGraph) -> RelayGraph:
@@ -411,10 +291,12 @@ def build_relay_graph(graph: Graph | DeviceGraph) -> RelayGraph:
         flat_src = graph.src.reshape(-1)
         flat_dst = graph.dst.reshape(-1)
         keep = flat_dst != graph.sentinel
-        src, dst = flat_src[keep].astype(np.int64), flat_dst[keep].astype(np.int64)
+        src = flat_src[keep].astype(np.int64)
+        dst = flat_dst[keep].astype(np.int64)
         v = graph.num_vertices
     else:
-        src, dst = graph.src.astype(np.int64), graph.dst.astype(np.int64)
+        src = graph.src.astype(np.int64)
+        dst = graph.dst.astype(np.int64)
         v = graph.num_vertices
     e = int(src.shape[0])
 
@@ -423,72 +305,156 @@ def build_relay_graph(graph: Graph | DeviceGraph) -> RelayGraph:
     in_w = _class_width(indeg)  # zero-indeg vertices get one INF slot
     out_w = _class_width(outdeg)
 
-    # ---- relabel by (in-class width, old id): in-classes contiguous -------
-    new2old = np.lexsort((np.arange(v), in_w)).astype(np.int64)
+    # ---- dst side: aligned classes over the relabeled vertex space --------
+    widths, counts = np.unique(in_w, return_counts=True)
+    in_classes = _build_classes(widths, counts)
+    vr = _round32(in_classes[-1].vb) if in_classes else 32
+    m1 = in_classes[-1].sb if in_classes else 0
+
+    # relabel: class-major, old-id-minor; dummies at padded class tails
+    new2old = np.full(vr, -1, dtype=np.int64)
     old2new = np.empty(v, dtype=np.int64)
-    old2new[new2old] = np.arange(v)
+    order = np.argsort(in_w, kind="stable")  # stable: old-id-minor
+    width_of_class = {}
+    for cs in in_classes:
+        width_of_class[(cs.width if not cs.vertex_major else None, cs.va)] = cs
+    # assign per class in ascending width order (order is sorted by width)
+    pos = 0
+    for cs in sorted(in_classes, key=lambda c: c.va):
+        ids = order[pos : pos + cs.real]
+        new2old[cs.va : cs.va + cs.real] = ids
+        old2new[ids] = cs.va + np.arange(cs.real)
+        pos += cs.real
+    assert pos == v
 
-    # ---- dst side (L1): slots per new-vertex, classes contiguous ----------
-    in_w_new = in_w[new2old]
-    in_classes = _class_slices(in_w_new)
-    slot_start = np.zeros(v + 1, dtype=np.int64)
-    np.cumsum(in_w_new, out=slot_start[1:])
-    m1 = int(slot_start[v])
+    # ---- src side: aligned classes over out-order positions ---------------
+    owidths, ocounts = np.unique(out_w, return_counts=True)
+    out_classes = _build_classes(owidths, ocounts)
+    out_space = out_classes[-1].vb if out_classes else 0
+    m2 = out_classes[-1].sb if out_classes else 0
 
+    outpos_of_old = np.empty(v, dtype=np.int64)
+    oorder = np.argsort(out_w, kind="stable")
+    pos = 0
+    for cs in sorted(out_classes, key=lambda c: c.va):
+        ids = oorder[pos : pos + cs.real]
+        outpos_of_old[ids] = cs.va + np.arange(cs.real)
+        pos += cs.real
+    assert pos == v
+
+    # ---- L1 slots: edges sorted by (dst_new, src); rank = in-row position --
     dstn = old2new[dst]
-    ord1 = np.lexsort((src, dstn))
-    rank1 = _rank_within_groups(dstn[ord1])
-    l1_pos = np.empty(e, dtype=np.int64)
-    l1_pos[ord1] = _edge_slots(in_classes, dstn[ord1], rank1)
-
+    order1, rank1 = _sort_rank(dstn.astype(np.int32), src.astype(np.int32))
+    base1, stride1 = _vertex_tables(in_classes, vr)
+    ds = dstn[order1]
+    l1_sorted = base1[ds] + rank1.astype(np.int64) * stride1[ds]
     src_l1 = np.full(m1, INF_DIST, dtype=np.int32)
-    src_l1[l1_pos] = src.astype(np.int32)  # ORIGINAL ids: canonical min-parent
+    src_l1[l1_sorted] = src[order1].astype(np.int32)  # ORIGINAL ids
 
-    # ---- src side (L2): out-class order over new ids ----------------------
-    out_w_new = out_w[new2old]
-    outorder2new = np.lexsort((np.arange(v), out_w_new)).astype(np.int64)
-    new2outpos = np.empty(v, dtype=np.int64)
-    new2outpos[outorder2new] = np.arange(v)
-    out_classes = _class_slices(out_w_new[outorder2new])
-    slot2_start = np.zeros(v + 1, dtype=np.int64)
-    np.cumsum(out_w_new[outorder2new], out=slot2_start[1:])
-    m2 = int(slot2_start[v])
+    # ---- L2 slots: edges sorted by (src out-position, dst) -----------------
+    srcpos = outpos_of_old[src]
+    order2, rank2 = _sort_rank(srcpos.astype(np.int32), dstn.astype(np.int32))
+    base2, stride2 = _vertex_tables(out_classes, out_classes[-1].vb)
+    sp = srcpos[order2]
+    l2_sorted = base2[sp] + rank2.astype(np.int64) * stride2[sp]
 
-    srcpos = new2outpos[old2new[src]]
-    ord2 = np.lexsort((dst, srcpos))
-    rank2 = _rank_within_groups(srcpos[ord2])
-    l2_pos = np.empty(e, dtype=np.int64)
-    l2_pos[ord2] = _edge_slots(out_classes, srcpos[ord2], rank2)
-
-    # ---- small network: vertex-order bits -> out-order bits ---------------
-    vp = _pow2_at_least(v)
-    vperm = np.full(vp, -1, dtype=np.int64)
-    vperm[:v] = outorder2new  # output j (out-order) <- input new-id
-    used = np.zeros(vp, dtype=bool)
-    used[outorder2new] = True
-    vperm = benes.pad_perm(vperm, vp, used)
-    vperm_masks = benes.route(vperm, bit_major=True)
-
-    # ---- big network: L2 slot -> L1 slot ----------------------------------
+    # ---- big network: L1 slot <- L2 slot -----------------------------------
     n = _pow2_at_least(max(m1, m2))
     net = np.full(n, -1, dtype=np.int64)
-    net[l1_pos] = l2_pos
+    l1_by_edge = np.empty(e, dtype=np.int64)
+    l1_by_edge[order1] = l1_sorted
+    l2_by_edge = np.empty(e, dtype=np.int64)
+    l2_by_edge[order2] = l2_sorted
+    net[l1_by_edge] = l2_by_edge
     used = np.zeros(n, dtype=bool)
-    used[l2_pos] = True
-    net = benes.pad_perm(net, n, used)
-    net_masks = benes.route(net, bit_major=True)
+    used[l2_by_edge] = True
+    _pad_identity(net, used, n)
+    net_masks_full = benes.route_std(net)
+    net_masks, net_table = _compact_and_table(net_masks_full, n)
+    del net_masks_full
+
+    # ---- small network: vertex-space words -> out-order words --------------
+    # Dummy out positions (padded rank-major class tails) must read zero:
+    # wire them to the guaranteed-zero input region [vr, vp).
+    out_vb = out_classes[-1].vb
+    dummies = out_vb - v
+    vp = _pow2_at_least(max(vr + dummies, out_vb, 32 * 128 * 2))
+    vperm = np.full(vp, -1, dtype=np.int64)
+    real_mask = np.zeros(out_vb, dtype=bool)
+    pos = 0
+    for cs in sorted(out_classes, key=lambda c: c.va):
+        real_mask[cs.va : cs.va + cs.real] = True
+        pos += cs.real
+    # real out positions <- relabeled id of their vertex
+    out_real_positions = np.flatnonzero(real_mask)
+    vperm[out_real_positions] = old2new[
+        _out_position_owner(out_classes, oorder)
+    ]
+    dummy_positions = np.flatnonzero(~real_mask)
+    vperm[dummy_positions] = vr + np.arange(dummy_positions.shape[0])
+    used = np.zeros(vp, dtype=bool)
+    used[vperm[vperm >= 0]] = True
+    _pad_identity(vperm, used, vp)
+    vperm_masks_full = benes.route_std(vperm)
+    vperm_masks, vperm_table = _compact_and_table(vperm_masks_full, vp)
+    del vperm_masks_full
 
     return RelayGraph(
         num_vertices=v,
         num_edges=e,
+        vr=vr,
         new2old=new2old.astype(np.int32),
         old2new=old2new.astype(np.int32),
         vperm_masks=vperm_masks,
+        vperm_table=vperm_table,
         vperm_size=vp,
         out_classes=tuple(out_classes),
+        out_space=out_vb,
         net_masks=net_masks,
+        net_table=net_table,
         net_size=n,
+        m1=m1,
         m2=m2,
         in_classes=tuple(in_classes),
         src_l1=src_l1,
     )
+
+
+def _out_position_owner(out_classes, oorder: np.ndarray) -> np.ndarray:
+    """Original vertex id owning each REAL out position, in ascending
+    position order (dummies excluded)."""
+    parts = []
+    pos = 0
+    for cs in sorted(out_classes, key=lambda c: c.va):
+        parts.append(oorder[pos : pos + cs.real])
+        pos += cs.real
+    return np.concatenate(parts) if parts else np.zeros(0, np.int64)
+
+
+def _pad_identity(perm: np.ndarray, used: np.ndarray, n: int) -> None:
+    """Complete a partial mapping to a bijection, wiring free outputs to free
+    inputs IDENTITY-first: output j takes input j wherever both are free.
+    Identity-wired pads route switch-free through the Beneš coloring, which
+    is what makes each stage's tail word range all-zero (StageSpec.lo/hi)."""
+    free_out = perm < 0
+    both = free_out & ~used
+    idx = np.flatnonzero(both)
+    perm[idx] = idx
+    used[idx] = True
+    free_outputs = np.flatnonzero(perm < 0)
+    free_inputs = np.flatnonzero(~used)
+    if free_outputs.shape[0] != free_inputs.shape[0]:
+        raise ValueError("partial permutation is not completable")
+    perm[free_outputs] = free_inputs
+
+
+def valid_slot_words(src_l1: np.ndarray, net_size: int) -> np.ndarray:
+    """Static valid-slot bitmask (STANDARD packing): uint32[net_size/32], bit
+    set iff that L1 slot holds a real edge.  Beneš pad routing may deliver
+    stray 1-bits to padded slots; this mask zeroes them before the row-min."""
+    m1 = src_l1.shape[0]
+    bits = np.zeros(net_size, dtype=bool)
+    bits[:m1] = src_l1 != np.int32(INF_DIST)
+    return np.packbits(
+        bits.reshape(-1, 32), axis=1, bitorder="little"
+    ).view(np.uint32).reshape(-1)

@@ -1,302 +1,249 @@
-"""Relay superstep: broadcast -> Beneš bit routing -> class row-min.
+"""Relay superstep v4: broadcast -> Beneš bit routing -> class row-min.
 
 The gather-free BFS superstep over a :class:`~bfs_tpu.graph.relay.RelayGraph`
 layout.  Every op here is dense (elementwise / reshape / broadcast / reduce)
 — the only data-dependent values are the bits themselves, never an index.
-See graph/relay.py for the measured rationale and the layout.
+See graph/relay.py for the measured rationale and the v4 layout.
 
-TPU layout discipline (the whole point of this module): every 2-D view
-keeps a LARGE trailing dimension, because (8,128) tiling pads small
-trailing dims ~100x (measured ~50x slowdown on naive reshapes):
+Everything uses STANDARD (word-major) packing: element ``e`` at word
+``e >> 5``, bit ``e & 31`` — the layout the native router emits and the one
+where 32-aligned degree classes make the broadcast a word replication and
+the row-min a word-level scan (no pack/unpack kernels anywhere, unlike the
+round-2 bit-major layout).
 
-  * bits pack **bit-major**: element ``e`` lives at (word ``e % nw``, bit
-    ``e // nw``), so pack/unpack are a 32-way reduce/concat over full-size
-    word arrays — never a ``[nw, 32]`` view.  native/benes.cpp emits masks
-    in the same layout (``route(..., bit_major=True)``).
-  * butterfly stages run on a fixed ``[R, 128]`` word view: intra-word
-    shifts for bit-level pairs, lane-rolls for word distance < 128, and
-    sublane-preserving row-block reshapes above that.
-  * degree-class phases choose vertex-major or rank-major slot order per
-    class (ClassSlice.vertex_major) so broadcast/reduce views are
-    ``[small, large]``.
+This module is the portable XLA reference path (CPU tests, sharded CPU
+matrix, fallback).  On real TPUs the same math runs as fused Pallas passes
+(:mod:`bfs_tpu.ops.relay_pallas`), bit-exact against this implementation.
 """
 
 from __future__ import annotations
+
+from typing import NamedTuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .relax import INT32_MAX, BfsState, apply_candidates
+from ..graph.relay import StageSpec
+from .relax import INT32_MAX
 
-LANES = 128
-#: Networks smaller than this run the simple unpacked element path.
-MIN_PACKED_BITS = 32 * LANES * 2
+__all__ = [
+    "RelayState",
+    "init_relay_state",
+    "pack_std",
+    "unpack_std",
+    "apply_benes_std",
+    "broadcast_l2",
+    "rowmin_candidates",
+    "apply_relay_candidates",
+    "relay_superstep_words",
+]
 
 
-def pack_bits(bits: jax.Array, n: int) -> jax.Array:
-    """uint8/bool[..., n] -> uint32[..., n/32], bit-major (element e -> word
-    e % nw); broadcasts over leading axes.
+class RelayState(NamedTuple):
+    """Relay-engine loop carry, all in the RELABELED vertex space of size vr.
 
-    Two-level pack keeps the traffic narrow (measured 76 ms -> ~4 ms on the
-    2^29-slot net): rows combine 8-at-a-time IN uint8 (no 4-byte widening of
-    the full bit array), then the four byte planes widen and OR — bit b of
-    word w is element b*nw + w, so byte plane k holds rows 8k..8k+7.
-    This is THE packed-word convention: ops/pull.py's frontier blocks and
-    native/benes.cpp's masks use the same layout."""
-    nw = max(n // 32, 1)
+    ``dist``: int32[vr] (INT32_MAX unreached); ``parent``: int32[vr] L1 SLOT
+    index of the parent edge (-1 unreached; the source's self-entry holds its
+    relabeled id and is fixed up host-side); ``fwords``: uint32[vr/32]
+    frontier bits, standard packing — fed to the vperm network directly.
+    """
+
+    dist: jax.Array
+    parent: jax.Array
+    fwords: jax.Array
+    level: jax.Array
+    changed: jax.Array
+
+
+def init_relay_state(vr: int, source_new) -> RelayState:
+    source_new = jnp.asarray(source_new, dtype=jnp.int32)
+    dist = jnp.full((vr,), INT32_MAX, jnp.int32).at[source_new].set(0)
+    parent = jnp.full((vr,), -1, jnp.int32).at[source_new].set(source_new)
+    fwords = (
+        jnp.zeros((vr // 32,), jnp.uint32)
+        .at[source_new >> 5]
+        .set(jnp.uint32(1) << (source_new & 31).astype(jnp.uint32))
+    )
+    return RelayState(dist, parent, fwords, jnp.int32(0), jnp.bool_(True))
+
+
+def pack_std(bits: jax.Array) -> jax.Array:
+    """bool/uint8[..., n] -> uint32[..., n/32], standard packing (element e
+    -> word e>>5, bit e&31).  XLA reference; fine on CPU, the TPU path packs
+    in-kernel instead."""
     lead = bits.shape[:-1]
-    if n <= 32:
-        b = bits.astype(jnp.uint32)
-        shifts = jnp.arange(n, dtype=jnp.uint32)
-        return (b << shifts).sum(axis=-1, dtype=jnp.uint32)[..., None]
-    from .benes_pallas import pack_bits_pallas, pack_kernel_ok
+    n = bits.shape[-1]
+    b = bits.reshape(*lead, n // 32, 32).astype(jnp.uint32)
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    return (b << shifts).sum(axis=-1, dtype=jnp.uint32)
 
-    if not lead and pack_kernel_ok(n):
-        return pack_bits_pallas(bits.astype(jnp.uint8), n)
-    b = bits.reshape(*lead, 4, 8, nw).astype(jnp.uint8)
-    shifts8 = jnp.arange(8, dtype=jnp.uint8)[:, None]
-    planes = (b << shifts8).sum(axis=-2, dtype=jnp.uint8).astype(jnp.uint32)
+
+def unpack_std(words: jax.Array, n: int) -> jax.Array:
+    """uint32[n/32] -> uint8[n], standard packing."""
+    shifts = jnp.arange(32, dtype=jnp.uint32)
     return (
-        planes[..., 0, :]
-        | (planes[..., 1, :] << 8)
-        | (planes[..., 2, :] << 16)
-        | (planes[..., 3, :] << 24)
+        ((words[..., :, None] >> shifts) & 1).astype(jnp.uint8).reshape(
+            *words.shape[:-1], n
+        )
     )
 
 
-def pack_bits_host(bits: np.ndarray, n: int) -> np.ndarray:
-    """NumPy twin of :func:`pack_bits` (same bit-major layout): uint8/bool[n]
-    -> uint32[n/32].  Used host-side to precompute static word masks (e.g.
-    the valid-slot mask) without touching the device."""
-    bits = np.asarray(bits, dtype=np.uint8)
-    if n <= 32:
-        word = np.uint32(0)
-        for b in range(n):
-            word |= np.uint32(bits[b]) << np.uint32(b)
-        return np.array([word], dtype=np.uint32)
-    nw = n // 32
-    planes = bits.reshape(32, nw)
-    words = np.zeros(nw, dtype=np.uint32)
-    for b in range(32):  # 32 cheap passes instead of one 32x-widened temp
-        words |= planes[b].astype(np.uint32) << np.uint32(b)
-    return words
+def pack_std_host(bits: np.ndarray) -> np.ndarray:
+    """NumPy twin of :func:`pack_std` for host-side precomputes."""
+    b = np.asarray(bits, dtype=bool).reshape(-1, 32)
+    return np.packbits(b, axis=1, bitorder="little").view(np.uint32).reshape(-1)
 
 
-def unpack_bits(words: jax.Array, n: int) -> jax.Array:
-    """uint32[n/32] -> uint8[n], bit-major."""
-    if n <= 32:
-        return ((words[0] >> jnp.arange(n, dtype=jnp.uint32)) & 1).astype(jnp.uint8)
-    from .benes_pallas import pack_kernel_ok, unpack_bits_pallas
-
-    if words.ndim == 1 and pack_kernel_ok(n):
-        return unpack_bits_pallas(words, n)
-    shifts = jnp.arange(32, dtype=jnp.uint32)[:, None]
-    return ((words[None, :] >> shifts) & 1).astype(jnp.uint8).reshape(-1)
+def _stage_slice(masks_flat: jax.Array, st: StageSpec) -> jax.Array:
+    return jax.lax.slice_in_dim(masks_flat, st.offset, st.offset + st.nwords)
 
 
-def _apply_benes_small(words: jax.Array, masks: jax.Array, n: int) -> jax.Array:
-    """Unpacked element-space applier for tiny networks (test graphs)."""
-    k = int(n).bit_length() - 1
-    x = unpack_bits(words, n)
-    for s in range(2 * k - 1):
-        d = n >> (s + 1) if s < k else n >> (2 * k - 1 - s)
-        me = unpack_bits(masks[s], n).reshape(-1, 2, d)[:, 0, :]
-        xr = x.reshape(-1, 2, d)
-        lo, hi = xr[:, 0, :], xr[:, 1, :]
-        t = (lo ^ hi) & me
-        x = jnp.stack([lo ^ t, hi ^ t], axis=1).reshape(-1)
-    return pack_bits(x, n)
+def apply_benes_std(
+    words: jax.Array, masks_flat: jax.Array, table: tuple[StageSpec, ...],
+    n: int,
+) -> jax.Array:
+    """Apply a routed Beneš network to standard-packed words (XLA path).
 
-
-def apply_benes(words: jax.Array, masks: jax.Array, n: int) -> jax.Array:
-    """Apply a routed Beneš network to bit-major packed words.
-
-    ``words``: uint32[n/32]; ``masks``: uint32[stages, n/32] from
-    ``benes.route(perm, bit_major=True)``.  Stage ``s`` swaps element pairs
-    at distance ``d_s``; in the bit-major layout an element distance ``d``
-    means a word-index distance ``d`` when ``d < nw`` and a bit-position
-    distance ``d // nw`` otherwise.
+    ``masks_flat``/``table`` come from the v4 layout: per-stage storage is
+    either full (n/32 words; only bits/words at the lower pair index are
+    nonzero) or pair-compacted (n/64 words, d >= COMPACT_MIN_D).
+    Stage ``s`` swaps element pairs at distance ``d``: intra-word bit shifts
+    for d < 32, word-pair butterflies above.
     """
-    k = int(n).bit_length() - 1
-    nw = n // 32
-    if n < MIN_PACKED_BITS:
-        return _apply_benes_small(words, masks, n)
-
-    from .benes_pallas import apply_benes_fused, pallas_enabled
-
-    if pallas_enabled():
-        # Whole network in <= 3 fused Pallas passes (x VMEM-resident,
-        # masks DMA-streamed); the per-stage loop below is the portable
-        # XLA fallback for CPU platforms.
-        return apply_benes_fused(words, masks, n=n)
-
-    r = nw // LANES
-    x = words.reshape(r, LANES)
-    lane = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
-    row = jax.lax.broadcasted_iota(jnp.int32, (r, 1), 0)
-    for s in range(2 * k - 1):
-        d = n >> (s + 1) if s < k else n >> (2 * k - 1 - s)
-        m = masks[s].reshape(r, LANES)
-        if d >= nw:
-            sh = jnp.uint32(d // nw)  # bit-position butterfly, elementwise
+    x = words
+    for st in table:
+        m = _stage_slice(masks_flat, st)
+        d = st.d
+        if d < 32:
+            sh = jnp.uint32(d)
             t = (x ^ (x >> sh)) & m
             x = x ^ t ^ (t << sh)
-        elif d < LANES:
-            # Word pairs in the same 128-lane row: partner lane = lane ^ d.
-            has_bit = (lane & d) != 0
-            partner = jnp.where(
-                has_bit, jnp.roll(x, d, axis=1), jnp.roll(x, -d, axis=1)
-            )
-            # Mask bits sit at the lower lane of each pair; mirror them onto
-            # the upper lane so one xor fixes both sides.
-            m_both = jnp.where(has_bit, jnp.roll(m, d, axis=1), m)
-            x = x ^ ((x ^ partner) & m_both)
         else:
-            br = d // LANES  # partner row = row ^ br; same roll+select form
-            has_bit = (row & br) != 0
-            partner = jnp.where(
-                has_bit, jnp.roll(x, br, axis=0), jnp.roll(x, -br, axis=0)
-            )
-            m_both = jnp.where(has_bit, jnp.roll(m, br, axis=0), m)
-            x = x ^ ((x ^ partner) & m_both)
-    return x.reshape(-1)
+            dw = d >> 5
+            if st.compact:
+                mv = m.reshape(-1, dw)
+            else:
+                mv = m.reshape(-1, 2, dw)[:, 0, :]
+            xr = x.reshape(-1, 2, dw)
+            lo, hi = xr[:, 0, :], xr[:, 1, :]
+            t = (lo ^ hi) & mv
+            x = jnp.stack([lo ^ t, hi ^ t], axis=1).reshape(-1)
+    return x
 
 
-def valid_slot_words(src_l1: np.ndarray, net_size: int) -> np.ndarray:
-    """Static valid-slot bitmask for :func:`relay_candidates`:
-    uint32[net_size/32], bit set iff that L1 slot holds a REAL edge.
-
-    The Beneš pad-routing may deliver stray 1-bits to padded row slots
-    (pad_perm wires unused outputs to arbitrary unused inputs, some of which
-    are broadcast copies of live frontier bits).  The old int32 src table
-    made those inert via INF entries; with iota slot candidates the mask
-    must zero them before the row-min instead."""
-    bits = np.zeros(net_size, dtype=np.uint8)
-    m1 = src_l1.shape[0]
-    bits[:m1] = src_l1 != np.int32(INT32_MAX)
-    return pack_bits_host(bits, net_size)
-
-
-def relay_candidates(
-    frontier: jax.Array,
-    *,
-    num_vertices: int,
-    vperm_masks: jax.Array,
-    vperm_size: int,
-    out_classes,
-    net_masks: jax.Array,
-    net_size: int,
-    m2: int,
-    in_classes,
-    valid_words: jax.Array,
+def broadcast_l2(
+    ywords: jax.Array, out_classes, net_size: int, out_space: int
 ) -> jax.Array:
-    """Min active in-edge SLOT per (relabeled) vertex: int32[V].
-
-    ``frontier``: bool[V+1] in relabeled vertex order (sentinel slot
-    ignored).  Candidate VALUES are global L1 slot indices, not src ids:
-    within a dst row, slots are filled in ascending ORIGINAL src-id order
-    (graph/relay.py ord1 lexsort), so min active slot == min active src id —
-    the canonical min-parent tie-break survives, while the hot loop never
-    reads the int32 src table (~4 bytes/edge/superstep saved).  Engines map
-    slot -> original src id once on the host via ``RelayGraph.src_l1``.
-    ``valid_words``: static bitmask from :func:`valid_slot_words`.
-    """
-    v = num_vertices
-    fbits = frontier[:v].astype(jnp.uint8)
-    fbits = jnp.concatenate([fbits, jnp.zeros(vperm_size - v, dtype=jnp.uint8)])
-    return relay_candidates_packed(
-        pack_bits(fbits, vperm_size),
-        vperm_masks=vperm_masks,
-        vperm_size=vperm_size,
-        out_classes=out_classes,
-        net_masks=net_masks,
-        net_size=net_size,
-        m2=m2,
-        in_classes=in_classes,
-        valid_words=valid_words,
-    )
-
-
-def _class_slot_iota(cs) -> jax.Array:
-    """Global L1 slot index per position of one in-class view — generated
-    on-chip (broadcasted_iota), zero HBM traffic."""
-    if cs.vertex_major:  # view [Nc, w], slot = sa + p*w + r
-        p = jax.lax.broadcasted_iota(jnp.int32, (cs.count, cs.width), 0)
-        r = jax.lax.broadcasted_iota(jnp.int32, (cs.count, cs.width), 1)
-        return cs.sa + p * cs.width + r
-    # view [w, Nc], slot = sa + r*Nc + p
-    r = jax.lax.broadcasted_iota(jnp.int32, (cs.width, cs.count), 0)
-    p = jax.lax.broadcasted_iota(jnp.int32, (cs.width, cs.count), 1)
-    return cs.sa + r * cs.count + p
-
-
-def relay_candidates_packed(
-    fwords: jax.Array,
-    *,
-    vperm_masks: jax.Array,
-    vperm_size: int,
-    out_classes,
-    net_masks: jax.Array,
-    net_size: int,
-    m2: int,
-    in_classes,
-    valid_words: jax.Array,
-) -> jax.Array:
-    """:func:`relay_candidates` from ALREADY-PACKED frontier words
-    (uint32[vperm_size/32]).  The sharded engine feeds the bit-packed
-    frontier all-gather here directly — the per-shard vperm network's routed
-    permutation absorbs the gathered block layout, so no unpack/repack sits
-    between the ICI exchange and the butterflies."""
-    fout = unpack_bits(
-        apply_benes(fwords, vperm_masks, vperm_size), vperm_size
-    )
-
+    """Vperm-output words (out-position space, standard packing) -> L2 slot
+    words.  Rank-major classes replicate whole words (slot = sa + r*count + p:
+    each rank's 32-slot word IS the class's position-bit word); the few
+    vertex-major classes fill width/32 words per position bit."""
     parts = []
     for cs in out_classes:
-        blk = fout[cs.va : cs.vb]
-        if cs.vertex_major:  # slot = p*w + r -> view [Nc, w]
-            parts.append(
-                jnp.broadcast_to(blk[:, None], (cs.count, cs.width)).reshape(-1)
-            )
-        else:  # slot = r*Nc + p -> view [w, Nc]
-            parts.append(
-                jnp.broadcast_to(blk[None, :], (cs.width, cs.count)).reshape(-1)
-            )
-    parts.append(jnp.zeros(net_size - m2, dtype=jnp.uint8))
-    l2 = jnp.concatenate(parts)
+        if not cs.vertex_major:
+            cw = cs.count // 32
+            blk = jax.lax.slice_in_dim(ywords, cs.va // 32, cs.va // 32 + cw)
+            parts.append(jnp.tile(blk, cs.width))
+        else:
+            # arbitrary (possibly unaligned) va: extract the few bits
+            pos = cs.va + jnp.arange(cs.count)
+            bits = (ywords[pos >> 5] >> (pos & 31).astype(jnp.uint32)) & 1
+            fill = (jnp.uint32(0) - bits).astype(jnp.uint32)  # 0 or ~0
+            parts.append(jnp.repeat(fill, cs.width // 32))
+    used = sum(int(p.shape[0]) for p in parts)
+    parts.append(jnp.zeros(net_size // 32 - used, jnp.uint32))
+    return jnp.concatenate(parts)
 
-    l1words = apply_benes(pack_bits(l2, net_size), net_masks, net_size)
-    l1bits = unpack_bits(l1words & valid_words, net_size)
 
+def _ctz32(word: jax.Array) -> jax.Array:
+    """Count trailing zeros of nonzero uint32 words."""
+    low = word & (jnp.uint32(0) - word)
+    return jax.lax.population_count(low - 1).astype(jnp.int32)
+
+
+def rowmin_candidates(
+    l1words: jax.Array, valid_words: jax.Array, in_classes, vr: int
+) -> jax.Array:
+    """Min active L1 slot per relabeled vertex: int32[vr], INT32_MAX where
+    none.  Slots within a dst row ascend by ORIGINAL src id (graph/relay.py
+    sort order), so min active slot == canonical min-parent."""
+    lw = l1words & valid_words
     cands = []
-    for cs in in_classes:
-        seg = l1bits[cs.sa : cs.sb]
-        if cs.vertex_major:
-            bits = seg.reshape(cs.count, cs.width)
-            cands.append(
-                jnp.min(jnp.where(bits != 0, _class_slot_iota(cs), INT32_MAX), axis=1)
+    covered = 0
+    for cs in sorted(in_classes, key=lambda c: c.va):
+        assert cs.va == covered, "in_classes must tile the vertex space"
+        if not cs.vertex_major:
+            cw = cs.count // 32
+            wv = jax.lax.slice_in_dim(
+                lw, cs.sa // 32, cs.sa // 32 + cs.width * cw
+            ).reshape(cs.width, cw)
+            bits = unpack_std(wv, cs.count).astype(bool)
+            r = jnp.arange(cs.width, dtype=jnp.int32)[:, None]
+            minr = jnp.min(
+                jnp.where(bits, r, INT32_MAX), axis=0
+            )
+            p = jnp.arange(cs.count, dtype=jnp.int32)
+            cand = jnp.where(
+                minr != INT32_MAX, cs.sa + minr * cs.count + p, INT32_MAX
             )
         else:
-            bits = seg.reshape(cs.width, cs.count)
-            cands.append(
-                jnp.min(jnp.where(bits != 0, _class_slot_iota(cs), INT32_MAX), axis=0)
+            ww = cs.width // 32
+            wv = jax.lax.slice_in_dim(
+                lw, cs.sa // 32, cs.sa // 32 + cs.count * ww
+            ).reshape(cs.count, ww)
+            nz = wv != 0
+            widx = jnp.min(
+                jnp.where(nz, jnp.arange(ww, dtype=jnp.int32)[None, :], ww),
+                axis=1,
             )
+            word = jnp.take_along_axis(
+                wv, jnp.clip(widx, 0, ww - 1)[:, None], axis=1
+            )[:, 0]
+            r = widx * 32 + _ctz32(jnp.maximum(word, 1))
+            p = jnp.arange(cs.count, dtype=jnp.int32)
+            cand = jnp.where(
+                widx < ww, cs.sa + p * cs.width + r, INT32_MAX
+            )
+        cands.append(cand)
+        covered = cs.vb
+    if covered < vr:
+        cands.append(jnp.full(vr - covered, INT32_MAX, jnp.int32))
     return jnp.concatenate(cands)
 
 
-def relay_superstep(state: BfsState, cand_fn) -> BfsState:
-    """One superstep given ``cand_fn(frontier) -> int32[V]`` candidates.
+def apply_relay_candidates(state: RelayState, cand: jax.Array) -> RelayState:
+    """Merge per-vertex candidate slots into the carry (the reducer's
+    min-merge applied to state, BfsSpark.java:90-108)."""
+    newly = (cand != INT32_MAX) & (state.dist == INT32_MAX)
+    new_level = state.level + 1
+    dist = jnp.where(newly, new_level, state.dist)
+    parent = jnp.where(newly, cand, state.parent)
+    fwords = pack_std(newly)
+    return RelayState(dist, parent, fwords, new_level, newly.any())
 
-    NOTE: ``state`` lives in the RELABELED vertex space; ``cand`` VALUES are
-    L1 slot indices (min active slot == canonical min-parent, see
-    :func:`relay_candidates`), which the loop never indexes with — engine
-    wrappers map slot -> original src id at the end (models/bfs.py
-    ``slots_to_parent``).
-    """
-    cand = cand_fn(state.frontier)
-    if cand.shape[-1] != state.dist.shape[-1]:
-        # [V+1] sentinel-carrying state (stepped runner) pads the inert slot;
-        # the fused engines run exact [V] shapes and skip this copy.
-        cand = jnp.concatenate([cand, jnp.full((1,), INT32_MAX, jnp.int32)])
-    return apply_candidates(state, cand)
+
+def relay_superstep_words(
+    state: RelayState,
+    *,
+    vperm_masks: jax.Array,
+    vperm_table: tuple[StageSpec, ...],
+    vperm_size: int,
+    out_classes,
+    out_space: int,
+    net_masks: jax.Array,
+    net_table: tuple[StageSpec, ...],
+    net_size: int,
+    in_classes,
+    valid_words: jax.Array,
+    vr: int,
+) -> RelayState:
+    """One full relay superstep, XLA reference path."""
+    fw = jnp.concatenate(
+        [state.fwords, jnp.zeros((vperm_size - vr) // 32, jnp.uint32)]
+    )
+    y = apply_benes_std(fw, vperm_masks, vperm_table, vperm_size)
+    l2 = broadcast_l2(y, out_classes, net_size, out_space)
+    l1 = apply_benes_std(l2, net_masks, net_table, net_size)
+    cand = rowmin_candidates(l1, valid_words, in_classes, vr)
+    return apply_relay_candidates(state, cand)

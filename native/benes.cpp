@@ -158,9 +158,290 @@ void transpose_stage(const uint32_t* in, uint32_t* out, int64_t n) {
   }
 }
 
+// ---------------------------------------------------------------------------
+// v2 router: iterative, int32, preallocated workspace, word-major output.
+//
+// The recursive int64 Router above costs ~27 min at n=2^28 on the 1-core
+// build VM (measured round 2: per-level std::vector churn + int64 memory
+// traffic + a final bit-major transpose).  This version routes the same
+// networks in a level sweep with two ping-pong int32 buffers, no per-block
+// allocation, and emits word-major masks directly — word-major IS the
+// layout-v4 "standard packing" the device kernels consume, so the transpose
+// pass disappears entirely.
+// The constraint graph per block: nodes = outputs; edges = "colors differ"
+// between (a) output pairs (j, j+h) and (b) consumers of paired inputs.
+// Nodes have degree 2, so constraints form even cycles; a valid 2-coloring
+// alternates around each cycle.  The classic walk (Router::route above) is a
+// strictly serial pointer chase — ~100 ns/step of dependent cache misses on
+// blocks larger than LLC, which made routing the 2^28-slot net cost ~27 min
+// on the 1-core build VM.  Here WALKERS independent walks are interleaved in
+// one thread so the out-of-order core overlaps their cache misses (~6x
+// measured).  Each walker colors a contiguous arc of some cycle and tags
+// every node with its segment id (c_[x] = seg<<1 | color); wherever a walker
+// meets already-colored territory it records a parity constraint between the
+// two segments instead of stopping the world.  A tiny union-find with parity
+// then decides which segments flip, and one sequential pass applies flips.
+struct RouterV2 {
+  static constexpr int kWalkers = 16;
+  struct Con {
+    int32_t a, b;
+    int8_t rel;  // flip[a] ^ flip[b] must equal rel
+  };
+
+  int64_t n;
+  int32_t k;
+  uint32_t* masks;
+  int64_t words_per_stage;
+  int32_t* a;    // current level's block-local perms
+  int32_t* b;    // next level's perms
+  int32_t* inv;  // scratch
+  int32_t* cw;   // scratch: seg<<1 | color, -1 = uncolored
+  std::vector<Con> cons;
+  std::vector<int32_t> uf;
+  std::vector<int8_t> ufp, segflip;
+
+  inline void set_bit(int32_t stage, int64_t pos) {
+    masks[stage * words_per_stage + (pos >> 5)] |=
+        (uint32_t{1} << (pos & 31));
+  }
+
+  // union-find with parity: parity(x) = xor of ufp along x's root path
+  int32_t find(int32_t x, int8_t& par) {
+    int8_t p = 0;
+    int32_t r = x;
+    while (uf[r] != r) {
+      p ^= ufp[r];
+      r = uf[r];
+    }
+    int32_t c2 = x;
+    int8_t pc = 0;
+    while (uf[c2] != r) {
+      const int32_t nx = uf[c2];
+      const int8_t np = ufp[c2];
+      uf[c2] = r;
+      ufp[c2] = static_cast<int8_t>(p ^ pc);
+      pc ^= np;
+      c2 = nx;
+    }
+    par = p;
+    return r;
+  }
+
+  // Interleaved-walker 2-coloring of one block; colors land in cw[0..m).
+  void color_block_walkers(const int32_t* p, const int32_t* iv, int32_t* c_,
+                           int64_t m) {
+    const int64_t h = m / 2;
+    int32_t nseg = 0;
+    cons.clear();
+    int64_t cursor = 0;
+    struct WS {
+      int64_t j;
+      int32_t seg;
+      int8_t c;
+      bool live;
+    };
+    WS ws[kWalkers];
+    int live = 0;
+    for (auto& w : ws) w.live = false;
+    for (;;) {
+      for (auto& s : ws) {
+        if (s.live) continue;
+        while (cursor < m && c_[cursor] != -1) ++cursor;
+        if (cursor >= m) continue;
+        const int32_t seg = nseg++;
+        c_[cursor] = seg << 1;  // color 0
+        // The walk leaves the seed across its pair edge; the seed's OTHER
+        // constraint edge (consumer-pair companion x) would go unexamined if
+        // x's segment also walks away — record it now when x is colored.
+        {
+          const int64_t i = p[cursor];
+          const int64_t ip = (i < h) ? i + h : i - h;
+          const int64_t x = iv[ip];
+          const int32_t vx = c_[x];
+          if (vx != -1)  // required: color[x] = 1
+            cons.push_back({seg, vx >> 1, static_cast<int8_t>(1 ^ (vx & 1))});
+        }
+        s = {cursor, seg, 0, true};
+        ++cursor;
+        ++live;
+      }
+      if (!live) break;
+      for (auto& s : ws) {
+        if (!s.live) continue;
+        const int64_t j = s.j;  // invariant: colored by this walker, color s.c
+        const int64_t jp = (j < h) ? j + h : j - h;
+        const int32_t vjp = c_[jp];
+        if (vjp != -1) {  // pair edge into foreign arc: jp must be 1-c
+          cons.push_back(
+              {s.seg, vjp >> 1,
+               static_cast<int8_t>(((vjp & 1) == s.c) ? 1 : 0)});
+          s.live = false;
+          --live;
+          continue;
+        }
+        c_[jp] = (s.seg << 1) | (1 - s.c);
+        const int64_t i = p[jp];
+        const int64_t ip = (i < h) ? i + h : i - h;
+        const int64_t nj = iv[ip];
+        const int32_t vnj = c_[nj];
+        if (vnj != -1) {  // consumer edge into foreign arc: nj must be c
+          cons.push_back(
+              {s.seg, vnj >> 1,
+               static_cast<int8_t>(((vnj & 1) != s.c) ? 1 : 0)});
+          s.live = false;
+          --live;
+        } else {
+          c_[nj] = (s.seg << 1) | s.c;
+          s.j = nj;
+        }
+      }
+    }
+    // Resolve segment flips.  Every recorded constraint is implied by any
+    // valid alternating coloring, so the system is consistent; union-find
+    // with parity yields one satisfying assignment.
+    uf.resize(static_cast<size_t>(nseg));
+    ufp.assign(static_cast<size_t>(nseg), 0);
+    for (int32_t i2 = 0; i2 < nseg; ++i2) uf[i2] = i2;
+    for (const Con& c2 : cons) {
+      int8_t pa, pb;
+      const int32_t ra = find(c2.a, pa), rb = find(c2.b, pb);
+      if (ra == rb) continue;
+      uf[ra] = rb;
+      ufp[ra] = static_cast<int8_t>(pa ^ pb ^ c2.rel);
+    }
+    segflip.assign(static_cast<size_t>(nseg), 0);
+    for (int32_t s0 = 0; s0 < nseg; ++s0) {
+      int8_t par;
+      find(s0, par);
+      segflip[s0] = par;
+    }
+    for (int64_t j = 0; j < m; ++j) c_[j] ^= segflip[c_[j] >> 1];
+  }
+
+  void run() {
+    //: blocks below this size are cache-resident; the serial walk is faster
+    // there than walker bookkeeping.
+    constexpr int64_t kWalkerMin = int64_t{1} << 20;
+    for (int32_t level = 0; level < k; ++level) {
+      const int64_t m = n >> level;
+      const int64_t nblocks = int64_t{1} << level;
+      if (m == 2) {  // final middle stage: swap iff output 0 takes input 1
+        for (int64_t blk = 0; blk < nblocks; ++blk) {
+          if (a[blk * 2] == 1) set_bit(level, blk * 2);
+        }
+        break;
+      }
+      const int64_t h = m / 2;
+      const int32_t in_stage = level;
+      const int32_t out_stage = 2 * k - 2 - level;
+      std::memset(cw, -1, static_cast<size_t>(n) * 4);
+      for (int64_t blk = 0; blk < nblocks; ++blk) {
+        const int64_t base = blk * m;
+        const int32_t* p = a + base;
+        int32_t* iv = inv + base;
+        int32_t* c_ = cw + base;
+        int32_t* up = b + base;
+        int32_t* lo = b + base + h;
+        for (int64_t j = 0; j < m; ++j) iv[p[j]] = static_cast<int32_t>(j);
+        if (m >= kWalkerMin) {
+          color_block_walkers(p, iv, c_, m);
+        } else {
+          // serial walk (colors only; cw low bit)
+          for (int64_t seed = 0; seed < m; ++seed) {
+            if (c_[seed] != -1) continue;
+            int64_t j = seed;
+            int32_t c = 0;
+            while (c_[j] == -1) {
+              c_[j] = c;
+              const int64_t jp = (j < h) ? j + h : j - h;
+              if (c_[jp] != -1) break;
+              c_[jp] = 1 - c;
+              const int64_t i = p[jp];
+              const int64_t ip = (i < h) ? i + h : i - h;
+              j = iv[ip];
+            }
+          }
+        }
+        // Switch bits + sub-perms in one pass.  In-stage switches read
+        // iv[q]/cl sequentially+independently (overlappable misses) and
+        // accumulate mask words in registers — much faster than the random
+        // read-modify-write set_bit pattern for blocks >= 32.
+        if ((h & 31) == 0) {
+          uint32_t* inw = masks + static_cast<int64_t>(in_stage) * words_per_stage;
+          uint32_t* outw =
+              masks + static_cast<int64_t>(out_stage) * words_per_stage;
+          for (int64_t q0 = 0; q0 < h; q0 += 32) {
+            uint32_t win = 0, wout = 0;
+            for (int64_t q = q0; q < q0 + 32; ++q) {
+              if (c_[iv[q]] & 1) win |= uint32_t{1} << (q - q0);
+              const int32_t cq = c_[q] & 1;
+              if (cq) wout |= uint32_t{1} << (q - q0);
+              const int64_t j_up = cq == 0 ? q : q + h;
+              const int64_t j_lo = cq == 0 ? q + h : q;
+              const int32_t pu = p[j_up];
+              const int32_t pl = p[j_lo];
+              up[q] = pu >= h ? pu - static_cast<int32_t>(h) : pu;
+              lo[q] = pl >= h ? pl - static_cast<int32_t>(h) : pl;
+            }
+            if (win) inw[(base + q0) >> 5] |= win;
+            if (wout) outw[(base + q0) >> 5] |= wout;
+          }
+        } else {  // h < 32: bit-at-a-time
+          for (int64_t q = 0; q < h; ++q) {
+            if (c_[iv[q]] & 1) set_bit(in_stage, base + q);
+            const int32_t cq = c_[q] & 1;
+            if (cq) set_bit(out_stage, base + q);
+            const int64_t j_up = cq == 0 ? q : q + h;
+            const int64_t j_lo = cq == 0 ? q + h : q;
+            const int32_t pu = p[j_up];
+            const int32_t pl = p[j_lo];
+            up[q] = pu >= h ? pu - static_cast<int32_t>(h) : pu;
+            lo[q] = pl >= h ? pl - static_cast<int32_t>(h) : pl;
+          }
+        }
+      }
+      std::swap(a, b);
+    }
+  }
+};
+
 }  // namespace
 
 extern "C" {
+
+// v2 entry point: int32 perm, word-major masks ("standard packing": mask
+// element e at word e>>5, bit e&31 — what bfs_tpu/ops/relay.py layout v4
+// consumes).  masks_out: uint32[(2k-1) * (n/32)] zero-initialised by the
+// caller.  Returns 0 on success, -1 on invalid input.
+int32_t benes_route_i32(int64_t n, const int32_t* perm, uint32_t* masks_out) {
+  if (n < 32 || (n & (n - 1)) != 0 || n > (int64_t{1} << 30)) return -1;
+  int32_t k = 0;
+  while ((int64_t{1} << k) < n) ++k;
+  {
+    std::vector<uint64_t> seen(static_cast<size_t>(n / 64 + 1), 0);
+    for (int64_t j = 0; j < n; ++j) {
+      const int64_t p = perm[j];
+      if (p < 0 || p >= n) return -1;
+      uint64_t& w = seen[static_cast<size_t>(p >> 6)];
+      const uint64_t bit = uint64_t{1} << (p & 63);
+      if (w & bit) return -1;
+      w |= bit;
+    }
+  }
+  std::vector<int32_t> a(perm, perm + n), b(static_cast<size_t>(n)),
+      inv(static_cast<size_t>(n)), cw(static_cast<size_t>(n));
+  RouterV2 r;
+  r.n = n;
+  r.k = k;
+  r.masks = masks_out;
+  r.words_per_stage = n / 32;
+  r.a = a.data();
+  r.b = b.data();
+  r.inv = inv.data();
+  r.cw = cw.data();
+  r.run();
+  return 0;
+}
 
 // perm: int64[n] with perm[j] = source index for output j (a bijection).
 // masks_out: uint32[(2k-1) * (n/32)] zero-initialised by the caller.

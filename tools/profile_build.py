@@ -1,0 +1,22 @@
+"""Profile the relay layout build at s22 to find host-side hot spots."""
+import numpy as np, time, sys
+sys.path.insert(0, "/root/repo")
+import cProfile, pstats
+
+from bfs_tpu.graph.csr import Graph
+from bfs_tpu.graph.native_gen import rmat_edges_native
+
+t0=time.time()
+u, v = rmat_edges_native(22, 6, seed=42)
+g = Graph(1<<22, np.concatenate([u,v]), np.concatenate([v,u]))
+print("gen", time.time()-t0, flush=True)
+
+from bfs_tpu.graph import relay
+t0=time.time()
+pr = cProfile.Profile()
+pr.enable()
+rg = relay.build_relay_graph(g)
+pr.disable()
+print("build s22 total", time.time()-t0, flush=True)
+st = pstats.Stats(pr)
+st.sort_stats("cumulative").print_stats(25)
