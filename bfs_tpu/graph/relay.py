@@ -33,8 +33,11 @@ v4 changes vs the round-2 layout (LAYOUT_VERSION 3):
     zero; they are dropped from storage, cutting streamed mask bytes ~29%
     (tools/mask_sparsity.py measurement round 3).
   * **Identity tail**: pad slots beyond max(m1, m2) are wired
-    input==output, which the router colors switch-free; each stage stores
-    its nonzero word range so kernels skip the dead tail entirely.
+    input==output and each stage stores its nonzero word range so kernels
+    can skip dead blocks.  NOTE: pads route switch-free only where BOTH
+    members of a top-stage pair are pads (live <= n/2); at the bench's
+    m1 ~ 0.94n the ranges rarely shrink — the real mask-byte win is the
+    pair compaction above.
 """
 
 from __future__ import annotations
@@ -97,6 +100,7 @@ class ClassSlice:
     sb: int
     real: int
     vertex_major: bool = False
+    real_width: int = -1  # pre-padding width (== width for rank-major)
 
     @property
     def count(self) -> int:
@@ -153,7 +157,7 @@ def _build_classes(widths: np.ndarray, counts: np.ndarray) -> list[ClassSlice]:
         cp = _round32(c)
         slices.append(
             ClassSlice(width=w, va=va, vb=va + cp, sa=sa, sb=sa + w * cp,
-                       real=c, vertex_major=False)
+                       real=c, vertex_major=False, real_width=w)
         )
         va += cp
         sa += w * cp
@@ -161,7 +165,7 @@ def _build_classes(widths: np.ndarray, counts: np.ndarray) -> list[ClassSlice]:
         wp = _round32(w)
         slices.append(
             ClassSlice(width=wp, va=va, vb=va + c, sa=sa, sb=sa + wp * c,
-                       real=c, vertex_major=True)
+                       real=c, vertex_major=True, real_width=w)
         )
         va += c
         sa += wp * c
@@ -274,8 +278,14 @@ class RelayGraph:
     # dst side
     in_classes: tuple[ClassSlice, ...]  # over relabeled vertex space
     src_l1: np.ndarray  # int32[m1] — ORIGINAL src id per L1 slot, INF padding
-    # sparse-path adjacency (relabeled CSR with per-edge L1 slot), built lazily
-    # by engines that want the hybrid small-frontier path.
+    # sparse-path adjacency: CSR over RELABELED src ids with, per out-edge,
+    # the relabeled dst and that edge's L1 slot.  The hybrid engine gathers
+    # these for small frontiers instead of paying the full-net superstep
+    # (supersteps 0 and the >=3 tail carry <2% of the edges at scale 24 —
+    # tools/measure_r3.py level profile).
+    adj_indptr: np.ndarray  # int32[vr + 2] (last entry repeated)
+    adj_dst: np.ndarray  # int32[E]
+    adj_slot: np.ndarray  # int32[E]
 
 
 def build_relay_graph(graph: Graph | DeviceGraph) -> RelayGraph:
@@ -315,16 +325,14 @@ def build_relay_graph(graph: Graph | DeviceGraph) -> RelayGraph:
     new2old = np.full(vr, -1, dtype=np.int64)
     old2new = np.empty(v, dtype=np.int64)
     order = np.argsort(in_w, kind="stable")  # stable: old-id-minor
-    width_of_class = {}
-    for cs in in_classes:
-        width_of_class[(cs.width if not cs.vertex_major else None, cs.va)] = cs
-    # assign per class in ascending width order (order is sorted by width)
+    in_map = _width_class_map(in_classes, widths)
     pos = 0
-    for cs in sorted(in_classes, key=lambda c: c.va):
-        ids = order[pos : pos + cs.real]
-        new2old[cs.va : cs.va + cs.real] = ids
-        old2new[ids] = cs.va + np.arange(cs.real)
-        pos += cs.real
+    for wv, cnt in zip(widths.tolist(), counts.tolist()):
+        cs = in_map[int(wv)]
+        ids = order[pos : pos + cnt]
+        new2old[cs.va : cs.va + cnt] = ids
+        old2new[ids] = cs.va + np.arange(cnt)
+        pos += cnt
     assert pos == v
 
     # ---- src side: aligned classes over out-order positions ---------------
@@ -335,11 +343,13 @@ def build_relay_graph(graph: Graph | DeviceGraph) -> RelayGraph:
 
     outpos_of_old = np.empty(v, dtype=np.int64)
     oorder = np.argsort(out_w, kind="stable")
+    out_map = _width_class_map(out_classes, owidths)
     pos = 0
-    for cs in sorted(out_classes, key=lambda c: c.va):
-        ids = oorder[pos : pos + cs.real]
-        outpos_of_old[ids] = cs.va + np.arange(cs.real)
-        pos += cs.real
+    for wv, cnt in zip(owidths.tolist(), ocounts.tolist()):
+        cs = out_map[int(wv)]
+        ids = oorder[pos : pos + cnt]
+        outpos_of_old[ids] = cs.va + np.arange(cnt)
+        pos += cnt
     assert pos == v
 
     # ---- L1 slots: edges sorted by (dst_new, src); rank = in-row position --
@@ -381,15 +391,11 @@ def build_relay_graph(graph: Graph | DeviceGraph) -> RelayGraph:
     vp = _pow2_at_least(max(vr + dummies, out_vb, 32 * 128 * 2))
     vperm = np.full(vp, -1, dtype=np.int64)
     real_mask = np.zeros(out_vb, dtype=bool)
-    pos = 0
-    for cs in sorted(out_classes, key=lambda c: c.va):
-        real_mask[cs.va : cs.va + cs.real] = True
-        pos += cs.real
-    # real out positions <- relabeled id of their vertex
-    out_real_positions = np.flatnonzero(real_mask)
-    vperm[out_real_positions] = old2new[
-        _out_position_owner(out_classes, oorder)
-    ]
+    for wv, cnt in zip(owidths.tolist(), ocounts.tolist()):
+        cs = out_map[int(wv)]
+        real_mask[cs.va : cs.va + cnt] = True
+    # real out positions <- relabeled id of their owning vertex
+    vperm[outpos_of_old] = old2new[np.arange(v)]
     dummy_positions = np.flatnonzero(~real_mask)
     vperm[dummy_positions] = vr + np.arange(dummy_positions.shape[0])
     used = np.zeros(vp, dtype=bool)
@@ -398,6 +404,15 @@ def build_relay_graph(graph: Graph | DeviceGraph) -> RelayGraph:
     vperm_masks_full = benes.route_std(vperm)
     vperm_masks, vperm_table = _compact_and_table(vperm_masks_full, vp)
     del vperm_masks_full
+
+    # ---- sparse-path CSR over relabeled src ids ----------------------------
+    srcn = old2new[src]
+    order3, _ = _sort_rank(srcn.astype(np.int32), dstn.astype(np.int32))
+    adj_indptr = np.zeros(vr + 2, dtype=np.int64)
+    np.cumsum(np.bincount(srcn, minlength=vr), out=adj_indptr[1 : vr + 1])
+    adj_indptr[vr + 1] = adj_indptr[vr]
+    adj_dst = dstn[order3].astype(np.int32)
+    adj_slot = l1_by_edge[order3].astype(np.int32)
 
     return RelayGraph(
         num_vertices=v,
@@ -417,25 +432,270 @@ def build_relay_graph(graph: Graph | DeviceGraph) -> RelayGraph:
         m2=m2,
         in_classes=tuple(in_classes),
         src_l1=src_l1,
+        adj_indptr=adj_indptr.astype(np.int32),
+        adj_dst=adj_dst,
+        adj_slot=adj_slot,
     )
 
 
-def _out_position_owner(out_classes, oorder: np.ndarray) -> np.ndarray:
-    """Original vertex id owning each REAL out position, in ascending
-    position order (dummies excluded)."""
-    parts = []
-    pos = 0
-    for cs in sorted(out_classes, key=lambda c: c.va):
-        parts.append(oorder[pos : pos + cs.real])
-        pos += cs.real
-    return np.concatenate(parts) if parts else np.zeros(0, np.int64)
+@dataclass(frozen=True)
+class ShardedRelayGraph:
+    """Per-shard relay layouts (v4) with ONE unified class structure.
+
+    The multi-device TPU-fast layout: shard ``s`` owns a contiguous block of
+    the (globally relabeled) vertex space and holds the relay pipeline for
+    exactly its owned destinations — its own vperm network, degree-class
+    broadcast, Beneš edge net and src-id tables — while all shards share the
+    SAME static shapes (class slices, network sizes, stage tables), so one
+    `shard_map` program runs everywhere and only the mask/table DATA differs
+    per device (stacked on axis 0).  The per-superstep exchange is the
+    bit-packed frontier all-gather (1 bit/vertex over ICI); with v4's
+    standard packing the gathered words ARE the global standard-packed
+    frontier (relabeling is shard-major), so they feed each shard's vperm
+    directly with no repacking at all.
+    """
+
+    num_vertices: int
+    num_edges: int
+    num_shards: int
+    block: int  # owned vertex slots per shard (multiple of 32)
+    new2old: np.ndarray  # int32[n*block]; -1 at dummies
+    old2new: np.ndarray  # int32[V]
+    vperm_masks: np.ndarray  # uint32[n, vperm_words]
+    vperm_table: tuple[StageSpec, ...]
+    vperm_size: int
+    out_classes: tuple[ClassSlice, ...]
+    out_space: int
+    net_masks: np.ndarray  # uint32[n, net_words]
+    net_table: tuple[StageSpec, ...]
+    net_size: int
+    m1: int
+    m2: int
+    in_classes: tuple[ClassSlice, ...]  # over local [0, block)
+    src_l1: np.ndarray  # int32[n, m1]; ORIGINAL src ids, INF padding
+
+
+def _merge_tables(tables: list[tuple[StageSpec, ...]]) -> tuple[StageSpec, ...]:
+    """Shared static stage table for stacked per-shard masks: identical
+    layout (same net size -> same offsets), per-stage nonzero range = union
+    over shards."""
+    out = []
+    for specs in zip(*tables):
+        st = specs[0]
+        out.append(
+            st._replace(
+                lo=min(s.lo for s in specs), hi=max(s.hi for s in specs)
+            )
+        )
+    return tuple(out)
+
+
+def _unified_classes(widths: np.ndarray, per_shard_counts: np.ndarray):
+    """Aligned classes from per-width counts maxed over shards.
+    ``per_shard_counts``: [num_widths, n]."""
+    return _build_classes(widths, per_shard_counts.max(axis=1))
+
+
+def build_sharded_relay_graph(
+    graph: Graph | DeviceGraph, num_shards: int
+) -> ShardedRelayGraph:
+    """Build per-shard relay layouts (v4) with a unified static structure.
+
+    Vertices are partitioned into ``num_shards`` contiguous original-id
+    ranges (the sharded pull engine's ownership rule), then relabeled within
+    each shard so in-degree classes are contiguous; the global new-id space
+    is the concatenation of shard blocks.
+    """
+    if not benes.native_available():
+        raise RuntimeError("relay engine requires the native benes router")
+    if num_shards < 1:
+        raise ValueError("num_shards must be >= 1")
+    from .csr import _sorted_by_dst, unpad_edges
+
+    if isinstance(graph, DeviceGraph):
+        src, dst = _sorted_by_dst(*unpad_edges(graph))
+    else:
+        src, dst = _sorted_by_dst(graph.src, graph.dst)
+    src = src.astype(np.int64)
+    dst = dst.astype(np.int64)
+    v = graph.num_vertices
+    e = int(src.shape[0])
+    n = num_shards
+    vblock = max((v + n - 1) // n, 1)
+    shard_of_old = np.minimum(np.arange(v, dtype=np.int64) // vblock, n - 1)
+
+    indeg = np.bincount(dst, minlength=v)
+    in_w = _class_width(indeg)
+
+    # ---- unified in-classes: per-width counts maxed over shards ------------
+    widths_all = np.unique(in_w)
+    counts = np.stack(
+        [
+            np.bincount(
+                np.searchsorted(widths_all, in_w[shard_of_old == s]),
+                minlength=widths_all.shape[0],
+            )
+            for s in range(n)
+        ],
+        axis=1,
+    )
+    in_classes = _unified_classes(widths_all, counts)
+    block = _round32(in_classes[-1].vb)
+    m1 = in_classes[-1].sb
+
+    # ---- relabel: shard-major, class-major, old-id-minor -------------------
+    new2old = np.full(n * block, -1, dtype=np.int64)
+    old2new = np.empty(v, dtype=np.int64)
+    cls_by_width = {}
+    for cs in in_classes:
+        cls_by_width.setdefault(cs.width, []).append(cs)
+    # map each vertex width -> its class (vertex-major classes have padded
+    # width; recover via ascending-width assignment like _build_classes)
+    width_to_class = _width_class_map(in_classes, widths_all)
+    for s in range(n):
+        own = np.flatnonzero(shard_of_old == s)
+        w_own = in_w[own]
+        order = np.argsort(w_own, kind="stable")
+        pos = 0
+        for wv in np.unique(w_own):
+            cs = width_to_class[int(wv)]
+            cnt = int(np.count_nonzero(w_own == wv))
+            ids = own[order[pos : pos + cnt]]
+            newids = s * block + cs.va + np.arange(cnt)
+            new2old[newids] = ids
+            old2new[ids] = newids
+            pos += cnt
+
+    # ---- edge shard slices (dst-sorted, contiguous original ownership) -----
+    bounds = np.searchsorted(dst, np.arange(n + 1, dtype=np.int64) * vblock)
+    bounds[-1] = e
+
+    # ---- unified out-classes over per-shard out-degrees --------------------
+    out_sparse = []
+    owidth_counts: dict[int, int] = {}
+    for s in range(n):
+        es, ee = bounds[s], bounds[s + 1]
+        uids, ucounts = np.unique(src[es:ee], return_counts=True)
+        w = _class_width(ucounts)
+        out_sparse.append((uids, w))
+        for wv, c in zip(*np.unique(w, return_counts=True)):
+            owidth_counts[int(wv)] = max(owidth_counts.get(int(wv), 0), int(c))
+    owidths = np.array(sorted(owidth_counts), dtype=np.int64)
+    ocounts = np.array([owidth_counts[int(w)] for w in owidths], dtype=np.int64)
+    out_classes = _build_classes(owidths, ocounts)
+    out_vb = out_classes[-1].vb
+    m2 = out_classes[-1].sb
+    out_width_to_class = _width_class_map(out_classes, owidths)
+
+    # ---- network sizes (shared across shards) ------------------------------
+    net_size = _pow2_at_least(max(m1, m2))
+    gtot = n * block
+    max_dummies = max(
+        int(out_vb - u.shape[0]) for u, _ in out_sparse
+    )
+    vp = _pow2_at_least(max(gtot + max_dummies, out_vb, 32 * 128 * 2))
+
+    base1, stride1 = _vertex_tables(in_classes, block)
+    base2, stride2 = _vertex_tables(out_classes, out_vb)
+
+    vperm_masks_l, vperm_tables = [], []
+    net_masks_l, net_tables = [], []
+    src_l1 = np.full((n, m1), INF_DIST, dtype=np.int32)
+
+    for s in range(n):
+        uids_s, uw_s = out_sparse[s]
+        # out positions for this shard's sources (ascending ORIGINAL id
+        # within each width class)
+        outpos_of_old = np.full(v, -1, dtype=np.int64)
+        oorder = np.argsort(uw_s, kind="stable")
+        vperm = np.full(vp, -1, dtype=np.int64)
+        dummy_cursor = gtot
+        pos = 0
+        for wv in np.unique(uw_s):
+            cs = out_width_to_class[int(wv)]
+            cnt = int(np.count_nonzero(uw_s == wv))
+            ids = uids_s[oorder[pos : pos + cnt]]
+            outpos_of_old[ids] = cs.va + np.arange(cnt)
+            vperm[cs.va : cs.va + cnt] = old2new[ids]
+            ndum = cs.count - cnt
+            if ndum > 0:
+                vperm[cs.va + cnt : cs.vb] = dummy_cursor + np.arange(ndum)
+                dummy_cursor += ndum
+            pos += cnt
+        # remaining dummy positions of classes this shard has no members of
+        missing = np.flatnonzero(vperm[:out_vb] < 0)
+        vperm[missing] = dummy_cursor + np.arange(missing.shape[0])
+        used = np.zeros(vp, dtype=bool)
+        used[vperm[vperm >= 0]] = True
+        _pad_identity(vperm, used, vp)
+        vm_full = benes.route_std(vperm)
+        vm, vt = _compact_and_table(vm_full, vp)
+        del vm_full
+        vperm_masks_l.append(vm)
+        vperm_tables.append(vt)
+
+        # ---- L1/L2 slots for this shard's edges ----------------------------
+        es, ee = bounds[s], bounds[s + 1]
+        s_src, s_dst = src[es:ee], dst[es:ee]
+        dstn = old2new[s_dst] - s * block  # local [0, block)
+        o1, r1 = _sort_rank(dstn.astype(np.int32), s_src.astype(np.int32))
+        ds = dstn[o1]
+        l1_sorted = base1[ds] + r1.astype(np.int64) * stride1[ds]
+        src_l1[s, l1_sorted] = s_src[o1].astype(np.int32)
+
+        srcpos = outpos_of_old[s_src]
+        o2, r2 = _sort_rank(srcpos.astype(np.int32), dstn.astype(np.int32))
+        sp = srcpos[o2]
+        l2_sorted = base2[sp] + r2.astype(np.int64) * stride2[sp]
+
+        net = np.full(net_size, -1, dtype=np.int64)
+        l1_by_edge = np.empty(ee - es, dtype=np.int64)
+        l1_by_edge[o1] = l1_sorted
+        l2_by_edge = np.empty(ee - es, dtype=np.int64)
+        l2_by_edge[o2] = l2_sorted
+        net[l1_by_edge] = l2_by_edge
+        used = np.zeros(net_size, dtype=bool)
+        used[l2_by_edge] = True
+        _pad_identity(net, used, net_size)
+        nm_full = benes.route_std(net)
+        nm, nt = _compact_and_table(nm_full, net_size)
+        del nm_full
+        net_masks_l.append(nm)
+        net_tables.append(nt)
+
+    return ShardedRelayGraph(
+        num_vertices=v,
+        num_edges=e,
+        num_shards=n,
+        block=block,
+        new2old=new2old.astype(np.int32),
+        old2new=old2new.astype(np.int32),
+        vperm_masks=np.stack(vperm_masks_l),
+        vperm_table=_merge_tables(vperm_tables),
+        vperm_size=vp,
+        out_classes=tuple(out_classes),
+        out_space=out_vb,
+        net_masks=np.stack(net_masks_l),
+        net_table=_merge_tables(net_tables),
+        net_size=net_size,
+        m1=m1,
+        m2=m2,
+        in_classes=tuple(in_classes),
+        src_l1=src_l1,
+    )
+
+
+def _width_class_map(classes, widths: np.ndarray):
+    """Map REAL (pre-padding) width -> its ClassSlice."""
+    del widths
+    return {int(c.real_width): c for c in classes}
 
 
 def _pad_identity(perm: np.ndarray, used: np.ndarray, n: int) -> None:
     """Complete a partial mapping to a bijection, wiring free outputs to free
     inputs IDENTITY-first: output j takes input j wherever both are free.
-    Identity-wired pads route switch-free through the Beneš coloring, which
-    is what makes each stage's tail word range all-zero (StageSpec.lo/hi)."""
+    Where both members of a stage pair are pads, identity wiring routes
+    switch-free (StageSpec.lo/hi shrink); mixed live/pad pairs still switch."""
     free_out = perm < 0
     both = free_out & ~used
     idx = np.flatnonzero(both)

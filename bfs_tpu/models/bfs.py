@@ -118,132 +118,186 @@ def slots_to_parent(parent_slots: np.ndarray, src_l1: np.ndarray) -> np.ndarray:
     """Map relay-engine parent values (L1 slot indices; -1 unreached; the
     source's self-entry is fixed up by callers) to ORIGINAL src ids — the
     once-per-run host gather that replaces a per-superstep int32 table read
-    on device (ops/relay.relay_candidates)."""
+    on device (ops/relay.rowmin_candidates)."""
     slots = np.clip(parent_slots, 0, src_l1.shape[-1] - 1)
     return np.where(parent_slots >= 0, src_l1[slots], parent_slots).astype(np.int32)
 
 
-@functools.lru_cache(maxsize=16)
-def _relay_fused_program(
-    num_vertices: int,
-    vperm_size: int,
-    out_classes: tuple,
-    net_size: int,
-    m2: int,
-    in_classes: tuple,
-):
-    """Jitted relay BFS loop, cached per static layout shape so two
-    :class:`RelayEngine` instances over the same graph (or two graphs with
-    identical class structure) share one compiled ~100-stage program instead
-    of recompiling from scratch."""
-    from ..ops.relay import relay_candidates, relay_superstep
+#: Hybrid sparse-path budgets: a superstep takes the gather path when the
+#: frontier has <= SPARSE_BV vertices AND <= SPARSE_BE out-edges.  At the
+#: measured ~0.1 G/s XLA gather rate (tools/microbench_r3.py) a 64K-edge
+#: gather costs ~1 ms vs ~20 ms for a full-net superstep; the scale-24 level
+#: profile (frontier edges 277K / 97.6M / 102M / 1.8M / 13K / 90 —
+#: tools/measure_r3.py) makes supersteps 4-5 (and 0-1 for non-hub roots)
+#: sparse.
+SPARSE_BV = 32 * 1024
+SPARSE_BE = 64 * 1024
+
+
+def _relay_static(rg):
+    """Hashable static layout descriptor for program caching."""
+    return (
+        rg.vr, rg.vperm_size, rg.vperm_table, tuple(rg.out_classes),
+        rg.out_space, rg.net_table, rg.net_size, tuple(rg.in_classes),
+    )
+
+
+def _superstep_fn(static, use_pallas: bool):
+    """Dense superstep closure.  ``vperm_m``/``net_m`` are either the flat
+    mask array (XLA per-stage path) or the tuple of per-pass arrays from
+    :func:`~bfs_tpu.ops.relay_pallas.prepare_pass_masks` (fused TPU path) —
+    chosen per network by :func:`_net_uses_pallas`."""
+    (vr, vperm_size, vperm_table, out_classes, out_space, net_table,
+     net_size, in_classes) = static
+    from ..ops import relay as R
+
+    vp_pallas = use_pallas and _net_uses_pallas(vperm_size)
+    net_pallas = use_pallas and _net_uses_pallas(net_size)
+    if vp_pallas or net_pallas:
+        from ..ops import relay_pallas as RP
+
+        vp_static = RP.pass_static(vperm_table, vperm_size) if vp_pallas else None
+        net_static = RP.pass_static(net_table, net_size) if net_pallas else None
+
+    def superstep(st, vperm_m, net_m, valid_words):
+        fw = jnp.concatenate(
+            [st.fwords, jnp.zeros((vperm_size - vr) // 32, jnp.uint32)]
+        )
+        if vp_pallas:
+            y = RP.apply_benes_fused(fw, vperm_m, vp_static, vperm_size)
+        else:
+            y = R.apply_benes_std(fw, vperm_m, vperm_table, vperm_size)
+        l2 = R.broadcast_l2(y, out_classes, net_size, out_space)
+        if net_pallas:
+            l1 = RP.apply_benes_fused(l2, net_m, net_static, net_size)
+        else:
+            l1 = R.apply_benes_std(l2, net_m, net_table, net_size)
+        cand = R.rowmin_candidates(l1, valid_words, in_classes, vr)
+        return R.apply_relay_candidates(st, cand)
+
+    return superstep
+
+
+def _net_uses_pallas(n: int) -> bool:
+    from ..ops.relay_pallas import pallas_net_ok
+
+    return pallas_net_ok(n)
+
+
+def _sparse_superstep(st, adj_indptr, adj_dst, adj_slot, *, vr: int):
+    """Small-frontier superstep: gather the frontier's out-edges (budgeted
+    static shapes), min-merge per destination by (dst, slot) sort, scatter
+    the updates.  Bit-exact vs the dense path: slots ascend with original
+    src id within a dst row, so min slot == canonical min-parent."""
+    from ..ops.relay import RelayState, unpack_std
+
+    bv, be = SPARSE_BV, SPARSE_BE
+    bools = unpack_std(st.fwords, vr)
+    flist = jnp.nonzero(bools, size=bv, fill_value=vr)[0].astype(jnp.int32)
+    deg = adj_indptr[flist + 1] - adj_indptr[flist]  # 0 at the vr fill slot
+    cum = jnp.cumsum(deg)
+    starts = adj_indptr[flist]
+    j = jnp.arange(be, dtype=jnp.int32)
+    owner = jnp.searchsorted(cum, j, side="right").astype(jnp.int32)
+    owner_c = jnp.clip(owner, 0, bv - 1)
+    prev = jnp.where(owner_c > 0, cum[jnp.maximum(owner_c - 1, 0)], 0)
+    eidx = starts[owner_c] + (j - prev)
+    valid = j < cum[-1]
+    eidx = jnp.where(valid, eidx, 0)
+    dstv = adj_dst[eidx]
+    slot = adj_slot[eidx]
+    dk, sk = jax.lax.sort(
+        (jnp.where(valid, dstv, jnp.int32(vr)), slot), num_keys=2
+    )
+    first = (
+        jnp.concatenate([jnp.ones(1, bool), dk[1:] != dk[:-1]]) & (dk < vr)
+    )
+    unreached = st.dist[jnp.clip(dk, 0, vr - 1)] == INT32_MAX
+    upd = first & unreached
+    tgt = jnp.where(upd, dk, jnp.int32(vr))  # vr = out of bounds -> dropped
+    new_level = st.level + 1
+    dist = st.dist.at[tgt].set(new_level, mode="drop")
+    parent = st.parent.at[tgt].set(sk, mode="drop")
+    fwords = (
+        jnp.zeros_like(st.fwords)
+        .at[tgt >> 5]
+        .add(jnp.uint32(1) << (tgt & 31).astype(jnp.uint32), mode="drop")
+    )
+    return RelayState(dist, parent, fwords, new_level, upd.any())
+
+
+@functools.lru_cache(maxsize=8)
+def _relay_fused_program(static, sparse: bool, use_pallas: bool):
+    """Jitted relay BFS loop (v4), cached per static layout shape.
+
+    With ``sparse``, every superstep computes the frontier's vertex and
+    out-edge counts (cheap word ops) and a ``lax.cond`` picks the gather
+    path under the budgets — the TPU analogue of direction-optimizing BFS's
+    top-down phase for small frontiers."""
+    (vr, *_rest) = static
+    from ..ops import relay as R
+
+    superstep = _superstep_fn(static, use_pallas)
 
     @functools.partial(jax.jit, static_argnames=("max_levels",))
-    def fused(source_new, vperm_masks, net_masks, valid_words, max_levels):
-        def cand_fn(frontier):
-            return relay_candidates(
-                frontier,
-                num_vertices=num_vertices,
-                vperm_masks=vperm_masks,
-                vperm_size=vperm_size,
-                out_classes=out_classes,
-                net_masks=net_masks,
-                net_size=net_size,
-                m2=m2,
-                in_classes=in_classes,
-                valid_words=valid_words,
-            )
+    def fused(source_new, vperm_masks, net_masks, valid_words,
+              adj_indptr, adj_dst, adj_slot, outdeg, max_levels):
+        state = R.init_relay_state(vr, source_new)
 
-        # Exact [V] shapes: the relay engine has no padded-edge sentinel to
-        # absorb, and the [V+1] convention costs a concat copy per superstep.
-        state = init_state(num_vertices, source_new, sentinel=False)
+        def dense(st):
+            return superstep(st, vperm_masks, net_masks, valid_words)
 
-        def cond(s: BfsState):
-            return s.changed & (s.level < max_levels)
+        def sparse_step(st):
+            return _sparse_superstep(st, adj_indptr, adj_dst, adj_slot, vr=vr)
 
-        def body(s: BfsState):
-            return relay_superstep(s, cand_fn)
+        def cond(st):
+            return st.changed & (st.level < max_levels)
+
+        def body(st):
+            if not sparse:
+                return dense(st)
+            fsize = jax.lax.population_count(st.fwords).sum(dtype=jnp.int32)
+            bools = R.unpack_std(st.fwords, vr)
+            fedges = jnp.where(bools != 0, outdeg, 0).sum(dtype=jnp.int32)
+            take_sparse = (fsize <= SPARSE_BV) & (fedges <= SPARSE_BE)
+            return jax.lax.cond(take_sparse, sparse_step, dense, st)
 
         return jax.lax.while_loop(cond, body, state)
 
     return fused
 
 
-@functools.lru_cache(maxsize=16)
-def _relay_step_program(
-    num_vertices: int,
-    vperm_size: int,
-    out_classes: tuple,
-    net_size: int,
-    m2: int,
-    in_classes: tuple,
-):
-    """One jitted relay superstep (the stepped / observable path): same math
-    as one iteration of :func:`_relay_fused_program`, with the layout tensors
-    as arguments so they are not baked into the program as constants."""
-    from ..ops.relay import relay_candidates, relay_superstep
+@functools.lru_cache(maxsize=8)
+def _relay_multi_fused_program(static, use_pallas: bool):
+    """Batched (multi-source) relay loop: ``vmap`` lifts the dense superstep
+    over a leading sources axis while all trees share one lock-step
+    ``while_loop`` (BASELINE.json config 5 semantics)."""
+    (vr, *_rest) = static
+    from ..ops import relay as R
 
-    @jax.jit
-    def step(state, vperm_masks, net_masks, valid_words):
-        def cand_fn(frontier):
-            return relay_candidates(
-                frontier,
-                num_vertices=num_vertices,
-                vperm_masks=vperm_masks,
-                vperm_size=vperm_size,
-                out_classes=out_classes,
-                net_masks=net_masks,
-                net_size=net_size,
-                m2=m2,
-                in_classes=in_classes,
-                valid_words=valid_words,
-            )
-
-        return relay_superstep(state, cand_fn)
-
-    return step
-
-
-@functools.lru_cache(maxsize=16)
-def _relay_multi_fused_program(
-    num_vertices: int,
-    vperm_size: int,
-    out_classes: tuple,
-    net_size: int,
-    m2: int,
-    in_classes: tuple,
-):
-    """Batched (multi-source) relay loop: ``vmap`` lifts the gather-free
-    candidate pipeline over a leading sources axis — every stage is dense
-    elementwise/reshape math, so batching is mechanical — while all trees
-    share one lock-step ``while_loop`` (BASELINE.json config 5 semantics,
-    matching the other engines' batched mode)."""
-    from ..ops.relay import relay_candidates
+    superstep = _superstep_fn(static, use_pallas)
 
     @functools.partial(jax.jit, static_argnames=("max_levels",))
     def fused(sources_new, vperm_masks, net_masks, valid_words, max_levels):
-        def cand_fn(frontier):
-            return relay_candidates(
-                frontier,
-                num_vertices=num_vertices,
-                vperm_masks=vperm_masks,
-                vperm_size=vperm_size,
-                out_classes=out_classes,
-                net_masks=net_masks,
-                net_size=net_size,
-                m2=m2,
-                in_classes=in_classes,
-                valid_words=valid_words,
+        per0 = jax.vmap(lambda s: R.init_relay_state(vr, s))(sources_new)
+        state = R.RelayState(
+            per0.dist, per0.parent, per0.fwords, jnp.int32(0), jnp.bool_(True)
+        )
+
+        def cond(st):
+            return st.changed & (st.level < max_levels)
+
+        def body(st):
+            per = jax.vmap(
+                lambda d, p, f: superstep(
+                    R.RelayState(d, p, f, st.level, st.changed),
+                    vperm_masks, net_masks, valid_words,
+                )
+            )(st.dist, st.parent, st.fwords)
+            return R.RelayState(
+                per.dist, per.parent, per.fwords,
+                st.level + 1, per.changed.any(),
             )
-
-        cand_batched = jax.vmap(cand_fn)
-        state = init_batched_state(num_vertices, sources_new, sentinel=False)
-
-        def cond(s: BfsState):
-            return s.changed & (s.level < max_levels)
-
-        def body(s: BfsState):
-            return apply_candidates(s, cand_batched(s.frontier))
 
         return jax.lax.while_loop(cond, body, state)
 
@@ -253,87 +307,124 @@ def _relay_multi_fused_program(
 class RelayEngine:
     """Device-resident relay layout + fused BFS loop (engine='relay').
 
-    Build once per graph; call :meth:`run` per source.  The whole superstep
-    loop is one XLA program of dense ops — see graph/relay.py.
+    Build once per graph; call :meth:`run` per source, or
+    :meth:`run_many_device` for Graph500-style chained timing.  The whole
+    superstep loop is one XLA program of dense ops — see graph/relay.py.
+    ``sparse_hybrid`` enables the small-frontier gather path in the loop.
     """
 
-    def __init__(self, graph):
-        from ..graph.relay import RelayGraph, build_relay_graph
-        from ..ops.relay import valid_slot_words
+    def __init__(self, graph, *, sparse_hybrid: bool = True):
+        from ..graph.relay import RelayGraph, build_relay_graph, valid_slot_words
 
         rg = graph if isinstance(graph, RelayGraph) else build_relay_graph(graph)
         self.relay_graph = rg
+        self.sparse_hybrid = sparse_hybrid
         # Device-resident layout tensors are passed as jit ARGUMENTS — a
         # closed-over concrete array is baked into the program as a constant,
         # and the routing masks are hundreds of MB at scale >= 20.  The int32
-        # src table stays HOST-side (candidates are slot indices; see
-        # ops/relay.relay_candidates), freeing ~4 bytes/edge of HBM.
+        # src table stays HOST-side (candidates are slot indices).  On the
+        # fused TPU path the mask arg is the tuple of per-pass arrays
+        # (outer stages re-chunked so every mask DMA is contiguous).
+        if self._use_pallas():
+            from ..ops import relay_pallas as RP
+
+            def mask_arg(masks, table, size):
+                if _net_uses_pallas(size):
+                    return tuple(
+                        jnp.asarray(a)
+                        for a in RP.prepare_pass_masks(masks, table, size)
+                    )
+                return jnp.asarray(masks)
+
+            vperm_arg = mask_arg(rg.vperm_masks, rg.vperm_table, rg.vperm_size)
+            net_arg = mask_arg(rg.net_masks, rg.net_table, rg.net_size)
+        else:
+            vperm_arg = jnp.asarray(rg.vperm_masks)
+            net_arg = jnp.asarray(rg.net_masks)
         self._tensors = (
-            jnp.asarray(rg.vperm_masks),
-            jnp.asarray(rg.net_masks),
+            vperm_arg,
+            net_arg,
             jnp.asarray(valid_slot_words(rg.src_l1, rg.net_size)),
         )
-        self._raw_fused = _relay_fused_program(
-            rg.num_vertices,
-            rg.vperm_size,
-            rg.out_classes,
-            rg.net_size,
-            rg.m2,
-            rg.in_classes,
+        outdeg = np.diff(rg.adj_indptr[: rg.vr + 1].astype(np.int64)).astype(
+            np.int32
         )
+        self._sparse_tensors = (
+            jnp.asarray(rg.adj_indptr),
+            jnp.asarray(rg.adj_dst),
+            jnp.asarray(rg.adj_slot),
+            jnp.asarray(outdeg),
+        )
+        self._static = _relay_static(rg)
+
+    def _use_pallas(self) -> bool:
+        from ..ops.relay_pallas import pallas_enabled
+
+        return pallas_enabled()
 
     def _fused(self, source_new, max_levels):
-        return self._raw_fused(source_new, *self._tensors, max_levels=max_levels)
-
-    def step(self, state: BfsState) -> BfsState:
-        """One compiled relay superstep (state in RELABELED space)."""
-        rg = self.relay_graph
-        step = _relay_step_program(
-            rg.num_vertices,
-            rg.vperm_size,
-            rg.out_classes,
-            rg.net_size,
-            rg.m2,
-            rg.in_classes,
+        fused = _relay_fused_program(
+            self._static, self.sparse_hybrid, self._use_pallas()
         )
-        return step(state, *self._tensors)
+        return fused(
+            source_new, *self._tensors, *self._sparse_tensors,
+            max_levels=max_levels,
+        )
+
+    def init_state(self, source: int):
+        from ..ops.relay import init_relay_state
+
+        rg = self.relay_graph
+        check_sources(rg.num_vertices, source)
+        return init_relay_state(rg.vr, int(rg.old2new[source]))
+
+    def step(self, state):
+        """One compiled relay superstep (RelayState, RELABELED space)."""
+        superstep = _superstep_fn(self._static, self._use_pallas())
+        return jax.jit(superstep)(state, *self._tensors)
+
+    def _to_result(self, state, source: int) -> BfsResult:
+        rg = self.relay_graph
+        dist = np.asarray(state.dist)[rg.old2new]
+        parent = slots_to_parent(np.asarray(state.parent), rg.src_l1)[
+            rg.old2new
+        ]
+        parent[source] = source  # init wrote the relabeled id at the source
+        return BfsResult(dist=dist, parent=parent, num_levels=int(state.level))
 
     def run(self, source: int = 0, *, max_levels: int | None = None) -> BfsResult:
         rg = self.relay_graph
         check_sources(rg.num_vertices, source)
-        max_levels = int(max_levels) if max_levels is not None else rg.num_vertices
+        max_levels = int(max_levels) if max_levels is not None else rg.vr
         source_new = int(rg.old2new[source])
         state = jax.device_get(self._fused(jnp.int32(source_new), max_levels))
-        # Engine state lives in relabeled space with L1-SLOT parent values;
-        # map slots -> original src ids and the index space back (host, once
-        # per run).
-        dist_new = np.asarray(state.dist[: rg.num_vertices])
-        parent_new = slots_to_parent(
-            np.asarray(state.parent[: rg.num_vertices]), rg.src_l1
-        )
-        dist = dist_new[rg.old2new]
-        parent = parent_new[rg.old2new]
-        parent[source] = source  # init wrote the relabeled id at the source
-        return BfsResult(dist=dist, parent=parent, num_levels=int(state.level))
+        return self._to_result(state, source)
 
-    def run_multi_device(self, sources, *, max_levels: int | None = None) -> BfsState:
-        """Batched multi-source BFS, DEVICE-resident result: the raw batched
-        :class:`BfsState` in the relabeled space with slot-index parents.
-        No host transfer — reading ``int(state.level)`` is the cheap sync
-        (benchmark timing path; through a remote-device tunnel the full
-        state pull costs several times the traversal itself)."""
+    def run_many_device(self, sources, *, max_levels: int | None = None):
+        """Graph500-style batched timing path: dispatch one fused BFS per
+        source WITHOUT syncing in between (a synchronized round-trip through
+        the axon tunnel costs ~107 ms — tools/microbench_r3.py; chained
+        dispatch amortizes it to ~10 ms/search).  Returns the device states;
+        callers sync once by reading a value off the last one."""
         rg = self.relay_graph
         sources = np.atleast_1d(np.asarray(sources, dtype=np.int32))
         check_sources(rg.num_vertices, sources)
-        max_levels = int(max_levels) if max_levels is not None else rg.num_vertices
-        fused = _relay_multi_fused_program(
-            rg.num_vertices,
-            rg.vperm_size,
-            rg.out_classes,
-            rg.net_size,
-            rg.m2,
-            rg.in_classes,
-        )
+        max_levels = int(max_levels) if max_levels is not None else rg.vr
+        return [
+            self._fused(jnp.int32(int(rg.old2new[s])), max_levels)
+            for s in sources
+        ]
+
+    def run_multi_device(self, sources, *, max_levels: int | None = None):
+        """Batched multi-source BFS (lock-step trees), device-resident
+        result: the raw batched RelayState in the relabeled space with
+        slot-index parents.  Reading ``int(state.level)`` is the cheap
+        sync."""
+        rg = self.relay_graph
+        sources = np.atleast_1d(np.asarray(sources, dtype=np.int32))
+        check_sources(rg.num_vertices, sources)
+        max_levels = int(max_levels) if max_levels is not None else rg.vr
+        fused = _relay_multi_fused_program(self._static, self._use_pallas())
         sources_new = jnp.asarray(rg.old2new[sources])
         return fused(sources_new, *self._tensors, max_levels=max_levels)
 
@@ -348,12 +439,10 @@ class RelayEngine:
         state = jax.device_get(
             self.run_multi_device(sources, max_levels=max_levels)
         )
-        dist_new = np.asarray(state.dist[:, : rg.num_vertices])
-        parent_new = slots_to_parent(
-            np.asarray(state.parent[:, : rg.num_vertices]), rg.src_l1
-        )
-        dist = dist_new[:, rg.old2new]
-        parent = parent_new[:, rg.old2new]
+        dist = np.asarray(state.dist)[:, rg.old2new]
+        parent = slots_to_parent(np.asarray(state.parent), rg.src_l1)[
+            :, rg.old2new
+        ]
         rows = np.arange(sources.shape[0])
         parent[rows, sources] = sources  # init wrote relabeled ids at sources
         return MultiBfsResult(
@@ -485,37 +574,57 @@ class SuperstepRunner:
             raise ValueError(
                 f"unknown engine {engine!r}; use 'push', 'pull' or 'relay'"
             )
-        self._init = jax.jit(functools.partial(init_state, self.num_vertices))
+        if engine != "relay":
+            self._init = jax.jit(
+                functools.partial(init_state, self.num_vertices)
+            )
 
-    def init(self, source: int = 0) -> BfsState:
+    def init(self, source: int = 0):
         check_sources(self.num_vertices, source)
-        if self._old2new is not None:
-            source = int(self._old2new[source])
+        if self.engine == "relay":
+            return self._relay.init_state(source)
         return self._init(jnp.int32(source))
 
-    def step(self, state: BfsState) -> BfsState:
+    def step(self, state):
         return self._step(state)
 
-    def frontier_size(self, state: BfsState) -> int:
+    def frontier_size(self, state) -> int:
+        if self.engine == "relay":
+            return int(
+                jax.lax.population_count(state.fwords).sum(dtype=jnp.int32)
+            )
         return int(frontier_size(state))
 
-    def to_original(self, state: BfsState, *, source: int | None = None):
+    def to_original(self, state, *, source: int | None = None):
         """Host ``(dist, parent, frontier)`` in ORIGINAL vertex-id space.
 
         ``source`` (original id) fixes the relay engine's self-parent entry,
-        which init writes in relabeled space."""
+        which init writes in relabeled space — REQUIRED for relay (a relay
+        parent mapped without it would silently pass the source's relabeled
+        id through the slot table, yielding a plausible-looking wrong id —
+        ADVICE.md round 2)."""
         state = jax.device_get(state)
         v = self.num_vertices
+        if self._old2new is not None:
+            if source is None:
+                raise ValueError(
+                    "to_original requires source= for the relay engine"
+                )
+            from ..ops.relay import unpack_std
+
+            rg = self._relay.relay_graph
+            dist = np.asarray(state.dist)[self._old2new]
+            parent = slots_to_parent(np.asarray(state.parent), rg.src_l1)[
+                self._old2new
+            ]
+            fbits = np.asarray(
+                unpack_std(jnp.asarray(state.fwords), rg.vr)
+            ).astype(bool)[self._old2new]
+            parent[source] = source
+            return dist, parent, fbits
         dist = np.asarray(state.dist[:v])
         parent = np.asarray(state.parent[:v])
         frontier = np.asarray(state.frontier[:v])
-        if self._old2new is not None:
-            parent = slots_to_parent(parent, self._relay.relay_graph.src_l1)
-            dist = dist[self._old2new]
-            parent = parent[self._old2new]
-            frontier = frontier[self._old2new]
-            if source is not None:
-                parent[source] = source
         return dist, parent, frontier
 
     def run(self, source: int = 0, *, max_levels: int | None = None, observer=None):

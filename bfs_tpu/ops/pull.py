@@ -61,23 +61,22 @@ def pull_candidates_rows(
 
 
 def pack_frontier_block(bits: jax.Array, num_words: int) -> jax.Array:
-    """bool[..., B] -> uint32[..., B/32], bit-major within the block:
-    :func:`bfs_tpu.ops.relay.pack_bits` (the one packed-word convention)."""
-    from .relay import pack_bits
+    """bool[..., B] -> uint32[..., B/32], STANDARD packing (element e at
+    word e>>5, bit e&31 — the v4 convention shared with the relay layout)."""
+    from .relay import pack_std
 
-    return pack_bits(bits, num_words * 32)
+    del num_words
+    return pack_std(bits)
 
 
 def unpack_frontier_blocks(
     words: jax.Array, num_blocks: int, num_words: int
 ) -> jax.Array:
-    """uint32[..., n*B/32] -> bool[..., n*B] for an all-gathered frontier:
-    ``n`` per-shard blocks, each bit-major within itself."""
-    lead = words.shape[:-1]
-    w = words.reshape(*lead, num_blocks, 1, num_words)
-    shifts = jnp.arange(32, dtype=jnp.uint32)[None, :, None]
-    bits = (w >> shifts) & jnp.uint32(1)
-    return bits.reshape(*lead, num_blocks * 32 * num_words) != 0
+    """uint32[..., n*B/32] -> bool[..., n*B] for an all-gathered frontier
+    (standard packing, shard blocks concatenated)."""
+    from .relay import unpack_std
+
+    return unpack_std(words, num_blocks * num_words * 32) != 0
 
 
 def relax_pull_superstep(

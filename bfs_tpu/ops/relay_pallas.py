@@ -1,0 +1,335 @@
+"""Pallas TPU kernels for the v4 relay superstep's Beneš networks.
+
+The XLA path runs one kernel per stage — an HBM round-trip of the word
+array plus ~0.4 ms launch overhead each (measured; 55 stages at net 2^28).
+Here the stages factor into at most three fused passes per network with the
+word array VMEM-resident and only the per-stage masks DMA-streamed (the
+masks are the irreducible traffic):
+
+viewing the standard-packed words as [R, 128] and a stage's element
+distance d as
+
+  * an intra-word bit distance d          (d < 32, elementwise)
+  * a lane distance d/32                  (32 <= d < 4096)
+  * a row distance d/4096                 (4096 <= d < TR*4096)
+  * an outer-block distance d/4096/TR     (above)
+
+pass B fuses the consecutive middle run (d < TR*4096) on [TR, 128] tiles;
+passes A/C fuse the outer prefix/suffix on [B, tt, 128] blocks.  v4
+additionally (a) streams PAIR-COMPACTED masks for d >= 4096 — half the
+words are structurally zero (graph/relay.py) — and (b) skips DMA + compute
+for pass-B tiles outside a stage's static nonzero range (the
+identity-wired tail).  Outer-stage masks are re-chunked host-side
+(:func:`prepare_pass_masks`) so every DMA is one contiguous row slice.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..graph.relay import StageSpec
+
+logger = logging.getLogger(__name__)
+
+LANES = 128
+#: pass-B tile rows: 2048 rows * 128 lanes * 4 B = 1 MB of VMEM for x.
+TILE_ROWS = 2048
+#: outer-pass inner-chunk rows; the x block is (B, OUTER_TT, 128).
+OUTER_TT = 64
+
+_warned = False
+
+
+def pallas_enabled() -> bool:
+    """Use the Pallas path only on real TPU backends (the CPU test platform
+    runs the pure-XLA stages).  BFS_TPU_PALLAS=0/1 overrides.  Accepts either
+    backend name or device platform 'tpu' (the axon tunnel can report the
+    platform differently — ADVICE.md round 2), and logs once when the fused
+    path is disabled so a silent fallback is visible."""
+    global _warned
+    env = os.environ.get("BFS_TPU_PALLAS", "")
+    if env in ("0", "1"):
+        return env == "1"
+    try:
+        ok = jax.default_backend() == "tpu" or any(
+            d.platform == "tpu" for d in jax.devices()
+        )
+    except Exception:  # pragma: no cover - backend init failure
+        ok = False
+    if not ok and not _warned:
+        _warned = True
+        logger.info(
+            "relay fused Pallas path disabled (backend=%s); per-stage XLA",
+            jax.default_backend(),
+        )
+    return ok
+
+
+def pallas_net_ok(n: int) -> bool:
+    """The fused passes need at least a [128, 128]-word view."""
+    return n // 32 // LANES >= 128
+
+
+def split_passes(table: tuple[StageSpec, ...], n: int, tile_rows: int = TILE_ROWS):
+    """(prefix outer stages, local run, suffix outer stages, tr)."""
+    r = n // 32 // LANES
+    tr = min(tile_rows, max(r, 1))
+    local = [i for i, st in enumerate(table) if st.d < tr * 4096]
+    assert local, "no local stages — network too small for the fused path"
+    lo, hi = local[0], local[-1] + 1
+    assert local == list(range(lo, hi)), "local stages must be consecutive"
+    return list(range(lo)), list(range(lo, hi)), list(range(hi, len(table))), tr
+
+
+def pass_static(
+    table: tuple[StageSpec, ...], n: int,
+    tile_rows: int = TILE_ROWS, outer_tt: int = OUTER_TT,
+):
+    """Static (hashable) per-pass info: ``((mode, tr, tt, specs), ...)`` in
+    execution order, with outer-stage specs rewritten to their local offsets
+    in the rearranged arrays.  Must mirror :func:`prepare_pass_masks`."""
+    pre, local, suf, tr = split_passes(table, n, tile_rows)
+    tt = min(outer_tt, tr)
+    out = []
+
+    def outer(idx):
+        specs = []
+        off = 0
+        for i in idx:
+            st = table[i]
+            specs.append(st._replace(offset=off, nwords=st.nwords,
+                                     lo=0, hi=st.nwords))
+            off += st.nwords
+        return ("outer", tr, tt, tuple(specs))
+
+    if pre:
+        out.append(outer(pre))
+    out.append(("local", tr, tt, tuple(table[i] for i in local)))
+    if suf:
+        out.append(outer(suf))
+    return tuple(out)
+
+
+def prepare_pass_masks(
+    masks_flat: np.ndarray, table: tuple[StageSpec, ...], n: int,
+    tile_rows: int = TILE_ROWS, outer_tt: int = OUTER_TT,
+):
+    """Host-side, once per layout: per-pass mask arrays + local stage specs.
+
+    Pass B reuses the stored layout as-is (stage tiles are already
+    contiguous row slices).  Outer passes get rearranged copies: a stage
+    stored (span, tr, LANES) becomes chunk-major (tr/tt, span, tt, LANES) so
+    each grid step's mask block is ONE contiguous DMA.
+    Returns ``[(mode, tr, tt, specs, array2d), ...]`` in execution order.
+    """
+    pre, local, suf, tr = split_passes(table, n, tile_rows)
+    r = n // 32 // LANES
+    b = r // tr
+    tt = min(outer_tt, tr)
+    arrays = []
+
+    def outer_arr(idx):
+        parts = []
+        for i in idx:
+            st = table[i]
+            assert st.compact, "outer stages are always pair-compacted"
+            span = b // 2
+            w = masks_flat[st.offset : st.offset + st.nwords]
+            parts.append(
+                w.reshape(span, tr // tt, tt, LANES)
+                .swapaxes(0, 1)
+                .reshape(-1, LANES)
+            )
+        return (
+            np.concatenate(parts)
+            if parts
+            else np.zeros((0, LANES), np.uint32)
+        )
+
+    if pre:
+        arrays.append(outer_arr(pre))
+    arrays.append(masks_flat.reshape(-1, LANES))
+    if suf:
+        arrays.append(outer_arr(suf))
+    return arrays
+
+
+def _kroll(x, shift: int, axis: int, interpret: bool):
+    """In-kernel roll by a STATIC shift.  pltpu.roll in compiled mode —
+    jnp.roll's closed_call lowering hits an MLIR cache bug when several
+    Pallas kernels in one program contain same-shaped rolls."""
+    size = x.shape[axis]
+    if interpret:
+        return jnp.roll(x, shift % size, axis)
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pltpu.roll(x, shift % size, axis)
+
+
+def _stage_local(x, m, st: StageSpec, interpret: bool):
+    """One butterfly stage on a pass-B tile x: (tr, LANES)."""
+    d = st.d
+    if d < 32:
+        sh = jnp.uint32(d)
+        t = (x ^ (x >> sh)) & m
+        return x ^ t ^ (t << sh)
+    dw = d >> 5
+    if dw < LANES:  # lane butterfly; full mask, bits at lower pair lanes
+        idx = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+        has = (idx & dw) != 0
+        partner = jnp.where(
+            has, _kroll(x, dw, 1, interpret), _kroll(x, -dw, 1, interpret)
+        )
+        m_both = jnp.where(has, _kroll(m, dw, 1, interpret), m)
+        return x ^ ((x ^ partner) & m_both)
+    rw = dw // LANES  # row butterfly; compact mask (tr/2 rows)
+    a = x.shape[0] // (2 * rw)
+    xr = x.reshape(a, 2, rw, LANES)
+    lo, hi = xr[:, 0], xr[:, 1]
+    t = (lo ^ hi) & m.reshape(a, rw, LANES)
+    return jnp.stack([lo ^ t, hi ^ t], axis=1).reshape(x.shape)
+
+
+def _stage_outer(x, m, st: StageSpec, tr: int):
+    """One outer-block butterfly on a pass-A/C block x: (B, tt, LANES);
+    m: (B/2, tt, LANES) pair-compacted."""
+    bw = (st.d >> 12) // tr
+    bdim = x.shape[0]
+    a = bdim // (2 * bw)
+    xr = x.reshape(a, 2, bw, *x.shape[1:])
+    lo, hi = xr[:, 0], xr[:, 1]
+    t = (lo ^ hi) & m.reshape(a, bw, *m.shape[1:])
+    return jnp.stack([lo ^ t, hi ^ t], axis=1).reshape(x.shape)
+
+
+def _run_pass(x, arr2d, mode, tr, tt, specs, n, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    nw = n // 32
+    r = nw // LANES
+    b = r // tr
+
+    if mode == "local":
+        grid = (r // tr,)
+        x_view = x.reshape(r, LANES)
+        x_spec = pl.BlockSpec((tr, LANES), lambda i: (i, 0))
+        buf_rows = tr
+
+        def stage_rows(st):
+            return tr // 2 if st.compact else tr
+
+        def dma(m_hbm, mbuf, sem, slot, st, rows, pid):
+            return pltpu.make_async_copy(
+                m_hbm.at[pl.ds(st.offset // LANES + pid * rows, rows), :],
+                mbuf.at[slot, pl.ds(0, rows), :],
+                sem.at[slot],
+            )
+
+        def guard(st, pid):
+            rows = stage_rows(st)
+            w0 = pid * rows * LANES
+            return (w0 < st.hi) & (w0 + rows * LANES > st.lo)
+
+        def run_stage(xv, mbuf, slot, st):
+            rows = stage_rows(st)
+            return _stage_local(
+                xv, mbuf[slot, pl.ds(0, rows), :], st, interpret
+            )
+    else:
+        span = b // 2  # outer stages are always compact
+        grid = (tr // tt,)
+        x_view = x.reshape(b, tr, LANES)
+        x_spec = pl.BlockSpec((b, tt, LANES), lambda j: (0, j, 0))
+        buf_rows = span * tt
+
+        def stage_rows(st):
+            return span * tt
+
+        def dma(m_hbm, mbuf, sem, slot, st, rows, pid):
+            return pltpu.make_async_copy(
+                m_hbm.at[pl.ds(st.offset // LANES + pid * rows, rows), :],
+                mbuf.at[slot],
+                sem.at[slot],
+            )
+
+        def guard(st, pid):
+            del st, pid
+            return None  # outer tiles always intersect live words
+
+        def run_stage(xv, mbuf, slot, st):
+            return _stage_outer(
+                xv, mbuf[slot].reshape(span, tt, LANES), st, tr
+            )
+
+    def kernel(x_ref, m_hbm, o_ref, mbuf, sem):
+        pid = pl.program_id(0)
+        xv = x_ref[...]
+        n_st = len(specs)
+        guards = [guard(st, pid) for st in specs]
+
+        def start(si):
+            st = specs[si]
+            g = guards[si]
+            if g is None:
+                dma(m_hbm, mbuf, sem, si % 2, st, stage_rows(st), pid).start()
+            else:
+
+                @pl.when(g)
+                def _():
+                    dma(
+                        m_hbm, mbuf, sem, si % 2, st, stage_rows(st), pid
+                    ).start()
+
+        if n_st:
+            start(0)
+        for si, st in enumerate(specs):
+            if si + 1 < n_st:
+                start(si + 1)
+            g = guards[si]
+            if g is None:
+                dma(m_hbm, mbuf, sem, si % 2, st, stage_rows(st), pid).wait()
+                xv = run_stage(xv, mbuf, si % 2, st)
+            else:
+
+                @pl.when(g)
+                def _():
+                    dma(
+                        m_hbm, mbuf, sem, si % 2, st, stage_rows(st), pid
+                    ).wait()
+
+                xv = jnp.where(g, run_stage(xv, mbuf, si % 2, st), xv)
+        o_ref[...] = xv
+
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[x_spec, pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=x_spec,
+        out_shape=jax.ShapeDtypeStruct(x_view.shape, jnp.uint32),
+        scratch_shapes=[
+            pltpu.VMEM((2, buf_rows, LANES), jnp.uint32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=interpret,
+    )(x_view, arr2d)
+    return out.reshape(-1)
+
+
+def apply_benes_fused(
+    words: jax.Array,
+    pass_arrays,  # device arrays in prepare_pass_masks order
+    pass_static,  # tuple of (mode, tr, tt, specs) in the same order
+    n: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """The full routed Beneš network in at most three fused Pallas passes."""
+    x = words
+    for (mode, tr, tt, specs), arr in zip(pass_static, pass_arrays):
+        x = _run_pass(x, arr, mode, tr, tt, specs, n, interpret)
+    return x

@@ -143,16 +143,14 @@ def _init_block_state(source, block: int):
 
 
 def _packed_source_frontier(source, block: int, n: int):
-    """Initial global bit-packed frontier words (bit-major per block) with
-    only the source bit set.  Every device computes it identically (no
-    collective), then `pcast` aligns the carry with the all_gather-refreshed
-    words of the loop body, which are graph-axis-varying."""
-    nw = block // 32
-    eloc = source % block
-    widx = (source // block) * nw + eloc % nw
-    bit = (eloc // nw).astype(jnp.uint32)
+    """Initial global standard-packed frontier words with only the source
+    bit set.  Every device computes it identically (no collective), then
+    `pcast` aligns the carry with the all_gather-refreshed words of the loop
+    body, which are graph-axis-varying."""
     fwords = (
-        jnp.zeros((n * nw,), jnp.uint32).at[widx].set(jnp.uint32(1) << bit)
+        jnp.zeros((n * block // 32,), jnp.uint32)
+        .at[source >> 5]
+        .set(jnp.uint32(1) << (source & 31).astype(jnp.uint32))
     )
     return jax.lax.pcast(fwords, (GRAPH_AXIS,), to="varying")
 
@@ -220,30 +218,52 @@ def _bfs_sharded_pull_fused(ell0, folds, source, *, mesh, block, max_levels):
     return fn(ell0, folds, source)
 
 
+def _relay_candidates_shard(
+    fwords_global, vperm_blk, net_blk, valid_blk, *, static
+):
+    """One shard's gather-free candidate pipeline (v4): global standard-
+    packed frontier words -> this shard's per-owned-vertex min active L1
+    slot.  With v4's standard packing the all-gathered words ARE the global
+    frontier in vperm element order (relabeling is shard-major), so they
+    feed the butterflies directly with no repacking."""
+    from ..ops import relay as R
+
+    (block, vperm_size, vperm_table, out_classes, out_space, net_table,
+     net_size, in_classes, n) = static
+    nw = block // 32
+    zpad = jnp.zeros(vperm_size // 32 - n * nw, jnp.uint32)
+    fw = jnp.concatenate([fwords_global, zpad])
+    y = R.apply_benes_std(fw, vperm_blk, vperm_table, vperm_size)
+    l2 = R.broadcast_l2(y, out_classes, net_size, out_space)
+    l1 = R.apply_benes_std(l2, net_blk, net_table, net_size)
+    return R.rowmin_candidates(l1, valid_blk, in_classes, block)
+
+
+def _sharded_relay_static(srg, n: int):
+    return (
+        srg.block, srg.vperm_size, srg.vperm_table, tuple(srg.out_classes),
+        srg.out_space, srg.net_table, srg.net_size, tuple(srg.in_classes), n,
+    )
+
+
 @functools.partial(
-    jax.jit,
-    static_argnames=(
-        "mesh", "block", "vperm_size", "out_classes", "net_size", "m2",
-        "in_classes", "max_levels",
-    ),
+    jax.jit, static_argnames=("mesh", "static", "max_levels")
 )
 def _bfs_sharded_relay_fused(
     vperm_masks, net_masks, valid_words, source_new, *,
-    mesh, block, vperm_size, out_classes, net_size, m2, in_classes,
-    max_levels,
+    mesh, static, max_levels,
 ):
-    """Vertex-partitioned relay BFS: per-shard Beneš layouts (one unified
-    SPMD program, per-device mask data), frontier exchanged as the same
-    bit-packed all-gather as the sharded pull engine; the all-gathered words
-    feed each shard's vperm network directly (its routed permutation absorbs
-    the block-packed layout).  State lives in the GLOBAL RELABELED space —
-    dist/parent fully distributed, parent VALUES are per-shard L1 slot
-    indices (converted to original src ids on the host, bfs_sharded)."""
-    from ..ops.relay import relay_candidates_packed
+    """Vertex-partitioned relay BFS (v4): per-shard Beneš layouts (one
+    unified SPMD program, per-device mask data), frontier exchanged as a
+    bit-packed all-gather (1 bit/vertex over ICI per superstep).  State
+    lives in the GLOBAL RELABELED space — dist/parent fully distributed,
+    parent VALUES are per-shard L1 slot indices (converted to original src
+    ids on the host, bfs_sharded)."""
+    from ..ops.relay import pack_std
 
     n = mesh.shape[GRAPH_AXIS]
+    block = static[0]
     nw = block // 32
-    nww = vperm_size // 32
 
     def inner(vperm_blk, net_blk, valid_blk, source):
         vperm_blk = vperm_blk[0]
@@ -251,25 +271,27 @@ def _bfs_sharded_relay_fused(
         valid_blk = valid_blk[0]
         dist, parent = _init_block_state(source, block)
         fwords = _packed_source_frontier(source, block, n)
-        zpad = jnp.zeros((nww - n * nw,), jnp.uint32)
 
         def cond(carry):
             _, _, _, level, changed = carry
             return changed & (level < max_levels)
 
         def body(carry):
-            cand = relay_candidates_packed(
-                jnp.concatenate([carry[2], zpad]),
-                vperm_masks=vperm_blk,
-                vperm_size=vperm_size,
-                out_classes=out_classes,
-                net_masks=net_blk,
-                net_size=net_size,
-                m2=m2,
-                in_classes=in_classes,
-                valid_words=valid_blk,
+            dist, parent, fw, level, _ = carry
+            cand = _relay_candidates_shard(
+                fw, vperm_blk, net_blk, valid_blk, static=static
             )
-            return _apply_block_candidates(carry, cand, nw)
+            improved = (cand != INT32_MAX) & (dist == INT32_MAX)
+            level = level + 1
+            dist = jnp.where(improved, level, dist)
+            parent = jnp.where(improved, cand, parent)
+            fw = jax.lax.all_gather(
+                pack_std(improved), GRAPH_AXIS, tiled=True
+            )
+            changed = (
+                jax.lax.pmax(improved.any().astype(jnp.int32), GRAPH_AXIS) > 0
+            )
+            return dist, parent, fw, level, changed
 
         dist, parent, _, level, _ = jax.lax.while_loop(
             cond, body, (dist, parent, fwords, jnp.int32(0), jnp.bool_(True))
@@ -280,8 +302,8 @@ def _bfs_sharded_relay_fused(
         inner,
         mesh=mesh,
         in_specs=(
-            P(GRAPH_AXIS, None, None),
-            P(GRAPH_AXIS, None, None),
+            P(GRAPH_AXIS, None),
+            P(GRAPH_AXIS, None),
             P(GRAPH_AXIS, None),
             P(),
         ),
@@ -289,6 +311,89 @@ def _bfs_sharded_relay_fused(
         axis_names={GRAPH_AXIS},
     )
     return fn(vperm_masks, net_masks, valid_words, source_new)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "static", "max_levels")
+)
+def _bfs_sharded_relay_multi_fused(
+    vperm_masks, net_masks, valid_words, sources_new, *,
+    mesh, static, max_levels,
+):
+    """Batched multi-source relay BFS on a 2-D mesh: sources data-parallel
+    over ``batch``, vertices (and the relay pipeline) partitioned over
+    ``graph``.  The per-superstep exchange is one frontier-word all-gather
+    PER LOCAL TREE; the routing masks are read once per superstep per shard
+    and shared by every tree in the local batch (the amortization config 5
+    is about)."""
+    from ..ops.relay import pack_std
+
+    n = mesh.shape[GRAPH_AXIS]
+    block = static[0]
+    nw = block // 32
+
+    def inner(vperm_blk, net_blk, valid_blk, sources_blk):
+        vperm_blk = vperm_blk[0]
+        net_blk = net_blk[0]
+        valid_blk = valid_blk[0]
+        s_l = sources_blk.shape[0]
+        lo = jax.lax.axis_index(GRAPH_AXIS).astype(jnp.int32) * block
+        ids_local = lo + jnp.arange(block, dtype=jnp.int32)
+        is_src = ids_local[None, :] == sources_blk[:, None]
+        dist = jnp.where(is_src, jnp.int32(0), INT32_MAX)
+        parent = jnp.where(is_src, sources_blk[:, None], jnp.int32(-1))
+        fwords = (
+            jnp.zeros((s_l, n * nw), jnp.uint32)
+            .at[jnp.arange(s_l), sources_blk >> 5]
+            .set(jnp.uint32(1) << (sources_blk & 31).astype(jnp.uint32))
+        )
+        fwords = jax.lax.pcast(fwords, (GRAPH_AXIS,), to="varying")
+
+        def cond(carry):
+            _, _, _, level, changed = carry
+            return changed & (level < max_levels)
+
+        def body(carry):
+            dist, parent, fw, level, _ = carry
+            cand = jax.vmap(
+                lambda f: _relay_candidates_shard(
+                    f, vperm_blk, net_blk, valid_blk, static=static
+                )
+            )(fw)
+            improved = (cand != INT32_MAX) & (dist == INT32_MAX)
+            level = level + 1
+            dist = jnp.where(improved, level, dist)
+            parent = jnp.where(improved, cand, parent)
+            fw = jax.lax.all_gather(
+                pack_std(improved), GRAPH_AXIS, tiled=True, axis=1
+            )
+            any_local = improved.any().astype(jnp.int32)
+            changed = (
+                jax.lax.pmax(
+                    jax.lax.pmax(any_local, GRAPH_AXIS), BATCH_AXIS
+                )
+                > 0
+            )
+            return dist, parent, fw, level, changed
+
+        dist, parent, _, level, _ = jax.lax.while_loop(
+            cond, body, (dist, parent, fwords, jnp.int32(0), jnp.bool_(True))
+        )
+        return dist, parent, level
+
+    fn = _shard_map(
+        inner,
+        mesh=mesh,
+        in_specs=(
+            P(GRAPH_AXIS, None),
+            P(GRAPH_AXIS, None),
+            P(GRAPH_AXIS, None),
+            P(BATCH_AXIS),
+        ),
+        out_specs=(P(BATCH_AXIS, GRAPH_AXIS), P(BATCH_AXIS, GRAPH_AXIS), P()),
+        axis_names={GRAPH_AXIS, BATCH_AXIS},
+    )
+    return fn(vperm_masks, net_masks, valid_words, sources_new)
 
 
 def _prepare_relay(graph, mesh: Mesh):
@@ -308,9 +413,9 @@ def _prepare_relay(graph, mesh: Mesh):
 
 
 def _relay_valid_words(srg):
-    """Per-shard valid-slot bitmasks (ops/relay.valid_slot_words), stacked
+    """Per-shard valid-slot bitmasks (graph/relay.valid_slot_words), stacked
     over shards: uint32[n, net_size/32]."""
-    from ..ops.relay import valid_slot_words
+    from ..graph.relay import valid_slot_words
 
     return jnp.asarray(
         np.stack(
@@ -318,6 +423,27 @@ def _relay_valid_words(srg):
              for s in range(srg.num_shards)]
         )
     )
+
+
+def _relay_map_back(srg, dist, parent, source_or_sources):
+    """Global-relabeled sharded state -> original-id arrays.  Parent values
+    are per-shard L1 slot indices; vertex at global new id g is owned by
+    shard g // block with src table src_l1[shard]."""
+    dist = np.asarray(dist)
+    parent = np.asarray(parent)
+    shard_of = np.arange(parent.shape[-1]) // srg.block
+    slots = np.clip(parent, 0, srg.src_l1.shape[1] - 1)
+    parent = np.where(
+        parent >= 0, srg.src_l1[shard_of, slots], parent
+    ).astype(np.int32)
+    dist = dist[..., srg.old2new]
+    parent = parent[..., srg.old2new]
+    if np.ndim(source_or_sources) == 0:
+        parent[int(source_or_sources)] = int(source_or_sources)
+    else:
+        rows = np.arange(len(source_or_sources))
+        parent[rows, source_or_sources] = source_or_sources
+    return dist, parent
 
 
 def _prepare_pull(
@@ -371,27 +497,12 @@ def bfs_sharded(
             _relay_valid_words(srg),
             source_new,
             mesh=mesh,
-            block=srg.block,
-            vperm_size=srg.vperm_size,
-            out_classes=srg.out_classes,
-            net_size=srg.net_size,
-            m2=srg.m2,
-            in_classes=srg.in_classes,
+            static=_sharded_relay_static(srg, _graph_shards(mesh)),
             max_levels=max_levels,
         )
-        dist = np.asarray(jax.device_get(dist))
-        parent = np.asarray(jax.device_get(parent))
-        # Parent values are per-shard L1 slot indices; vertex at global new
-        # id g is owned by shard g // block with src table src_l1[shard].
-        shard_of = np.arange(parent.shape[0]) // srg.block
-        slots = np.clip(parent, 0, srg.src_l1.shape[1] - 1)
-        parent = np.where(
-            parent >= 0, srg.src_l1[shard_of, slots], parent
-        ).astype(np.int32)
-        # State is in the global relabeled space; map back to original ids.
-        dist = dist[srg.old2new]
-        parent = parent[srg.old2new]
-        parent[source] = source  # init wrote the relabeled id at the source
+        dist, parent = _relay_map_back(
+            srg, jax.device_get(dist), jax.device_get(parent), source
+        )
         return BfsResult(dist=dist, parent=parent, num_levels=int(level))
     if engine == "pull":
         spg = _prepare_pull(graph, mesh, vertex_block_multiple)
@@ -485,13 +596,10 @@ def _bfs_sharded_pull_multi_fused(ell0, folds, sources, *, mesh, block, max_leve
         is_src = ids_local[None, :] == sources_blk[:, None]
         dist = jnp.where(is_src, jnp.int32(0), INT32_MAX)
         parent = jnp.where(is_src, sources_blk[:, None], jnp.int32(-1))
-        eloc = sources_blk % block
-        widx = (sources_blk // block) * nw + eloc % nw
-        bits0 = jnp.uint32(1) << (eloc // nw).astype(jnp.uint32)
         fwords = (
             jnp.zeros((s_l, n * nw), jnp.uint32)
-            .at[jnp.arange(s_l), widx]
-            .set(bits0)
+            .at[jnp.arange(s_l), sources_blk >> 5]
+            .set(jnp.uint32(1) << (sources_blk & 31).astype(jnp.uint32))
         )
         # See the single-source variant: the all_gather in the body makes
         # the frontier carry graph-axis-varying.
@@ -561,6 +669,26 @@ def bfs_sharded_multi(
     nb = mesh.shape[BATCH_AXIS]
     if sources.shape[0] % nb != 0:
         raise ValueError(f"{sources.shape[0]} sources not divisible by batch axis {nb}")
+    if engine == "relay":
+        srg = _prepare_relay(graph, mesh)
+        check_sources(srg.num_vertices, sources)
+        max_levels = int(max_levels) if max_levels is not None else srg.num_vertices
+        sources_new = jnp.asarray(srg.old2new[sources])
+        dist, parent, level = _bfs_sharded_relay_multi_fused(
+            jnp.asarray(srg.vperm_masks),
+            jnp.asarray(srg.net_masks),
+            _relay_valid_words(srg),
+            sources_new,
+            mesh=mesh,
+            static=_sharded_relay_static(srg, _graph_shards(mesh)),
+            max_levels=max_levels,
+        )
+        dist, parent = _relay_map_back(
+            srg, jax.device_get(dist), jax.device_get(parent), sources
+        )
+        return MultiBfsResult(
+            sources=sources, dist=dist, parent=parent, num_levels=int(level)
+        )
     if engine == "pull":
         spg = _prepare_pull(graph, mesh, vertex_block_multiple)
         check_sources(spg.num_vertices, sources)
@@ -582,8 +710,7 @@ def bfs_sharded_multi(
         )
     if engine != "push":
         raise ValueError(
-            f"unknown engine {engine!r}; use 'pull' or 'push'"
-            " ('relay' has no batched sharded mode yet)"
+            f"unknown engine {engine!r}; use 'relay', 'pull' or 'push'"
         )
     _reject_wrong_layout_for_push(graph)
     dg = _prepare(graph, mesh, block)

@@ -1,94 +1,102 @@
-"""Fused Beneš Pallas passes vs the per-stage XLA path and an element-space
-NumPy reference.
+"""v4 fused-pass Pallas kernels vs the XLA per-stage reference.
 
-apply_benes_fused (ops/benes_pallas.py) must be bit-exact with applying the
-same stages one butterfly at a time.  Runs under the Pallas interpreter so
-the CPU test platform covers the kernel math (including the mask DMA
-streaming); the real-TPU compiled path is exercised by bench.py, whose
-result is check()-verified.
+Runs in Pallas interpret mode on the CPU test platform: same kernel code
+path as the TPU (minus Mosaic lowering), bit-exact against apply_benes_std.
+The real-TPU compiled path is additionally exercised by the bench's check()
+invariants (bfs_tpu/bench.py) on every benchmark run.
 """
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 import numpy as np
 import pytest
 
-jax = pytest.importorskip("jax")
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bfs_tpu.graph import benes  # noqa: E402
+
+if not benes.native_available():  # pragma: no cover
+    pytest.skip("native benes router unavailable", allow_module_level=True)
+
 import jax.numpy as jnp  # noqa: E402
 
-from bfs_tpu.ops.benes_pallas import (  # noqa: E402
-    LANES,
+from bfs_tpu.graph.relay import _compact_and_table  # noqa: E402
+from bfs_tpu.ops.relay import apply_benes_std, pack_std, unpack_std  # noqa: E402
+from bfs_tpu.ops.relay_pallas import (  # noqa: E402
     apply_benes_fused,
-    local_stage_run,
-    stage_distances,
+    pass_static,
+    prepare_pass_masks,
 )
-from bfs_tpu.ops.relay import pack_bits_host  # noqa: E402
 
 
-def _unpack_host(words: np.ndarray, n: int) -> np.ndarray:
-    nw = max(n // 32, 1)
-    out = np.zeros(n, dtype=np.uint8)
-    for b in range(32):
-        out[b * nw : (b + 1) * nw] = (words >> np.uint32(b)) & 1
-    return out
+@pytest.mark.parametrize("tile_rows", [16, 64])
+def test_fused_passes_match_xla(tile_rows):
+    """All three fused passes (outer prefix, local run, outer suffix) with
+    compacted masks and tail-range skips route exactly perm."""
+    rng = np.random.default_rng(5)
+    n = 1 << 19  # r = 128 rows; tile_rows < r forces outer passes
+    perm = rng.permutation(n).astype(np.int64)
+    masks, table = _compact_and_table(benes.route_std(perm), n)
+    ps = pass_static(table, n, tile_rows=tile_rows)
+    arrays = [
+        jnp.asarray(a)
+        for a in prepare_pass_masks(masks, table, n, tile_rows=tile_rows)
+    ]
+    assert len(ps) == len(arrays) == 3  # outer + local + outer
+    bits = rng.integers(0, 2, size=n).astype(np.uint8)
+    x = pack_std(jnp.asarray(bits))
+    want = np.asarray(
+        unpack_std(apply_benes_std(x, jnp.asarray(masks), table, n), n)
+    )
+    got_x = x
+    for (mode, tr, tt, specs), arr in zip(ps, arrays):
+        from bfs_tpu.ops.relay_pallas import _run_pass
+
+        got_x = _run_pass(got_x, arr, mode, tr, tt, specs, n, interpret=True)
+    got = np.asarray(unpack_std(got_x, n))
+    np.testing.assert_array_equal(got, want)
+    np.testing.assert_array_equal(got, bits[perm])
 
 
-def _butterfly_elements(x: np.ndarray, mask_bits: np.ndarray, d: int) -> np.ndarray:
-    """One stage in element space: swap pairs (e, e+d) where the mask bit at
-    the LOWER element is set (matches ops/relay._apply_benes_small)."""
-    x2 = x.reshape(-1, 2, d).copy()
-    m = mask_bits.reshape(-1, 2, d)[:, 0, :].astype(bool)
-    lo, hi = x2[:, 0, :].copy(), x2[:, 1, :].copy()
-    x2[:, 0, :] = np.where(m, hi, lo)
-    x2[:, 1, :] = np.where(m, lo, hi)
-    return x2.reshape(-1)
+def test_fused_identity_tail_skips_are_correct():
+    """A permutation with a large identity tail: with live <= n/2 the pad
+    pairs are pure and route switch-free, so stages carry skippable nonzero
+    ranges; the guarded DMA/compute path must still route exactly."""
+    rng = np.random.default_rng(6)
+    n = 1 << 19
+    live = n * 3 // 8
+    perm = np.arange(n, dtype=np.int64)
+    perm[:live] = rng.permutation(live)
+    masks, table = _compact_and_table(benes.route_std(perm), n)
+    # the tail must actually produce skippable ranges
+    assert any(st.hi < st.nwords for st in table)
+    ps = pass_static(table, n, tile_rows=16)
+    arrays = [
+        jnp.asarray(a) for a in prepare_pass_masks(masks, table, n, tile_rows=16)
+    ]
+    bits = rng.integers(0, 2, size=n).astype(np.uint8)
+    x = pack_std(jnp.asarray(bits))
+    from bfs_tpu.ops.relay_pallas import _run_pass
+
+    for (mode, tr, tt, specs), arr in zip(ps, arrays):
+        x = _run_pass(x, arr, mode, tr, tt, specs, n, interpret=True)
+    np.testing.assert_array_equal(np.asarray(unpack_std(x, n)), bits[perm])
 
 
-def test_pack_unpack_kernels_roundtrip():
-    from bfs_tpu.ops.benes_pallas import pack_bits_pallas, unpack_bits_pallas
-
-    n = 1 << 20
-    rng = np.random.default_rng(3)
-    bits = rng.integers(0, 2, size=n, dtype=np.uint8)
-    words = pack_bits_host(bits, n)
-    got_w = np.asarray(pack_bits_pallas(jnp.asarray(bits), n, interpret=True))
-    np.testing.assert_array_equal(got_w, words)
-    got_b = np.asarray(unpack_bits_pallas(jnp.asarray(words), n, interpret=True))
-    np.testing.assert_array_equal(got_b, bits)
-
-
-@pytest.mark.parametrize(
-    "n,tile_rows",
-    [
-        (1 << 15, 4),   # r=8: outer passes carry the bit stages + big rolls
-        (1 << 16, 8),   # r=16
-        (1 << 16, 16),  # tr == r: outer passes carry ONLY bit-plane stages
-    ],
-)
-def test_fused_passes_match_element_reference(n, tile_rows):
+def test_apply_benes_fused_end_to_end():
     rng = np.random.default_rng(7)
-    dists = stage_distances(n)
-    # Mask contract (native/benes.cpp): swap bits sit ONLY at the lower
-    # element of each pair — the bit-plane stage formula relies on it.
-    lower = [np.asarray((np.arange(n) & d) == 0, dtype=np.uint8) for d in dists]
-    masks = np.stack(
-        [pack_bits_host(rng.integers(0, 2, size=n, dtype=np.uint8) & lw, n)
-         for lw in lower]
+    n = 1 << 19
+    perm = rng.permutation(n).astype(np.int64)
+    masks, table = _compact_and_table(benes.route_std(perm), n)
+    ps = pass_static(table, n, tile_rows=32)
+    arrays = [
+        jnp.asarray(a) for a in prepare_pass_masks(masks, table, n, tile_rows=32)
+    ]
+    bits = rng.integers(0, 2, size=n).astype(np.uint8)
+    out = apply_benes_fused(
+        pack_std(jnp.asarray(bits)), arrays, ps, n, interpret=True
     )
-    xbits = rng.integers(0, 2, size=n, dtype=np.uint8)
-    xwords = pack_bits_host(xbits, n)
-
-    lo, hi = local_stage_run(n, tile_rows)
-    assert hi > lo
-    if tile_rows < n // 32 // LANES:
-        assert lo > 0 and hi < len(dists)  # all three passes exercised
-
-    got = np.asarray(
-        apply_benes_fused(
-            jnp.asarray(xwords), jnp.asarray(masks), n=n,
-            tile_rows=tile_rows, interpret=True,
-        )
-    )
-
-    ref = xbits.copy()
-    for s, d in enumerate(dists):
-        ref = _butterfly_elements(ref, _unpack_host(masks[s], n), d)
-    np.testing.assert_array_equal(got, pack_bits_host(ref, n))
+    np.testing.assert_array_equal(np.asarray(unpack_std(out, n)), bits[perm])

@@ -100,8 +100,9 @@ def test_device_resident_entry_points_match_host_results():
         host = eng.run_multi(srcs)
         state = eng.run_multi_device(srcs)
         assert int(state.level) == host.num_levels
-        # device dist is in relabeled space; reached COUNTS are invariant
+        # device dist is in relabeled space (padded to vr >= V; dummies are
+        # never reached) — reached COUNTS are permutation-invariant
         np.testing.assert_array_equal(
-            (np.asarray(state.dist)[:, : g.num_vertices] != inf).sum(axis=1),
+            (np.asarray(state.dist) != inf).sum(axis=1),
             (host.dist != inf).sum(axis=1),
         )

@@ -39,13 +39,13 @@ def test_pack_unpack_roundtrip():
     np.testing.assert_array_equal(benes.unpack_bits(benes.pack_bits(bits)), bits)
 
 
-def test_ops_pack_bits_layout_and_batching():
-    """ops.relay.pack_bits agrees with the numpy reference layout (bit-major:
-    element e -> word e % nw, bit e // nw), for bool and uint8 inputs and
-    with leading batch axes (the sharded/batched engines' path)."""
+def test_ops_pack_std_layout_and_batching():
+    """ops.relay.pack_std agrees with the standard packing (element e ->
+    word e >> 5, bit e & 31), for bool and uint8 inputs and with leading
+    batch axes (the sharded/batched engines' path)."""
     import jax.numpy as jnp
 
-    from bfs_tpu.ops.relay import pack_bits, unpack_bits
+    from bfs_tpu.ops.relay import pack_std, unpack_std
 
     rng = np.random.default_rng(9)
     for n in (64, 4096):
@@ -54,38 +54,42 @@ def test_ops_pack_bits_layout_and_batching():
         want = np.zeros(nw, dtype=np.uint32)
         for e in range(n):
             if bits[e]:
-                want[e % nw] |= np.uint32(1) << (e // nw)
-        got = np.asarray(pack_bits(jnp.asarray(bits), n))
+                want[e >> 5] |= np.uint32(1) << (e & 31)
+        got = np.asarray(pack_std(jnp.asarray(bits)))
         np.testing.assert_array_equal(got, want)
-        got_bool = np.asarray(pack_bits(jnp.asarray(bits.astype(bool)), n))
+        got_bool = np.asarray(pack_std(jnp.asarray(bits.astype(bool))))
         np.testing.assert_array_equal(got_bool, want)
         np.testing.assert_array_equal(
-            np.asarray(unpack_bits(jnp.asarray(want), n)), bits
+            np.asarray(unpack_std(jnp.asarray(want), n)), bits
         )
     batched = rng.integers(0, 2, size=(3, 2048)).astype(np.uint8)
-    got = np.asarray(pack_bits(jnp.asarray(batched), 2048))
+    got = np.asarray(pack_std(jnp.asarray(batched)))
     for i in range(3):
         np.testing.assert_array_equal(
-            got[i], np.asarray(pack_bits(jnp.asarray(batched[i]), 2048))
+            got[i], np.asarray(pack_std(jnp.asarray(batched[i])))
         )
 
 
 def test_xla_applier_matches_numpy():
+    """apply_benes_std (v4 stage table: full + pair-compacted masks with
+    nonzero ranges) routes exactly perm for all stage regimes."""
     import jax.numpy as jnp
 
-    from bfs_tpu.ops.relay import MIN_PACKED_BITS, apply_benes, pack_bits, unpack_bits
+    from bfs_tpu.graph.relay import _compact_and_table
+    from bfs_tpu.ops.relay import apply_benes_std, pack_std, unpack_std
 
     rng = np.random.default_rng(3)
-    # Covers the unpacked small path, the packed path's word/lane stages,
-    # and (at 2^21) row-block stages.
-    for n in (32, 64, 2048, MIN_PACKED_BITS, 1 << 17, 1 << 21):
+    for n in (64, 2048, 1 << 13, 1 << 17, 1 << 21):
         perm = rng.permutation(n).astype(np.int64)
-        masks = benes.route(perm, bit_major=True)
+        masks_full = benes.route_std(perm)
+        masks, table = _compact_and_table(masks_full, n)
         bits = rng.integers(0, 2, size=n).astype(np.uint8)
         want = bits[perm]
         got = np.asarray(
-            unpack_bits(
-                apply_benes(pack_bits(jnp.asarray(bits), n), jnp.asarray(masks), n),
+            unpack_std(
+                apply_benes_std(
+                    pack_std(jnp.asarray(bits)), jnp.asarray(masks), table, n
+                ),
                 n,
             )
         )
