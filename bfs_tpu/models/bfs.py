@@ -268,6 +268,48 @@ def _relay_fused_program(static, sparse: bool, use_pallas: bool):
 
 
 @functools.lru_cache(maxsize=8)
+def _relay_elem_program(static, pt: int, groups: int, use_pallas: bool):
+    """Element-major batched multi-source loop: 32 trees per uint32 element,
+    one mask stream amortized over every tree (ops/relay_elem.py)."""
+    (vr, vperm_size, vperm_table, out_classes, out_space, net_table,
+     net_size, in_classes) = static
+    from ..ops import relay_elem as RE
+
+    plane_offsets, _ = RE.rank_plane_layout(in_classes)
+    if use_pallas:
+        from ..ops import relay_pallas as RP
+
+        step = RP.elem_superstep_tpu_factory(
+            static, plane_offsets, pt
+        )
+    else:
+
+        def step(st, vperm_m, net_m, valid_words):
+            return RE.elem_superstep(
+                st,
+                vperm_masks=vperm_m, vperm_table=vperm_table,
+                vperm_size=vperm_size, out_classes=out_classes,
+                net_masks=net_m, net_table=net_table, net_size=net_size,
+                in_classes=in_classes, valid_words=valid_words, vr=vr,
+                plane_offsets=plane_offsets, pt=pt,
+            )
+
+    @functools.partial(jax.jit, static_argnames=("max_levels",))
+    def fused(sources_new, vperm_m, net_m, valid_words, max_levels):
+        state = RE.init_elem_state(vr, sources_new, pt)
+
+        def cond(st):
+            return st.changed & (st.level < max_levels)
+
+        def body(st):
+            return step(st, vperm_m, net_m, valid_words)
+
+        return jax.lax.while_loop(cond, body, state)
+
+    return fused
+
+
+@functools.lru_cache(maxsize=8)
 def _relay_multi_fused_program(static, use_pallas: bool):
     """Batched (multi-source) relay loop: ``vmap`` lifts the dense superstep
     over a leading sources axis while all trees share one lock-step
@@ -356,20 +398,35 @@ class RelayEngine:
             jnp.asarray(outdeg),
         )
         self._static = _relay_static(rg)
+        self._compiled = {}
 
     def _use_pallas(self) -> bool:
         from ..ops.relay_pallas import pallas_enabled
 
         return pallas_enabled()
 
+    #: XLA keeps Pallas operands/results VMEM-resident when they fit under
+    #: its scoped-vmem budget; mid-size nets (2^25..2^26 words arrays of
+    #: 4-8 MB) then blow the 16 MB default limit at compile time.  The TPU
+    #: flag cannot go through XLA_FLAGS (the local CPU XLA aborts on unknown
+    #: flags), so fused programs are AOT-compiled with per-compile options.
+    _COMPILER_OPTIONS = {"xla_tpu_scoped_vmem_limit_kib": "65536"}
+
     def _fused(self, source_new, max_levels):
         fused = _relay_fused_program(
             self._static, self.sparse_hybrid, self._use_pallas()
         )
-        return fused(
-            source_new, *self._tensors, *self._sparse_tensors,
-            max_levels=max_levels,
-        )
+        args = (source_new, *self._tensors, *self._sparse_tensors)
+        if not self._use_pallas():
+            return fused(*args, max_levels=max_levels)
+        key = ("fused", max_levels)
+        compiled = self._compiled.get(key)
+        if compiled is None:
+            compiled = fused.lower(*args, max_levels=max_levels).compile(
+                compiler_options=self._COMPILER_OPTIONS
+            )
+            self._compiled[key] = compiled
+        return compiled(*args)
 
     def init_state(self, source: int):
         from ..ops.relay import init_relay_state
@@ -426,7 +483,71 @@ class RelayEngine:
         max_levels = int(max_levels) if max_levels is not None else rg.vr
         fused = _relay_multi_fused_program(self._static, self._use_pallas())
         sources_new = jnp.asarray(rg.old2new[sources])
-        return fused(sources_new, *self._tensors, max_levels=max_levels)
+        args = (sources_new, *self._tensors)
+        if not self._use_pallas():
+            return fused(*args, max_levels=max_levels)
+        key = ("multi", sources_new.shape[0], max_levels)
+        compiled = self._compiled.get(key)
+        if compiled is None:
+            compiled = fused.lower(*args, max_levels=max_levels).compile(
+                compiler_options=self._COMPILER_OPTIONS
+            )
+            self._compiled[key] = compiled
+        return compiled(*args)
+
+    def run_multi_elem_device(self, sources, *, max_levels: int | None = None):
+        """Element-major batched multi-source BFS: sources count must be a
+        multiple of 32; all trees run lock-step in ONE program with the
+        routing masks read once per superstep for the whole batch.  Returns
+        the device ElemState (sync = reading ``int(state.level)``)."""
+        from ..ops.relay_elem import MAX_ELEM_LEVELS, rank_plane_layout
+
+        rg = self.relay_graph
+        sources = np.atleast_1d(np.asarray(sources, dtype=np.int32))
+        if sources.shape[0] % 32 != 0:
+            raise ValueError("element-major batching needs a multiple of 32 sources")
+        check_sources(rg.num_vertices, sources)
+        max_levels = (
+            int(max_levels) if max_levels is not None else MAX_ELEM_LEVELS
+        )
+        if max_levels > MAX_ELEM_LEVELS:
+            raise ValueError(
+                f"element-major mode carries {MAX_ELEM_LEVELS} levels max; "
+                "use run_multi_device for deeper graphs"
+            )
+        groups = sources.shape[0] // 32
+        _, pt = rank_plane_layout(rg.in_classes)
+        fused = _relay_elem_program(
+            self._static, pt, groups, self._use_pallas()
+        )
+        src_new = jnp.asarray(rg.old2new[sources].reshape(groups, 32))
+        args = (src_new, *self._tensors)
+        if not self._use_pallas():
+            return fused(*args, max_levels=max_levels)
+        key = ("elem", groups, max_levels)
+        compiled = self._compiled.get(key)
+        if compiled is None:
+            compiled = fused.lower(*args, max_levels=max_levels).compile(
+                compiler_options=self._COMPILER_OPTIONS
+            )
+            self._compiled[key] = compiled
+        return compiled(*args)
+
+    def run_multi_elem(self, sources, *, max_levels: int | None = None):
+        """Element-major batched multi-source BFS, host results
+        (MultiBfsResult in original-id space, bit-exact vs run_multi)."""
+        from ..ops.relay_elem import extract_results
+        from .multisource import MultiBfsResult
+
+        sources = np.atleast_1d(np.asarray(sources, dtype=np.int32))
+        state = jax.device_get(
+            self.run_multi_elem_device(sources, max_levels=max_levels)
+        )
+        dist, parent = extract_results(state, self.relay_graph, sources)
+        return MultiBfsResult(
+            sources=sources, dist=dist, parent=parent,
+            num_levels=int(state.level),
+        )
 
     def run_multi(self, sources, *, max_levels: int | None = None):
         """Batched multi-source BFS on the relay layout; returns a

@@ -1,0 +1,18 @@
+"""Build + cache the v4 s24 relay layout, timing each phase (task 3 target:
+cold-cache < 300 s)."""
+import os, sys, time
+sys.path.insert(0, "/root/repo")
+os.environ["JAX_PLATFORMS"] = "cpu"  # no device needed
+import numpy as np
+
+t_all = time.perf_counter()
+from bfs_tpu.bench import load_or_build, load_or_build_relay, _generator_backend
+backend = _generator_backend()
+dg, source = load_or_build(24, 6, 42, 8192, backend)
+print(f"graph load: {time.perf_counter()-t_all:.1f}s", flush=True)
+
+t0 = time.perf_counter()
+rg, build_seconds = load_or_build_relay(dg, f"{backend}_s24_ef6_seed42_block8192")
+print(f"relay layout: build_seconds={build_seconds:.1f} (incl. in wall {time.perf_counter()-t0:.1f}s with npz save)", flush=True)
+print("net_size", rg.net_size, "m1", rg.m1, "m2", rg.m2, "vr", rg.vr, "vperm", rg.vperm_size)
+print("net mask MB", rg.net_masks.nbytes/1e6, "vperm mask MB", rg.vperm_masks.nbytes/1e6)
