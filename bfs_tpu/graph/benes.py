@@ -42,6 +42,13 @@ def _register(lib: ctypes.CDLL) -> None:
         np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
         np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS"),
     ]
+    lib.benes_route_i32_v2.restype = ctypes.c_int32
+    lib.benes_route_i32_v2.argtypes = [
+        ctypes.c_int64,
+        np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS"),
+        ctypes.c_int32,
+    ]
 
 
 _LIB = NativeLib(
@@ -87,7 +94,7 @@ def route(perm: np.ndarray, *, bit_major: bool = False) -> np.ndarray:
     return masks.reshape(num_stages(n), words)
 
 
-def route_std(perm: np.ndarray) -> np.ndarray:
+def route_std(perm: np.ndarray, *, trusted: bool = False) -> np.ndarray:
     """Layout-v4 router: Beneš masks in STANDARD (word-major) packing — mask
     element ``e`` at word ``e >> 5``, bit ``e & 31`` — via the iterative int32
     native router (``benes_route_i32``).  This is the packing the v4 device
@@ -102,7 +109,7 @@ def route_std(perm: np.ndarray) -> np.ndarray:
         raise ValueError(f"network size {n} is not a power of two >= 32")
     words = n // 32
     masks = np.zeros(num_stages(n) * words, dtype=np.uint32)
-    if lib.benes_route_i32(n, perm, masks) != 0:
+    if lib.benes_route_i32_v2(n, perm, masks, int(trusted)) != 0:
         raise ValueError("perm is not a bijection")
     return masks.reshape(num_stages(n), words)
 

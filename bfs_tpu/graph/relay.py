@@ -42,10 +42,32 @@ v4 changes vs the round-2 layout (LAYOUT_VERSION 3):
 
 from __future__ import annotations
 
+import logging
+import time as _time
 from dataclasses import dataclass
 from typing import NamedTuple
 
 import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class _phase:
+    """Build-phase timer: logs at INFO (enable with BFS_TPU_BUILD_LOG=1 or
+    logging config) so the <300 s layout-build budget stays accountable."""
+
+    def __init__(self, name: str):
+        self.name = name
+
+    def __enter__(self):
+        self.t0 = _time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        logger.info(
+            "layout phase %-22s %.1fs", self.name,
+            _time.perf_counter() - self.t0,
+        )
 
 from . import benes
 from .csr import DeviceGraph, Graph, INF_DIST
@@ -196,10 +218,10 @@ def _vertex_tables(classes: list[ClassSlice], num_ids: int):
     """Per-(relabeled id / out-position) slot tables: slot(id, r) =
     base[id] + r * stride[id].  Rank-major: base = sa + p, stride = count;
     vertex-major: base = sa + p*width, stride = 1."""
-    base = np.zeros(num_ids, dtype=np.int64)
-    stride = np.ones(num_ids, dtype=np.int64)
+    base = np.zeros(num_ids, dtype=np.int32)
+    stride = np.ones(num_ids, dtype=np.int32)
     for cs in classes:
-        p = np.arange(cs.count, dtype=np.int64)
+        p = np.arange(cs.count, dtype=np.int32)
         if cs.vertex_major:
             base[cs.va : cs.vb] = cs.sa + p * cs.width
             stride[cs.va : cs.vb] = 1
@@ -301,19 +323,20 @@ def build_relay_graph(graph: Graph | DeviceGraph) -> RelayGraph:
         flat_src = graph.src.reshape(-1)
         flat_dst = graph.dst.reshape(-1)
         keep = flat_dst != graph.sentinel
-        src = flat_src[keep].astype(np.int64)
-        dst = flat_dst[keep].astype(np.int64)
+        src = flat_src[keep].astype(np.int32)
+        dst = flat_dst[keep].astype(np.int32)
         v = graph.num_vertices
     else:
-        src = graph.src.astype(np.int64)
-        dst = graph.dst.astype(np.int64)
+        src = graph.src.astype(np.int32)
+        dst = graph.dst.astype(np.int32)
         v = graph.num_vertices
     e = int(src.shape[0])
 
-    indeg = np.bincount(dst, minlength=v)
-    outdeg = np.bincount(src, minlength=v)
-    in_w = _class_width(indeg)  # zero-indeg vertices get one INF slot
-    out_w = _class_width(outdeg)
+    with _phase("degrees"):
+        indeg = np.bincount(dst, minlength=v)
+        outdeg = np.bincount(src, minlength=v)
+        in_w = _class_width(indeg)  # zero-indeg vertices get one INF slot
+        out_w = _class_width(outdeg)
 
     # ---- dst side: aligned classes over the relabeled vertex space --------
     widths, counts = np.unique(in_w, return_counts=True)
@@ -322,8 +345,8 @@ def build_relay_graph(graph: Graph | DeviceGraph) -> RelayGraph:
     m1 = in_classes[-1].sb if in_classes else 0
 
     # relabel: class-major, old-id-minor; dummies at padded class tails
-    new2old = np.full(vr, -1, dtype=np.int64)
-    old2new = np.empty(v, dtype=np.int64)
+    new2old = np.full(vr, -1, dtype=np.int32)
+    old2new = np.empty(v, dtype=np.int32)
     order = np.argsort(in_w, kind="stable")  # stable: old-id-minor
     in_map = _width_class_map(in_classes, widths)
     pos = 0
@@ -331,7 +354,7 @@ def build_relay_graph(graph: Graph | DeviceGraph) -> RelayGraph:
         cs = in_map[int(wv)]
         ids = order[pos : pos + cnt]
         new2old[cs.va : cs.va + cnt] = ids
-        old2new[ids] = cs.va + np.arange(cnt)
+        old2new[ids] = (cs.va + np.arange(cnt)).astype(np.int32)
         pos += cnt
     assert pos == v
 
@@ -341,47 +364,52 @@ def build_relay_graph(graph: Graph | DeviceGraph) -> RelayGraph:
     out_space = out_classes[-1].vb if out_classes else 0
     m2 = out_classes[-1].sb if out_classes else 0
 
-    outpos_of_old = np.empty(v, dtype=np.int64)
+    outpos_of_old = np.empty(v, dtype=np.int32)
     oorder = np.argsort(out_w, kind="stable")
     out_map = _width_class_map(out_classes, owidths)
     pos = 0
     for wv, cnt in zip(owidths.tolist(), ocounts.tolist()):
         cs = out_map[int(wv)]
         ids = oorder[pos : pos + cnt]
-        outpos_of_old[ids] = cs.va + np.arange(cnt)
+        outpos_of_old[ids] = (cs.va + np.arange(cnt)).astype(np.int32)
         pos += cnt
     assert pos == v
 
     # ---- L1 slots: edges sorted by (dst_new, src); rank = in-row position --
-    dstn = old2new[dst]
-    order1, rank1 = _sort_rank(dstn.astype(np.int32), src.astype(np.int32))
-    base1, stride1 = _vertex_tables(in_classes, vr)
-    ds = dstn[order1]
-    l1_sorted = base1[ds] + rank1.astype(np.int64) * stride1[ds]
-    src_l1 = np.full(m1, INF_DIST, dtype=np.int32)
-    src_l1[l1_sorted] = src[order1].astype(np.int32)  # ORIGINAL ids
+    with _phase("l1 slots"):
+        dstn = old2new[dst]
+        order1, rank1 = _sort_rank(dstn, src)
+        base1, stride1 = _vertex_tables(in_classes, vr)
+        ds = dstn[order1]
+        l1_sorted = base1[ds] + rank1 * stride1[ds]  # int32; slots < 2^28
+        src_l1 = np.full(m1, INF_DIST, dtype=np.int32)
+        src_l1[l1_sorted] = src[order1]  # ORIGINAL ids
 
     # ---- L2 slots: edges sorted by (src out-position, dst) -----------------
-    srcpos = outpos_of_old[src]
-    order2, rank2 = _sort_rank(srcpos.astype(np.int32), dstn.astype(np.int32))
-    base2, stride2 = _vertex_tables(out_classes, out_classes[-1].vb)
-    sp = srcpos[order2]
-    l2_sorted = base2[sp] + rank2.astype(np.int64) * stride2[sp]
+    with _phase("l2 slots"):
+        srcpos = outpos_of_old[src]
+        order2, rank2 = _sort_rank(srcpos, dstn)
+        base2, stride2 = _vertex_tables(out_classes, out_classes[-1].vb)
+        sp = srcpos[order2]
+        l2_sorted = base2[sp] + rank2 * stride2[sp]
 
     # ---- big network: L1 slot <- L2 slot -----------------------------------
     n = _pow2_at_least(max(m1, m2))
-    net = np.full(n, -1, dtype=np.int64)
-    l1_by_edge = np.empty(e, dtype=np.int64)
-    l1_by_edge[order1] = l1_sorted
-    l2_by_edge = np.empty(e, dtype=np.int64)
-    l2_by_edge[order2] = l2_sorted
-    net[l1_by_edge] = l2_by_edge
-    used = np.zeros(n, dtype=bool)
-    used[l2_by_edge] = True
-    _pad_identity(net, used, n)
-    net_masks_full = benes.route_std(net)
-    net_masks, net_table = _compact_and_table(net_masks_full, n)
-    del net_masks_full
+    with _phase("net perm assembly"):
+        net = np.full(n, -1, dtype=np.int32)
+        l1_by_edge = np.empty(e, dtype=np.int32)
+        l1_by_edge[order1] = l1_sorted
+        l2_by_edge = np.empty(e, dtype=np.int32)
+        l2_by_edge[order2] = l2_sorted
+        net[l1_by_edge] = l2_by_edge
+        used = np.zeros(n, dtype=bool)
+        used[l2_by_edge] = True
+        _pad_identity(net, used, n)
+    with _phase("net route"):
+        net_masks_full = benes.route_std(net, trusted=True)
+    with _phase("net compact"):
+        net_masks, net_table = _compact_and_table(net_masks_full, n)
+        del net_masks_full
 
     # ---- small network: vertex-space words -> out-order words --------------
     # Dummy out positions (padded rank-major class tails) must read zero:
@@ -389,7 +417,7 @@ def build_relay_graph(graph: Graph | DeviceGraph) -> RelayGraph:
     out_vb = out_classes[-1].vb
     dummies = out_vb - v
     vp = _pow2_at_least(max(vr + dummies, out_vb, 32 * 128 * 2))
-    vperm = np.full(vp, -1, dtype=np.int64)
+    vperm = np.full(vp, -1, dtype=np.int32)
     real_mask = np.zeros(out_vb, dtype=bool)
     for wv, cnt in zip(owidths.tolist(), ocounts.tolist()):
         cs = out_map[int(wv)]
@@ -398,28 +426,30 @@ def build_relay_graph(graph: Graph | DeviceGraph) -> RelayGraph:
     vperm[outpos_of_old] = old2new[np.arange(v)]
     dummy_positions = np.flatnonzero(~real_mask)
     vperm[dummy_positions] = vr + np.arange(dummy_positions.shape[0])
-    used = np.zeros(vp, dtype=bool)
-    used[vperm[vperm >= 0]] = True
-    _pad_identity(vperm, used, vp)
-    vperm_masks_full = benes.route_std(vperm)
-    vperm_masks, vperm_table = _compact_and_table(vperm_masks_full, vp)
-    del vperm_masks_full
+    with _phase("vperm route"):
+        used = np.zeros(vp, dtype=bool)
+        used[vperm[vperm >= 0]] = True
+        _pad_identity(vperm, used, vp)
+        vperm_masks_full = benes.route_std(vperm, trusted=True)
+        vperm_masks, vperm_table = _compact_and_table(vperm_masks_full, vp)
+        del vperm_masks_full
 
     # ---- sparse-path CSR over relabeled src ids ----------------------------
-    srcn = old2new[src]
-    order3, _ = _sort_rank(srcn.astype(np.int32), dstn.astype(np.int32))
-    adj_indptr = np.zeros(vr + 2, dtype=np.int64)
-    np.cumsum(np.bincount(srcn, minlength=vr), out=adj_indptr[1 : vr + 1])
-    adj_indptr[vr + 1] = adj_indptr[vr]
-    adj_dst = dstn[order3].astype(np.int32)
-    adj_slot = l1_by_edge[order3].astype(np.int32)
+    with _phase("sparse CSR"):
+        srcn = old2new[src]
+        order3, _ = _sort_rank(srcn, dstn)
+        adj_indptr = np.zeros(vr + 2, dtype=np.int64)
+        np.cumsum(np.bincount(srcn, minlength=vr), out=adj_indptr[1 : vr + 1])
+        adj_indptr[vr + 1] = adj_indptr[vr]
+        adj_dst = dstn[order3]
+        adj_slot = l1_by_edge[order3]
 
     return RelayGraph(
         num_vertices=v,
         num_edges=e,
         vr=vr,
-        new2old=new2old.astype(np.int32),
-        old2new=old2new.astype(np.int32),
+        new2old=new2old,
+        old2new=old2new,
         vperm_masks=vperm_masks,
         vperm_table=vperm_table,
         vperm_size=vp,
@@ -608,7 +638,7 @@ def build_sharded_relay_graph(
         # within each width class)
         outpos_of_old = np.full(v, -1, dtype=np.int64)
         oorder = np.argsort(uw_s, kind="stable")
-        vperm = np.full(vp, -1, dtype=np.int64)
+        vperm = np.full(vp, -1, dtype=np.int32)
         dummy_cursor = gtot
         pos = 0
         for wv in np.unique(uw_s):
@@ -628,7 +658,7 @@ def build_sharded_relay_graph(
         used = np.zeros(vp, dtype=bool)
         used[vperm[vperm >= 0]] = True
         _pad_identity(vperm, used, vp)
-        vm_full = benes.route_std(vperm)
+        vm_full = benes.route_std(vperm, trusted=True)
         vm, vt = _compact_and_table(vm_full, vp)
         del vm_full
         vperm_masks_l.append(vm)
@@ -657,7 +687,7 @@ def build_sharded_relay_graph(
         used = np.zeros(net_size, dtype=bool)
         used[l2_by_edge] = True
         _pad_identity(net, used, net_size)
-        nm_full = benes.route_std(net)
+        nm_full = benes.route_std(net, trusted=True)
         nm, nt = _compact_and_table(nm_full, net_size)
         del nm_full
         net_masks_l.append(nm)

@@ -521,7 +521,7 @@ class RelayEngine:
             self._static, pt, groups, self._use_pallas()
         )
         src_new = jnp.asarray(rg.old2new[sources].reshape(groups, 32))
-        args = (src_new, *self._tensors)
+        args = (src_new, *self._elem_tensors())
         if not self._use_pallas():
             return fused(*args, max_levels=max_levels)
         key = ("elem", groups, max_levels)
@@ -532,6 +532,39 @@ class RelayEngine:
             )
             self._compiled[key] = compiled
         return compiled(*args)
+
+    def _elem_tensors(self):
+        """Mask tensors for element-major mode: vertically-repacked per-pass
+        arrays for the fused TPU path (ops/relay_pallas.py elem mode), flat
+        arrays otherwise.  Prepared lazily once per engine."""
+        cached = getattr(self, "_elem_mask_tensors", None)
+        if cached is not None:
+            return cached
+        rg = self.relay_graph
+        if self._use_pallas():
+            from ..ops import relay_pallas as RP
+
+            def mask_arg(masks, table, size):
+                if RP.pallas_net_ok(size):
+                    return tuple(
+                        jnp.asarray(a)
+                        for a in RP.prepare_elem_pass_masks(masks, table, size)
+                    )
+                return jnp.asarray(masks)
+
+            tensors = (
+                mask_arg(rg.vperm_masks, rg.vperm_table, rg.vperm_size),
+                mask_arg(rg.net_masks, rg.net_table, rg.net_size),
+                self._tensors[2],
+            )
+        else:
+            tensors = (
+                jnp.asarray(rg.vperm_masks),
+                jnp.asarray(rg.net_masks),
+                self._tensors[2],
+            )
+        self._elem_mask_tensors = tensors
+        return tensors
 
     def run_multi_elem(self, sources, *, max_levels: int | None = None):
         """Element-major batched multi-source BFS, host results

@@ -99,6 +99,9 @@ def _stage_slice(masks_flat: jax.Array, st: StageSpec) -> jax.Array:
     return jax.lax.slice_in_dim(masks_flat, st.offset, st.offset + st.nwords)
 
 
+LANES = 128
+
+
 def apply_benes_std(
     words: jax.Array, masks_flat: jax.Array, table: tuple[StageSpec, ...],
     n: int,
@@ -107,10 +110,61 @@ def apply_benes_std(
 
     ``masks_flat``/``table`` come from the v4 layout: per-stage storage is
     either full (n/32 words; only bits/words at the lower pair index are
-    nonzero) or pair-compacted (n/64 words, d >= COMPACT_MIN_D).
-    Stage ``s`` swaps element pairs at distance ``d``: intra-word bit shifts
-    for d < 32, word-pair butterflies above.
+    nonzero) or pair-compacted (n/64 words, d >= COMPACT_MIN_D).  Stage
+    ``s`` swaps element pairs at distance ``d``: intra-word bit shifts for
+    d < 32, word-pair butterflies above.
+
+    Large networks use a roll-form on a fixed [r, 128] view: lane rolls for
+    word distances < 128, row rolls above, with pair-compacted masks
+    broadcast-expanded along the pair axis.  Every intermediate keeps a
+    128-lane trailing dim — the naive ``reshape(-1, 2, dw)`` pairing tiles
+    catastrophically on TPU for small dw (a (..,2,2) u32 reshape at net 2^26
+    materializes 19.8 GB of padding).
     """
+    nw = n // 32
+    if nw < 2 * LANES:
+        return _apply_benes_std_small(words, masks_flat, table, n)
+    r = nw // LANES
+    x = words.reshape(r, LANES)
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, LANES), 1)
+    row = jax.lax.broadcasted_iota(jnp.int32, (r, 1), 0)
+    for st in table:
+        m = _stage_slice(masks_flat, st)
+        d = st.d
+        if d < 32:
+            sh = jnp.uint32(d)
+            mv = m.reshape(r, LANES)
+            t = (x ^ (x >> sh)) & mv
+            x = x ^ t ^ (t << sh)
+            continue
+        dw = d >> 5
+        if dw < LANES:  # lane butterfly; full storage, bits at lower lanes
+            mv = m.reshape(r, LANES)
+            has = (lane & dw) != 0
+            partner = jnp.where(
+                has, jnp.roll(x, dw, axis=1), jnp.roll(x, -dw, axis=1)
+            )
+            m_both = jnp.where(has, jnp.roll(mv, dw, axis=1), mv)
+            x = x ^ ((x ^ partner) & m_both)
+        else:  # row butterfly; pair-compacted storage, broadcast-expanded
+            rw = dw // LANES
+            a = r // (2 * rw)
+            m_both = jnp.broadcast_to(
+                m.reshape(a, 1, rw, LANES), (a, 2, rw, LANES)
+            ).reshape(r, LANES)
+            has = (row & rw) != 0
+            partner = jnp.where(
+                has, jnp.roll(x, rw, axis=0), jnp.roll(x, -rw, axis=0)
+            )
+            x = x ^ ((x ^ partner) & m_both)
+    return x.reshape(-1)
+
+
+def _apply_benes_std_small(
+    words: jax.Array, masks_flat: jax.Array, table: tuple[StageSpec, ...],
+    n: int,
+) -> jax.Array:
+    """Reshape-form applier for small networks (tests / tiny graphs)."""
     x = words
     for st in table:
         m = _stage_slice(masks_flat, st)
@@ -162,6 +216,38 @@ def _ctz32(word: jax.Array) -> jax.Array:
     return jax.lax.population_count(low - 1).astype(jnp.int32)
 
 
+def _word_tournament(wv: jax.Array):
+    """Min-row-index reduce over packed word rows: wv uint32[rows, cw] ->
+    (found word row [cw], rank bit-plane word rows list low..high).
+
+    Pure word-level elementwise merges in log2(rows) rounds — the unpack-free
+    formulation that keeps the XLA rowmin at word bandwidth (the naive
+    per-bit unpack materializes 8x the class bytes and dominated the
+    round-3 superstep profile)."""
+    rows, cw = wv.shape
+    p2 = 1 << max((int(rows) - 1).bit_length(), 0)
+    if p2 != rows:
+        wv = jnp.concatenate(
+            [wv, jnp.zeros((p2 - rows, cw), jnp.uint32)], axis=0
+        )
+        rows = p2
+    f = wv
+    planes: list[jax.Array] = []
+    while rows > 1:
+        fr = f.reshape(rows // 2, 2, cw)
+        fa, fb = fr[:, 0, :], fr[:, 1, :]
+        choose_b = fb & ~fa
+        new_planes = []
+        for pl in planes:
+            pr = pl.reshape(rows // 2, 2, cw)
+            new_planes.append(pr[:, 0, :] | (pr[:, 1, :] & ~fa))
+        new_planes.append(choose_b)
+        planes = new_planes
+        f = fa | fb
+        rows //= 2
+    return f[0], [pl[0] for pl in planes]
+
+
 def rowmin_candidates(
     l1words: jax.Array, valid_words: jax.Array, in_classes, vr: int
 ) -> jax.Array:
@@ -178,14 +264,17 @@ def rowmin_candidates(
             wv = jax.lax.slice_in_dim(
                 lw, cs.sa // 32, cs.sa // 32 + cs.width * cw
             ).reshape(cs.width, cw)
-            bits = unpack_std(wv, cs.count).astype(bool)
-            r = jnp.arange(cs.width, dtype=jnp.int32)[:, None]
-            minr = jnp.min(
-                jnp.where(bits, r, INT32_MAX), axis=0
-            )
+            found_w, plane_w = _word_tournament(wv)
+            nb = len(plane_w)
+            minr = jnp.zeros(cs.count, jnp.int32)
+            for j in range(nb):
+                minr = minr | (
+                    unpack_std(plane_w[j], cs.count).astype(jnp.int32) << j
+                )
+            found = unpack_std(found_w, cs.count) != 0
             p = jnp.arange(cs.count, dtype=jnp.int32)
             cand = jnp.where(
-                minr != INT32_MAX, cs.sa + minr * cs.count + p, INT32_MAX
+                found, cs.sa + minr * cs.count + p, INT32_MAX
             )
         else:
             ww = cs.width // 32

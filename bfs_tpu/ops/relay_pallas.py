@@ -44,6 +44,11 @@ OUTER_TT = 64
 
 _warned = False
 
+#: Tail-range DMA/compute guards (static per stage, dynamic per tile).  At
+#: m1 ~ 0.94n the skippable ranges are tiny while the conditional DMAs can
+#: cost pipeline overlap — BFS_TPU_GUARDS=0 disables them for measurement.
+_GUARDS = os.environ.get("BFS_TPU_GUARDS", "1") != "0"
+
 
 def pallas_enabled() -> bool:
     """Use the Pallas path only on real TPU backends (the CPU test platform
@@ -232,7 +237,11 @@ def _run_pass(x, arr2d, mode, tr, tt, specs, n, interpret):
             )
 
         def guard(st, pid):
+            if not _GUARDS:
+                return None
             rows = stage_rows(st)
+            if st.lo <= 0 and st.hi >= st.nwords:
+                return None  # dense stage: unconditional (keeps DMA pipeline)
             w0 = pid * rows * LANES
             return (w0 < st.hi) & (w0 + rows * LANES > st.lo)
 
@@ -319,6 +328,320 @@ def _run_pass(x, arr2d, mode, tr, tt, specs, n, interpret):
         interpret=interpret,
     )(x_view, arr2d)
     return out.reshape(-1)
+
+
+# ---------------------------------------------------------------------------
+# Element-major mode: x carries one uint32 PER ELEMENT (bit t = tree t of a
+# 32-tree batch, ops/relay_elem.py).  Stage masks are re-packed VERTICALLY
+# host-side (:func:`prepare_elem_pass_masks`): word (R, l) holds bits for
+# elements (32R + j, l) — so the in-kernel bit->select expansion is one
+# sublane broadcast plus a per-row variable shift, no lane shuffles.
+
+#: element-mode pass-B tile rows: (G, TILE_ROWS_E, 128) uint32 elements.
+TILE_ROWS_E = 1024
+OUTER_TT_E = 32
+
+
+def elem_pass_static(
+    table: tuple[StageSpec, ...], n: int,
+    tile_rows: int = TILE_ROWS_E, outer_tt: int = OUTER_TT_E,
+):
+    """Pass split for element-major mode (element rows of 128; local run is
+    d < tile_rows*128).  Mirrors :func:`prepare_elem_pass_masks`."""
+    r = n // LANES
+    tr = min(tile_rows, max(r, 1))
+    local = [i for i, st in enumerate(table) if st.d < tr * LANES]
+    assert local and local == list(
+        range(local[0], local[-1] + 1)
+    ), "local stages must be consecutive"
+    lo, hi = local[0], local[-1] + 1
+    tt = min(outer_tt, tr)
+    out = []
+
+    def seg(idx, mode):
+        specs = []
+        off = 0
+        for i in idx:
+            st = table[i]
+            nw = st.nwords
+            specs.append(st._replace(offset=off, nwords=nw, lo=0, hi=nw))
+            off += nw
+        return (mode, tr, tt, tuple(specs))
+
+    if lo > 0:
+        out.append(seg(list(range(lo)), "outer"))
+    out.append(seg(list(range(lo, hi)), "local"))
+    if hi < len(table):
+        out.append(seg(list(range(hi, len(table))), "outer"))
+    return tuple(out)
+
+
+def _vertical_repack(words: np.ndarray, nelem: int) -> np.ndarray:
+    """Standard-packed stage words -> vertical packing: output word (R, l)
+    holds bits of elements (32R + j, l), j in [0, 32)."""
+    bits = np.unpackbits(
+        words.view(np.uint8), bitorder="little"
+    ).reshape(-1, 32, LANES)
+    by = np.packbits(bits, axis=1, bitorder="little")  # (R, 4, LANES) bytes
+    return (
+        np.ascontiguousarray(by.transpose(0, 2, 1))  # word bytes contiguous
+        .view(np.uint32)
+        .reshape(-1)
+    )
+
+
+def prepare_elem_pass_masks(
+    masks_flat: np.ndarray, table: tuple[StageSpec, ...], n: int,
+    tile_rows: int = TILE_ROWS_E, outer_tt: int = OUTER_TT_E,
+):
+    """Host-side (cached by engines): per-pass vertically-packed mask arrays
+    for element-major mode.  Outer stages additionally re-chunk to
+    (tr/tt, span, tt_rows...) order so each DMA is contiguous, mirroring
+    :func:`prepare_pass_masks`."""
+    ps = elem_pass_static(table, n, tile_rows, outer_tt)
+    r = n // LANES
+    tr = min(tile_rows, max(r, 1))
+    b = r // tr
+    tt = min(outer_tt, tr)
+    # map pass-local specs back to the original global stages in order
+    arrays = []
+    gi = 0
+    for mode, _tr, _tt, specs in ps:
+        parts = []
+        for st_local in specs:
+            st = table[gi]
+            gi += 1
+            w = masks_flat[st.offset : st.offset + st.nwords]
+            wv = _vertical_repack(w, st.nwords * 32)
+            if mode == "outer":
+                # stage rows (in vertical-packed units of 32 elem rows):
+                # (span, tr/32, LANES) -> chunk-major (tr/tt, span, tt/32, L)
+                span = b // 2  # outer stages are compact
+                wv = (
+                    wv.reshape(span, tr // 32 // (tt // 32), tt // 32, LANES)
+                    .swapaxes(0, 1)
+                    .reshape(-1)
+                )
+            parts.append(wv)
+        arrays.append(
+            np.concatenate(parts).reshape(-1, LANES)
+            if parts
+            else np.zeros((0, LANES), np.uint32)
+        )
+    return arrays
+
+
+def _expand_vertical(mv, rows: int, interpret: bool):
+    """Vertically-packed mask words (rows//32, LANES) -> per-element select
+    (rows, LANES) uint32 0/~0."""
+    rep = jnp.repeat(mv, 32, axis=0)
+    ri = jax.lax.broadcasted_iota(jnp.uint32, (rows, LANES), 0) & 31
+    return jnp.uint32(0) - ((rep >> ri) & 1)
+
+
+def _elem_stage_local(x, sel_rows, st: StageSpec, interpret: bool):
+    """One butterfly stage on an element tile x: (G, tr, LANES).
+    ``sel_rows``: expanded select for the stage's stored rows."""
+    d = st.d
+    g = x.shape[0]
+    tr = x.shape[1]
+    if d < LANES:  # lane butterfly: select at lower pair lanes, roll-mirror
+        idx = jax.lax.broadcasted_iota(jnp.int32, x.shape, 2)
+        has = (idx & d) != 0
+        sel = sel_rows[None, :, :]
+        partner = jnp.where(
+            has, _kroll(x, d, 2, interpret), _kroll(x, -d, 2, interpret)
+        )
+        m_both = jnp.where(has, _kroll(sel, d, 2, interpret), sel)
+        return x ^ ((x ^ partner) & m_both)
+    rw = d // LANES  # row butterfly
+    if st.compact:
+        a = tr // (2 * rw)
+        xr = x.reshape(g, a, 2, rw, LANES)
+        lo, hi = xr[:, :, 0], xr[:, :, 1]
+        t = (lo ^ hi) & sel_rows.reshape(1, a, rw, LANES)
+        return jnp.stack([lo ^ t, hi ^ t], axis=2).reshape(x.shape)
+    a = tr // (2 * rw)
+    xr = x.reshape(g, a, 2, rw, LANES)
+    lo, hi = xr[:, :, 0], xr[:, :, 1]
+    sl = sel_rows.reshape(a, 2, rw, LANES)[:, 0]
+    t = (lo ^ hi) & sl.reshape(1, a, rw, LANES)
+    return jnp.stack([lo ^ t, hi ^ t], axis=2).reshape(x.shape)
+
+
+def _elem_stage_outer(x, sel, st: StageSpec, tr: int):
+    """Outer-block butterfly: x (G, B, tt, LANES); sel (B/2, tt, LANES)."""
+    bw = (st.d // LANES) // tr
+    bdim = x.shape[1]
+    a = bdim // (2 * bw)
+    xr = x.reshape(x.shape[0], a, 2, bw, *x.shape[2:])
+    lo, hi = xr[:, :, 0], xr[:, :, 1]
+    t = (lo ^ hi) & sel.reshape(1, a, bw, *sel.shape[1:])
+    return jnp.stack([lo ^ t, hi ^ t], axis=2).reshape(x.shape)
+
+
+def _run_elem_pass(x, arr2d, mode, tr, tt, specs, n, interpret):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    g = x.shape[0]
+    r = n // LANES
+    b = r // tr
+
+    if mode == "local":
+        grid = (r // tr,)
+        x_view = x.reshape(g, r, LANES)
+        x_spec = pl.BlockSpec((g, tr, LANES), lambda i: (0, i, 0))
+
+        def stage_mrows(st):
+            rows = tr // 2 if st.compact else tr
+            return rows // 32
+
+        def dma(m_hbm, mbuf, sem, slot, st, mrows, pid):
+            return pltpu.make_async_copy(
+                m_hbm.at[pl.ds(st.offset // LANES + pid * mrows, mrows), :],
+                mbuf.at[slot, pl.ds(0, mrows), :],
+                sem.at[slot],
+            )
+
+        def run_stage(xv, mbuf, slot, st):
+            mrows = stage_mrows(st)
+            sel = _expand_vertical(
+                mbuf[slot, pl.ds(0, mrows), :], mrows * 32, interpret
+            )
+            return _elem_stage_local(xv, sel, st, interpret)
+
+        buf_rows = tr // 32
+    else:
+        span = b // 2
+        grid = (tr // tt,)
+        x_view = x.reshape(g, b, tr, LANES)
+        x_spec = pl.BlockSpec((g, b, tt, LANES), lambda j: (0, 0, j, 0))
+
+        def stage_mrows(st):
+            return span * (tt // 32)
+
+        def dma(m_hbm, mbuf, sem, slot, st, mrows, pid):
+            return pltpu.make_async_copy(
+                m_hbm.at[pl.ds(st.offset // LANES + pid * mrows, mrows), :],
+                mbuf.at[slot],
+                sem.at[slot],
+            )
+
+        def run_stage(xv, mbuf, slot, st):
+            mrows = stage_mrows(st)
+            sel = _expand_vertical(
+                mbuf[slot].reshape(mrows, LANES), mrows * 32, interpret
+            ).reshape(span, tt, LANES)
+            return _elem_stage_outer(xv, sel, st, tr)
+
+        buf_rows = span * (tt // 32)
+
+    def kernel(x_ref, m_hbm, o_ref, mbuf, sem):
+        pid = pl.program_id(0)
+        xv = x_ref[...]
+        n_st = len(specs)
+        if n_st:
+            dma(m_hbm, mbuf, sem, 0, specs[0], stage_mrows(specs[0]),
+                pid).start()
+        for si, st in enumerate(specs):
+            if si + 1 < n_st:
+                nst = specs[si + 1]
+                dma(m_hbm, mbuf, sem, (si + 1) % 2, nst, stage_mrows(nst),
+                    pid).start()
+            dma(m_hbm, mbuf, sem, si % 2, st, stage_mrows(st), pid).wait()
+            xv = run_stage(xv, mbuf, si % 2, st)
+        o_ref[...] = xv
+
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[x_spec, pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=x_spec,
+        out_shape=jax.ShapeDtypeStruct(x_view.shape, jnp.uint32),
+        scratch_shapes=[
+            pltpu.VMEM((2, buf_rows, LANES), jnp.uint32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=interpret,
+    )(x_view, arr2d)
+    return out.reshape(g, n)
+
+
+def apply_benes_elem_fused(
+    x: jax.Array, pass_arrays, pass_static_info, n: int,
+    interpret: bool = False,
+) -> jax.Array:
+    """Element-major routed Beneš network in fused passes: x uint32[G, n]."""
+    for (mode, tr, tt, specs), arr in zip(pass_static_info, pass_arrays):
+        x = _run_elem_pass(x, arr, mode, tr, tt, specs, n, interpret)
+    return x
+
+
+def elem_superstep_tpu_factory(static, plane_offsets, pt: int):
+    """Element-major superstep for real TPUs: the two Beneš networks run as
+    fused element-major passes (x VMEM-resident, vertically-packed masks
+    streamed once per superstep FOR ALL 32*G trees); broadcast, row-min
+    tournament and the bit-sliced apply stay in XLA."""
+    (vr, vperm_size, vperm_table, out_classes, out_space, net_table,
+     net_size, in_classes) = static
+    from . import relay_elem as RE
+
+    vp_ok = pallas_net_ok(vperm_size)
+    net_ok = pallas_net_ok(net_size)
+    vp_static = elem_pass_static(vperm_table, vperm_size) if vp_ok else None
+    net_static = elem_pass_static(net_table, net_size) if net_ok else None
+
+    def superstep(st, vperm_m, net_m, valid_words):
+        g = st.frontier.shape[0]
+        fw = jnp.concatenate(
+            [st.frontier, jnp.zeros((g, vperm_size - vr), jnp.uint32)],
+            axis=1,
+        )
+        if vp_ok:
+            y = apply_benes_elem_fused(fw, vperm_m, vp_static, vperm_size)
+        else:
+            y = RE.apply_benes_elem(fw, vperm_m, vperm_table, vperm_size)
+        l2 = RE.broadcast_l2_elem(y, out_classes, net_size)
+        if net_ok:
+            l1 = apply_benes_elem_fused(l2, net_m, net_static, net_size)
+        else:
+            l1 = RE.apply_benes_elem(l2, net_m, net_table, net_size)
+        found, rp_new = RE.rowmin_elem(
+            l1, valid_words, in_classes, vr, plane_offsets, pt
+        )
+        newly = found & ~st.visited
+        visited = st.visited | newly
+        new_level = st.level + 1
+        lev = new_level.astype(jnp.uint32)
+        dist_planes = jnp.stack(
+            [
+                jnp.where(
+                    (lev >> b) & 1, st.dist_planes[b] | newly,
+                    st.dist_planes[b],
+                )
+                for b in range(RE.DIST_PLANES)
+            ]
+        )
+        rp_mask_parts = []
+        for cs in sorted(in_classes, key=lambda c: c.va):
+            _, nb = plane_offsets[cs.va]
+            if nb:
+                seg = jax.lax.slice_in_dim(newly, cs.va, cs.vb, axis=1)
+                rp_mask_parts.append(jnp.tile(seg, (1, nb)))
+        rp_mask = (
+            jnp.concatenate(rp_mask_parts, axis=1)
+            if rp_mask_parts
+            else jnp.zeros_like(st.rank_planes)
+        )
+        rank_planes = st.rank_planes | (rp_new & rp_mask)
+        return RE.ElemState(
+            visited=visited, frontier=newly, dist_planes=dist_planes,
+            rank_planes=rank_planes, level=new_level,
+            changed=(newly != 0).any(),
+        )
+
+    return superstep
 
 
 def apply_benes_fused(

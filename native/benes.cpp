@@ -412,12 +412,16 @@ extern "C" {
 // v2 entry point: int32 perm, word-major masks ("standard packing": mask
 // element e at word e>>5, bit e&31 — what bfs_tpu/ops/relay.py layout v4
 // consumes).  masks_out: uint32[(2k-1) * (n/32)] zero-initialised by the
-// caller.  Returns 0 on success, -1 on invalid input.
-int32_t benes_route_i32(int64_t n, const int32_t* perm, uint32_t* masks_out) {
+// caller.  trusted != 0 skips the bijection check (a random-access pass
+// worth ~10% of routing time at n=2^28; layout-internal perms are
+// constructed bijective by _pad_identity).  Returns 0 on success, -1 on
+// invalid input.
+int32_t benes_route_i32_v2(int64_t n, const int32_t* perm,
+                           uint32_t* masks_out, int32_t trusted) {
   if (n < 32 || (n & (n - 1)) != 0 || n > (int64_t{1} << 30)) return -1;
   int32_t k = 0;
   while ((int64_t{1} << k) < n) ++k;
-  {
+  if (!trusted) {
     std::vector<uint64_t> seen(static_cast<size_t>(n / 64 + 1), 0);
     for (int64_t j = 0; j < n; ++j) {
       const int64_t p = perm[j];
@@ -441,6 +445,10 @@ int32_t benes_route_i32(int64_t n, const int32_t* perm, uint32_t* masks_out) {
   r.cw = cw.data();
   r.run();
   return 0;
+}
+
+int32_t benes_route_i32(int64_t n, const int32_t* perm, uint32_t* masks_out) {
+  return benes_route_i32_v2(n, perm, masks_out, 0);
 }
 
 // perm: int64[n] with perm[j] = source index for output j (a bijection).

@@ -100,3 +100,37 @@ def test_apply_benes_fused_end_to_end():
         pack_std(jnp.asarray(bits)), arrays, ps, n, interpret=True
     )
     np.testing.assert_array_equal(np.asarray(unpack_std(out, n)), bits[perm])
+
+
+def test_elem_fused_passes_match_reference():
+    """Element-major fused passes (uint32 per element, vertically-packed
+    masks) route exactly perm on whole uint32 payloads, both groups."""
+    import jax.numpy as jnp
+
+    from bfs_tpu.ops.relay_elem import apply_benes_elem
+    from bfs_tpu.ops.relay_pallas import (
+        _run_elem_pass,
+        elem_pass_static,
+        prepare_elem_pass_masks,
+    )
+
+    rng = np.random.default_rng(9)
+    n = 1 << 16
+    perm = rng.permutation(n).astype(np.int64)
+    masks, table = _compact_and_table(benes.route_std(perm), n)
+    ps = elem_pass_static(table, n, tile_rows=128, outer_tt=32)
+    arrays = [
+        jnp.asarray(a)
+        for a in prepare_elem_pass_masks(masks, table, n, tile_rows=128,
+                                         outer_tt=32)
+    ]
+    assert [m[0] for m in ps] == ["outer", "local", "outer"]
+    x = rng.integers(0, 2**32, (2, n), dtype=np.uint32)
+    want = np.asarray(
+        apply_benes_elem(jnp.asarray(x), jnp.asarray(masks), table, n)
+    )
+    np.testing.assert_array_equal(want, x[:, perm])
+    got = jnp.asarray(x)
+    for (mode, tr, tt, specs), arr in zip(ps, arrays):
+        got = _run_elem_pass(got, arr, mode, tr, tt, specs, n, True)
+    np.testing.assert_array_equal(np.asarray(got), want)

@@ -1,0 +1,67 @@
+"""Element-major batched multi-source relay vs the oracle and the other
+batched modes (BreadthFirstPaths.java:114-132 semantics x BASELINE.json
+config 5)."""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bfs_tpu.graph import benes  # noqa: E402
+
+if not benes.native_available():  # pragma: no cover
+    pytest.skip("native benes router unavailable", allow_module_level=True)
+
+from bfs_tpu.graph.csr import Graph  # noqa: E402
+from bfs_tpu.models.bfs import RelayEngine  # noqa: E402
+from bfs_tpu.oracle.bfs import canonical_bfs  # noqa: E402
+
+
+def _random_graph(seed, v=1500, ne=5000):
+    rng = np.random.default_rng(seed)
+    u = rng.integers(0, v, ne)
+    w = rng.integers(0, v, ne)
+    keep = u != w
+    u, w = u[keep], w[keep]
+    return Graph(v, np.concatenate([u, w]), np.concatenate([w, u])), rng
+
+
+def test_elem_64_sources_match_oracle():
+    g, rng = _random_graph(21, v=2500, ne=7000)
+    eng = RelayEngine(g)
+    sources = rng.choice(g.num_vertices, size=64, replace=False).astype(np.int32)
+    mr = eng.run_multi_elem(sources)
+    assert mr.dist.shape == (64, g.num_vertices)
+    for i in (0, 1, 17, 31, 32, 40, 63):  # both uint32 groups
+        od, op = canonical_bfs(g, int(sources[i]))
+        np.testing.assert_array_equal(mr.dist[i], od)
+        np.testing.assert_array_equal(mr.parent[i], op)
+
+
+def test_elem_matches_vmapped_mode_bitexact():
+    g, rng = _random_graph(33)
+    eng = RelayEngine(g)
+    sources = rng.choice(g.num_vertices, size=32, replace=False).astype(np.int32)
+    a = eng.run_multi_elem(sources)
+    b = eng.run_multi(sources)
+    np.testing.assert_array_equal(a.dist, b.dist)
+    np.testing.assert_array_equal(a.parent, b.parent)
+
+
+def test_elem_repeated_and_batch_rules():
+    g, rng = _random_graph(44)
+    eng = RelayEngine(g)
+    with pytest.raises(ValueError):
+        eng.run_multi_elem([1, 2, 3])  # not a multiple of 32
+    sources = np.array([7] * 16 + [11] * 16, dtype=np.int32)  # duplicates OK
+    mr = eng.run_multi_elem(sources)
+    od7, _ = canonical_bfs(g, 7)
+    od11, _ = canonical_bfs(g, 11)
+    np.testing.assert_array_equal(mr.dist[0], od7)
+    np.testing.assert_array_equal(mr.dist[15], od7)
+    np.testing.assert_array_equal(mr.dist[16], od11)

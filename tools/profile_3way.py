@@ -1,0 +1,46 @@
+import os, sys, time, importlib.util
+sys.path.insert(0, "/root/repo")
+os.environ["BFS_TPU_PALLAS"] = "1"
+import jax, jax.numpy as jnp, numpy as np
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.bench_cache/xla")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+from bfs_tpu.ops import relay_pallas as RP
+from bfs_tpu.ops import relay as R
+from bfs_tpu.bench import load_or_build, load_or_build_relay
+OPTS={"xla_tpu_scoped_vmem_limit_kib": "65536"}
+K=16
+dg, _ = load_or_build(20, 16, 42, 8192, "native")
+rg, _ = load_or_build_relay(dg, "native_s20_ef16_seed42_block8192")
+net_static = RP.pass_static(rg.net_table, rg.net_size)
+arrays = [jnp.asarray(a) for a in RP.prepare_pass_masks(rg.net_masks, rg.net_table, rg.net_size)]
+masks = jnp.asarray(rg.net_masks)
+x0 = jnp.zeros(rg.net_size // 32, jnp.uint32)
+
+spec = importlib.util.spec_from_file_location("benes_pallas_r2", "/tmp/benes_pallas_r2.py")
+m2 = importlib.util.module_from_spec(spec); m2.__package__ = "bfs_tpu.ops"
+sys.modules["benes_pallas_r2"] = m2; spec.loader.exec_module(m2)
+z3 = np.load("/root/repo/.bench_cache/relay_v3_native_s20_ef16_seed42_block8192.npz")
+m3 = jnp.asarray(z3["net_masks"]); n3 = int(z3["net_size"])
+x3 = jnp.zeros(n3 // 32, jnp.uint32)
+
+def compile_k(fn, args):
+    c = jax.jit(fn).lower(*args).compile(compiler_options=OPTS)
+    r = c(*args); _ = np.asarray(jax.device_get(r)).ravel()[0]
+    return c
+def k_mine(x, *m):
+    def b(i, x): return RP.apply_benes_fused(x, m, net_static, rg.net_size) ^ (x & 1)
+    return jax.lax.fori_loop(0, K, b, x)
+def k_r2(x, m):
+    def b(i, x): return m2.apply_benes_fused(x, m, n=n3) ^ (x & 1)
+    return jax.lax.fori_loop(0, K, b, x)
+def k_xla(x, m):
+    def b(i, x): return R.apply_benes_std(x, m, rg.net_table, rg.net_size) ^ (x & 1)
+    return jax.lax.fori_loop(0, K, b, x)
+c_m = compile_k(k_mine, (x0, *arrays))
+c_r = compile_k(k_r2, (x3, m3))
+c_x = compile_k(k_xla, (x0, masks))
+def t_of(c, args):
+    t0=time.perf_counter(); r=c(*args); _=np.asarray(jax.device_get(r)).ravel()[0]
+    return (time.perf_counter()-t0-0.11)/K*1000
+for rnd in range(5):
+    print(f"round {rnd}: mine {t_of(c_m,(x0,*arrays)):6.1f} ms | r2 {t_of(c_r,(x3,m3)):6.1f} ms | xla {t_of(c_x,(x0,masks)):6.1f} ms", flush=True)

@@ -1,0 +1,42 @@
+import os, sys, time
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp, numpy as np
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.bench_cache/xla")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+from bfs_tpu.ops import relay_pallas as RP
+from bfs_tpu.bench import load_or_build, load_or_build_relay
+
+LANES=128; OPTS={"xla_tpu_scoped_vmem_limit_kib": "65536"}
+dg, _ = load_or_build(20, 16, 42, 8192, "native")
+rg, _ = load_or_build_relay(dg, "native_s20_ef16_seed42_block8192")
+K=16
+net_static = RP.pass_static(rg.net_table, rg.net_size)
+arrays = [jnp.asarray(a) for a in RP.prepare_pass_masks(rg.net_masks, rg.net_table, rg.net_size)]
+x0 = jnp.zeros(rg.net_size // 32, jnp.uint32)
+def k_mine(x, *m):
+    def body(i, x):
+        return RP.apply_benes_fused(x, m, net_static, rg.net_size) ^ (x & 1)
+    return jax.lax.fori_loop(0, K, body, x)
+c_mine = jax.jit(k_mine).lower(x0, *arrays).compile(compiler_options=OPTS)
+
+big = jnp.asarray(np.random.default_rng(1).integers(0,2**32,(1<<27,),dtype=np.uint32))  # 512MB
+@jax.jit
+def k_xla(x, s):
+    def body(i, acc):
+        return acc ^ (x + acc).sum(dtype=jnp.uint32)
+    return jax.lax.fori_loop(0, 8, body, s)
+c_xla = jax.jit(k_xla).lower(big, jnp.uint32(0)).compile(compiler_options=OPTS)
+
+def t_mine():
+    t0=time.perf_counter(); r=c_mine(x0, *arrays); _=np.asarray(jax.device_get(r)).ravel()[0]
+    return (time.perf_counter()-t0-0.11)/K
+def t_xla():
+    t0=time.perf_counter(); r=c_xla(big, jnp.uint32(3)); _=np.asarray(jax.device_get(r))
+    return (time.perf_counter()-t0-0.11)/8
+# warm
+t_mine(); t_xla()
+for rnd in range(6):
+    a=t_mine(); b=t_xla()
+    print(f"round {rnd}: net-kernel {a*1000:6.1f} ms ({rg.net_masks.nbytes/a/1e9:4.0f} GB/s) | xla-read {b*1000:6.1f} ms ({0.537/b:4.0f} GB/s)", flush=True)

@@ -1,0 +1,37 @@
+import os, sys, time
+sys.path.insert(0, "/root/repo"); sys.path.insert(0, "/tmp")
+import importlib.util
+import jax, jax.numpy as jnp, numpy as np
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.bench_cache/xla")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+
+# load round-2 kernel module standalone (it imports bfs_tpu.graph.benes for stage math)
+spec = importlib.util.spec_from_file_location("benes_pallas_r2", "/tmp/benes_pallas_r2.py")
+m2 = importlib.util.module_from_spec(spec)
+import types
+# fake package context for its relative import
+m2.__package__ = "bfs_tpu.ops"
+sys.modules["benes_pallas_r2"] = m2
+os.environ["BFS_TPU_PALLAS"] = "1"
+spec.loader.exec_module(m2)
+
+z = np.load("/root/repo/.bench_cache/relay_v3_native_s20_ef16_seed42_block8192.npz")
+net_masks = z["net_masks"]; net_size = int(z["net_size"])
+print("v3 s20 net", net_size, net_masks.shape, net_masks.nbytes/1e6, "MB")
+masks = jnp.asarray(net_masks)
+x0 = jnp.zeros(net_size // 32, jnp.uint32)
+K = 16
+OPTS = {"xla_tpu_scoped_vmem_limit_kib": "65536"}
+def k(x, m):
+    def body(i, x):
+        return m2.apply_benes_fused(x, m, n=net_size) ^ (x & 1)
+    return jax.lax.fori_loop(0, K, body, x)
+f = jax.jit(k)
+c = f.lower(x0, masks).compile(compiler_options=OPTS)
+r = c(x0, masks); _ = np.asarray(jax.device_get(r)).ravel()[0]
+best=1e9
+for _ in range(8):
+    t0=time.perf_counter(); r=c(x0,masks); _=np.asarray(jax.device_get(r)).ravel()[0]
+    best=min(best,time.perf_counter()-t0)
+t=(best-0.11)/K
+print(f"ROUND-2 kernel full net: {t*1000:.2f} ms/iter -> {net_masks.nbytes/t/1e9:.0f} GB/s")

@@ -1,0 +1,37 @@
+import os, sys, time
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp, numpy as np
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.bench_cache/xla")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+from bfs_tpu.bench import load_or_build, load_or_build_relay
+from bfs_tpu.ops import relay_pallas as RP
+
+dg, _ = load_or_build(20, 16, 42, 8192, "native")
+rg, _ = load_or_build_relay(dg, "native_s20_ef16_seed42_block8192")
+K = 16
+OPTS = {"xla_tpu_scoped_vmem_limit_kib": "65536"}
+net_static = RP.pass_static(rg.net_table, rg.net_size)
+arrays = [jnp.asarray(a) for a in RP.prepare_pass_masks(rg.net_masks, rg.net_table, rg.net_size)]
+x0 = jnp.zeros(rg.net_size // 32, jnp.uint32)
+
+def k_full(x, *m):
+    def body(i, x):
+        return RP.apply_benes_fused(x, m, net_static, rg.net_size) ^ (x & 1)
+    return jax.lax.fori_loop(0, K, body, x)
+f = jax.jit(k_full)
+c = f.lower(x0, *arrays).compile(compiler_options=OPTS)
+r = c(x0, *arrays); _ = np.asarray(jax.device_get(r)).ravel()[0]
+ts=[]
+for i in range(10):
+    t0=time.perf_counter(); r=c(x0, *arrays); _ = np.asarray(jax.device_get(r)).ravel()[0]
+    ts.append(time.perf_counter()-t0)
+print("full-net K=16 raw times:", [f"{t:.3f}" for t in ts])
+# trivial program latency right now
+@jax.jit
+def triv(x): return x + 1
+t_ = triv(jnp.zeros(8)); _ = np.asarray(jax.device_get(t_))[0]
+ts2=[]
+for i in range(5):
+    t0=time.perf_counter(); t_=triv(jnp.zeros(8)); _=np.asarray(jax.device_get(t_))[0]
+    ts2.append(time.perf_counter()-t0)
+print("trivial roundtrip:", [f"{t:.3f}" for t in ts2])

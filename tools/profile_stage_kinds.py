@@ -1,0 +1,51 @@
+import os, sys, time
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp, numpy as np
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.bench_cache/xla")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+from bfs_tpu.bench import load_or_build, load_or_build_relay
+from bfs_tpu.ops import relay_pallas as RP
+
+dg, _ = load_or_build(20, 16, 42, 8192, "native")
+rg, _ = load_or_build_relay(dg, "native_s20_ef16_seed42_block8192")
+K = 16
+OPTS = {"xla_tpu_scoped_vmem_limit_kib": "65536"}
+net_static = RP.pass_static(rg.net_table, rg.net_size)
+arrays = [jnp.asarray(a) for a in RP.prepare_pass_masks(rg.net_masks, rg.net_table, rg.net_size)]
+x0 = jnp.zeros(rg.net_size // 32, jnp.uint32)
+
+def bench(fn, args, label, nbytes):
+    f = jax.jit(fn)
+    c = f.lower(*args).compile(compiler_options=OPTS)
+    r = c(*args); _ = np.asarray(jax.device_get(r)).ravel()[0]
+    ts=[]
+    for _ in range(3):
+        t0=time.perf_counter(); r=c(*args); _ = np.asarray(jax.device_get(r)).ravel()[0]
+        ts.append(time.perf_counter()-t0)
+    t=(min(ts)-0.107)/K
+    print(f"{label:36s}: {t*1000:7.2f} ms/iter ({nbytes/t/1e9:5.0f} GB/s)", flush=True)
+
+# local pass subsets by stage kind
+mode, tr, tt, specs = net_static[1]
+arr = arrays[1]
+kinds = {
+    "word (d<32)": [s for s in specs if s.d < 32],
+    "lane (32<=d<4096)": [s for s in specs if 32 <= s.d < 4096],
+    "row-compact (d>=4096)": [s for s in specs if s.d >= 4096],
+}
+for label, sub in kinds.items():
+    sub = tuple(sub)
+    nbytes = sum(s.nwords for s in sub) * 4
+    def k(x, m, sub=sub):
+        def body(i, x):
+            return RP._run_pass(x, m, "local", tr, tt, sub, rg.net_size, False) ^ (x & 1)
+        return jax.lax.fori_loop(0, K, body, x)
+    bench(k, (x0, arr), f"local {label} x{len(sub)}", nbytes)
+
+# DMA-only: stages with compute replaced? approximate: single word stage repeated
+one = tuple([s for s in specs if s.d < 32][:1]) * 9
+def k1(x, m):
+    def body(i, x):
+        return RP._run_pass(x, m, "local", tr, tt, one, rg.net_size, False) ^ (x & 1)
+    return jax.lax.fori_loop(0, K, body, x)
+bench(k1, (x0, arr), "local 9x same word stage", sum(s.nwords for s in one)*4)

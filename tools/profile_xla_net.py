@@ -1,0 +1,23 @@
+import os, sys, time
+sys.path.insert(0, "/root/repo")
+import jax, jax.numpy as jnp, numpy as np
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.bench_cache/xla")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+from bfs_tpu.ops import relay as R
+from bfs_tpu.bench import load_or_build, load_or_build_relay
+OPTS={"xla_tpu_scoped_vmem_limit_kib": "65536"}
+dg, _ = load_or_build(20, 16, 42, 8192, "native")
+rg, _ = load_or_build_relay(dg, "native_s20_ef16_seed42_block8192")
+K=16
+masks = jnp.asarray(rg.net_masks)
+x0 = jnp.zeros(rg.net_size // 32, jnp.uint32)
+def k(x, m):
+    def body(i, x):
+        return R.apply_benes_std(x, m, rg.net_table, rg.net_size) ^ (x & 1)
+    return jax.lax.fori_loop(0, K, body, x)
+c = jax.jit(k).lower(x0, masks).compile(compiler_options=OPTS)
+r=c(x0,masks); _=np.asarray(jax.device_get(r)).ravel()[0]
+for _ in range(6):
+    t0=time.perf_counter(); r=c(x0,masks); _=np.asarray(jax.device_get(r)).ravel()[0]
+    t=(time.perf_counter()-t0-0.11)/K
+    print(f"XLA per-stage net: {t*1000:6.2f} ms/iter ({rg.net_masks.nbytes/t/1e9:4.0f} GB/s)", flush=True)
