@@ -49,6 +49,11 @@ LARGEG_V, LARGEG_E = 1_000_000, 7_586_063  # paper §1.5 / service.properties:9
 #: soc-Pokec's exact shape (SNAP): BASELINE.json config 4, synthesized with
 #: R-MAT skew and shipped through the SNAP text format end-to-end.
 POKEC_V, POKEC_E = 1_632_803, 30_622_564
+#: soc-LiveJournal1's exact shape (SNAP): the second BASELINE.json config-4
+#: graph (4.8M V / 69M directed E).  Zero-egress environment: synthesized at
+#: the exact vertex/edge counts with R-MAT degree skew (same stand-in
+#: methodology as the Pokec row; provenance documented in BENCHMARKS.md).
+LJ_V, LJ_E = 4_847_571, 68_993_773
 
 #: Reference Table 7 (docs/BigData_Project.pdf §1.5), normalized to seconds;
 #: None = OOM.  Keyed (dataset, column) for the side-by-side report.
@@ -147,6 +152,39 @@ def _load_dataset(name: str, scale: int):
 
         (dg, source) = _cached(f"pokec_snap_v{POKEC_V}_e{POKEC_E}_seed4", unpack, build)
         return None, dg, source, f"soc-Pokec-shape SNAP ({POKEC_V} V)"
+    if name == "livejournal":
+        def unpack(z):
+            return (
+                DeviceGraph(
+                    num_vertices=int(z["num_vertices"]),
+                    num_edges=int(z["num_edges"]),
+                    src=z["src"],
+                    dst=z["dst"],
+                ),
+                int(z["source"]),
+            )
+
+        def build():
+            from .graph.generators import snap_shape_edges
+
+            pairs = snap_shape_edges(LJ_V, LJ_E, seed=11)
+            from .graph.csr import Graph
+
+            g = Graph(
+                LJ_V,
+                np.concatenate([pairs[:, 0], pairs[:, 1]]),
+                np.concatenate([pairs[:, 1], pairs[:, 0]]),
+            )
+            dg = build_device_graph(g, block=8 * 1024)
+            degrees = np.bincount(g.src, minlength=g.num_vertices)
+            source = int(np.argmax(degrees))
+            return (dg, source), dict(
+                num_vertices=dg.num_vertices, num_edges=dg.num_edges,
+                src=dg.src, dst=dg.dst, source=source,
+            )
+
+        (dg, source) = _cached(f"lj_snapshape_v{LJ_V}_e{LJ_E}_seed11", unpack, build)
+        return None, dg, source, f"soc-LiveJournal1-shape ({LJ_V} V)"
     if name == "rmat":
         backend = _generator_backend()
         dg, source = load_or_build(scale, 16, 42, 8 * 1024, backend)
@@ -388,7 +426,19 @@ def run_cell(spec: dict) -> dict:
         from .models.multisource import bfs_multi_device
 
         key = _graph_key(dataset, scale)
-        if engine == "relay":
+        if engine == "elem":
+            # element-major batched relay: ALL 64 sources in one program,
+            # 32 trees per uint32 element (no chunking; VERDICT r2 item 2)
+            from .bench import load_or_build_relay
+            from .models.bfs import RelayEngine
+
+            rg, _ = load_or_build_relay(dg, key)
+            eng = RelayEngine(rg)
+            chunk = num_sources  # single batch
+            chunks = [sources]
+            run_dev = lambda c: eng.run_multi_elem_device(c)  # noqa: E731
+            run_host = lambda c: eng.run_multi_elem(c)  # noqa: E731
+        elif engine == "relay":
             from .bench import load_or_build_relay
             from .models.bfs import RelayEngine
 
@@ -509,7 +559,7 @@ def _cell_str(r: dict) -> str:
 def main(argv=None):
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--cell", help="JSON cell spec (child-process mode)")
-    ap.add_argument("--datasets", default="tinyCG,randomG,largeG,pokec,rmat")
+    ap.add_argument("--datasets", default="tinyCG,randomG,largeG,pokec,livejournal,rmat")
     ap.add_argument("--repeats", type=int, default=3)
     ap.add_argument("--timeout", type=int, default=3600)
     ap.add_argument("--skip-multi", action="store_true")
@@ -565,7 +615,7 @@ def main(argv=None):
         for n in SHARD_COUNTS:
             cell(ds, f"sharded-relay-{n}", virtual=max(SHARD_COUNTS))
     if not args.skip_multi and "rmat" in datasets:
-        for engine in ("pull", "relay"):
+        for engine in ("pull", "relay", "elem"):
             cell("rmat", f"multi-{engine}", num_sources=64)
 
     if prior:
