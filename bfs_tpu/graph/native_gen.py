@@ -37,6 +37,12 @@ def _register(lib: ctypes.CDLL) -> None:
     lib.sort_edges_by_dst.argtypes = [ctypes.c_int64, _I32, _I32]
     lib.sort_rank_pairs.restype = None
     lib.sort_rank_pairs.argtypes = [ctypes.c_int64, _I32, _I32, _I32, _I32]
+    lib.gather_i32.restype = None
+    lib.gather_i32.argtypes = [ctypes.c_int64, _I32, _I32, _I32]
+    lib.scatter_i32.restype = None
+    lib.scatter_i32.argtypes = [ctypes.c_int64, _I32, _I32, _I32]
+    lib.slot_assign_i32.restype = None
+    lib.slot_assign_i32.argtypes = [ctypes.c_int64, _I32, _I32, _I32, _I32, _I32]
     lib.sedgewick_header.restype = ctypes.c_int64
     lib.sedgewick_header.argtypes = [ctypes.c_char_p, _I64, _I64]
     lib.sedgewick_edges.restype = ctypes.c_int64
@@ -96,6 +102,40 @@ def sort_rank_pairs_native(
     rank = np.empty(n, dtype=np.int32)
     lib.sort_rank_pairs(n, key_hi, key_lo, order, rank)
     return order, rank
+
+
+def gather_i32_native(table: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    lib = _LIB.load()
+    if lib is None:
+        raise RuntimeError("native graph_gen unavailable")
+    table = np.ascontiguousarray(table, dtype=np.int32)
+    idx = np.ascontiguousarray(idx, dtype=np.int32)
+    out = np.empty(idx.shape[0], dtype=np.int32)
+    lib.gather_i32(idx.shape[0], table, idx, out)
+    return out
+
+
+def scatter_i32_native(out: np.ndarray, idx: np.ndarray, val: np.ndarray) -> None:
+    lib = _LIB.load()
+    if lib is None:
+        raise RuntimeError("native graph_gen unavailable")
+    idx = np.ascontiguousarray(idx, dtype=np.int32)
+    val = np.ascontiguousarray(val, dtype=np.int32)
+    assert out.dtype == np.int32 and out.flags.c_contiguous
+    lib.scatter_i32(idx.shape[0], idx, val, out)
+
+
+def slot_assign_native(base, stride, idx, rank) -> np.ndarray:
+    lib = _LIB.load()
+    if lib is None:
+        raise RuntimeError("native graph_gen unavailable")
+    base = np.ascontiguousarray(base, dtype=np.int32)
+    stride = np.ascontiguousarray(stride, dtype=np.int32)
+    idx = np.ascontiguousarray(idx, dtype=np.int32)
+    rank = np.ascontiguousarray(rank, dtype=np.int32)
+    out = np.empty(idx.shape[0], dtype=np.int32)
+    lib.slot_assign_i32(idx.shape[0], base, stride, idx, rank, out)
+    return out
 
 
 def sort_edges_by_dst_native(

@@ -194,6 +194,42 @@ def _build_classes(widths: np.ndarray, counts: np.ndarray) -> list[ClassSlice]:
     return slices
 
 
+def _gather(table: np.ndarray, idx: np.ndarray) -> np.ndarray:
+    """int32 gather with the native fast path (numpy fancy indexing is the
+    layout build's second-biggest cost after routing on the 1-core VM)."""
+    try:
+        from .native_gen import gather_i32_native, native_available
+
+        if native_available() and table.dtype == np.int32:
+            return gather_i32_native(table, idx)
+    except Exception:
+        pass
+    return table[idx]
+
+
+def _scatter(out: np.ndarray, idx: np.ndarray, val: np.ndarray) -> None:
+    try:
+        from .native_gen import native_available, scatter_i32_native
+
+        if native_available() and out.dtype == np.int32:
+            scatter_i32_native(out, idx, val)
+            return
+    except Exception:
+        pass
+    out[idx] = val
+
+
+def _slot_assign(base, stride, idx, rank) -> np.ndarray:
+    try:
+        from .native_gen import native_available, slot_assign_native
+
+        if native_available():
+            return slot_assign_native(base, stride, idx, rank)
+    except Exception:
+        pass
+    return base[idx] + rank * stride[idx]
+
+
 def _sort_rank(key_hi: np.ndarray, key_lo: np.ndarray):
     """(order, rank-within-hi-runs) sorted by (key_hi, key_lo) — native radix
     when available, np.lexsort fallback."""
@@ -377,31 +413,31 @@ def build_relay_graph(graph: Graph | DeviceGraph) -> RelayGraph:
 
     # ---- L1 slots: edges sorted by (dst_new, src); rank = in-row position --
     with _phase("l1 slots"):
-        dstn = old2new[dst]
+        dstn = _gather(old2new, dst)
         order1, rank1 = _sort_rank(dstn, src)
         base1, stride1 = _vertex_tables(in_classes, vr)
-        ds = dstn[order1]
-        l1_sorted = base1[ds] + rank1 * stride1[ds]  # int32; slots < 2^28
+        ds = _gather(dstn, order1)
+        l1_sorted = _slot_assign(base1, stride1, ds, rank1)  # slots < 2^28
         src_l1 = np.full(m1, INF_DIST, dtype=np.int32)
-        src_l1[l1_sorted] = src[order1]  # ORIGINAL ids
+        _scatter(src_l1, l1_sorted, _gather(src, order1))  # ORIGINAL ids
 
     # ---- L2 slots: edges sorted by (src out-position, dst) -----------------
     with _phase("l2 slots"):
-        srcpos = outpos_of_old[src]
+        srcpos = _gather(outpos_of_old, src)
         order2, rank2 = _sort_rank(srcpos, dstn)
         base2, stride2 = _vertex_tables(out_classes, out_classes[-1].vb)
-        sp = srcpos[order2]
-        l2_sorted = base2[sp] + rank2 * stride2[sp]
+        sp = _gather(srcpos, order2)
+        l2_sorted = _slot_assign(base2, stride2, sp, rank2)
 
     # ---- big network: L1 slot <- L2 slot -----------------------------------
     n = _pow2_at_least(max(m1, m2))
     with _phase("net perm assembly"):
         net = np.full(n, -1, dtype=np.int32)
         l1_by_edge = np.empty(e, dtype=np.int32)
-        l1_by_edge[order1] = l1_sorted
+        _scatter(l1_by_edge, order1, l1_sorted)
         l2_by_edge = np.empty(e, dtype=np.int32)
-        l2_by_edge[order2] = l2_sorted
-        net[l1_by_edge] = l2_by_edge
+        _scatter(l2_by_edge, order2, l2_sorted)
+        _scatter(net, l1_by_edge, l2_by_edge)
         used = np.zeros(n, dtype=bool)
         used[l2_by_edge] = True
         _pad_identity(net, used, n)
@@ -436,13 +472,13 @@ def build_relay_graph(graph: Graph | DeviceGraph) -> RelayGraph:
 
     # ---- sparse-path CSR over relabeled src ids ----------------------------
     with _phase("sparse CSR"):
-        srcn = old2new[src]
+        srcn = _gather(old2new, src)
         order3, _ = _sort_rank(srcn, dstn)
         adj_indptr = np.zeros(vr + 2, dtype=np.int64)
         np.cumsum(np.bincount(srcn, minlength=vr), out=adj_indptr[1 : vr + 1])
         adj_indptr[vr + 1] = adj_indptr[vr]
-        adj_dst = dstn[order3]
-        adj_slot = l1_by_edge[order3]
+        adj_dst = _gather(dstn, order3)
+        adj_slot = _gather(l1_by_edge, order3)
 
     return RelayGraph(
         num_vertices=v,
