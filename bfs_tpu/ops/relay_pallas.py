@@ -337,9 +337,13 @@ def _run_pass(x, arr2d, mode, tr, tt, specs, n, interpret):
 # elements (32R + j, l) — so the in-kernel bit->select expansion is one
 # sublane broadcast plus a per-row variable shift, no lane shuffles.
 
-#: element-mode pass-B tile rows: (G, TILE_ROWS_E, 128) uint32 elements.
-TILE_ROWS_E = 1024
-OUTER_TT_E = 32
+#: element-mode pass-B tile rows: (1, TILE_ROWS_E, 128) uint32 elements —
+#: 4 MB in + 4 MB out under the raised 64 MB scoped-vmem budget.  Element
+#: rows are 32x more numerous than word rows, so tree GROUPS run through
+#: the passes sequentially (G=1 per pallas call); at net 2^28 this keeps
+#: the outer span at 256 blocks instead of the 2048 that OOMed VMEM.
+TILE_ROWS_E = 8192
+OUTER_TT_E = 64
 
 
 def elem_pass_static(
@@ -598,13 +602,23 @@ def elem_superstep_tpu_factory(static, plane_offsets, pt: int):
             [st.frontier, jnp.zeros((g, vperm_size - vr), jnp.uint32)],
             axis=1,
         )
-        if vp_ok:
-            y = apply_benes_elem_fused(fw, vperm_m, vp_static, vperm_size)
+        if vp_ok:  # groups run sequentially: element tiles are VMEM-hungry
+            y = jnp.concatenate([
+                apply_benes_elem_fused(
+                    fw[gi : gi + 1], vperm_m, vp_static, vperm_size
+                )
+                for gi in range(g)
+            ])
         else:
             y = RE.apply_benes_elem(fw, vperm_m, vperm_table, vperm_size)
         l2 = RE.broadcast_l2_elem(y, out_classes, net_size)
         if net_ok:
-            l1 = apply_benes_elem_fused(l2, net_m, net_static, net_size)
+            l1 = jnp.concatenate([
+                apply_benes_elem_fused(
+                    l2[gi : gi + 1], net_m, net_static, net_size
+                )
+                for gi in range(g)
+            ])
         else:
             l1 = RE.apply_benes_elem(l2, net_m, net_table, net_size)
         found, rp_new = RE.rowmin_elem(
