@@ -410,7 +410,7 @@ class RelayEngine:
     #: 4-8 MB) then blow the 16 MB default limit at compile time.  The TPU
     #: flag cannot go through XLA_FLAGS (the local CPU XLA aborts on unknown
     #: flags), so fused programs are AOT-compiled with per-compile options.
-    _COMPILER_OPTIONS = {"xla_tpu_scoped_vmem_limit_kib": "65536"}
+    _COMPILER_OPTIONS = {"xla_tpu_scoped_vmem_limit_kib": "98304"}
 
     def _fused(self, source_new, max_levels):
         fused = _relay_fused_program(
