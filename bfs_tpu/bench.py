@@ -20,18 +20,27 @@ TEPS convention (Graph500-honest): the numerator is the number of INPUT
 undirected edges inside the traversed component — all roots are drawn from
 one component, so every search traverses the same edge set.
 
-Every run is verified: BENCH_CHECK_ROOTS results (default 2) must pass the
-ported algs4 ``check()`` optimality invariants (BreadthFirstPaths.java:
-172-221), and all roots must reach exactly the component.  BENCH_CHECK=0
-skips.
+Every run is verified: BENCH_CHECK_ROOTS results (default: ALL roots) must
+pass the ported algs4 ``check()`` optimality invariants
+(BreadthFirstPaths.java:172-221), and all roots must reach exactly the
+component.  BENCH_CHECK=0 skips.
+
+The run is self-diagnosing (VERDICT round 3): the relay engine times BOTH
+Beneš appliers on the real mask arrays at init and keeps the faster
+(``applier`` + ``applier_probe`` in details, incl. mask-stream and
+dense-read bandwidths measured THIS run), and a stepped pass decomposes one
+search into per-superstep times with the dense/sparse path decision
+(``superstep_profile``).
 
 Env knobs: BENCH_SCALE (default 24), BENCH_EDGE_FACTOR (default 6 — exactly
 the BASELINE.json "100M-edge R-MAT scale-24" config), BENCH_ROOTS (8),
 BENCH_REPEATS (3), BENCH_ENGINE (relay|pull|push), BENCH_CHECK (1),
-BENCH_CHECK_ROOTS (2), BENCH_PROFILE (path — jax.profiler trace of one
-timed batch), BENCH_SOURCES (>1 runs the BASELINE.json config-5 batched
-multi-source benchmark reporting AGGREGATE TEPS), BENCH_SPARSE (1 — the
-hybrid small-frontier path inside the fused loop).
+BENCH_CHECK_ROOTS (default = BENCH_ROOTS), BENCH_APPLIER
+(auto|pallas|xla, default auto — the measured probe), BENCH_STEP_PROFILE
+(1), BENCH_PROFILE (path — jax.profiler trace of one timed batch),
+BENCH_SOURCES (>1 runs the BASELINE.json config-5 batched multi-source
+benchmark reporting AGGREGATE TEPS), BENCH_SPARSE (1 — the hybrid
+small-frontier path inside the fused loop).
 """
 
 from __future__ import annotations
@@ -289,12 +298,67 @@ def _component_and_numerator(result, dg):
     return reached_mask, directed
 
 
-def _multi_source_bench(rg, eng, dg, source, *, num_sources, chunk, do_check):
+def _superstep_profile(eng, source, *, max_steps: int = 64):
+    """Stepped decomposition of one search: per-superstep wall time and the
+    dense/sparse path decision, using EXACTLY the fused loop's body
+    (RelayEngine.step_hybrid).  Each entry's time includes one device sync;
+    the measured empty round-trip is reported as ``sync_overhead_seconds``
+    so the reader can subtract it."""
+    from .models.bfs import SPARSE_BE, SPARSE_BV
+
+    tiny = jnp.zeros(8, jnp.uint32)
+    sync_fn = jax.jit(lambda a: a + 1)
+    _ = np.asarray(jax.device_get(sync_fn(tiny)))[0]  # warm
+
+    def _t_sync():
+        t0 = time.perf_counter()
+        _ = np.asarray(jax.device_get(sync_fn(tiny)))[0]
+        return time.perf_counter() - t0
+
+    t_sync = min(_t_sync() for _ in range(3))
+
+    state = eng.init_state(source)
+    st = eng.step_hybrid(state)  # compile + warm
+    _ = int(st.level)
+    state = eng.init_state(source)
+    prof = []
+    while bool(state.changed) and len(prof) < max_steps:
+        fsize, fedges = eng.frontier_stats(state)
+        t0 = time.perf_counter()
+        state = eng.step_hybrid(state)
+        level = int(state.level)  # sync
+        dt = time.perf_counter() - t0
+        prof.append(
+            {
+                "level": level,
+                "frontier_vertices": fsize,
+                "frontier_edges": fedges,
+                "path": (
+                    "sparse"
+                    if (
+                        eng.sparse_hybrid
+                        and fsize <= SPARSE_BV
+                        and fedges <= SPARSE_BE
+                    )
+                    else "dense"
+                ),
+                "seconds_incl_sync": dt,
+            }
+        )
+    return {"sync_overhead_seconds": t_sync, "supersteps": prof}
+
+
+def _multi_source_bench(rg, eng, dg, source, *, num_sources, do_check):
     """BASELINE.json config-5: ``num_sources`` independent lock-step BFS
     trees on the relay layout, ELEMENT-MAJOR: 32 trees per uint32 element,
     every routing-mask word read once per superstep for the WHOLE batch, 64
     sources in ONE program (no chunking — VERDICT r2 item 2).  Sources are
-    padded to a multiple of 32 by repeating (numerator counts real ones)."""
+    padded to a multiple of 32 by repeating (numerator counts real ones).
+
+    Also times ``min(8, num_sources)`` chained SINGLE-source searches in the
+    same run so the batching multiplier (``aggregate_vs_single``) is a
+    same-device-state measurement, and — unless BENCH_CHECK=0 — verifies
+    EVERY tree against the ported algs4 ``check()`` invariants."""
     from .oracle.bfs import check
 
     ref = eng.run(source)
@@ -309,20 +373,45 @@ def _multi_source_bench(rg, eng, dg, source, *, num_sources, chunk, do_check):
             [padded, padded[: (-padded.shape[0]) % 32]]
         )
 
+    # Same-run single-source reference: K chained searches, one sync (the
+    # headline methodology) — the denominator of the batching multiplier.
+    # Median of the same repeat count as the batch side, so the multiplier
+    # does not rest on one draw from a time-varying device.
+    repeats = int(os.environ.get("BENCH_REPEATS", "3"))
+    k_single = min(8, num_sources)
+    ss_roots = [int(s) for s in sources[:k_single]]
+    _ = int(eng.run_many_device(ss_roots)[-1].level)  # warm
+    single_times = []
+    for _i in range(repeats):
+        t0 = time.perf_counter()
+        _ = int(eng.run_many_device(ss_roots)[-1].level)
+        single_times.append(time.perf_counter() - t0)
+    t_single = float(np.median(single_times)) / k_single
+    single_teps = (directed_per_tree / 2) / t_single
+
     state = eng.run_multi_elem_device(padded)
     _ = int(state.level)  # compile + sync
 
-    t0 = time.perf_counter()
-    state = eng.run_multi_elem_device(padded)
-    levels = [int(state.level)]
-    t = time.perf_counter() - t0
+    times = []
+    for _i in range(repeats):
+        t0 = time.perf_counter()
+        state = eng.run_multi_elem_device(padded)
+        levels = [int(state.level)]
+        times.append(time.perf_counter() - t0)
+    t = float(np.median(times))
+
+    if bool(np.asarray(jax.device_get(state.changed))):
+        raise SystemExit(
+            "element-major run unconverged at its 31-level cap — this graph "
+            "is too deep for elem mode; rerun the bench with BENCH_SOURCES "
+            "on the vmapped path (models/bfs.py run_multi_device)"
+        )
 
     check_status = "skipped"
     if do_check:
-        ncheck = min(8, num_sources)
-        mr = eng.run_multi_elem(padded)
+        mr = eng.run_multi_elem(padded)  # host results for ALL trees
         host_graph = Graph(dg.num_vertices, *unpad_edges(dg))
-        for i in range(ncheck):
+        for i in range(num_sources):
             s = int(padded[i])
             np.testing.assert_array_equal(
                 mr.dist[i] != np.iinfo(np.int32).max, reached_mask,
@@ -333,7 +422,9 @@ def _multi_source_bench(rg, eng, dg, source, *, num_sources, chunk, do_check):
                 raise SystemExit(
                     f"BFS invariant violations on tree {i}: {violations[:5]}"
                 )
-        check_status = f"passed ({ncheck}/{num_sources} trees fully verified)"
+        check_status = (
+            f"passed ({num_sources}/{num_sources} trees fully verified)"
+        )
 
     aggregate_teps = (num_sources * directed_per_tree / 2) / t
     print(
@@ -346,6 +437,8 @@ def _multi_source_bench(rg, eng, dg, source, *, num_sources, chunk, do_check):
                 "details": {
                     "device": str(jax.devices()[0]),
                     "engine": "relay",
+                    "applier": eng.applier,
+                    "applier_probe": eng.applier_probe,
                     "num_vertices": dg.num_vertices,
                     "num_directed_edges": dg.num_edges,
                     "num_sources": num_sources,
@@ -354,7 +447,11 @@ def _multi_source_bench(rg, eng, dg, source, *, num_sources, chunk, do_check):
                     "directed_edges_traversed_per_tree": directed_per_tree,
                     "teps_convention": "graph500 aggregate: sources * input undirected edges in traversed component / total time",
                     "total_seconds": t,
+                    "batch_times": times,
                     "seconds_per_tree": t / num_sources,
+                    "single_source_teps_same_run": single_teps,
+                    "single_source_seconds_same_run": t_single,
+                    "aggregate_vs_single": aggregate_teps / single_teps,
                     "check": check_status,
                 },
             }
@@ -369,7 +466,8 @@ def main():
     num_roots = int(os.environ.get("BENCH_ROOTS", "8"))
     engine = os.environ.get("BENCH_ENGINE", "relay")
     do_check = os.environ.get("BENCH_CHECK", "1") != "0"
-    check_roots = int(os.environ.get("BENCH_CHECK_ROOTS", "2"))
+    # Default: verify EVERY timed root (untimed host work — VERDICT r3 #8).
+    check_roots = int(os.environ.get("BENCH_CHECK_ROOTS", str(num_roots)))
     profile_dir = os.environ.get("BENCH_PROFILE", "")
     num_sources = int(os.environ.get("BENCH_SOURCES", "1"))
     sparse = os.environ.get("BENCH_SPARSE", "1") != "0"
@@ -388,15 +486,19 @@ def main():
         from .models.bfs import RelayEngine
 
         rg, build_seconds = load_or_build_relay(dg, graph_key)
-        eng = RelayEngine(rg, sparse_hybrid=sparse)
+        eng = RelayEngine(
+            rg, sparse_hybrid=sparse,
+            applier=os.environ.get("BENCH_APPLIER", "auto"),
+        )
         if num_sources > 1:
-            chunk = int(os.environ.get("BENCH_MULTI_CHUNK", "8"))
             _multi_source_bench(
                 rg, eng, dg, source,
-                num_sources=num_sources, chunk=chunk, do_check=do_check,
+                num_sources=num_sources, do_check=do_check,
             )
             return
         layout_detail = {
+            "applier": eng.applier,
+            "applier_probe": eng.applier_probe,
             "relay_layout_build_seconds": build_seconds,
             "relay_mask_bytes": int(rg.net_masks.nbytes + rg.vperm_masks.nbytes),
             "relay_net_mask_bytes": int(rg.net_masks.nbytes),
@@ -494,6 +596,11 @@ def main():
             times.append(time.perf_counter() - t0)
     total = float(np.median(times))
     per_search = total / num_roots
+
+    # Per-superstep dense/sparse decomposition of the first (hub) root —
+    # untimed diagnostics, after the timed repeats (VERDICT r3 #2).
+    if engine == "relay" and os.environ.get("BENCH_STEP_PROFILE", "1") != "0":
+        layout_detail["superstep_profile"] = _superstep_profile(eng, source)
 
     teps = (directed_traversed / 2) / per_search
     teps_directed_total = dg.num_edges / per_search

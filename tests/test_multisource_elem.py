@@ -65,3 +65,45 @@ def test_elem_repeated_and_batch_rules():
     np.testing.assert_array_equal(mr.dist[0], od7)
     np.testing.assert_array_equal(mr.dist[15], od7)
     np.testing.assert_array_equal(mr.dist[16], od11)
+
+
+def test_elem_deep_graph_falls_back_to_vmapped():
+    """Eccentricity > MAX_ELEM_LEVELS (31): the bit-sliced distance planes
+    cannot converge, so run_multi_elem must detect the unconverged flag and
+    fall back to the vmapped engine instead of silently truncating
+    (ADVICE.md round 3, medium)."""
+    # Path graph 0-1-2-...-99: depth 99 from vertex 0.
+    n = 100
+    u = np.arange(n - 1, dtype=np.int64)
+    w = u + 1
+    g = Graph(n, np.concatenate([u, w]), np.concatenate([w, u]))
+    eng = RelayEngine(g)
+    sources = np.zeros(32, dtype=np.int32)
+    mr = eng.run_multi_elem(sources)
+    od, op = canonical_bfs(g, 0)
+    np.testing.assert_array_equal(mr.dist[0], od)   # full depth, no truncation
+    np.testing.assert_array_equal(mr.parent[0], op)
+    assert mr.dist[0].max() == n - 1
+
+    # An explicit max_levels request still truncates (caller asked for it).
+    state = eng.run_multi_elem_device(sources, max_levels=5)
+    assert bool(np.asarray(state.changed))
+
+
+def test_elem_eccentricity_exactly_31_converges():
+    """Depth exactly MAX_ELEM_LEVELS (31): representable in the distance
+    planes; the extra confirming superstep must prove convergence instead of
+    triggering the fallback (code-review round 4)."""
+    n = 32  # path 0-1-...-31: ecc(0) = 31
+    u = np.arange(n - 1, dtype=np.int64)
+    w = u + 1
+    g = Graph(n, np.concatenate([u, w]), np.concatenate([w, u]))
+    eng = RelayEngine(g)
+    sources = np.zeros(32, dtype=np.int32)
+    state = eng.run_multi_elem_device(sources)
+    assert not bool(np.asarray(state.changed))  # converged, no fallback
+    mr = eng.run_multi_elem(sources)
+    od, op = canonical_bfs(g, 0)
+    np.testing.assert_array_equal(mr.dist[0], od)
+    np.testing.assert_array_equal(mr.parent[0], op)
+    assert mr.dist[0].max() == 31

@@ -1,34 +1,52 @@
-"""Per-stage sparsity of the cached s24 net masks (bit-major layout)."""
-import numpy as np, time
-z = np.load("/root/repo/.bench_cache/relay_v3_native_s24_ef6_seed42_block8192.npz")
-print({k: (z[k].shape if hasattr(z[k],'shape') and z[k].ndim else int(z[k])) for k in z.files if k not in ('net_masks','vperm_masks','src_l1','new2old','old2new')})
-net_size = int(z["net_size"]); m2=int(z["m2"])
-ic = z["in_classes"]; m1 = int(ic[-1][4])
-print(f"net_size=2^{int(np.log2(net_size))}, m1={m1} ({m1/net_size:.3f}), m2={m2} ({m2/net_size:.3f})")
-print(f"in_classes: {len(ic)} classes, widths {ic[:,0].min()}..{ic[:,0].max()}")
-oc = z["out_classes"]; print(f"out_classes: {len(oc)} classes, widths {oc[:,0].min()}..{oc[:,0].max()}, out_space={int(oc[-1][4])}")
+"""Per-stage zero-word sparsity of the cached s24 net masks (v4 layout).
+
+Published result (2026-07-30, relay_v4_native_s24_ef6_seed42_block8192):
+
+  - 16+16 outer stages (d >= 2^12) are PAIR-COMPACTED at build time:
+    4.19M words each, ~0% zero words — nothing left to elide.
+  - 14 lane-distance stages (2^5 <= d <= 2^11, stages 16-22 and 32-38):
+    8.39M words each, EXACTLY 50% zero words — the structural pair-zeros
+    (mask bits live only at the lower lane of each pair) that pair
+    compaction removes for d >= 4096 but which sub-row strides keep in the
+    stored stream here.  Total structurally-zero traffic: 58.7M words =
+    235 MB/superstep (16% of the 1.46 GB mask stream).
+  - 9 intra-word stages (d < 2^5): 8.39M words each, ~0% zero WORDS (the
+    pair-zeros are at the BIT level inside each word — half the bits — so
+    word-level elision cannot see them; bit-level repacking would trade
+    ~5 VPU ops/word for 50% of these stages' bytes, breakeven at the
+    device's fast-window bandwidth).
+  - No stage has leading/trailing all-zero block runs (nz-range frac = 1.0
+    everywhere; the identity-tail skip in ops/relay_pallas.py already
+    covers the only case that occurs, via StageSpec.lo/hi).
+
+Conclusion recorded in docs/ARCHITECTURE.md: elision's ceiling is ~16% of
+mask bytes; the concat-friendly subset (lane distance >= 16 words) is ~8%.
+"""
+import numpy as np
+
+z = np.load("/root/repo/.bench_cache/relay_v4_native_s24_ef6_seed42_block8192.npz")
+nt = z["net_table"]  # rows: d, offset, nwords, compact, lo, hi
+net_size = int(z["net_size"])
 nm = z["net_masks"]
-S, nw = nm.shape
-print("stages", S, "words/stage", nw)
-SB = 1<<13   # words per chunk -> element blocks of 8192 elems per plane... we analyze chunks of words
-tot_blocks0 = 0; nz_blocks0 = 0
-print("stage | dist | bit_density | zero-bitmajor-word-frac | nz-elem-block-frac(2^13w=2^13e/plane) | elem nonzero range frac")
-k = int(net_size).bit_length()-1
-for s in range(S):
-    d = net_size >> (s+1) if s < k else net_size >> (2*k-1-s)
-    w = nm[s]
-    pc = np.unpackbits(w.view(np.uint8)).sum()
-    zword = float(np.mean(w==0))
-    # element-space blocks: chunk words by SB, OR-reduce, then count set bits over (chunk, plane)
-    orch = np.bitwise_or.reduce(w.reshape(-1, SB), axis=1)  # [nw/SB]
-    nzblocks = np.unpackbits(orch.view(np.uint8)).sum()  # nonzero (plane,chunk) blocks
-    totblocks = orch.shape[0]*32
-    # element-space nonzero contiguous range: element = b*nw + wd; block id in element order = b*(nw/SB)+chunk
-    bits = np.unpackbits(orch.view(np.uint8), bitorder='little').reshape(-1, 32).T.reshape(-1)  # [32, nchunk] -> element-ordered blocks
-    nz = np.flatnonzero(bits)
-    rng = (nz[0], nz[-1]+1) if len(nz) else (0,0)
-    rngfrac = (rng[1]-rng[0])/len(bits)
-    if s < 8 or s > S-8 or s % 5 == 0:
-        print(f"{s:3d} | 2^{int(np.log2(d)):2d} | {pc/net_size:.3f} | {zword:.3f} | {nzblocks/totblocks:.3f} | {rngfrac:.3f}")
-    tot_blocks0 += totblocks; nz_blocks0 += nzblocks
-print(f"TOTAL elem-block(2^13 elems) nonzero fraction: {nz_blocks0/tot_blocks0:.4f}")
+print(f"net_size=2^{int(np.log2(net_size))}, m1={int(z['m1'])}, m2={int(z['m2'])}")
+print("stage | d | nwords(M) | compact | zero-word frac | nz-range frac")
+tot = nz_tot = 0
+lane_zero_words = 0
+for s, (d, off, nw, comp, lo, hi) in enumerate(nt):
+    w = nm[off : off + nw]
+    zf = float(np.mean(w == 0))
+    nz = np.flatnonzero(w)
+    rng = (int(nz[0]), int(nz[-1]) + 1) if len(nz) else (0, 0)
+    tot += nw
+    nz_tot += len(nz)
+    if 32 <= d < 4096 and not comp:
+        lane_zero_words += int(nw) - len(nz)
+    print(
+        f"{s:3d} | 2^{int(np.log2(d)):2d} | {nw/1e6:8.2f} | {comp} | "
+        f"{zf:.4f} | {(rng[1]-rng[0])/nw:.3f}"
+    )
+print(
+    f"TOTAL words {tot/1e6:.1f}M, nonzero {nz_tot/1e6:.1f}M ({nz_tot/tot:.4f}); "
+    f"lane-stage structural zeros {lane_zero_words/1e6:.1f}M words "
+    f"({lane_zero_words*4/1e6:.0f} MB/superstep)"
+)

@@ -1,0 +1,71 @@
+"""Sharded-relay applier microbench on a REAL-chip 1-device mesh.
+
+VERDICT r3 weak #5: the mesh path applied the Beneš networks with the
+per-stage XLA path only (~55 launches x ~0.4 ms/superstep of launch
+overhead), so the ARCHITECTURE §6 real-hardware model described a program
+that could not run.  parallel/sharded.py now routes the fused 3-pass Pallas
+kernels through ``shard_map`` (applier='auto'/'pallas'); this tool proves
+the sharded program COMPILES AND RUNS on real TPU hardware and measures the
+per-superstep cost of both appliers on the same mesh — the kernel-count
+collapse (~55 stage kernels + launch train -> 3 fused passes/network).
+
+Runs on the one available chip as a graph=1 mesh (the per-shard program is
+identical at any shard count; only the all-gather width changes).
+"""
+import os
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.bench_cache/xla")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+
+from bfs_tpu.bench import load_or_build
+from bfs_tpu.graph.relay import build_sharded_relay_graph
+from bfs_tpu.oracle.bfs import canonical_bfs  # noqa: F401 (host check path)
+from bfs_tpu.parallel import sharded as S
+
+SCALE = int(os.environ.get("MB_SCALE", "20"))
+EF = int(os.environ.get("MB_EF", "16"))
+
+dg, source = load_or_build(SCALE, EF, 42, 8192, "native")
+from bfs_tpu.graph.csr import Graph, unpad_edges
+
+esrc, edst = unpad_edges(dg)
+g = Graph(dg.num_vertices, esrc, edst)
+srg = build_sharded_relay_graph(g, 1)
+mesh = S.make_mesh(graph=1, batch=1, devices=jax.devices()[:1])
+
+print(
+    f"s{SCALE} ef{EF}: V={dg.num_vertices}, E={dg.num_edges}, "
+    f"per-shard net 2^{int(np.log2(srg.net_size))}", flush=True,
+)
+
+results = {}
+for applier in ("pallas", "xla"):
+    t0 = time.perf_counter()
+    r = S.bfs_sharded(srg, source, mesh=mesh, engine="relay", applier=applier)
+    t_first = time.perf_counter() - t0  # includes compile
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        r = S.bfs_sharded(srg, source, mesh=mesh, engine="relay",
+                          applier=applier)
+        times.append(time.perf_counter() - t0)
+    t = float(np.median(times))
+    per_ss = t / max(r.num_levels, 1)
+    results[applier] = (t, per_ss, r)
+    print(
+        f"sharded-relay applier={applier:6s}: search {t*1000:8.1f} ms "
+        f"({r.num_levels} supersteps, {per_ss*1000:6.1f} ms/superstep; "
+        f"first incl. compile {t_first:.1f} s)", flush=True,
+    )
+
+pa, xa = results["pallas"][2], results["xla"][2]
+np.testing.assert_array_equal(pa.dist, xa.dist)
+np.testing.assert_array_equal(pa.parent, xa.parent)
+print("pallas vs xla sharded results: bit-exact", flush=True)
