@@ -39,8 +39,12 @@ BENCH_CHECK_ROOTS (default = BENCH_ROOTS), BENCH_APPLIER
 (auto|pallas|xla, default auto — the measured probe), BENCH_STEP_PROFILE
 (1), BENCH_PROFILE (path — jax.profiler trace of one timed batch),
 BENCH_SOURCES (>1 runs the BASELINE.json config-5 batched multi-source
-benchmark reporting AGGREGATE TEPS), BENCH_SPARSE (1 — the hybrid
-small-frontier path inside the fused loop).
+benchmark reporting AGGREGATE TEPS), BENCH_SPARSE (default 0: measured
+round 4, a sparse superstep costs ~23 ms in-loop — frontier extraction +
+the full dist/parent copies forced through ``lax.cond`` — while a dense
+superstep with the fused Pallas applier costs ~13 ms, so the hybrid LOSES
+~40% of the headline at s24; it remains available for high-diameter /
+CPU-bound cases where dense supersteps dominate).
 """
 
 from __future__ import annotations
@@ -470,7 +474,7 @@ def main():
     check_roots = int(os.environ.get("BENCH_CHECK_ROOTS", str(num_roots)))
     profile_dir = os.environ.get("BENCH_PROFILE", "")
     num_sources = int(os.environ.get("BENCH_SOURCES", "1"))
-    sparse = os.environ.get("BENCH_SPARSE", "1") != "0"
+    sparse = os.environ.get("BENCH_SPARSE", "0") != "0"
     if engine not in ("relay", "pull", "push"):
         raise SystemExit(f"unknown BENCH_ENGINE {engine!r}; use relay/pull/push")
     if num_sources > 1 and engine != "relay":

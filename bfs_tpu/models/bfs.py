@@ -184,16 +184,46 @@ def _net_uses_pallas(n: int) -> bool:
     return pallas_net_ok(n)
 
 
+def _extract_frontier_list(fwords: jax.Array, vr: int, bv: int) -> jax.Array:
+    """Ascending list of set-bit element ids (standard packing), padded with
+    ``vr``: int32[bv].
+
+    ``jnp.nonzero`` over the vr-sized unpacked bools costs ~157 ms at s24 on
+    the bench chip (XLA lowers it through a full sort — measured round 4);
+    this word-level formulation — popcount + cumsum offsets, searchsorted
+    owner word per output slot, 5-step binary-search bit-rank select inside
+    the word — is ~3 ms and bit-identical (words ascend, bits within a word
+    ascend == nonzero's element order)."""
+    nw = fwords.shape[0]
+    cnt = jax.lax.population_count(fwords).astype(jnp.int32)
+    cs = jnp.cumsum(cnt)  # inclusive
+    o = jnp.arange(bv, dtype=jnp.int32)
+    w = jnp.searchsorted(cs, o, side="right").astype(jnp.int32)
+    wc = jnp.clip(w, 0, nw - 1)
+    prev = jnp.where(wc > 0, cs[jnp.maximum(wc - 1, 0)], 0)
+    r = o - prev  # rank of the wanted bit within its word
+    x = fwords[wc]
+    pos = jnp.zeros_like(o)
+    for k in (16, 8, 4, 2, 1):
+        low = jax.lax.population_count(
+            x & jnp.uint32((1 << k) - 1)
+        ).astype(jnp.int32)
+        go_high = r >= low
+        r = jnp.where(go_high, r - low, r)
+        x = jnp.where(go_high, x >> jnp.uint32(k), x)
+        pos = pos + jnp.where(go_high, k, 0)
+    return jnp.where(o < cs[-1], wc * 32 + pos, jnp.int32(vr))
+
+
 def _sparse_superstep(st, adj_indptr, adj_dst, adj_slot, *, vr: int):
     """Small-frontier superstep: gather the frontier's out-edges (budgeted
     static shapes), min-merge per destination by (dst, slot) sort, scatter
     the updates.  Bit-exact vs the dense path: slots ascend with original
     src id within a dst row, so min slot == canonical min-parent."""
-    from ..ops.relay import RelayState, unpack_std
+    from ..ops.relay import RelayState
 
     bv, be = SPARSE_BV, SPARSE_BE
-    bools = unpack_std(st.fwords, vr)
-    flist = jnp.nonzero(bools, size=bv, fill_value=vr)[0].astype(jnp.int32)
+    flist = _extract_frontier_list(st.fwords, vr, bv)
     deg = adj_indptr[flist + 1] - adj_indptr[flist]  # 0 at the vr fill slot
     cum = jnp.cumsum(deg)
     starts = adj_indptr[flist]
