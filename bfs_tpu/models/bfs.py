@@ -621,6 +621,19 @@ class RelayEngine:
     def _use_pallas(self) -> bool:
         return self.applier == "pallas"
 
+    def _elem_use_pallas(self) -> bool:
+        """Element-major mode follows the BACKEND, not the single-source
+        probe: the probe's applier choice reflects single-tree mask-stream
+        economics, while elem mode amortizes the mask stream over the whole
+        32*G-tree batch — and the XLA elem applier's pair reshapes cannot
+        tile on TPU at bench scale at all (a [N, 2, d] u32 view pads x16 to
+        ~20 GB at net 2^28; measured round 4, the round-3 elem bench's
+        silent blocker).  BFS_TPU_PALLAS=0 still forces the XLA reference
+        path (CPU tests)."""
+        from ..ops.relay_pallas import pallas_enabled
+
+        return pallas_enabled()
+
     #: XLA keeps Pallas operands/results VMEM-resident when they fit under
     #: its scoped-vmem budget; mid-size nets (2^25..2^26 words arrays of
     #: 4-8 MB) then blow the 16 MB default limit at compile time.  The TPU
@@ -793,11 +806,11 @@ class RelayEngine:
         groups = sources.shape[0] // 32
         _, pt = rank_plane_layout(rg.in_classes)
         fused = _relay_elem_program(
-            self._static, pt, groups, self._use_pallas()
+            self._static, pt, groups, self._elem_use_pallas()
         )
         src_new = jnp.asarray(rg.old2new[sources].reshape(groups, 32))
         args = (src_new, *self._elem_tensors())
-        if not self._use_pallas():
+        if not self._elem_use_pallas():
             return fused(*args, max_levels=max_levels)
         key = ("elem", groups, max_levels)
         compiled = self._compiled.get(key)
@@ -816,7 +829,7 @@ class RelayEngine:
         if cached is not None:
             return cached
         rg = self.relay_graph
-        if self._use_pallas():
+        if self._elem_use_pallas():
             from ..ops import relay_pallas as RP
 
             def mask_arg(masks, table, size):
