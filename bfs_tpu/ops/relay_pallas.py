@@ -212,7 +212,7 @@ def _stage_outer(x, m, st: StageSpec, tr: int):
     return jnp.stack([lo ^ t, hi ^ t], axis=1).reshape(x.shape)
 
 
-def _run_pass(x, arr2d, mode, tr, tt, specs, n, interpret):
+def _run_pass(x, arr2d, mode, tr, tt, specs, n, interpret, vma=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -315,12 +315,21 @@ def _run_pass(x, arr2d, mode, tr, tt, specs, n, interpret):
                 xv = jnp.where(g, run_stage(xv, mbuf, si % 2, st), xv)
         o_ref[...] = xv
 
+    if vma is None:
+        out_shape = jax.ShapeDtypeStruct(x_view.shape, jnp.uint32)
+    else:
+        # Inside shard_map with varying-mesh-axes checking, a pallas output
+        # must declare which mesh axes it varies over (parallel/sharded.py
+        # passes the graph axis).
+        out_shape = jax.ShapeDtypeStruct(
+            x_view.shape, jnp.uint32, vma=frozenset(vma)
+        )
     out = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[x_spec, pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=x_spec,
-        out_shape=jax.ShapeDtypeStruct(x_view.shape, jnp.uint32),
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((2, buf_rows, LANES), jnp.uint32),
             pltpu.SemaphoreType.DMA((2,)),
@@ -664,9 +673,10 @@ def apply_benes_fused(
     pass_static,  # tuple of (mode, tr, tt, specs) in the same order
     n: int,
     interpret: bool = False,
+    vma=None,  # mesh axes the result varies over (shard_map callers)
 ) -> jax.Array:
     """The full routed Beneš network in at most three fused Pallas passes."""
     x = words
     for (mode, tr, tt, specs), arr in zip(pass_static, pass_arrays):
-        x = _run_pass(x, arr, mode, tr, tt, specs, n, interpret)
+        x = _run_pass(x, arr, mode, tr, tt, specs, n, interpret, vma)
     return x

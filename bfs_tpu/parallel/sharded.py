@@ -244,14 +244,16 @@ def _relay_candidates_shard(
     fw = jnp.concatenate([fwords_global, zpad])
     if use_pallas and isinstance(vperm_blk, tuple):
         y = RP.apply_benes_fused(
-            fw, vperm_blk, RP.pass_static(vperm_table, vperm_size), vperm_size
+            fw, vperm_blk, RP.pass_static(vperm_table, vperm_size),
+            vperm_size, vma={GRAPH_AXIS},
         )
     else:
         y = R.apply_benes_std(fw, vperm_blk, vperm_table, vperm_size)
     l2 = R.broadcast_l2(y, out_classes, net_size, out_space)
     if use_pallas and isinstance(net_blk, tuple):
         l1 = RP.apply_benes_fused(
-            l2, net_blk, RP.pass_static(net_table, net_size), net_size
+            l2, net_blk, RP.pass_static(net_table, net_size),
+            net_size, vma={GRAPH_AXIS},
         )
     else:
         l1 = R.apply_benes_std(l2, net_blk, net_table, net_size)
@@ -396,7 +398,12 @@ def _bfs_sharded_relay_fused(
             P(),
         ),
         out_specs=(P(GRAPH_AXIS), P(GRAPH_AXIS), P()),
-        axis_names={GRAPH_AXIS},
+        # Fully manual over BOTH mesh axes: a partially-manual program (the
+        # batch axis left in auto mode) would require the SPMD partitioner
+        # to partition the Mosaic custom calls over the auto axis, which it
+        # cannot do — even at axis size 1.  The program never communicates
+        # over batch; it is simply replicated along it.
+        axis_names={GRAPH_AXIS, BATCH_AXIS},
     )
     return fn(vperm_masks, net_masks, valid_words, source_new)
 
