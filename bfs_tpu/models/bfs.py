@@ -438,10 +438,18 @@ def _probe_appliers(rg, compiler_options, loops: int = 16) -> dict:
         k = loops
         while True:
             t1 = min(timed(compiled, jnp.int32(k), *args) for _ in range(2))
-            if t1 >= 0.4 or k >= 4096:
+            if t1 >= 0.8 or k >= 4096:
                 break
             k *= 2
         t2 = min(timed(compiled, jnp.int32(2 * k), *args) for _ in range(2))
+        if t2 - t1 < 0.25 * t1 and k < 4096:
+            # Difference still noise-level (t1 was mostly dispatch/sync, not
+            # work — seen at s25 where 16 applies ~ the 0.4s gate): double
+            # once more so the work term dominates.
+            k *= 2
+            t1, t2 = t2, min(
+                timed(compiled, jnp.int32(2 * k), *args) for _ in range(2)
+            )
         return max(t2 - t1, 1e-7) / k, k
 
     results = {}
