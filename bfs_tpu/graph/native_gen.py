@@ -24,6 +24,7 @@ _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__f
 
 _I32 = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
 _I64 = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
+_U8 = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
 
 
 def _register(lib: ctypes.CDLL) -> None:
@@ -43,6 +44,18 @@ def _register(lib: ctypes.CDLL) -> None:
     lib.scatter_i32.argtypes = [ctypes.c_int64, _I32, _I32, _I32]
     lib.slot_assign_i32.restype = None
     lib.slot_assign_i32.argtypes = [ctypes.c_int64, _I32, _I32, _I32, _I32, _I32]
+    lib.rank_by_count.restype = None
+    lib.rank_by_count.argtypes = [ctypes.c_int64, _I32, ctypes.c_int64, _I32]
+    lib.bincount_i32.restype = None
+    lib.bincount_i32.argtypes = [ctypes.c_int64, _I32, ctypes.c_int64, _I32]
+    lib.csr_fill.restype = None
+    lib.csr_fill.argtypes = [
+        ctypes.c_int64, ctypes.c_int64, _I32, _I32, _I32, _I32, _I32, _I32,
+    ]
+    lib.mark_u8.restype = None
+    lib.mark_u8.argtypes = [ctypes.c_int64, _I32, _U8]
+    lib.pad_identity_i32.restype = None
+    lib.pad_identity_i32.argtypes = [ctypes.c_int64, _I32, _U8]
     lib.sedgewick_header.restype = ctypes.c_int64
     lib.sedgewick_header.argtypes = [ctypes.c_char_p, _I64, _I64]
     lib.sedgewick_edges.restype = ctypes.c_int64
@@ -136,6 +149,65 @@ def slot_assign_native(base, stride, idx, rank) -> np.ndarray:
     out = np.empty(idx.shape[0], dtype=np.int32)
     lib.slot_assign_i32(idx.shape[0], base, stride, idx, rank, out)
     return out
+
+
+def rank_by_count_native(key: np.ndarray, nk: int) -> np.ndarray:
+    """rank[i] = number of earlier records with the same key — the
+    arbitrary-within-group rank used where ordering is free (L2 slots)."""
+    lib = _LIB.load()
+    if lib is None:
+        raise RuntimeError("native graph_gen unavailable")
+    key = np.ascontiguousarray(key, dtype=np.int32)
+    out = np.empty(key.shape[0], dtype=np.int32)
+    lib.rank_by_count(key.shape[0], key, int(nk), out)
+    return out
+
+
+def bincount_i32_native(key: np.ndarray, nk: int) -> np.ndarray:
+    lib = _LIB.load()
+    if lib is None:
+        raise RuntimeError("native graph_gen unavailable")
+    key = np.ascontiguousarray(key, dtype=np.int32)
+    out = np.empty(int(nk), dtype=np.int32)
+    lib.bincount_i32(key.shape[0], key, int(nk), out)
+    return out
+
+
+def csr_fill_native(srcn, dstn, slotv, nk: int):
+    """Counting-sort CSR: returns (indptr int32[nk+2], adj_dst, adj_slot)
+    grouped by srcn with arbitrary within-row order."""
+    lib = _LIB.load()
+    if lib is None:
+        raise RuntimeError("native graph_gen unavailable")
+    srcn = np.ascontiguousarray(srcn, dtype=np.int32)
+    dstn = np.ascontiguousarray(dstn, dtype=np.int32)
+    slotv = np.ascontiguousarray(slotv, dtype=np.int32)
+    n = srcn.shape[0]
+    indptr = np.empty(int(nk) + 2, dtype=np.int32)
+    adj_dst = np.empty(n, dtype=np.int32)
+    adj_slot = np.empty(n, dtype=np.int32)
+    lib.csr_fill(n, int(nk), srcn, dstn, slotv, indptr, adj_dst, adj_slot)
+    return indptr, adj_dst, adj_slot
+
+
+def mark_u8_native(idx: np.ndarray, used: np.ndarray) -> None:
+    lib = _LIB.load()
+    if lib is None:
+        raise RuntimeError("native graph_gen unavailable")
+    idx = np.ascontiguousarray(idx, dtype=np.int32)
+    assert used.dtype == np.uint8 and used.flags.c_contiguous
+    lib.mark_u8(idx.shape[0], idx, used)
+
+
+def pad_identity_native(perm: np.ndarray, used: np.ndarray) -> None:
+    """In-place identity-first bijection completion (see graph/relay.py
+    _pad_identity for the routing rationale); ``used`` updated too."""
+    lib = _LIB.load()
+    if lib is None:
+        raise RuntimeError("native graph_gen unavailable")
+    assert perm.dtype == np.int32 and perm.flags.c_contiguous
+    assert used.dtype == np.uint8 and used.flags.c_contiguous
+    lib.pad_identity_i32(perm.shape[0], perm, used)
 
 
 def sort_edges_by_dst_native(

@@ -239,6 +239,59 @@ def _slot_assign(base, stride, idx, rank) -> np.ndarray:
     return base[idx] + rank * stride[idx]
 
 
+def _rank_by_count(key: np.ndarray, nk: int) -> np.ndarray:
+    """Arbitrary-but-stable rank within each key group (native one-pass; a
+    cumcount fallback otherwise)."""
+    try:
+        from .native_gen import native_available, rank_by_count_native
+
+        if native_available():
+            return rank_by_count_native(key, nk)
+    except Exception:
+        pass
+    order = np.argsort(key, kind="stable")
+    ks = key[order]
+    starts = np.flatnonzero(np.concatenate([[True], ks[1:] != ks[:-1]]))
+    sor = starts[np.searchsorted(starts, np.arange(ks.shape[0]), side="right") - 1]
+    rank = np.empty_like(order)
+    rank[order] = (np.arange(ks.shape[0]) - sor).astype(np.int32)
+    return rank.astype(np.int32)
+
+
+def _mark_used(idx: np.ndarray, used: np.ndarray) -> None:
+    """used[idx] = 1 on a uint8 array (native scatter fast path)."""
+    try:
+        from .native_gen import mark_u8_native, native_available
+
+        if native_available():
+            mark_u8_native(idx, used)
+            return
+    except Exception:
+        pass
+    used[np.asarray(idx)] = 1
+
+
+def _csr_fill(srcn, dstn, slotv, nk: int):
+    """Counting-sort CSR grouped by ``srcn`` (arbitrary within-row order);
+    returns (indptr int32[nk+2], adj_dst, adj_slot)."""
+    try:
+        from .native_gen import csr_fill_native, native_available
+
+        if native_available():
+            return csr_fill_native(srcn, dstn, slotv, nk)
+    except Exception:
+        pass
+    order = np.argsort(srcn, kind="stable")
+    indptr = np.zeros(nk + 2, dtype=np.int64)
+    np.cumsum(np.bincount(srcn, minlength=nk), out=indptr[1 : nk + 1])
+    indptr[nk + 1] = indptr[nk]
+    return (
+        indptr.astype(np.int32),
+        np.asarray(dstn)[order].astype(np.int32),
+        np.asarray(slotv)[order].astype(np.int32),
+    )
+
+
 def _sort_rank(key_hi: np.ndarray, key_lo: np.ndarray):
     """(order, rank-within-hi-runs) sorted by (key_hi, key_lo) — native radix
     when available, np.lexsort fallback."""
@@ -378,8 +431,17 @@ def build_relay_graph(graph: Graph | DeviceGraph) -> RelayGraph:
     e = int(src.shape[0])
 
     with _phase("degrees"):
-        indeg = np.bincount(dst, minlength=v)
-        outdeg = np.bincount(src, minlength=v)
+        try:
+            from .native_gen import bincount_i32_native, native_available
+
+            if native_available():
+                indeg = bincount_i32_native(dst, v).astype(np.int64)
+                outdeg = bincount_i32_native(src, v).astype(np.int64)
+            else:
+                raise RuntimeError
+        except Exception:
+            indeg = np.bincount(dst, minlength=v)
+            outdeg = np.bincount(src, minlength=v)
         in_w = _class_width(indeg)  # zero-indeg vertices get one INF slot
         out_w = _class_width(outdeg)
 
@@ -430,13 +492,18 @@ def build_relay_graph(graph: Graph | DeviceGraph) -> RelayGraph:
         src_l1 = np.full(m1, INF_DIST, dtype=np.int32)
         _scatter(src_l1, l1_sorted, _gather(src, order1))  # ORIGINAL ids
 
-    # ---- L2 slots: edges sorted by (src out-position, dst) -----------------
+    # ---- L2 slots: edges grouped by src out-position ------------------------
+    # The within-row rank is FREE here: the big network routes any
+    # permutation, and the broadcast fills every rank slot of a source with
+    # the same bit, so any bijection of a source's edges onto its rank slots
+    # works.  A single counting pass replaces the full (srcpos, dst) radix
+    # sort (measured 272 s -> ~3 s at s25), assigning slots directly in edge
+    # order.
     with _phase("l2 slots"):
         srcpos = _gather(outpos_of_old, src)
-        order2, rank2 = _sort_rank(srcpos, dstn)
+        rank2 = _rank_by_count(srcpos, out_classes[-1].vb)
         base2, stride2 = _vertex_tables(out_classes, out_classes[-1].vb)
-        sp = _gather(srcpos, order2)
-        l2_sorted = _slot_assign(base2, stride2, sp, rank2)
+        l2_by_edge = _slot_assign(base2, stride2, srcpos, rank2)
 
     # ---- big network: L1 slot <- L2 slot -----------------------------------
     n = _pow2_at_least(max(m1, m2))
@@ -444,11 +511,9 @@ def build_relay_graph(graph: Graph | DeviceGraph) -> RelayGraph:
         net = np.full(n, -1, dtype=np.int32)
         l1_by_edge = np.empty(e, dtype=np.int32)
         _scatter(l1_by_edge, order1, l1_sorted)
-        l2_by_edge = np.empty(e, dtype=np.int32)
-        _scatter(l2_by_edge, order2, l2_sorted)
         _scatter(net, l1_by_edge, l2_by_edge)
-        used = np.zeros(n, dtype=bool)
-        used[l2_by_edge] = True
+        used = np.zeros(n, dtype=np.uint8)
+        _mark_used(l2_by_edge, used)
         _pad_identity(net, used, n)
     with _phase("net route"):
         net_masks_full = benes.route_std(net, trusted=True)
@@ -472,22 +537,21 @@ def build_relay_graph(graph: Graph | DeviceGraph) -> RelayGraph:
     dummy_positions = np.flatnonzero(~real_mask)
     vperm[dummy_positions] = vr + np.arange(dummy_positions.shape[0])
     with _phase("vperm route"):
-        used = np.zeros(vp, dtype=bool)
-        used[vperm[vperm >= 0]] = True
+        used = np.zeros(vp, dtype=np.uint8)
+        _mark_used(vperm[vperm >= 0], used)
         _pad_identity(vperm, used, vp)
         vperm_masks_full = benes.route_std(vperm, trusted=True)
         vperm_masks, vperm_table = _compact_and_table(vperm_masks_full, vp)
         del vperm_masks_full
 
     # ---- sparse-path CSR over relabeled src ids ----------------------------
+    # Within-row order is free: the sparse superstep min-merges its gathered
+    # candidates by a (dst, slot) sort of its own (models/bfs.py
+    # _sparse_superstep), so a counting placement replaces the third full
+    # edge sort of the build.
     with _phase("sparse CSR"):
         srcn = _gather(old2new, src)
-        order3, _ = _sort_rank(srcn, dstn)
-        adj_indptr = np.zeros(vr + 2, dtype=np.int64)
-        np.cumsum(np.bincount(srcn, minlength=vr), out=adj_indptr[1 : vr + 1])
-        adj_indptr[vr + 1] = adj_indptr[vr]
-        adj_dst = _gather(dstn, order3)
-        adj_slot = _gather(l1_by_edge, order3)
+        adj_indptr, adj_dst, adj_slot = _csr_fill(srcn, dstn, l1_by_edge, vr)
 
     return RelayGraph(
         num_vertices=v,
@@ -770,17 +834,33 @@ def _pad_identity(perm: np.ndarray, used: np.ndarray, n: int) -> None:
     """Complete a partial mapping to a bijection, wiring free outputs to free
     inputs IDENTITY-first: output j takes input j wherever both are free.
     Where both members of a stage pair are pads, identity wiring routes
-    switch-free (StageSpec.lo/hi shrink); mixed live/pad pairs still switch."""
+    switch-free (StageSpec.lo/hi shrink); mixed live/pad pairs still switch.
+    ``used`` is uint8 (or bool) and is updated in place; the native two-scan
+    replaces the numpy multi-pass at big nets."""
+    try:
+        from .native_gen import native_available, pad_identity_native
+
+        if (
+            native_available()
+            and used.dtype == np.uint8
+            and perm.dtype == np.int32
+        ):
+            pad_identity_native(perm, used)
+            return
+    except Exception:
+        pass
     free_out = perm < 0
-    both = free_out & ~used
+    unused = used == 0  # dtype-safe (uint8 bitwise ~ would misfire)
+    both = free_out & unused
     idx = np.flatnonzero(both)
     perm[idx] = idx
-    used[idx] = True
+    used[idx] = 1
     free_outputs = np.flatnonzero(perm < 0)
-    free_inputs = np.flatnonzero(~used)
+    free_inputs = np.flatnonzero(used == 0)
     if free_outputs.shape[0] != free_inputs.shape[0]:
         raise ValueError("partial permutation is not completable")
     perm[free_outputs] = free_inputs
+    used[free_inputs] = 1
 
 
 def valid_slot_words(src_l1: np.ndarray, net_size: int) -> np.ndarray:

@@ -185,6 +185,80 @@ void slot_assign_i32(int64_t n, const int32_t* base, const int32_t* stride,
   }
 }
 
+// Arbitrary-rank counting pass: rank_out[i] = how many earlier records
+// share key[i].  Replaces a full (key, tiebreak) radix sort wherever the
+// within-group order is free — the L2 slot assignment is one such place:
+// the Beneš network routes ANY permutation, so any bijection of a source's
+// edges onto its rank slots is routable, and the broadcast fills every
+// rank slot of a source with the same bit regardless of which edge owns
+// it (graph/relay.py L2 phase; measured 272 s as a radix sort at s25,
+// ~3 s as this single pass).
+void rank_by_count(int64_t n, const int32_t* key, int64_t nk,
+                   int32_t* rank_out) {
+  std::vector<int32_t> cnt(static_cast<size_t>(nk), 0);
+  for (int64_t i = 0; i < n; ++i) rank_out[i] = cnt[key[i]]++;
+}
+
+// One-pass int32 bincount (numpy's runs ~10x slower on the 1-core VM).
+void bincount_i32(int64_t n, const int32_t* key, int64_t nk, int32_t* out) {
+  std::memset(out, 0, static_cast<size_t>(nk) * sizeof(int32_t));
+  for (int64_t i = 0; i < n; ++i) ++out[key[i]];
+}
+
+// Counting-sort CSR fill: group edges by srcn WITHOUT sorting — the
+// sparse-path superstep re-sorts its gathered candidates by (dst, slot)
+// itself (models/bfs.py _sparse_superstep), so within-row order is free.
+// indptr_out: int32[nk+2] exclusive offsets (last entry duplicated, the
+// sentinel row the gather path expects).
+void csr_fill(int64_t n, int64_t nk, const int32_t* srcn, const int32_t* dstn,
+              const int32_t* slotv, int32_t* indptr_out, int32_t* adj_dst,
+              int32_t* adj_slot) {
+  std::vector<int32_t> off(static_cast<size_t>(nk), 0);
+  for (int64_t i = 0; i < n; ++i) ++off[srcn[i]];
+  int32_t run = 0;
+  for (int64_t k = 0; k < nk; ++k) {
+    indptr_out[k] = run;
+    const int32_t c = off[k];
+    off[k] = run;
+    run += c;
+  }
+  indptr_out[nk] = run;
+  indptr_out[nk + 1] = run;
+  for (int64_t i = 0; i < n; ++i) {
+    const int32_t o = off[srcn[i]]++;
+    adj_dst[o] = dstn[i];
+    adj_slot[o] = slotv[i];
+  }
+}
+
+// used[idx[i]] = 1 (uint8 scatter; numpy bool fancy-assign is ~10x slower).
+void mark_u8(int64_t n, const int32_t* idx, uint8_t* used) {
+  for (int64_t i = 0; i < n; ++i) used[idx[i]] = 1;
+}
+
+// Complete a partial mapping to a bijection, IDENTITY-FIRST (output j takes
+// input j wherever both are free — switch-free pad routing keeps the
+// compacted stage ranges tight, see graph/relay._pad_identity), then wire
+// the remaining holes to the remaining unused inputs ascending.  ``used``
+// is updated in place.  Replaces the numpy multi-scan (~30-60 s at net
+// 2^29) with two linear passes.
+void pad_identity_i32(int64_t n, int32_t* perm, uint8_t* used) {
+  for (int64_t i = 0; i < n; ++i) {
+    if (perm[i] < 0 && !used[i]) {
+      perm[i] = static_cast<int32_t>(i);
+      used[i] = 1;
+    }
+  }
+  int64_t j = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    if (perm[i] >= 0) continue;
+    while (used[j]) ++j;
+    perm[i] = static_cast<int32_t>(j);
+    used[j] = 1;
+    ++j;
+  }
+}
+
 // Sedgewick text parser, pass 1: return V and E from the header, or -1 on
 // malformed input.  (Format: line1=V, line2=E, then E lines "v w";
 // GraphFileUtil.java:48-63 / Graph.java:85-94.)
