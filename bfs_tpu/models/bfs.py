@@ -124,12 +124,15 @@ def slots_to_parent(parent_slots: np.ndarray, src_l1: np.ndarray) -> np.ndarray:
 
 
 #: Hybrid sparse-path budgets: a superstep takes the gather path when the
-#: frontier has <= SPARSE_BV vertices AND <= SPARSE_BE out-edges.  At the
-#: measured ~0.1 G/s XLA gather rate (tools/microbench_r3.py) a 64K-edge
-#: gather costs ~1 ms vs ~20 ms for a full-net superstep; the scale-24 level
-#: profile (frontier edges 277K / 97.6M / 102M / 1.8M / 13K / 90 —
-#: tools/measure_r3.py) makes supersteps 4-5 (and 0-1 for non-hub roots)
-#: sparse.
+#: frontier has <= SPARSE_BV vertices AND <= SPARSE_BE out-edges.
+#: Round-4 measured economics (docs/ARCHITECTURE.md §8): a sparse superstep
+#: costs ~23 ms in-loop at s24 (frontier extraction ~5 ms + the full
+#: dist/parent copies forced through ``lax.cond``) vs ~13 ms for a dense
+#: superstep on the probed Pallas applier — so the hybrid LOSES on the TPU
+#: headline config and bench.py defaults it OFF.  It remains right where a
+#: dense full-net superstep is much costlier than ~25 ms: CPU backends
+#: (tests run with it on) and high-diameter graphs with long tiny-frontier
+#: tails.
 SPARSE_BV = 32 * 1024
 SPARSE_BE = 64 * 1024
 

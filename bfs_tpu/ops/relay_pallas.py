@@ -91,13 +91,41 @@ def split_passes(table: tuple[StageSpec, ...], n: int, tile_rows: int = TILE_ROW
     return list(range(lo)), list(range(lo, hi)), list(range(hi, len(table))), tr
 
 
+#: Lane-distance stages (32 <= d < 4096) store mask bits only at the lower
+#: lane of each pair — exactly 50% structurally-zero words in the flat
+#: stream (tools/mask_sparsity.py).  For word distances dw >= this bound
+#: the prepared pass-B operand drops the zero lanes ([r, 64] blocks, a
+#: separate side array) and the kernel re-expands with <= 2 conditional
+#: lane rolls; smaller dw would need too many relayout pieces.  Saves
+#: ~100 MB of the s24 net mask stream per superstep.
+LANE_COMPACT_MIN_DW = 16
+
+
+def _lane_compactable(st: StageSpec) -> bool:
+    if os.environ.get("BFS_TPU_LANE_COMPACT", "1") == "0":
+        return False  # measurement/fallback switch
+    return (
+        32 <= st.d < 4096
+        and not st.compact
+        and (st.d >> 5) >= LANE_COMPACT_MIN_DW
+    )
+
+
+def _is_lane_compact(st: StageSpec) -> bool:
+    """Pass-local spec marker: lane-compacted stages are flagged compact
+    with d < 4096 (the stored table never pair-compacts below 4096)."""
+    return bool(st.compact) and st.d < 4096
+
+
 def pass_static(
     table: tuple[StageSpec, ...], n: int,
     tile_rows: int = TILE_ROWS, outer_tt: int = OUTER_TT,
 ):
     """Static (hashable) per-pass info: ``((mode, tr, tt, specs), ...)`` in
     execution order, with outer-stage specs rewritten to their local offsets
-    in the rearranged arrays.  Must mirror :func:`prepare_pass_masks`."""
+    in the rearranged arrays.  Must mirror :func:`prepare_pass_masks`.
+    Lane-compactable local stages are flagged (compact=True, d < 4096) with
+    offsets into the side lane64 array."""
     pre, local, suf, tr = split_passes(table, n, tile_rows)
     tt = min(outer_tt, tr)
     out = []
@@ -114,7 +142,20 @@ def pass_static(
 
     if pre:
         out.append(outer(pre))
-    out.append(("local", tr, tt, tuple(table[i] for i in local)))
+    lane_off = 0
+    local_specs = []
+    for i in local:
+        st = table[i]
+        if _lane_compactable(st):
+            half = st.nwords // 2
+            local_specs.append(
+                st._replace(compact=True, offset=lane_off, nwords=half,
+                            lo=0, hi=half)
+            )
+            lane_off += half
+        else:
+            local_specs.append(st)
+    out.append(("local", tr, tt, tuple(local_specs)))
     if suf:
         out.append(outer(suf))
     return tuple(out)
@@ -159,6 +200,25 @@ def prepare_pass_masks(
     if pre:
         arrays.append(outer_arr(pre))
     arrays.append(masks_flat.reshape(-1, LANES))
+    # Side array for lane-compacted local stages: even-group lanes only
+    # ([r, 64] per stage, concatenated).  Appended directly after the local
+    # array; apply_benes_fused consumes both for the local pass.
+    lane_parts = []
+    for i in local:
+        st = table[i]
+        if _lane_compactable(st):
+            dw = st.d >> 5
+            w = masks_flat[st.offset : st.offset + st.nwords].reshape(
+                -1, LANES
+            )
+            lanes = np.arange(LANES)
+            lane_parts.append(
+                np.ascontiguousarray(w[:, (lanes & dw) == 0]).reshape(-1)
+            )
+    if lane_parts:
+        # [-1, 128] storage (HBM DMA slices must be 128-lane aligned):
+        # storage row q packs x-rows 2q | 2q+1's compacted 64 lanes.
+        arrays.append(np.concatenate(lane_parts).reshape(-1, LANES))
     if suf:
         arrays.append(outer_arr(suf))
     return arrays
@@ -184,13 +244,40 @@ def _stage_local(x, m, st: StageSpec, interpret: bool):
         t = (x ^ (x >> sh)) & m
         return x ^ t ^ (t << sh)
     dw = d >> 5
-    if dw < LANES:  # lane butterfly; full mask, bits at lower pair lanes
+    if dw < LANES:  # lane butterfly; mask bits live at lower pair lanes
         idx = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
         has = (idx & dw) != 0
+        if _is_lane_compact(st):
+            # m: (tr/2, 128) — storage row q packs x-rows 2q|2q+1's
+            # compacted (even-group-lane) masks in its two 64-lane halves.
+            # Reconstruct mv (tr, 128) whose EVEN-GROUP lanes hold the
+            # stage's mask (odd-group lanes end up garbage, which is fine:
+            # m_both only reads even-group lanes of mv — directly at even
+            # lanes, rolled by dw at odd ones):
+            #   1. sublane-double so each x-row sees its storage row,
+            #   2. odd x-rows take the upper 64-lane half,
+            #   3. duplicate the low half across the lane dim,
+            #   4. shift each 2s-lane block into place (largest shift
+            #      first — the selects test the DESTINATION lane, so
+            #      composition goes coarse to fine).
+            mcr = jnp.repeat(m, 2, axis=0)
+            row = jax.lax.broadcasted_iota(jnp.int32, x.shape, 0)
+            a = jnp.where(
+                (row & 1) != 0, _kroll(mcr, -64, 1, interpret), mcr
+            )
+            mv = jnp.where(idx >= 64, _kroll(a, 64, 1, interpret), a)
+            s = 32
+            while s >= dw:
+                mv = jnp.where(
+                    (idx & (2 * s)) != 0, _kroll(mv, s, 1, interpret), mv
+                )
+                s //= 2
+        else:
+            mv = m
         partner = jnp.where(
             has, _kroll(x, dw, 1, interpret), _kroll(x, -dw, 1, interpret)
         )
-        m_both = jnp.where(has, _kroll(m, dw, 1, interpret), m)
+        m_both = jnp.where(has, _kroll(mv, dw, 1, interpret), mv)
         return x ^ ((x ^ partner) & m_both)
     rw = dw // LANES  # row butterfly; compact mask (tr/2 rows)
     a = x.shape[0] // (2 * rw)
@@ -212,7 +299,8 @@ def _stage_outer(x, m, st: StageSpec, tr: int):
     return jnp.stack([lo ^ t, hi ^ t], axis=1).reshape(x.shape)
 
 
-def _run_pass(x, arr2d, mode, tr, tt, specs, n, interpret, vma=None):
+def _run_pass(x, arr2d, mode, tr, tt, specs, n, interpret, vma=None,
+              lane64=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -225,14 +313,20 @@ def _run_pass(x, arr2d, mode, tr, tt, specs, n, interpret, vma=None):
         x_view = x.reshape(r, LANES)
         x_spec = pl.BlockSpec((tr, LANES), lambda i: (i, 0))
         buf_rows = tr
+        has_lane64 = any(_is_lane_compact(st) for st in specs)
+        assert not has_lane64 or lane64 is not None
 
         def stage_rows(st):
+            # lane-compact and row-compact stages both span tr//2 storage
+            # rows of the 128-lane view; full stages span tr.
             return tr // 2 if st.compact else tr
 
-        def dma(m_hbm, mbuf, sem, slot, st, rows, pid):
+        def dma(refs, mbufs, sem, slot, st, rows, pid):
+            ref = refs[1] if _is_lane_compact(st) else refs[0]
+            buf = mbufs[1] if _is_lane_compact(st) else mbufs[0]
             return pltpu.make_async_copy(
-                m_hbm.at[pl.ds(st.offset // LANES + pid * rows, rows), :],
-                mbuf.at[slot, pl.ds(0, rows), :],
+                ref.at[pl.ds(st.offset // LANES + pid * rows, rows), :],
+                buf.at[slot, pl.ds(0, rows), :],
                 sem.at[slot],
             )
 
@@ -245,10 +339,11 @@ def _run_pass(x, arr2d, mode, tr, tt, specs, n, interpret, vma=None):
             w0 = pid * rows * LANES
             return (w0 < st.hi) & (w0 + rows * LANES > st.lo)
 
-        def run_stage(xv, mbuf, slot, st):
+        def run_stage(xv, mbufs, slot, st):
             rows = stage_rows(st)
+            buf = mbufs[1] if _is_lane_compact(st) else mbufs[0]
             return _stage_local(
-                xv, mbuf[slot, pl.ds(0, rows), :], st, interpret
+                xv, buf[slot, pl.ds(0, rows), :], st, interpret
             )
     else:
         span = b // 2  # outer stages are always compact
@@ -256,14 +351,15 @@ def _run_pass(x, arr2d, mode, tr, tt, specs, n, interpret, vma=None):
         x_view = x.reshape(b, tr, LANES)
         x_spec = pl.BlockSpec((b, tt, LANES), lambda j: (0, j, 0))
         buf_rows = span * tt
+        has_lane64 = False
 
         def stage_rows(st):
             return span * tt
 
-        def dma(m_hbm, mbuf, sem, slot, st, rows, pid):
+        def dma(refs, mbufs, sem, slot, st, rows, pid):
             return pltpu.make_async_copy(
-                m_hbm.at[pl.ds(st.offset // LANES + pid * rows, rows), :],
-                mbuf.at[slot],
+                refs[0].at[pl.ds(st.offset // LANES + pid * rows, rows), :],
+                mbufs[0].at[slot],
                 sem.at[slot],
             )
 
@@ -271,49 +367,57 @@ def _run_pass(x, arr2d, mode, tr, tt, specs, n, interpret, vma=None):
             del st, pid
             return None  # outer tiles always intersect live words
 
-        def run_stage(xv, mbuf, slot, st):
+        def run_stage(xv, mbufs, slot, st):
             return _stage_outer(
-                xv, mbuf[slot].reshape(span, tt, LANES), st, tr
+                xv, mbufs[0][slot].reshape(span, tt, LANES), st, tr
             )
 
-    def kernel(x_ref, m_hbm, o_ref, mbuf, sem):
-        pid = pl.program_id(0)
-        xv = x_ref[...]
-        n_st = len(specs)
-        guards = [guard(st, pid) for st in specs]
+    def make_kernel(nrefs):
+        def kernel(x_ref, *rest):
+            refs = rest[:nrefs]
+            o_ref = rest[nrefs]
+            scratch = rest[nrefs + 1 :]
+            mbufs = scratch[:-1]
+            sem = scratch[-1]
+            pid = pl.program_id(0)
+            xv = x_ref[...]
+            n_st = len(specs)
+            guards = [guard(st, pid) for st in specs]
 
-        def start(si):
-            st = specs[si]
-            g = guards[si]
-            if g is None:
-                dma(m_hbm, mbuf, sem, si % 2, st, stage_rows(st), pid).start()
-            else:
+            def start(si):
+                st = specs[si]
+                g = guards[si]
+                if g is None:
+                    dma(refs, mbufs, sem, si % 2, st, stage_rows(st),
+                        pid).start()
+                else:
 
-                @pl.when(g)
-                def _():
-                    dma(
-                        m_hbm, mbuf, sem, si % 2, st, stage_rows(st), pid
-                    ).start()
+                    @pl.when(g)
+                    def _():
+                        dma(refs, mbufs, sem, si % 2, st, stage_rows(st),
+                            pid).start()
 
-        if n_st:
-            start(0)
-        for si, st in enumerate(specs):
-            if si + 1 < n_st:
-                start(si + 1)
-            g = guards[si]
-            if g is None:
-                dma(m_hbm, mbuf, sem, si % 2, st, stage_rows(st), pid).wait()
-                xv = run_stage(xv, mbuf, si % 2, st)
-            else:
+            if n_st:
+                start(0)
+            for si, st in enumerate(specs):
+                if si + 1 < n_st:
+                    start(si + 1)
+                g = guards[si]
+                if g is None:
+                    dma(refs, mbufs, sem, si % 2, st, stage_rows(st),
+                        pid).wait()
+                    xv = run_stage(xv, mbufs, si % 2, st)
+                else:
 
-                @pl.when(g)
-                def _():
-                    dma(
-                        m_hbm, mbuf, sem, si % 2, st, stage_rows(st), pid
-                    ).wait()
+                    @pl.when(g)
+                    def _():
+                        dma(refs, mbufs, sem, si % 2, st, stage_rows(st),
+                            pid).wait()
 
-                xv = jnp.where(g, run_stage(xv, mbuf, si % 2, st), xv)
-        o_ref[...] = xv
+                    xv = jnp.where(g, run_stage(xv, mbufs, si % 2, st), xv)
+            o_ref[...] = xv
+
+        return kernel
 
     if vma is None:
         out_shape = jax.ShapeDtypeStruct(x_view.shape, jnp.uint32)
@@ -324,18 +428,23 @@ def _run_pass(x, arr2d, mode, tr, tt, specs, n, interpret, vma=None):
         out_shape = jax.ShapeDtypeStruct(
             x_view.shape, jnp.uint32, vma=frozenset(vma)
         )
+    operands = [x_view, arr2d]
+    in_specs = [x_spec, pl.BlockSpec(memory_space=pl.ANY)]
+    scratch = [pltpu.VMEM((2, buf_rows, LANES), jnp.uint32)]
+    if has_lane64:
+        operands.append(lane64)
+        in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
+        scratch.append(pltpu.VMEM((2, tr // 2, LANES), jnp.uint32))
+    scratch.append(pltpu.SemaphoreType.DMA((2,)))
     out = pl.pallas_call(
-        kernel,
+        make_kernel(len(operands) - 1),
         grid=grid,
-        in_specs=[x_spec, pl.BlockSpec(memory_space=pl.ANY)],
+        in_specs=in_specs,
         out_specs=x_spec,
         out_shape=out_shape,
-        scratch_shapes=[
-            pltpu.VMEM((2, buf_rows, LANES), jnp.uint32),
-            pltpu.SemaphoreType.DMA((2,)),
-        ],
+        scratch_shapes=scratch,
         interpret=interpret,
-    )(x_view, arr2d)
+    )(*operands)
     return out.reshape(-1)
 
 
@@ -675,8 +784,18 @@ def apply_benes_fused(
     interpret: bool = False,
     vma=None,  # mesh axes the result varies over (shard_map callers)
 ) -> jax.Array:
-    """The full routed Beneš network in at most three fused Pallas passes."""
+    """The full routed Beneš network in at most three fused Pallas passes.
+    The local pass consumes TWO arrays (main + lane64 side array) when any
+    of its stages is lane-compacted — prepare_pass_masks emits them
+    adjacently."""
     x = words
-    for (mode, tr, tt, specs), arr in zip(pass_static, pass_arrays):
-        x = _run_pass(x, arr, mode, tr, tt, specs, n, interpret, vma)
+    ai = 0
+    for mode, tr, tt, specs in pass_static:
+        arr = pass_arrays[ai]
+        ai += 1
+        lane64 = None
+        if mode == "local" and any(_is_lane_compact(st) for st in specs):
+            lane64 = pass_arrays[ai]
+            ai += 1
+        x = _run_pass(x, arr, mode, tr, tt, specs, n, interpret, vma, lane64)
     return x

@@ -45,17 +45,20 @@ def test_fused_passes_match_xla(tile_rows):
         jnp.asarray(a)
         for a in prepare_pass_masks(masks, table, n, tile_rows=tile_rows)
     ]
-    assert len(ps) == len(arrays) == 3  # outer + local + outer
+    from bfs_tpu.ops.relay_pallas import _is_lane_compact
+
+    # 3 passes; +1 array when the local pass lane-compacts any stage (the
+    # lane64 side array is emitted right after the local array).
+    local_specs = next(sp for m, _t, _tt, sp in ps if m == "local")
+    n_lane = 1 if any(_is_lane_compact(st) for st in local_specs) else 0
+    assert len(ps) == 3 and len(arrays) == 3 + n_lane
+    assert n_lane == 1  # d=2^9..2^11 stages exist at n=2^19
     bits = rng.integers(0, 2, size=n).astype(np.uint8)
     x = pack_std(jnp.asarray(bits))
     want = np.asarray(
         unpack_std(apply_benes_std(x, jnp.asarray(masks), table, n), n)
     )
-    got_x = x
-    for (mode, tr, tt, specs), arr in zip(ps, arrays):
-        from bfs_tpu.ops.relay_pallas import _run_pass
-
-        got_x = _run_pass(got_x, arr, mode, tr, tt, specs, n, interpret=True)
+    got_x = apply_benes_fused(x, arrays, ps, n, interpret=True)
     got = np.asarray(unpack_std(got_x, n))
     np.testing.assert_array_equal(got, want)
     np.testing.assert_array_equal(got, bits[perm])
@@ -79,10 +82,7 @@ def test_fused_identity_tail_skips_are_correct():
     ]
     bits = rng.integers(0, 2, size=n).astype(np.uint8)
     x = pack_std(jnp.asarray(bits))
-    from bfs_tpu.ops.relay_pallas import _run_pass
-
-    for (mode, tr, tt, specs), arr in zip(ps, arrays):
-        x = _run_pass(x, arr, mode, tr, tt, specs, n, interpret=True)
+    x = apply_benes_fused(x, arrays, ps, n, interpret=True)
     np.testing.assert_array_equal(np.asarray(unpack_std(x, n)), bits[perm])
 
 
