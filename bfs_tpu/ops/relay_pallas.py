@@ -102,8 +102,15 @@ LANE_COMPACT_MIN_DW = 16
 
 
 def _lane_compactable(st: StageSpec) -> bool:
-    if os.environ.get("BFS_TPU_LANE_COMPACT", "1") == "0":
-        return False  # measurement/fallback switch
+    """Default OFF by measurement (round 4, interleaved same-process A/B at
+    ~200 GB/s DMA): the in-kernel expansion relayouts (sublane repeat +
+    conditional lane rolls) cost ~1 ms MORE per net apply than the ~100 MB
+    of zero-lane DMA they save (~7.3 vs ~6.5 ms).  The trade flips in
+    DMA-starved windows (3-27 GB/s was typical in round 3, where 100 MB is
+    4-30 ms) — hence BFS_TPU_LANE_COMPACT=1 as an opt-in switch rather
+    than dead code."""
+    if os.environ.get("BFS_TPU_LANE_COMPACT", "0") != "1":
+        return False
     return (
         32 <= st.d < 4096
         and not st.compact

@@ -10,6 +10,9 @@ import os
 import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Exercise the lane-compacted pass-B path (opt-in on real runs — slower in
+# fast-DMA windows, kept for DMA-starved ones; see relay_pallas).
+os.environ["BFS_TPU_LANE_COMPACT"] = "1"
 
 import numpy as np
 import pytest
