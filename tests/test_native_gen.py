@@ -60,3 +60,54 @@ def test_sedgewick_native_rejects_malformed(tmp_path):
     bad.write_text("6\n8\n0 5\n")  # promises 8 edges, has 1
     with pytest.raises(ValueError):
         native_gen.read_sedgewick_native(str(bad))
+
+
+def test_rank_by_count_matches_stable_sort_ranks():
+    rng = np.random.default_rng(11)
+    key = rng.integers(0, 50, 3000).astype(np.int32)
+    rank = native_gen.rank_by_count_native(key, 50)
+    # rank[i] = number of earlier records with the same key
+    want = np.zeros_like(rank)
+    seen = {}
+    for i, k in enumerate(key.tolist()):
+        want[i] = seen.get(k, 0)
+        seen[k] = want[i] + 1
+    np.testing.assert_array_equal(rank, want)
+
+
+def test_csr_fill_groups_by_key():
+    rng = np.random.default_rng(12)
+    n, nk = 5000, 200
+    srcn = rng.integers(0, nk, n).astype(np.int32)
+    dstn = rng.integers(0, 10_000, n).astype(np.int32)
+    slotv = np.arange(n, dtype=np.int32)
+    indptr, adj_dst, adj_slot = native_gen.csr_fill_native(srcn, dstn, slotv, nk)
+    assert indptr.shape == (nk + 2,)
+    assert indptr[nk] == indptr[nk + 1] == n
+    for k in range(nk):
+        sl = slice(int(indptr[k]), int(indptr[k + 1]))
+        # every edge in row k really has key k, and the row is complete
+        np.testing.assert_array_equal(srcn[adj_slot[sl]], k)
+        np.testing.assert_array_equal(
+            np.sort(adj_slot[sl]), np.sort(np.flatnonzero(srcn == k))
+        )
+        np.testing.assert_array_equal(adj_dst[sl], dstn[adj_slot[sl]])
+
+
+def test_pad_identity_native_identity_first():
+    rng = np.random.default_rng(13)
+    n = 4096
+    perm = np.full(n, -1, dtype=np.int32)
+    # partial mapping: outputs 0..99 <- random distinct inputs 1000..1099
+    ins = (1000 + rng.permutation(100)).astype(np.int32)
+    perm[:100] = ins
+    used = np.zeros(n, dtype=np.uint8)
+    native_gen.mark_u8_native(ins, used)
+    native_gen.pad_identity_native(perm, used)
+    # bijection
+    np.testing.assert_array_equal(np.sort(perm), np.arange(n))
+    # identity-first: free output j with free input j must map j -> j
+    for j in range(100, 1000):
+        assert perm[j] == j
+    for j in range(1100, n):
+        assert perm[j] == j
