@@ -598,12 +598,26 @@ class RelayEngine:
         outdeg = np.diff(rg.adj_indptr[: rg.vr + 1].astype(np.int64)).astype(
             np.int32
         )
-        self._sparse_tensors = (
-            jnp.asarray(rg.adj_indptr),
-            jnp.asarray(rg.adj_dst),
-            jnp.asarray(rg.adj_slot),
-            jnp.asarray(outdeg),
-        )
+        if sparse_hybrid:
+            self._sparse_tensors = (
+                jnp.asarray(rg.adj_indptr),
+                jnp.asarray(rg.adj_dst),
+                jnp.asarray(rg.adj_slot),
+                jnp.asarray(outdeg),
+            )
+        else:
+            # The fused program traces (and XLA drops) the sparse operands
+            # when the hybrid is off; ship 1-element dummies instead of the
+            # ~2*E adjacency (6.4 GB at scale 26 — the difference between
+            # fitting and not fitting the single-chip HBM envelope,
+            # ARCHITECTURE §7).  indptr/outdeg stay real: frontier_stats
+            # and the superstep profiler read outdeg.
+            self._sparse_tensors = (
+                jnp.asarray(rg.adj_indptr),
+                jnp.zeros(1, jnp.int32),
+                jnp.zeros(1, jnp.int32),
+                jnp.asarray(outdeg),
+            )
         self._static = _relay_static(rg)
         self._compiled = {}
 
