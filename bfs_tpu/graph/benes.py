@@ -94,6 +94,33 @@ def route(perm: np.ndarray, *, bit_major: bool = False) -> np.ndarray:
     return masks.reshape(num_stages(n), words)
 
 
+def _reserve_hugepages(n: int) -> None:
+    """Best-effort explicit 2MB huge-page reservation for the native
+    router's working set (a/b/inv = 20 bytes/slot; native/benes.cpp
+    ``HugeBuf`` prefers ``mmap(MAP_HUGETLB)``).  The build VM's kernel
+    grants ZERO transparent huge pages in madvise mode (verified via
+    smaps_rollup), so without an explicit pool the route's pointer chase
+    pays a 4KB-page walk on nearly every random access — measured +21-26%
+    route throughput with the pool.
+
+    CAUTION: this raises the SYSTEM-WIDE ``/proc/sys/vm/nr_hugepages``
+    sysctl (~5 GB at net 2^28) and does not restore it — hugetlb pages are
+    unusable by normal allocations until an operator lowers the sysctl.
+    That is the right trade on a dedicated build VM and wrong on a shared
+    host: set ``BFS_TPU_HUGEPAGES=0`` to skip (the router falls back to
+    4KB pages).  Needs root; silently a no-op without it."""
+    if os.environ.get("BFS_TPU_HUGEPAGES", "1") == "0":
+        return
+    try:
+        pages = (20 * n + (2 << 20) - 1) // (2 << 20) + 16
+        with open("/proc/sys/vm/nr_hugepages", "r+") as f:
+            if int(f.read()) < pages:
+                f.seek(0)
+                f.write(str(pages))
+    except (OSError, ValueError):
+        pass
+
+
 def route_std(perm: np.ndarray, *, trusted: bool = False) -> np.ndarray:
     """Layout-v4 router: Beneš masks in STANDARD (word-major) packing — mask
     element ``e`` at word ``e >> 5``, bit ``e & 31`` — via the iterative int32
@@ -107,6 +134,8 @@ def route_std(perm: np.ndarray, *, trusted: bool = False) -> np.ndarray:
     n = int(perm.shape[0])
     if n < 32 or n & (n - 1):
         raise ValueError(f"network size {n} is not a power of two >= 32")
+    if n >= (1 << 24):
+        _reserve_hugepages(n)
     words = n // 32
     masks = np.zeros(num_stages(n) * words, dtype=np.uint32)
     if lib.benes_route_i32_v2(n, perm, masks, int(trusted)) != 0:

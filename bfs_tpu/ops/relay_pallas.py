@@ -38,9 +38,16 @@ logger = logging.getLogger(__name__)
 
 LANES = 128
 #: pass-B tile rows: 2048 rows * 128 lanes * 4 B = 1 MB of VMEM for x.
-TILE_ROWS = 2048
+#: Env-tunable for on-chip sweeps (tools/profile_net_kernel.py).
+TILE_ROWS = int(os.environ.get("BFS_TPU_TILE_ROWS", "2048"))
 #: outer-pass inner-chunk rows; the x block is (B, OUTER_TT, 128).
-OUTER_TT = 64
+OUTER_TT = int(os.environ.get("BFS_TPU_OUTER_TT", "64"))
+#: mask-DMA pipeline depth (buffers per pass).  2 = classic double
+#: buffering: stage si+1's DMA is issued when stage si starts computing.
+#: The per-stage mask DMA is ~0.5-1 MB, whose issue+semaphore latency
+#: exceeds its transfer time, so at depth 2 the pipeline is
+#: issue-latency-bound; deeper prefetch (4) keeps more copies in flight.
+DMA_DEPTH = max(2, int(os.environ.get("BFS_TPU_DMA_DEPTH", "2")))
 
 _warned = False
 
@@ -379,6 +386,8 @@ def _run_pass(x, arr2d, mode, tr, tt, specs, n, interpret, vma=None,
                 xv, mbufs[0][slot].reshape(span, tt, LANES), st, tr
             )
 
+    depth = DMA_DEPTH
+
     def make_kernel(nrefs):
         def kernel(x_ref, *rest):
             refs = rest[:nrefs]
@@ -395,33 +404,37 @@ def _run_pass(x, arr2d, mode, tr, tt, specs, n, interpret, vma=None,
                 st = specs[si]
                 g = guards[si]
                 if g is None:
-                    dma(refs, mbufs, sem, si % 2, st, stage_rows(st),
+                    dma(refs, mbufs, sem, si % depth, st, stage_rows(st),
                         pid).start()
                 else:
 
                     @pl.when(g)
                     def _():
-                        dma(refs, mbufs, sem, si % 2, st, stage_rows(st),
+                        dma(refs, mbufs, sem, si % depth, st, stage_rows(st),
                             pid).start()
 
-            if n_st:
-                start(0)
+            # Keep depth-1 mask copies in flight: stage si+depth-1's DMA is
+            # issued as stage si begins.  Slot si%depth is reclaimed at issue
+            # time si+depth-1, whose program point is after stage si's
+            # compute consumed it.
+            for w in range(min(depth - 1, n_st)):
+                start(w)
             for si, st in enumerate(specs):
-                if si + 1 < n_st:
-                    start(si + 1)
+                if si + depth - 1 < n_st:
+                    start(si + depth - 1)
                 g = guards[si]
                 if g is None:
-                    dma(refs, mbufs, sem, si % 2, st, stage_rows(st),
+                    dma(refs, mbufs, sem, si % depth, st, stage_rows(st),
                         pid).wait()
-                    xv = run_stage(xv, mbufs, si % 2, st)
+                    xv = run_stage(xv, mbufs, si % depth, st)
                 else:
 
                     @pl.when(g)
                     def _():
-                        dma(refs, mbufs, sem, si % 2, st, stage_rows(st),
+                        dma(refs, mbufs, sem, si % depth, st, stage_rows(st),
                             pid).wait()
 
-                    xv = jnp.where(g, run_stage(xv, mbufs, si % 2, st), xv)
+                    xv = jnp.where(g, run_stage(xv, mbufs, si % depth, st), xv)
             o_ref[...] = xv
 
         return kernel
@@ -437,12 +450,12 @@ def _run_pass(x, arr2d, mode, tr, tt, specs, n, interpret, vma=None,
         )
     operands = [x_view, arr2d]
     in_specs = [x_spec, pl.BlockSpec(memory_space=pl.ANY)]
-    scratch = [pltpu.VMEM((2, buf_rows, LANES), jnp.uint32)]
+    scratch = [pltpu.VMEM((depth, buf_rows, LANES), jnp.uint32)]
     if has_lane64:
         operands.append(lane64)
         in_specs.append(pl.BlockSpec(memory_space=pl.ANY))
-        scratch.append(pltpu.VMEM((2, tr // 2, LANES), jnp.uint32))
-    scratch.append(pltpu.SemaphoreType.DMA((2,)))
+        scratch.append(pltpu.VMEM((depth, tr // 2, LANES), jnp.uint32))
+    scratch.append(pltpu.SemaphoreType.DMA((depth,)))
     out = pl.pallas_call(
         make_kernel(len(operands) - 1),
         grid=grid,
@@ -666,20 +679,22 @@ def _run_elem_pass(x, arr2d, mode, tr, tt, specs, n, interpret):
 
         buf_rows = span * (tt // 32)
 
+    depth = DMA_DEPTH
+
     def kernel(x_ref, m_hbm, o_ref, mbuf, sem):
         pid = pl.program_id(0)
         xv = x_ref[...]
         n_st = len(specs)
-        if n_st:
-            dma(m_hbm, mbuf, sem, 0, specs[0], stage_mrows(specs[0]),
+        for w in range(min(depth - 1, n_st)):
+            dma(m_hbm, mbuf, sem, w % depth, specs[w], stage_mrows(specs[w]),
                 pid).start()
         for si, st in enumerate(specs):
-            if si + 1 < n_st:
-                nst = specs[si + 1]
-                dma(m_hbm, mbuf, sem, (si + 1) % 2, nst, stage_mrows(nst),
-                    pid).start()
-            dma(m_hbm, mbuf, sem, si % 2, st, stage_mrows(st), pid).wait()
-            xv = run_stage(xv, mbuf, si % 2, st)
+            if si + depth - 1 < n_st:
+                nst = specs[si + depth - 1]
+                dma(m_hbm, mbuf, sem, (si + depth - 1) % depth, nst,
+                    stage_mrows(nst), pid).start()
+            dma(m_hbm, mbuf, sem, si % depth, st, stage_mrows(st), pid).wait()
+            xv = run_stage(xv, mbuf, si % depth, st)
         o_ref[...] = xv
 
     out = pl.pallas_call(
@@ -689,8 +704,8 @@ def _run_elem_pass(x, arr2d, mode, tr, tt, specs, n, interpret):
         out_specs=x_spec,
         out_shape=jax.ShapeDtypeStruct(x_view.shape, jnp.uint32),
         scratch_shapes=[
-            pltpu.VMEM((2, buf_rows, LANES), jnp.uint32),
-            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.VMEM((depth, buf_rows, LANES), jnp.uint32),
+            pltpu.SemaphoreType.DMA((depth,)),
         ],
         interpret=interpret,
     )(x_view, arr2d)

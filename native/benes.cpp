@@ -22,11 +22,68 @@
 //   * The network computes y with y[j] = x[perm[j]].
 
 #include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <ctime>
 #include <cstring>
 #include <thread>
 #include <vector>
 
+#if defined(__linux__)
+#include <sys/mman.h>
+#endif
+
 namespace {
+
+// 2MB-page allocation for the router's working set (a/b/inv — 5 GB at
+// n=2^28), which is walked in a random dependent-miss pattern: on 4 KB
+// pages nearly every access is also a TLB miss whose page walk serializes
+// with the data miss.  Preference order:
+//   1. mmap(MAP_HUGETLB) — explicit 2 MB pages, measured +21-26% on the
+//      build VM's interleaved pointer chase.  Requires a reservation
+//      (/proc/sys/vm/nr_hugepages); bfs_tpu/graph/benes.py::route_std
+//      raises it best-effort before routing (BFS_TPU_HUGEPAGES=0 skips).
+//   2. posix_memalign + MADV_HUGEPAGE — worthless on the build VM (the
+//      kernel grants 0 huge pages in madvise mode there, verified via
+//      smaps_rollup), but correct where THP actually works.
+struct HugeBuf {
+  void* p = nullptr;
+  size_t bytes = 0;
+  bool mapped = false;
+  explicit HugeBuf(size_t n_bytes) {
+    constexpr size_t kHuge = size_t{2} << 20;
+    bytes = (n_bytes + kHuge - 1) & ~(kHuge - 1);
+#if defined(__linux__) && defined(MAP_HUGETLB)
+    void* m = mmap(nullptr, bytes, PROT_READ | PROT_WRITE,
+                   MAP_PRIVATE | MAP_ANONYMOUS | MAP_HUGETLB, -1, 0);
+    if (m != MAP_FAILED) {
+      p = m;
+      mapped = true;
+      return;
+    }
+#endif
+    if (posix_memalign(&p, kHuge, bytes) != 0) {
+      p = nullptr;
+      bytes = 0;
+      return;
+    }
+#if defined(__linux__) && defined(MADV_HUGEPAGE)
+    madvise(p, bytes, MADV_HUGEPAGE);
+#endif
+  }
+  ~HugeBuf() {
+#if defined(__linux__) && defined(MAP_HUGETLB)
+    if (mapped) {
+      munmap(p, bytes);
+      return;
+    }
+#endif
+    std::free(p);
+  }
+  HugeBuf(const HugeBuf&) = delete;
+  HugeBuf& operator=(const HugeBuf&) = delete;
+  int32_t* i32() const { return static_cast<int32_t*>(p); }
+};
 
 // Route one Beneš block covering positions [base, base+n) at recursion
 // level l.  perm is block-local: output slot j (local) must receive the
@@ -182,20 +239,33 @@ void transpose_stage(const uint32_t* in, uint32_t* out, int64_t n) {
 // two segments instead of stopping the world.  A tiny union-find with parity
 // then decides which segments flip, and one sequential pass applies flips.
 struct RouterV2 {
-  static constexpr int kWalkers = 16;
+#ifndef BENES_WALKERS
+#define BENES_WALKERS 32
+#endif
+  static constexpr int kWalkers = BENES_WALKERS;
   struct Con {
     int32_t a, b;
     int8_t rel;  // flip[a] ^ flip[b] must equal rel
+  };
+  // Perm value + color word in ONE 8-byte struct.  The coloring walk's hot
+  // loop reads p[x] at the node it just colored, so keeping them in the
+  // same cache line turns 4 random lines per walk step (c[jp], p[jp],
+  // iv[ip], c[nj]) into 3 — the walk is random-line-throughput-bound on the
+  // build VM (~45M lines/s measured, W>=16 interleave saturated).  c is
+  // seg<<1 | color, -1 = uncolored; sub-perm emission stores {p, -1}, which
+  // also replaces the old per-level 4*n-byte memset of the color array.
+  struct PC {
+    int32_t p;
+    int32_t c;
   };
 
   int64_t n;
   int32_t k;
   uint32_t* masks;
   int64_t words_per_stage;
-  int32_t* a;    // current level's block-local perms
-  int32_t* b;    // next level's perms
+  PC* a;         // current level's block-local perms + colors
+  PC* b;         // next level's perms (+ colors reset to -1)
   int32_t* inv;  // scratch
-  int32_t* cw;   // scratch: seg<<1 | color, -1 = uncolored
   std::vector<Con> cons;
   std::vector<int32_t> uf;
   std::vector<int8_t> ufp, segflip;
@@ -227,9 +297,8 @@ struct RouterV2 {
     return r;
   }
 
-  // Interleaved-walker 2-coloring of one block; colors land in cw[0..m).
-  void color_block_walkers(const int32_t* p, const int32_t* iv, int32_t* c_,
-                           int64_t m) {
+  // Interleaved-walker 2-coloring of one block; colors land in pc[0..m).c.
+  void color_block_walkers(PC* pc, const int32_t* iv, int64_t m) {
     const int64_t h = m / 2;
     int32_t nseg = 0;
     cons.clear();
@@ -246,18 +315,18 @@ struct RouterV2 {
     for (;;) {
       for (auto& s : ws) {
         if (s.live) continue;
-        while (cursor < m && c_[cursor] != -1) ++cursor;
+        while (cursor < m && pc[cursor].c != -1) ++cursor;
         if (cursor >= m) continue;
         const int32_t seg = nseg++;
-        c_[cursor] = seg << 1;  // color 0
+        pc[cursor].c = seg << 1;  // color 0
         // The walk leaves the seed across its pair edge; the seed's OTHER
         // constraint edge (consumer-pair companion x) would go unexamined if
         // x's segment also walks away — record it now when x is colored.
         {
-          const int64_t i = p[cursor];
+          const int64_t i = pc[cursor].p;
           const int64_t ip = (i < h) ? i + h : i - h;
           const int64_t x = iv[ip];
-          const int32_t vx = c_[x];
+          const int32_t vx = pc[x].c;
           if (vx != -1)  // required: color[x] = 1
             cons.push_back({seg, vx >> 1, static_cast<int8_t>(1 ^ (vx & 1))});
         }
@@ -270,7 +339,7 @@ struct RouterV2 {
         if (!s.live) continue;
         const int64_t j = s.j;  // invariant: colored by this walker, color s.c
         const int64_t jp = (j < h) ? j + h : j - h;
-        const int32_t vjp = c_[jp];
+        const int32_t vjp = pc[jp].c;
         if (vjp != -1) {  // pair edge into foreign arc: jp must be 1-c
           cons.push_back(
               {s.seg, vjp >> 1,
@@ -279,11 +348,11 @@ struct RouterV2 {
           --live;
           continue;
         }
-        c_[jp] = (s.seg << 1) | (1 - s.c);
-        const int64_t i = p[jp];
+        pc[jp].c = (s.seg << 1) | (1 - s.c);
+        const int64_t i = pc[jp].p;  // same cache line as the c write above
         const int64_t ip = (i < h) ? i + h : i - h;
         const int64_t nj = iv[ip];
-        const int32_t vnj = c_[nj];
+        const int32_t vnj = pc[nj].c;
         if (vnj != -1) {  // consumer edge into foreign arc: nj must be c
           cons.push_back(
               {s.seg, vnj >> 1,
@@ -291,7 +360,7 @@ struct RouterV2 {
           s.live = false;
           --live;
         } else {
-          c_[nj] = (s.seg << 1) | s.c;
+          pc[nj].c = (s.seg << 1) | s.c;
           s.j = nj;
         }
       }
@@ -315,52 +384,68 @@ struct RouterV2 {
       find(s0, par);
       segflip[s0] = par;
     }
-    for (int64_t j = 0; j < m; ++j) c_[j] ^= segflip[c_[j] >> 1];
+    for (int64_t j = 0; j < m; ++j) pc[j].c ^= segflip[pc[j].c >> 1];
+  }
+
+  static double now_s() {
+    struct timespec ts;
+    clock_gettime(CLOCK_MONOTONIC, &ts);
+    return ts.tv_sec + 1e-9 * ts.tv_nsec;
   }
 
   void run() {
     //: blocks below this size are cache-resident; the serial walk is faster
     // there than walker bookkeeping.
     constexpr int64_t kWalkerMin = int64_t{1} << 20;
+    const bool timing = std::getenv("BENES_TIME") != nullptr;
     for (int32_t level = 0; level < k; ++level) {
       const int64_t m = n >> level;
       const int64_t nblocks = int64_t{1} << level;
       if (m == 2) {  // final middle stage: swap iff output 0 takes input 1
         for (int64_t blk = 0; blk < nblocks; ++blk) {
-          if (a[blk * 2] == 1) set_bit(level, blk * 2);
+          if (a[blk * 2].p == 1) set_bit(level, blk * 2);
         }
         break;
       }
       const int64_t h = m / 2;
       const int32_t in_stage = level;
       const int32_t out_stage = 2 * k - 2 - level;
-      std::memset(cw, -1, static_cast<size_t>(n) * 4);
+      double t_inv = 0, t_col = 0, t_emit = 0, t0 = timing ? now_s() : 0;
       for (int64_t blk = 0; blk < nblocks; ++blk) {
         const int64_t base = blk * m;
-        const int32_t* p = a + base;
+        PC* pc = a + base;
         int32_t* iv = inv + base;
-        int32_t* c_ = cw + base;
-        int32_t* up = b + base;
-        int32_t* lo = b + base + h;
-        for (int64_t j = 0; j < m; ++j) iv[p[j]] = static_cast<int32_t>(j);
+        PC* up = b + base;
+        PC* lo = b + base + h;
+        for (int64_t j = 0; j < m; ++j) iv[pc[j].p] = static_cast<int32_t>(j);
+        if (timing) {
+          const double t = now_s();
+          t_inv += t - t0;
+          t0 = t;
+        }
         if (m >= kWalkerMin) {
-          color_block_walkers(p, iv, c_, m);
+          color_block_walkers(pc, iv, m);
         } else {
-          // serial walk (colors only; cw low bit)
+          // serial walk (colors only; c low bit)
           for (int64_t seed = 0; seed < m; ++seed) {
-            if (c_[seed] != -1) continue;
+            if (pc[seed].c != -1) continue;
             int64_t j = seed;
             int32_t c = 0;
-            while (c_[j] == -1) {
-              c_[j] = c;
+            while (pc[j].c == -1) {
+              pc[j].c = c;
               const int64_t jp = (j < h) ? j + h : j - h;
-              if (c_[jp] != -1) break;
-              c_[jp] = 1 - c;
-              const int64_t i = p[jp];
+              if (pc[jp].c != -1) break;
+              pc[jp].c = 1 - c;
+              const int64_t i = pc[jp].p;
               const int64_t ip = (i < h) ? i + h : i - h;
               j = iv[ip];
             }
           }
+        }
+        if (timing) {
+          const double t = now_s();
+          t_col += t - t0;
+          t0 = t;
         }
         // Switch bits + sub-perms in one pass.  In-stage switches read
         // iv[q]/cl sequentially+independently (overlappable misses) and
@@ -373,33 +458,42 @@ struct RouterV2 {
           for (int64_t q0 = 0; q0 < h; q0 += 32) {
             uint32_t win = 0, wout = 0;
             for (int64_t q = q0; q < q0 + 32; ++q) {
-              if (c_[iv[q]] & 1) win |= uint32_t{1} << (q - q0);
-              const int32_t cq = c_[q] & 1;
+              if (pc[iv[q]].c & 1) win |= uint32_t{1} << (q - q0);
+              const int32_t cq = pc[q].c & 1;
               if (cq) wout |= uint32_t{1} << (q - q0);
               const int64_t j_up = cq == 0 ? q : q + h;
               const int64_t j_lo = cq == 0 ? q + h : q;
-              const int32_t pu = p[j_up];
-              const int32_t pl = p[j_lo];
-              up[q] = pu >= h ? pu - static_cast<int32_t>(h) : pu;
-              lo[q] = pl >= h ? pl - static_cast<int32_t>(h) : pl;
+              const int32_t pu = pc[j_up].p;
+              const int32_t pl = pc[j_lo].p;
+              up[q] = {pu >= h ? pu - static_cast<int32_t>(h) : pu, -1};
+              lo[q] = {pl >= h ? pl - static_cast<int32_t>(h) : pl, -1};
             }
             if (win) inw[(base + q0) >> 5] |= win;
             if (wout) outw[(base + q0) >> 5] |= wout;
           }
         } else {  // h < 32: bit-at-a-time
           for (int64_t q = 0; q < h; ++q) {
-            if (c_[iv[q]] & 1) set_bit(in_stage, base + q);
-            const int32_t cq = c_[q] & 1;
+            if (pc[iv[q]].c & 1) set_bit(in_stage, base + q);
+            const int32_t cq = pc[q].c & 1;
             if (cq) set_bit(out_stage, base + q);
             const int64_t j_up = cq == 0 ? q : q + h;
             const int64_t j_lo = cq == 0 ? q + h : q;
-            const int32_t pu = p[j_up];
-            const int32_t pl = p[j_lo];
-            up[q] = pu >= h ? pu - static_cast<int32_t>(h) : pu;
-            lo[q] = pl >= h ? pl - static_cast<int32_t>(h) : pl;
+            const int32_t pu = pc[j_up].p;
+            const int32_t pl = pc[j_lo].p;
+            up[q] = {pu >= h ? pu - static_cast<int32_t>(h) : pu, -1};
+            lo[q] = {pl >= h ? pl - static_cast<int32_t>(h) : pl, -1};
           }
         }
+        if (timing) {
+          const double t = now_s();
+          t_emit += t - t0;
+          t0 = t;
+        }
       }
+      if (timing)
+        std::fprintf(stderr, "benes level %2d m=2^%d  inv %.2fs  color %.2fs  emit %.2fs\n",
+                     level, 63 - __builtin_clzll(static_cast<uint64_t>(m)),
+                     t_inv, t_col, t_emit);
       std::swap(a, b);
     }
   }
@@ -432,17 +526,19 @@ int32_t benes_route_i32_v2(int64_t n, const int32_t* perm,
       w |= bit;
     }
   }
-  std::vector<int32_t> a(perm, perm + n), b(static_cast<size_t>(n)),
-      inv(static_cast<size_t>(n)), cw(static_cast<size_t>(n));
+  const size_t nb_pc = static_cast<size_t>(n) * sizeof(RouterV2::PC);
+  HugeBuf a(nb_pc), b(nb_pc), inv(static_cast<size_t>(n) * 4);
+  if (!a.p || !b.p || !inv.p) return -1;
+  RouterV2::PC* ap = static_cast<RouterV2::PC*>(a.p);
+  for (int64_t j = 0; j < n; ++j) ap[j] = {perm[j], -1};
   RouterV2 r;
   r.n = n;
   r.k = k;
   r.masks = masks_out;
   r.words_per_stage = n / 32;
-  r.a = a.data();
-  r.b = b.data();
-  r.inv = inv.data();
-  r.cw = cw.data();
+  r.a = ap;
+  r.b = static_cast<RouterV2::PC*>(b.p);
+  r.inv = inv.i32();
   r.run();
   return 0;
 }
