@@ -40,11 +40,12 @@ BENCH_CHECK_ROOTS (default = BENCH_ROOTS), BENCH_APPLIER
 (1), BENCH_PROFILE (path — jax.profiler trace of one timed batch),
 BENCH_SOURCES (>1 runs the BASELINE.json config-5 batched multi-source
 benchmark reporting AGGREGATE TEPS), BENCH_SPARSE (default 0: measured
-round 4, a sparse superstep costs ~23 ms in-loop — frontier extraction +
-the full dist/parent copies forced through ``lax.cond`` — while a dense
+round 4, a sparse superstep costs ~25 ms of INTRINSIC gather work at the
+TPU's scalar-gather rate — frontier extraction 9 ms, degree gathers
+3.4 ms, then edge gathers + 64K-pair sort + scatters — while a dense
 superstep with the fused Pallas applier costs ~13 ms, so the hybrid LOSES
-~40% of the headline at s24; it remains available for high-diameter /
-CPU-bound cases where dense supersteps dominate).
+at s24 even with the cond-free nested-while dispatch; it remains right
+for high-diameter / CPU-bound cases where dense supersteps dominate).
 """
 
 from __future__ import annotations
@@ -304,11 +305,11 @@ def _component_and_numerator(result, dg):
 
 def _superstep_profile(eng, source, *, max_steps: int = 64):
     """Stepped decomposition of one search: per-superstep wall time and the
-    dense/sparse path decision, using EXACTLY the fused loop's body
-    (RelayEngine.step_hybrid).  Each entry's time includes one device sync;
-    the measured empty round-trip is reported as ``sync_overhead_seconds``
-    so the reader can subtract it."""
-    from .models.bfs import SPARSE_BE, SPARSE_BV
+    dense/sparse path decision, running the same superstep body the fused
+    loop would pick for each frontier (RelayEngine.step_dispatch on the
+    SPARSE_BV/BE predicate, decided from the measured stats).  Each entry's
+    time includes one device sync; the measured empty round-trip is
+    reported as ``sync_overhead_seconds`` so the reader can subtract it."""
 
     tiny = jnp.zeros(8, jnp.uint32)
     sync_fn = jax.jit(lambda a: a + 1)
@@ -321,15 +322,17 @@ def _superstep_profile(eng, source, *, max_steps: int = 64):
 
     t_sync = min(_t_sync() for _ in range(3))
 
+    # Compile + warm BOTH path bodies so no in-loop entry pays compile time.
     state = eng.init_state(source)
-    st = eng.step_hybrid(state)  # compile + warm
-    _ = int(st.level)
+    eng.warm_step_bodies(state)
+    _ = int(eng.step_dispatch(state)[0].level)
     state = eng.init_state(source)
     prof = []
     while bool(state.changed) and len(prof) < max_steps:
         fsize, fedges = eng.frontier_stats(state)
+        decide = eng.take_sparse(state)  # predicate round-trip untimed
         t0 = time.perf_counter()
-        state = eng.step_hybrid(state)
+        state, path = eng.step_dispatch(state, take_sparse=decide)
         level = int(state.level)  # sync
         dt = time.perf_counter() - t0
         prof.append(
@@ -337,15 +340,7 @@ def _superstep_profile(eng, source, *, max_steps: int = 64):
                 "level": level,
                 "frontier_vertices": fsize,
                 "frontier_edges": fedges,
-                "path": (
-                    "sparse"
-                    if (
-                        eng.sparse_hybrid
-                        and fsize <= SPARSE_BV
-                        and fedges <= SPARSE_BE
-                    )
-                    else "dense"
-                ),
+                "path": path,
                 "seconds_incl_sync": dt,
             }
         )

@@ -18,6 +18,7 @@ Conventions shared with the C++ router and the XLA applier:
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import os
 
@@ -94,7 +95,10 @@ def route(perm: np.ndarray, *, bit_major: bool = False) -> np.ndarray:
     return masks.reshape(num_stages(n), words)
 
 
-def _reserve_hugepages(n: int) -> None:
+_NR_HUGEPAGES = "/proc/sys/vm/nr_hugepages"
+
+
+def _reserve_hugepages(n: int) -> int | None:
     """Best-effort explicit 2MB huge-page reservation for the native
     router's working set (a/b/inv = 20 bytes/slot; native/benes.cpp
     ``HugeBuf`` prefers ``mmap(MAP_HUGETLB)``).  The build VM's kernel
@@ -103,22 +107,62 @@ def _reserve_hugepages(n: int) -> None:
     pays a 4KB-page walk on nearly every random access — measured +21-26%
     route throughput with the pool.
 
-    CAUTION: this raises the SYSTEM-WIDE ``/proc/sys/vm/nr_hugepages``
-    sysctl (~5 GB at net 2^28) and does not restore it — hugetlb pages are
-    unusable by normal allocations until an operator lowers the sysctl.
-    That is the right trade on a dedicated build VM and wrong on a shared
-    host: set ``BFS_TPU_HUGEPAGES=0`` to skip (the router falls back to
-    4KB pages).  Needs root; silently a no-op without it."""
+    Raises the SYSTEM-WIDE ``/proc/sys/vm/nr_hugepages`` sysctl (~5 GB at
+    net 2^28); :func:`route_std` restores the previous value after routing
+    (the router's hugetlb mappings are freed by then).  Returns the prior
+    value when the sysctl was raised, else None.  Set
+    ``BFS_TPU_HUGEPAGES=0`` to skip entirely (the router falls back to 4KB
+    pages).  Needs root; silently a no-op without it."""
     if os.environ.get("BFS_TPU_HUGEPAGES", "1") == "0":
-        return
+        return None
     try:
         pages = (20 * n + (2 << 20) - 1) // (2 << 20) + 16
-        with open("/proc/sys/vm/nr_hugepages", "r+") as f:
-            if int(f.read()) < pages:
+        with open(_NR_HUGEPAGES, "r+") as f:
+            prev = int(f.read())
+            if prev < pages:
                 f.seek(0)
                 f.write(str(pages))
+                return prev
     except (OSError, ValueError):
         pass
+    return None
+
+
+def _restore_hugepages(prev: int | None) -> None:
+    if prev is None:
+        return
+    try:
+        with open(_NR_HUGEPAGES, "w") as f:
+            f.write(str(prev))
+    except (OSError, ValueError):
+        pass
+
+
+_HOLD_DEPTH = 0
+_HOLD_PREV: int | None = None
+
+
+@contextlib.contextmanager
+def hugepage_reservation(n: int):
+    """Hold ONE huge-page reservation across several :func:`route_std`
+    calls (a layout build routes the net and then the vperm): repeated
+    reserve/free cycles pay kernel compaction per route and the later
+    reservations can fall short on a fragmented allocator, silently losing
+    the 2MB-page speedup.  ``route_std`` skips its own per-call
+    reservation while a hold is active.  Same ``n >= 2^24`` gate as
+    route_std's own reservation: small builds (test graphs) stay sysctl
+    no-ops."""
+    global _HOLD_DEPTH, _HOLD_PREV
+    if _HOLD_DEPTH == 0:
+        _HOLD_PREV = _reserve_hugepages(n) if n >= (1 << 24) else None
+    _HOLD_DEPTH += 1
+    try:
+        yield
+    finally:
+        _HOLD_DEPTH -= 1
+        if _HOLD_DEPTH == 0:
+            _restore_hugepages(_HOLD_PREV)
+            _HOLD_PREV = None
 
 
 def route_std(perm: np.ndarray, *, trusted: bool = False) -> np.ndarray:
@@ -134,11 +178,20 @@ def route_std(perm: np.ndarray, *, trusted: bool = False) -> np.ndarray:
     n = int(perm.shape[0])
     if n < 32 or n & (n - 1):
         raise ValueError(f"network size {n} is not a power of two >= 32")
-    if n >= (1 << 24):
-        _reserve_hugepages(n)
-    words = n // 32
-    masks = np.zeros(num_stages(n) * words, dtype=np.uint32)
-    if lib.benes_route_i32_v2(n, perm, masks, int(trusted)) != 0:
+    reserve = n >= (1 << 24) and _HOLD_DEPTH == 0
+    prev_pages = _reserve_hugepages(n) if reserve else None
+    try:
+        words = n // 32
+        masks = np.zeros(num_stages(n) * words, dtype=np.uint32)
+        rc = lib.benes_route_i32_v2(n, perm, masks, int(trusted))
+    finally:
+        _restore_hugepages(prev_pages)
+    if rc == -2:
+        raise MemoryError(
+            f"native router could not allocate its ~{20 * n >> 20} MiB "
+            "working set"
+        )
+    if rc != 0:
         raise ValueError("perm is not a bijection")
     return masks.reshape(num_stages(n), words)
 

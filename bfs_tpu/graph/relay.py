@@ -515,34 +515,41 @@ def build_relay_graph(graph: Graph | DeviceGraph) -> RelayGraph:
         used = np.zeros(n, dtype=np.uint8)
         _mark_used(l2_by_edge, used)
         _pad_identity(net, used, n)
-    with _phase("net route"):
-        net_masks_full = benes.route_std(net, trusted=True)
-    with _phase("net compact"):
-        net_masks, net_table = _compact_and_table(net_masks_full, n)
-        del net_masks_full
-
-    # ---- small network: vertex-space words -> out-order words --------------
-    # Dummy out positions (padded rank-major class tails) must read zero:
-    # wire them to the guaranteed-zero input region [vr, vp).
+    # One huge-page reservation held across BOTH routes (net + vperm):
+    # per-route reserve/free cycles pay kernel compaction twice and the
+    # second reservation can fall short on a fragmented allocator.
+    # vperm network size, computed up front so the huge-page hold covers
+    # the LARGER of the two routed networks (vp can exceed n on
+    # vertex-heavy, edge-sparse graphs).
     out_vb = out_classes[-1].vb
     dummies = out_vb - v
     vp = _pow2_at_least(max(vr + dummies, out_vb, 32 * 128 * 2))
-    vperm = np.full(vp, -1, dtype=np.int32)
-    real_mask = np.zeros(out_vb, dtype=bool)
-    for wv, cnt in zip(owidths.tolist(), ocounts.tolist()):
-        cs = out_map[int(wv)]
-        real_mask[cs.va : cs.va + cnt] = True
-    # real out positions <- relabeled id of their owning vertex
-    vperm[outpos_of_old] = old2new[np.arange(v)]
-    dummy_positions = np.flatnonzero(~real_mask)
-    vperm[dummy_positions] = vr + np.arange(dummy_positions.shape[0])
-    with _phase("vperm route"):
-        used = np.zeros(vp, dtype=np.uint8)
-        _mark_used(vperm[vperm >= 0], used)
-        _pad_identity(vperm, used, vp)
-        vperm_masks_full = benes.route_std(vperm, trusted=True)
-        vperm_masks, vperm_table = _compact_and_table(vperm_masks_full, vp)
-        del vperm_masks_full
+    with benes.hugepage_reservation(max(n, vp)):
+        with _phase("net route"):
+            net_masks_full = benes.route_std(net, trusted=True)
+        with _phase("net compact"):
+            net_masks, net_table = _compact_and_table(net_masks_full, n)
+            del net_masks_full
+
+        # ---- small network: vertex-space words -> out-order words ----------
+        # Dummy out positions (padded rank-major class tails) must read zero:
+        # wire them to the guaranteed-zero input region [vr, vp).
+        vperm = np.full(vp, -1, dtype=np.int32)
+        real_mask = np.zeros(out_vb, dtype=bool)
+        for wv, cnt in zip(owidths.tolist(), ocounts.tolist()):
+            cs = out_map[int(wv)]
+            real_mask[cs.va : cs.va + cnt] = True
+        # real out positions <- relabeled id of their owning vertex
+        vperm[outpos_of_old] = old2new[np.arange(v)]
+        dummy_positions = np.flatnonzero(~real_mask)
+        vperm[dummy_positions] = vr + np.arange(dummy_positions.shape[0])
+        with _phase("vperm route"):
+            used = np.zeros(vp, dtype=np.uint8)
+            _mark_used(vperm[vperm >= 0], used)
+            _pad_identity(vperm, used, vp)
+            vperm_masks_full = benes.route_std(vperm, trusted=True)
+            vperm_masks, vperm_table = _compact_and_table(vperm_masks_full, vp)
+            del vperm_masks_full
 
     # ---- sparse-path CSR over relabeled src ids ----------------------------
     # Within-row order is free: the sparse superstep min-merges its gathered
@@ -741,66 +748,69 @@ def build_sharded_relay_graph(
     net_masks_l, net_tables = [], []
     src_l1 = np.full((n, m1), INF_DIST, dtype=np.int32)
 
-    for s in range(n):
-        uids_s, uw_s = out_sparse[s]
-        # out positions for this shard's sources (ascending ORIGINAL id
-        # within each width class)
-        outpos_of_old = np.full(v, -1, dtype=np.int64)
-        oorder = np.argsort(uw_s, kind="stable")
-        vperm = np.full(vp, -1, dtype=np.int32)
-        dummy_cursor = gtot
-        pos = 0
-        for wv in np.unique(uw_s):
-            cs = out_width_to_class[int(wv)]
-            cnt = int(np.count_nonzero(uw_s == wv))
-            ids = uids_s[oorder[pos : pos + cnt]]
-            outpos_of_old[ids] = cs.va + np.arange(cnt)
-            vperm[cs.va : cs.va + cnt] = old2new[ids]
-            ndum = cs.count - cnt
-            if ndum > 0:
-                vperm[cs.va + cnt : cs.vb] = dummy_cursor + np.arange(ndum)
-                dummy_cursor += ndum
-            pos += cnt
-        # remaining dummy positions of classes this shard has no members of
-        missing = np.flatnonzero(vperm[:out_vb] < 0)
-        vperm[missing] = dummy_cursor + np.arange(missing.shape[0])
-        used = np.zeros(vp, dtype=bool)
-        used[vperm[vperm >= 0]] = True
-        _pad_identity(vperm, used, vp)
-        vm_full = benes.route_std(vperm, trusted=True)
-        vm, vt = _compact_and_table(vm_full, vp)
-        del vm_full
-        vperm_masks_l.append(vm)
-        vperm_tables.append(vt)
+    # One huge-page hold across all 2n per-shard routes (see the
+    # single-shard builder for why per-route reserve/free cycles lose).
+    with benes.hugepage_reservation(max(net_size, vp)):
+        for s in range(n):
+            uids_s, uw_s = out_sparse[s]
+            # out positions for this shard's sources (ascending ORIGINAL id
+            # within each width class)
+            outpos_of_old = np.full(v, -1, dtype=np.int64)
+            oorder = np.argsort(uw_s, kind="stable")
+            vperm = np.full(vp, -1, dtype=np.int32)
+            dummy_cursor = gtot
+            pos = 0
+            for wv in np.unique(uw_s):
+                cs = out_width_to_class[int(wv)]
+                cnt = int(np.count_nonzero(uw_s == wv))
+                ids = uids_s[oorder[pos : pos + cnt]]
+                outpos_of_old[ids] = cs.va + np.arange(cnt)
+                vperm[cs.va : cs.va + cnt] = old2new[ids]
+                ndum = cs.count - cnt
+                if ndum > 0:
+                    vperm[cs.va + cnt : cs.vb] = dummy_cursor + np.arange(ndum)
+                    dummy_cursor += ndum
+                pos += cnt
+            # remaining dummy positions of classes this shard has no members of
+            missing = np.flatnonzero(vperm[:out_vb] < 0)
+            vperm[missing] = dummy_cursor + np.arange(missing.shape[0])
+            used = np.zeros(vp, dtype=bool)
+            used[vperm[vperm >= 0]] = True
+            _pad_identity(vperm, used, vp)
+            vm_full = benes.route_std(vperm, trusted=True)
+            vm, vt = _compact_and_table(vm_full, vp)
+            del vm_full
+            vperm_masks_l.append(vm)
+            vperm_tables.append(vt)
 
-        # ---- L1/L2 slots for this shard's edges ----------------------------
-        es, ee = bounds[s], bounds[s + 1]
-        s_src, s_dst = src[es:ee], dst[es:ee]
-        dstn = old2new[s_dst] - s * block  # local [0, block)
-        o1, r1 = _sort_rank(dstn.astype(np.int32), s_src.astype(np.int32))
-        ds = dstn[o1]
-        l1_sorted = base1[ds] + r1.astype(np.int64) * stride1[ds]
-        src_l1[s, l1_sorted] = s_src[o1].astype(np.int32)
+            # ---- L1/L2 slots for this shard's edges ----------------------------
+            es, ee = bounds[s], bounds[s + 1]
+            s_src, s_dst = src[es:ee], dst[es:ee]
+            dstn = old2new[s_dst] - s * block  # local [0, block)
+            o1, r1 = _sort_rank(dstn.astype(np.int32), s_src.astype(np.int32))
+            ds = dstn[o1]
+            l1_sorted = base1[ds] + r1.astype(np.int64) * stride1[ds]
+            src_l1[s, l1_sorted] = s_src[o1].astype(np.int32)
 
-        srcpos = outpos_of_old[s_src]
-        o2, r2 = _sort_rank(srcpos.astype(np.int32), dstn.astype(np.int32))
-        sp = srcpos[o2]
-        l2_sorted = base2[sp] + r2.astype(np.int64) * stride2[sp]
+            srcpos = outpos_of_old[s_src]
+            o2, r2 = _sort_rank(srcpos.astype(np.int32), dstn.astype(np.int32))
+            sp = srcpos[o2]
+            l2_sorted = base2[sp] + r2.astype(np.int64) * stride2[sp]
 
-        net = np.full(net_size, -1, dtype=np.int64)
-        l1_by_edge = np.empty(ee - es, dtype=np.int64)
-        l1_by_edge[o1] = l1_sorted
-        l2_by_edge = np.empty(ee - es, dtype=np.int64)
-        l2_by_edge[o2] = l2_sorted
-        net[l1_by_edge] = l2_by_edge
-        used = np.zeros(net_size, dtype=bool)
-        used[l2_by_edge] = True
-        _pad_identity(net, used, net_size)
-        nm_full = benes.route_std(net, trusted=True)
-        nm, nt = _compact_and_table(nm_full, net_size)
-        del nm_full
-        net_masks_l.append(nm)
-        net_tables.append(nt)
+            net = np.full(net_size, -1, dtype=np.int64)
+            l1_by_edge = np.empty(ee - es, dtype=np.int64)
+            l1_by_edge[o1] = l1_sorted
+            l2_by_edge = np.empty(ee - es, dtype=np.int64)
+            l2_by_edge[o2] = l2_sorted
+            net[l1_by_edge] = l2_by_edge
+            used = np.zeros(net_size, dtype=bool)
+            used[l2_by_edge] = True
+            _pad_identity(net, used, net_size)
+            nm_full = benes.route_std(net, trusted=True)
+            nm, nt = _compact_and_table(nm_full, net_size)
+            del nm_full
+            net_masks_l.append(nm)
+            net_tables.append(nt)
 
     return ShardedRelayGraph(
         num_vertices=v,

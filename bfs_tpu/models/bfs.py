@@ -126,13 +126,14 @@ def slots_to_parent(parent_slots: np.ndarray, src_l1: np.ndarray) -> np.ndarray:
 #: Hybrid sparse-path budgets: a superstep takes the gather path when the
 #: frontier has <= SPARSE_BV vertices AND <= SPARSE_BE out-edges.
 #: Round-4 measured economics (docs/ARCHITECTURE.md §8): a sparse superstep
-#: costs ~23 ms in-loop at s24 (frontier extraction ~5 ms + the full
-#: dist/parent copies forced through ``lax.cond``) vs ~13 ms for a dense
+#: costs ~25 ms of intrinsic gather work at the TPU's scalar-gather rate
+#: (0.02-0.09 G gathers/s measured: extraction 9 ms + degree gathers
+#: 3.4 ms + edge gathers, 64K-pair sort, scatters) vs ~13 ms for a dense
 #: superstep on the probed Pallas applier — so the hybrid LOSES on the TPU
-#: headline config and bench.py defaults it OFF.  It remains right where a
-#: dense full-net superstep is much costlier than ~25 ms: CPU backends
-#: (tests run with it on) and high-diameter graphs with long tiny-frontier
-#: tails.
+#: headline config even under the cond-free nested-while dispatch, and
+#: bench.py defaults it OFF.  It remains right where a dense full-net
+#: superstep is much costlier than ~25 ms: CPU backends (tests run with it
+#: on) and high-diameter graphs with long tiny-frontier tails.
 SPARSE_BV = 32 * 1024
 SPARSE_BE = 64 * 1024
 
@@ -260,8 +261,10 @@ def _sparse_superstep(st, adj_indptr, adj_dst, adj_slot, *, vr: int):
 
 
 def _frontier_stats(st, outdeg, vr: int):
-    """(frontier vertex count, frontier out-edge count) — the sparse-path
-    dispatch quantities, cheap word ops on the packed frontier."""
+    """(frontier vertex count, frontier out-edge count) — observability
+    quantities, cheap word ops on the packed frontier.  ``fedges`` is an
+    int32 sum: exact below 2^31 frontier out-edges, wrapped above — fine
+    for reporting, NOT for dispatch (use :func:`_take_sparse`)."""
     from ..ops import relay as R
 
     fsize = jax.lax.population_count(st.fwords).sum(dtype=jnp.int32)
@@ -270,57 +273,84 @@ def _frontier_stats(st, outdeg, vr: int):
     return fsize, fedges
 
 
-def _hybrid_body_fn(static, sparse: bool, use_pallas: bool):
-    """One full superstep including the sparse-path ``lax.cond`` — the body
-    of the fused loop, also jitted standalone for per-superstep profiling
-    (bench.py superstep_profile)."""
-    (vr, *_rest) = static
-    superstep = _superstep_fn(static, use_pallas)
+def _take_sparse(st, outdeg, vr: int):
+    """THE sparse-path dispatch predicate (single definition — the fused
+    loop's ``small()`` and the stepped ``step_dispatch`` both call this):
+    frontier has <= SPARSE_BV vertices AND <= SPARSE_BE out-edges.
+    Overflow-safe without int64: per-vertex degrees are capped at
+    SPARSE_BE+1 before the uint32 sum, so any frontier small enough to
+    pass the vertex bound sums to at most SPARSE_BV*(SPARSE_BE+1) < 2^32
+    — a >2^31-edge frontier on a scale-27+ graph cannot wrap into a
+    spuriously-small ``fedges`` and silently overrun the sparse path's
+    static edge budget."""
+    from ..ops import relay as R
 
-    def body(st, vperm_masks, net_masks, valid_words,
-             adj_indptr, adj_dst, adj_slot, outdeg):
-        def dense(s):
-            return superstep(s, vperm_masks, net_masks, valid_words)
-
-        if not sparse:
-            return dense(st)
-
-        def sparse_step(s):
-            return _sparse_superstep(s, adj_indptr, adj_dst, adj_slot, vr=vr)
-
-        fsize, fedges = _frontier_stats(st, outdeg, vr)
-        take_sparse = (fsize <= SPARSE_BV) & (fedges <= SPARSE_BE)
-        return jax.lax.cond(take_sparse, sparse_step, dense, st)
-
-    return body
+    fsize = jax.lax.population_count(st.fwords).sum(dtype=jnp.int32)
+    bools = R.unpack_std(st.fwords, vr)
+    capped = jnp.minimum(outdeg, SPARSE_BE + 1).astype(jnp.uint32)
+    fedges = jnp.where(bools != 0, capped, jnp.uint32(0)).sum(
+        dtype=jnp.uint32
+    )
+    return (fsize <= SPARSE_BV) & (fedges <= jnp.uint32(SPARSE_BE))
 
 
 @functools.lru_cache(maxsize=8)
 def _relay_fused_program(static, sparse: bool, use_pallas: bool):
     """Jitted relay BFS loop (v4), cached per static layout shape.
 
-    With ``sparse``, every superstep computes the frontier's vertex and
-    out-edge counts (cheap word ops) and a ``lax.cond`` picks the gather
-    path under the budgets — the TPU analogue of direction-optimizing BFS's
-    top-down phase for small frontiers."""
+    With ``sparse``, small frontiers (under the SPARSE_BV/BE budgets) take
+    the gather path — the TPU analogue of direction-optimizing BFS's
+    top-down phase.  The dispatch is structured as nested while-loops
+    rather than a per-superstep ``lax.cond``:
+
+        sparse_phase; while live: { dense; sparse_phase }
+
+    where ``sparse_phase`` is itself a while-loop draining consecutive
+    small supersteps.  This runs sparse on EXACTLY the supersteps the old
+    per-superstep predicate chose (the dense step only executes when the
+    sparse phase exited on a big live frontier, and the outer loop exits
+    directly when it converged), with no ``lax.cond`` in the body.
+    Measured effect (docs/ARCHITECTURE.md §8): removing the cond did NOT
+    rescue the hybrid at s24 — the sparse superstep's ~25 ms is intrinsic
+    gather work at the TPU's scalar-gather rate, so hybrid-on still
+    measured 149 vs 103 ms/search — but the structure is strictly less
+    overhead wherever the hybrid IS right (CPU backends, high-diameter
+    tails)."""
     (vr, *_rest) = static
     from ..ops import relay as R
 
-    body_fn = _hybrid_body_fn(static, sparse, use_pallas)
+    superstep = _superstep_fn(static, use_pallas)
 
     @functools.partial(jax.jit, static_argnames=("max_levels",))
     def fused(source_new, vperm_masks, net_masks, valid_words,
               adj_indptr, adj_dst, adj_slot, outdeg, max_levels):
         state = R.init_relay_state(vr, source_new)
 
-        def cond(st):
+        def live(st):
             return st.changed & (st.level < max_levels)
 
-        def body(st):
-            return body_fn(st, vperm_masks, net_masks, valid_words,
-                           adj_indptr, adj_dst, adj_slot, outdeg)
+        def dense(st):
+            return superstep(st, vperm_masks, net_masks, valid_words)
 
-        return jax.lax.while_loop(cond, body, state)
+        if not sparse:
+            return jax.lax.while_loop(live, dense, state)
+
+        def small(st):
+            return _take_sparse(st, outdeg, vr)
+
+        def sparse_phase(st):
+            return jax.lax.while_loop(
+                lambda s: live(s) & small(s),
+                lambda s: _sparse_superstep(
+                    s, adj_indptr, adj_dst, adj_slot, vr=vr
+                ),
+                st,
+            )
+
+        def body(st):
+            return sparse_phase(dense(st))
+
+        return jax.lax.while_loop(live, body, sparse_phase(state))
 
     return fused
 
@@ -689,26 +719,72 @@ class RelayEngine:
         check_sources(rg.num_vertices, source)
         return init_relay_state(rg.vr, int(rg.old2new[source]))
 
-    def step_hybrid(self, state):
-        """One compiled superstep with EXACTLY the fused loop's body — the
-        sparse-path cond included — so stepped timing decomposes the fused
-        program's per-superstep cost faithfully (bench.py superstep
-        profile).  AOT-compiled once per engine with the scoped-vmem
-        options."""
-        key = ("hybrid_step",)
+    def take_sparse(self, state) -> bool:
+        """Evaluate THE dispatch predicate (:func:`_take_sparse` — the same
+        function the fused loop's ``small()`` compiles) for this state, as
+        a host bool."""
+        if not self.sparse_hybrid:
+            return False
+        key = ("take_sparse",)
         compiled = self._compiled.get(key)
         if compiled is None:
-            body = _hybrid_body_fn(
-                self._static, self.sparse_hybrid, self._use_pallas()
+            vr = self.relay_graph.vr
+            compiled = jax.jit(
+                lambda st, od: _take_sparse(st, od, vr)
             )
-            args = (state, *self._tensors, *self._sparse_tensors)
+            self._compiled[key] = compiled
+        return bool(
+            jax.device_get(compiled(state, self._sparse_tensors[3]))
+        )
+
+    def _step_body(self, kind: str, state):
+        """AOT-compiled dense or sparse superstep body (cached per engine,
+        scoped-vmem options)."""
+        key = (kind + "_step",)
+        compiled = self._compiled.get(key)
+        if compiled is None:
+            if kind == "sparse":
+                vr = self.relay_graph.vr
+
+                def fn(st, indptr, adst, aslot):
+                    return _sparse_superstep(st, indptr, adst, aslot, vr=vr)
+
+                args = (state, *self._sparse_tensors[:3])
+            else:
+                fn = _superstep_fn(self._static, self._use_pallas())
+                args = (state, *self._tensors)
             compiled = (
-                jax.jit(body)
+                jax.jit(fn)
                 .lower(*args)
                 .compile(compiler_options=self._COMPILER_OPTIONS)
             )
             self._compiled[key] = compiled
-        return compiled(state, *self._tensors, *self._sparse_tensors)
+        return compiled
+
+    def warm_step_bodies(self, state) -> None:
+        """Pre-compile both superstep bodies so stepped timing
+        (:meth:`step_dispatch` in bench.py's superstep_profile) never pays
+        compile time inside a timed superstep."""
+        self._step_body("dense", state)
+        if self.sparse_hybrid:
+            self._step_body("sparse", state)
+
+    def step_dispatch(self, state, take_sparse: bool | None = None):
+        """One compiled superstep on the path the fused program would take
+        for this frontier, returning ``(new_state, "sparse"|"dense")``.
+        The decision comes from :meth:`take_sparse` — the single dispatch
+        predicate — so a stepped decomposition (bench.py
+        superstep_profile) runs and labels exactly the bodies the fused
+        loop's nested-while structure would run.  Pass a precomputed
+        ``take_sparse`` to keep the predicate's device round-trip out of a
+        timed window."""
+        if take_sparse is None:
+            take_sparse = self.take_sparse(state)
+        if take_sparse:
+            body = self._step_body("sparse", state)
+            return body(state, *self._sparse_tensors[:3]), "sparse"
+        body = self._step_body("dense", state)
+        return body(state, *self._tensors), "dense"
 
     def frontier_stats(self, state):
         """(frontier vertices, frontier out-edges) for a RelayState — the

@@ -42,7 +42,8 @@ namespace {
 //   1. mmap(MAP_HUGETLB) — explicit 2 MB pages, measured +21-26% on the
 //      build VM's interleaved pointer chase.  Requires a reservation
 //      (/proc/sys/vm/nr_hugepages); bfs_tpu/graph/benes.py::route_std
-//      raises it best-effort before routing (BFS_TPU_HUGEPAGES=0 skips).
+//      raises it best-effort before routing and restores the prior value
+//      after (BFS_TPU_HUGEPAGES=0 skips).
 //   2. posix_memalign + MADV_HUGEPAGE — worthless on the build VM (the
 //      kernel grants 0 huge pages in madvise mode there, verified via
 //      smaps_rollup), but correct where THP actually works.
@@ -509,7 +510,7 @@ extern "C" {
 // caller.  trusted != 0 skips the bijection check (a random-access pass
 // worth ~10% of routing time at n=2^28; layout-internal perms are
 // constructed bijective by _pad_identity).  Returns 0 on success, -1 on
-// invalid input.
+// invalid input, -2 when the ~20n-byte working set cannot be allocated.
 int32_t benes_route_i32_v2(int64_t n, const int32_t* perm,
                            uint32_t* masks_out, int32_t trusted) {
   if (n < 32 || (n & (n - 1)) != 0 || n > (int64_t{1} << 30)) return -1;
@@ -528,7 +529,7 @@ int32_t benes_route_i32_v2(int64_t n, const int32_t* perm,
   }
   const size_t nb_pc = static_cast<size_t>(n) * sizeof(RouterV2::PC);
   HugeBuf a(nb_pc), b(nb_pc), inv(static_cast<size_t>(n) * 4);
-  if (!a.p || !b.p || !inv.p) return -1;
+  if (!a.p || !b.p || !inv.p) return -2;
   RouterV2::PC* ap = static_cast<RouterV2::PC*>(a.p);
   for (int64_t j = 0; j < n; ++j) ap[j] = {perm[j], -1};
   RouterV2 r;
