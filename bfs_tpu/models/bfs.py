@@ -524,6 +524,31 @@ def _probe_appliers(rg, compiler_options, loops: int = 16) -> dict:
     t_read, k_read = per_iter(c_read, flat)
     results["dense_read_gbs"] = mask_bytes / t_read / 1e9
 
+    # Write-bandwidth reference: the chip's HBM WRITE path collapses by
+    # orders of magnitude in some windows while reads stay fast (measured
+    # round 4: plain elementwise read+write at ~1 GB/s in the same minutes
+    # a read-only stream held 33-274 GB/s).  The engine's superstep writes
+    # ~170-300 MB (pass outputs + dist/parent/fwords updates), so a capture
+    # taken in such a window is write-bound regardless of applier; this
+    # field stamps each capture with the window's write health.
+    wb = jnp.zeros(1 << 22, jnp.uint32)  # 16 MB
+
+    def loop_write(k, w):
+        def body(i, w):
+            # index-dependent so the iterated xor cannot constant-fold away
+            return w ^ (i.astype(jnp.uint32) | jnp.uint32(1))
+
+        return jax.lax.fori_loop(0, k, body, w)
+
+    c_write = (
+        jax.jit(loop_write)
+        .lower(k1, wb)
+        .compile(compiler_options=compiler_options)
+    )
+    timed(c_write, k1, wb)
+    t_write, k_write = per_iter(c_write, wb)
+    results["rw_stream_gbs"] = 2 * wb.nbytes / t_write / 1e9
+
     # --- fused Pallas passes on the re-chunked masks -------------------------
     net_static = RP.pass_static(rg.net_table, n)
     prepared = tuple(
@@ -549,7 +574,7 @@ def _probe_appliers(rg, compiler_options, loops: int = 16) -> dict:
 
     results["net_mask_bytes"] = mask_bytes
     # ACTUAL loop counts each measurement settled at (adaptive doubling).
-    results["probe_loops"] = {"xla": k_xla, "read": k_read, "pallas": k_pal}
+    results["probe_loops"] = {"xla": k_xla, "read": k_read, "write": k_write, "pallas": k_pal}
     results["selected"] = "pallas" if t_pal <= t_xla else "xla"
     # Hand the winner's device-resident mask buffers back so init does not
     # re-ship ~GBs through the tunnel; the loser's buffers are freed when
@@ -780,6 +805,13 @@ class RelayEngine:
         timed window."""
         if take_sparse is None:
             take_sparse = self.take_sparse(state)
+        elif take_sparse and not self.sparse_hybrid:
+            # Without the hybrid, the engine ships 1-element dummy adjacency
+            # tensors — running the sparse body against them would return
+            # plausible-looking wrong state.
+            raise ValueError(
+                "take_sparse=True on an engine built with sparse_hybrid=False"
+            )
         if take_sparse:
             body = self._step_body("sparse", state)
             return body(state, *self._sparse_tensors[:3]), "sparse"
@@ -805,14 +837,13 @@ class RelayEngine:
     def step(self, state):
         """One compiled relay superstep (RelayState, RELABELED space).
 
-        The jitted closure is built once per engine and reused, so stepped
-        execution (SuperstepRunner) hits the jit cache instead of retracing
-        every superstep (ADVICE.md round 3)."""
-        step_jit = getattr(self, "_step_jit", None)
-        if step_jit is None:
-            step_jit = jax.jit(_superstep_fn(self._static, self._use_pallas()))
-            self._step_jit = step_jit
-        return step_jit(state, *self._tensors)
+        Compiled once per engine and reused, so stepped execution
+        (SuperstepRunner) hits the cache instead of retracing every
+        superstep (ADVICE.md round 3).  Delegates to the same AOT-compiled
+        dense body as :meth:`step_dispatch` — the tile-major local pass's
+        ~73 MB VMEM scratch needs the raised scoped-vmem compile budget,
+        which plain ``jax.jit`` would not apply."""
+        return self._step_body("dense", state)(state, *self._tensors)
 
     def _to_result(self, state, source: int) -> BfsResult:
         rg = self.relay_graph

@@ -47,7 +47,27 @@ OUTER_TT = int(os.environ.get("BFS_TPU_OUTER_TT", "64"))
 #: The per-stage mask DMA is ~0.5-1 MB, whose issue+semaphore latency
 #: exceeds its transfer time, so at depth 2 the pipeline is
 #: issue-latency-bound; deeper prefetch (4) keeps more copies in flight.
+#: Only relevant on the per-stage path (BFS_TPU_TM=0).
 DMA_DEPTH = max(2, int(os.environ.get("BFS_TPU_DMA_DEPTH", "2")))
+
+#: Tile-major pass-B mask streaming: the local pass's masks are relaid
+#: host-side so ALL ~45 stages' rows for one x-tile are contiguous, and the
+#: kernel fetches them in ONE ~36 MB DMA per tile (double-buffered across
+#: grid steps) instead of ~45 per-stage ~0.5-1 MB copies.  Measured on the
+#: bench chip (interleaved same-process A/B at s24): marginally faster
+#: than the per-stage path in mixed windows (46-54 vs 48-62 ms/apply) and
+#: equal in the chip's write-collapsed windows, where both are bound by
+#: the pass outputs' HBM writes, not the mask reads — amortized probes
+#: showed read streaming at 163-449 GB/s at EVERY DMA size while
+#: read+write paths collapsed to ~1 GB/s (docs/ARCHITECTURE.md §8).  Kept
+#: as default for the structural simplicity (no DMA-depth tuning).
+#: Incompatible with BFS_TPU_LANE_COMPACT (which keeps the per-stage
+#: path).
+TILE_MAJOR = os.environ.get("BFS_TPU_TM", "1") != "0"
+
+
+def _tile_major_enabled() -> bool:
+    return TILE_MAJOR and os.environ.get("BFS_TPU_LANE_COMPACT", "0") != "1"
 
 _warned = False
 
@@ -131,6 +151,15 @@ def _is_lane_compact(st: StageSpec) -> bool:
     return bool(st.compact) and st.d < 4096
 
 
+def _stage_rows(st: StageSpec, tr: int) -> int:
+    """Storage rows a local-pass stage spans within one x-tile of ``tr``
+    rows: pair-compacted (and lane-compacted) stages store half.  THE
+    single definition — the host relayout (pass_static /
+    prepare_pass_masks) and the kernels' buffer offsets must agree on it
+    exactly."""
+    return (tr // 2) if st.compact else tr
+
+
 def pass_static(
     table: tuple[StageSpec, ...], n: int,
     tile_rows: int = TILE_ROWS, outer_tt: int = OUTER_TT,
@@ -156,20 +185,33 @@ def pass_static(
 
     if pre:
         out.append(outer(pre))
-    lane_off = 0
-    local_specs = []
-    for i in local:
-        st = table[i]
-        if _lane_compactable(st):
-            half = st.nwords // 2
-            local_specs.append(
-                st._replace(compact=True, offset=lane_off, nwords=half,
-                            lo=0, hi=half)
-            )
-            lane_off += half
-        else:
-            local_specs.append(st)
-    out.append(("local", tr, tt, tuple(local_specs)))
+    if _tile_major_enabled():
+        # Tile-major local pass: specs' offsets become WORD offsets within
+        # one tile's concatenated mask block (all stages' rows for that
+        # tile contiguous — ONE DMA per tile).  lo/hi keep their
+        # within-stage word semantics for the compute guards.
+        row_off = 0
+        tm_specs = []
+        for i in local:
+            st = table[i]
+            tm_specs.append(st._replace(offset=row_off * LANES))
+            row_off += _stage_rows(st, tr)
+        out.append(("local_tm", tr, tt, tuple(tm_specs)))
+    else:
+        lane_off = 0
+        local_specs = []
+        for i in local:
+            st = table[i]
+            if _lane_compactable(st):
+                half = st.nwords // 2
+                local_specs.append(
+                    st._replace(compact=True, offset=lane_off, nwords=half,
+                                lo=0, hi=half)
+                )
+                lane_off += half
+            else:
+                local_specs.append(st)
+        out.append(("local", tr, tt, tuple(local_specs)))
     if suf:
         out.append(outer(suf))
     return tuple(out)
@@ -213,26 +255,44 @@ def prepare_pass_masks(
 
     if pre:
         arrays.append(outer_arr(pre))
-    arrays.append(masks_flat.reshape(-1, LANES))
-    # Side array for lane-compacted local stages: even-group lanes only
-    # ([r, 64] per stage, concatenated).  Appended directly after the local
-    # array; apply_benes_fused consumes both for the local pass.
-    lane_parts = []
-    for i in local:
-        st = table[i]
-        if _lane_compactable(st):
-            dw = st.d >> 5
-            w = masks_flat[st.offset : st.offset + st.nwords].reshape(
-                -1, LANES
-            )
-            lanes = np.arange(LANES)
-            lane_parts.append(
-                np.ascontiguousarray(w[:, (lanes & dw) == 0]).reshape(-1)
-            )
-    if lane_parts:
-        # [-1, 128] storage (HBM DMA slices must be 128-lane aligned):
-        # storage row q packs x-rows 2q | 2q+1's compacted 64 lanes.
-        arrays.append(np.concatenate(lane_parts).reshape(-1, LANES))
+    if _tile_major_enabled():
+        # Tile-major local array: for each x-tile, all local stages' row
+        # slices concatenated (mirrors pass_static's "local_tm" offsets).
+        m2d = masks_flat.reshape(-1, LANES)
+        ntiles = max(r // tr, 1)
+        tile_parts = []
+        for pid in range(ntiles):
+            for i in local:
+                st = table[i]
+                rows = _stage_rows(st, tr)
+                base = st.offset // LANES + pid * rows
+                tile_parts.append(m2d[base : base + rows])
+        arrays.append(
+            np.ascontiguousarray(np.concatenate(tile_parts))
+            if tile_parts
+            else np.zeros((0, LANES), np.uint32)
+        )
+    else:
+        arrays.append(masks_flat.reshape(-1, LANES))
+        # Side array for lane-compacted local stages: even-group lanes only
+        # ([r, 64] per stage, concatenated).  Appended directly after the
+        # local array; apply_benes_fused consumes both for the local pass.
+        lane_parts = []
+        for i in local:
+            st = table[i]
+            if _lane_compactable(st):
+                dw = st.d >> 5
+                w = masks_flat[st.offset : st.offset + st.nwords].reshape(
+                    -1, LANES
+                )
+                lanes = np.arange(LANES)
+                lane_parts.append(
+                    np.ascontiguousarray(w[:, (lanes & dw) == 0]).reshape(-1)
+                )
+        if lane_parts:
+            # [-1, 128] storage (HBM DMA slices must be 128-lane aligned):
+            # storage row q packs x-rows 2q | 2q+1's compacted 64 lanes.
+            arrays.append(np.concatenate(lane_parts).reshape(-1, LANES))
     if suf:
         arrays.append(outer_arr(suf))
     return arrays
@@ -313,6 +373,83 @@ def _stage_outer(x, m, st: StageSpec, tr: int):
     return jnp.stack([lo ^ t, hi ^ t], axis=1).reshape(x.shape)
 
 
+def _run_local_tile_major(x, arr2d, tr, specs, n, interpret, vma=None):
+    """Pass B with tile-major masks: one big DMA per x-tile (all local
+    stages' rows contiguous), double-buffered across grid steps — the
+    next tile's block streams in while this tile computes.  See TILE_MAJOR
+    for the measured rationale (big DMAs ride the chip's fast sequential
+    path; many small per-stage copies collapse in slow-DMA windows)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    nw = n // 32
+    r = nw // LANES
+    ntiles = max(r // tr, 1)
+    block_rows = sum(_stage_rows(st, tr) for st in specs)
+    x_view = x.reshape(r, LANES)
+    x_spec = pl.BlockSpec((tr, LANES), lambda i: (i, 0))
+
+    def guard(st, pid):
+        if not _GUARDS:
+            return None
+        rows = _stage_rows(st, tr)
+        if st.lo <= 0 and st.hi >= st.nwords:
+            return None
+        w0 = pid * rows * LANES
+        return (w0 < st.hi) & (w0 + rows * LANES > st.lo)
+
+    def kernel(x_ref, m_hbm, o_ref, buf, sem):
+        pid = pl.program_id(0)
+
+        def dma(slot, t):
+            return pltpu.make_async_copy(
+                m_hbm.at[pl.ds(t * block_rows, block_rows), :],
+                buf.at[slot],
+                sem.at[slot],
+            )
+
+        @pl.when(pid == 0)
+        def _():
+            dma(0, 0).start()
+
+        @pl.when(pid + 1 < ntiles)
+        def _():
+            dma((pid + 1) % 2, pid + 1).start()
+
+        dma(pid % 2, pid).wait()
+        xv = x_ref[...]
+        slot = pid % 2
+        for st in specs:
+            rows = _stage_rows(st, tr)
+            mv = buf[slot, pl.ds(st.offset // LANES, rows), :]
+            g = guard(st, pid)
+            if g is None:
+                xv = _stage_local(xv, mv, st, interpret)
+            else:
+                xv = jnp.where(g, _stage_local(xv, mv, st, interpret), xv)
+        o_ref[...] = xv
+
+    if vma is None:
+        out_shape = jax.ShapeDtypeStruct(x_view.shape, jnp.uint32)
+    else:
+        out_shape = jax.ShapeDtypeStruct(
+            x_view.shape, jnp.uint32, vma=frozenset(vma)
+        )
+    out = pl.pallas_call(
+        kernel,
+        grid=(ntiles,),
+        in_specs=[x_spec, pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=x_spec,
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((2, block_rows, LANES), jnp.uint32),
+            pltpu.SemaphoreType.DMA((2,)),
+        ],
+        interpret=interpret,
+    )(x_view, arr2d)
+    return out.reshape(-1)
+
+
 def _run_pass(x, arr2d, mode, tr, tt, specs, n, interpret, vma=None,
               lane64=None):
     from jax.experimental import pallas as pl
@@ -322,6 +459,10 @@ def _run_pass(x, arr2d, mode, tr, tt, specs, n, interpret, vma=None,
     r = nw // LANES
     b = r // tr
 
+    if mode == "local_tm":
+        return _run_local_tile_major(
+            x, arr2d, tr, specs, n, interpret, vma
+        )
     if mode == "local":
         grid = (r // tr,)
         x_view = x.reshape(r, LANES)
@@ -333,7 +474,7 @@ def _run_pass(x, arr2d, mode, tr, tt, specs, n, interpret, vma=None,
         def stage_rows(st):
             # lane-compact and row-compact stages both span tr//2 storage
             # rows of the 128-lane view; full stages span tr.
-            return tr // 2 if st.compact else tr
+            return _stage_rows(st, tr)
 
         def dma(refs, mbufs, sem, slot, st, rows, pid):
             ref = refs[1] if _is_lane_compact(st) else refs[0]
