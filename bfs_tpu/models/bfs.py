@@ -763,8 +763,9 @@ class RelayEngine:
         )
 
     def _step_body(self, kind: str, state):
-        """AOT-compiled dense or sparse superstep body (cached per engine,
-        scoped-vmem options)."""
+        """AOT-compiled dense or sparse superstep body (cached per engine;
+        scoped-vmem options on TPU backends only — the CPU XLA rejects the
+        TPU flag)."""
         key = (kind + "_step",)
         compiled = self._compiled.get(key)
         if compiled is None:
@@ -778,10 +779,13 @@ class RelayEngine:
             else:
                 fn = _superstep_fn(self._static, self._use_pallas())
                 args = (state, *self._tensors)
+            opts = (
+                self._COMPILER_OPTIONS
+                if jax.default_backend() == "tpu"
+                else None
+            )
             compiled = (
-                jax.jit(fn)
-                .lower(*args)
-                .compile(compiler_options=self._COMPILER_OPTIONS)
+                jax.jit(fn).lower(*args).compile(compiler_options=opts)
             )
             self._compiled[key] = compiled
         return compiled
