@@ -458,7 +458,11 @@ def _probe_appliers(rg, compiler_options, loops: int = 16) -> dict:
     def timed(compiled, *args):
         t0 = time.perf_counter()
         r = compiled(*args)
-        _ = int(np.asarray(jax.device_get(r)).ravel()[0])
+        # Sync via a VALUE read of ONE element (block_until_ready can
+        # return early through the tunnel) — device-side slice first, so
+        # the 256 MB write-probe carry is not shipped to the host per call.
+        leaf = jax.tree_util.tree_leaves(r)[0]
+        _ = int(np.asarray(jax.device_get(leaf.ravel()[:1]))[0])
         return time.perf_counter() - t0
 
     def per_iter(compiled, *args):
@@ -531,7 +535,11 @@ def _probe_appliers(rg, compiler_options, loops: int = 16) -> dict:
     # ~170-300 MB (pass outputs + dist/parent/fwords updates), so a capture
     # taken in such a window is write-bound regardless of applier; this
     # field stamps each capture with the window's write health.
-    wb = jnp.zeros(1 << 22, jnp.uint32)  # 16 MB
+    # Must exceed physical VMEM (~128 MB on v5e) so the loop carry cannot
+    # stay resident — a VMEM-resident carry writes no HBM at all and
+    # measured ~2.9 TB/s (the inflated rw figure in the first capture,
+    # taken with a 16 MB buffer).
+    wb = jnp.zeros(1 << 26, jnp.uint32)  # 256 MB
 
     def loop_write(k, w):
         def body(i, w):
