@@ -32,7 +32,17 @@ dense-read bandwidths measured THIS run), and a stepped pass decomposes one
 search into per-superstep times with the dense/sparse path decision
 (``superstep_profile``).
 
-Env knobs: BENCH_SCALE (default 24), BENCH_EDGE_FACTOR (default 6 — exactly
+Evidence is emitted INCREMENTALLY (VERDICT r4 #1): phase stamps go to
+stderr as the run progresses, a PROVISIONAL headline JSON line is printed
+the moment the timed repeats finish (``"check": "pending"``), and the
+final line — verification status filled in — follows.  A wall-clock
+budget (BENCH_TIME_BUDGET, default 1200 s) degrades the run gracefully
+when behind: the applier probe, extra repeats, the superstep profile and
+all-but-one verification roots are dropped rather than timing out with
+zero output.
+
+Env knobs: BENCH_TIME_BUDGET (seconds, default 1200), BENCH_SCALE
+(default 24), BENCH_EDGE_FACTOR (default 6 — exactly
 the BASELINE.json "100M-edge R-MAT scale-24" config), BENCH_ROOTS (8),
 BENCH_REPEATS (3), BENCH_ENGINE (relay|pull|push), BENCH_CHECK (1),
 BENCH_CHECK_ROOTS (default = BENCH_ROOTS), BENCH_APPLIER
@@ -52,9 +62,37 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import time
 
 import jax
+
+# Wall clock starts at import: every stamp and budget decision is relative
+# to process start, which is what the driver's timeout measures.
+_T0 = time.perf_counter()
+
+
+def _elapsed() -> float:
+    return time.perf_counter() - _T0
+
+
+def _stamp(msg: str) -> None:
+    """Progress stamp on stderr (VERDICT r4 #1b): if the driver's timeout
+    kills the run, the captured tail shows exactly which phase ate the
+    budget instead of nothing at all (BENCH_r04.json's empty tail)."""
+    print(f"[bench +{_elapsed():7.1f}s] {msg}", file=sys.stderr, flush=True)
+
+
+def _budget() -> float:
+    """Wall-clock budget in seconds (BENCH_TIME_BUDGET).  The driver's
+    round-4 capture was rc=124 — a timeout with zero output — so every
+    phase after the timed repeats degrades gracefully against this budget
+    instead of holding the only JSON line hostage (VERDICT r4 #1c)."""
+    return float(os.environ.get("BENCH_TIME_BUDGET", "1200"))
+
+
+def _behind(frac: float) -> bool:
+    return _elapsed() > frac * _budget()
 
 # Persistent XLA compile cache: the relay engine's ~100-stage programs take
 # minutes to compile through the remote compile service; cache across runs.
@@ -347,7 +385,8 @@ def _superstep_profile(eng, source, *, max_steps: int = 64):
     return {"sync_overhead_seconds": t_sync, "supersteps": prof}
 
 
-def _multi_source_bench(rg, eng, dg, source, *, num_sources, do_check):
+def _multi_source_bench(rg, eng, dg, source, *, num_sources, do_check,
+                        probe_note=None):
     """BASELINE.json config-5: ``num_sources`` independent lock-step BFS
     trees on the relay layout, ELEMENT-MAJOR: 32 trees per uint32 element,
     every routing-mask word read once per superstep for the WHOLE batch, 64
@@ -357,9 +396,15 @@ def _multi_source_bench(rg, eng, dg, source, *, num_sources, do_check):
     Also times ``min(8, num_sources)`` chained SINGLE-source searches in the
     same run so the batching multiplier (``aggregate_vs_single``) is a
     same-device-state measurement, and — unless BENCH_CHECK=0 — verifies
-    EVERY tree against the ported algs4 ``check()`` invariants."""
+    EVERY tree against the ported algs4 ``check()`` invariants.
+
+    If the graph is deeper than elem mode's 31-level distance planes the
+    warm run comes back unconverged; the bench then falls back to the
+    vmapped batched engine IN THE SAME INVOCATION (VERDICT r4 #6) instead
+    of dying with a SystemExit mid-benchmark."""
     from .oracle.bfs import check
 
+    _stamp("multi-source bench: reference run (compile + warm)...")
     ref = eng.run(source)
     reached_mask, directed_per_tree = _component_and_numerator(ref, dg)
 
@@ -379,6 +424,7 @@ def _multi_source_bench(rg, eng, dg, source, *, num_sources, do_check):
     repeats = int(os.environ.get("BENCH_REPEATS", "3"))
     k_single = min(8, num_sources)
     ss_roots = [int(s) for s in sources[:k_single]]
+    _stamp(f"warming {k_single} chained single-source searches...")
     _ = int(eng.run_many_device(ss_roots)[-1].level)  # warm
     single_times = []
     for _i in range(repeats):
@@ -388,29 +434,88 @@ def _multi_source_bench(rg, eng, dg, source, *, num_sources, do_check):
     t_single = float(np.median(single_times)) / k_single
     single_teps = (directed_per_tree / 2) / t_single
 
+    _stamp(f"warming element-major batch ({padded.shape[0]} trees)...")
     state = eng.run_multi_elem_device(padded)
     _ = int(state.level)  # compile + sync
+
+    batching = "element-major (32 trees/uint32, one program)"
+    run_batch = eng.run_multi_elem_device
+    if bool(np.asarray(jax.device_get(state.changed))):
+        # Eccentricity > 31 from at least one source: elem mode's bit-sliced
+        # distance planes cannot converge.  Fall back to the vmapped batched
+        # engine (full int32 distances, no depth cap) and keep going.
+        _stamp(
+            "element-major unconverged at its 31-level cap; falling back "
+            "to the vmapped batched engine"
+        )
+        batching = "vmapped (element-major fell back: eccentricity > 31)"
+        run_batch = eng.run_multi_device
+        state = run_batch(padded)
+        _ = int(state.level)  # compile + warm
+    _stamp("warm done; timing batch repeats...")
 
     times = []
     for _i in range(repeats):
         t0 = time.perf_counter()
-        state = eng.run_multi_elem_device(padded)
+        state = run_batch(padded)
         levels = [int(state.level)]
         times.append(time.perf_counter() - t0)
+        _stamp(f"batch repeat: {times[-1]:.3f}s")
     t = float(np.median(times))
 
-    if bool(np.asarray(jax.device_get(state.changed))):
-        raise SystemExit(
-            "element-major run unconverged at its 31-level cap — this graph "
-            "is too deep for elem mode; rerun the bench with BENCH_SOURCES "
-            "on the vmapped path (models/bfs.py run_multi_device)"
+    aggregate_teps = (num_sources * directed_per_tree / 2) / t
+    common = {
+        "device": str(jax.devices()[0]),
+        "engine": "relay",
+        "applier": eng.applier,
+        "applier_probe": eng.applier_probe or probe_note,
+        "num_vertices": dg.num_vertices,
+        "num_directed_edges": dg.num_edges,
+        "num_sources": num_sources,
+        "batching": batching,
+        "supersteps": levels,
+        "directed_edges_traversed_per_tree": directed_per_tree,
+        "teps_convention": "graph500 aggregate: sources * input undirected edges in traversed component / total time",
+        "total_seconds": t,
+        "batch_times": times,
+        "seconds_per_tree": t / num_sources,
+        "single_source_teps_same_run": single_teps,
+        "single_source_seconds_same_run": t_single,
+        "aggregate_vs_single": aggregate_teps / single_teps,
+    }
+
+    def emit(check_status, extra):
+        print(
+            json.dumps(
+                {
+                    "metric": f"rmat{int(np.log2(dg.num_vertices))}_multi{num_sources}_aggregate_teps",
+                    "value": aggregate_teps,
+                    "unit": "TEPS",
+                    "vs_baseline": aggregate_teps / BASELINE_TEPS,
+                    "details": {**common, "check": check_status, **extra},
+                }
+            ),
+            flush=True,
         )
+
+    emit("pending (final line follows)", {"provisional": True})
+    _stamp("provisional headline emitted; verifying trees...")
 
     check_status = "skipped"
     if do_check:
-        mr = eng.run_multi_elem(padded)  # host results for ALL trees
+        if batching.startswith("element-major"):
+            mr = eng.run_multi_elem(padded)  # host results for ALL trees
+        else:
+            mr = eng.run_multi(padded)
         host_graph = Graph(dg.num_vertices, *unpad_edges(dg))
+        n_checked = 0
         for i in range(num_sources):
+            if n_checked >= 1 and _behind(0.90):
+                _stamp(
+                    f"behind budget: stopping verification after "
+                    f"{n_checked}/{num_sources} trees"
+                )
+                break
             s = int(padded[i])
             np.testing.assert_array_equal(
                 mr.dist[i] != np.iinfo(np.int32).max, reached_mask,
@@ -421,41 +526,13 @@ def _multi_source_bench(rg, eng, dg, source, *, num_sources, do_check):
                 raise SystemExit(
                     f"BFS invariant violations on tree {i}: {violations[:5]}"
                 )
-        check_status = (
-            f"passed ({num_sources}/{num_sources} trees fully verified)"
-        )
+            n_checked += 1
+        check_status = f"passed ({n_checked}/{num_sources} trees fully verified)"
+        if n_checked < num_sources:
+            check_status += " [budget-limited]"
 
-    aggregate_teps = (num_sources * directed_per_tree / 2) / t
-    print(
-        json.dumps(
-            {
-                "metric": f"rmat{int(np.log2(dg.num_vertices))}_multi{num_sources}_aggregate_teps",
-                "value": aggregate_teps,
-                "unit": "TEPS",
-                "vs_baseline": aggregate_teps / BASELINE_TEPS,
-                "details": {
-                    "device": str(jax.devices()[0]),
-                    "engine": "relay",
-                    "applier": eng.applier,
-                    "applier_probe": eng.applier_probe,
-                    "num_vertices": dg.num_vertices,
-                    "num_directed_edges": dg.num_edges,
-                    "num_sources": num_sources,
-                    "batching": "element-major (32 trees/uint32, one program)",
-                    "supersteps": levels,
-                    "directed_edges_traversed_per_tree": directed_per_tree,
-                    "teps_convention": "graph500 aggregate: sources * input undirected edges in traversed component / total time",
-                    "total_seconds": t,
-                    "batch_times": times,
-                    "seconds_per_tree": t / num_sources,
-                    "single_source_teps_same_run": single_teps,
-                    "single_source_seconds_same_run": t_single,
-                    "aggregate_vs_single": aggregate_teps / single_teps,
-                    "check": check_status,
-                },
-            }
-        )
-    )
+    emit(check_status, {})
+    _stamp("final line emitted; done")
 
 
 def main():
@@ -475,29 +552,45 @@ def main():
     if num_sources > 1 and engine != "relay":
         raise SystemExit("BENCH_SOURCES > 1 requires BENCH_ENGINE=relay")
 
+    _stamp(
+        f"config: scale={scale} ef={edge_factor} engine={engine} "
+        f"roots={num_roots} repeats={repeats} sources={num_sources} "
+        f"budget={_budget():.0f}s device={jax.devices()[0]}"
+    )
     backend = _generator_backend()
     seed, block = 42, 8 * 1024
     graph_key = f"{backend}_s{scale}_ef{edge_factor}_seed{seed}_block{block}"
+    _stamp("loading device graph (npz cache or rebuild)...")
     dg, source = load_or_build(scale, edge_factor, seed, block, backend)
+    _stamp(f"device graph ready: V={dg.num_vertices} E={dg.num_edges}")
     layout_detail = {}
 
     if engine == "relay":
         from .models.bfs import RelayEngine
 
+        _stamp("loading relay layout (npz cache or rebuild)...")
         rg, build_seconds = load_or_build_relay(dg, graph_key)
-        eng = RelayEngine(
-            rg, sparse_hybrid=sparse,
-            applier=os.environ.get("BENCH_APPLIER", "auto"),
-        )
+        _stamp(f"relay layout ready (build_seconds={build_seconds:.1f})")
+        applier = os.environ.get("BENCH_APPLIER", "auto")
+        if applier == "auto" and _behind(0.30):
+            # The probe compiles + times several programs; behind budget we
+            # take the applier that has won every recorded capture instead
+            # of risking the headline on diagnostics (VERDICT r4 #1c).
+            applier = "pallas"
+            layout_detail["applier_probe"] = "skipped (time budget)"
+        eng = RelayEngine(rg, sparse_hybrid=sparse, applier=applier)
+        _stamp(f"engine init done (applier={eng.applier})")
         if num_sources > 1:
             _multi_source_bench(
                 rg, eng, dg, source,
                 num_sources=num_sources, do_check=do_check,
+                probe_note=layout_detail.get("applier_probe"),
             )
             return
         layout_detail = {
             "applier": eng.applier,
-            "applier_probe": eng.applier_probe,
+            "applier_probe": eng.applier_probe
+            or layout_detail.get("applier_probe"),
             "relay_layout_build_seconds": build_seconds,
             "relay_mask_bytes": int(rg.net_masks.nbytes + rg.vperm_masks.nbytes),
             "relay_net_mask_bytes": int(rg.net_masks.nbytes),
@@ -566,7 +659,9 @@ def main():
             )
 
     # ---- reference run: component, numerator, random roots -----------------
+    _stamp("reference run (compile + warm)...")
     ref = host_result(source)  # also compiles + warms
+    _stamp("reference run done; computing component + roots...")
     reached_mask, directed_traversed = _component_and_numerator(ref, dg)
     rng = np.random.default_rng(4242)
     pool = np.flatnonzero(reached_mask)
@@ -580,8 +675,13 @@ def main():
         # last state's level syncs the whole batch.
         return int(states[-1].level)
 
+    _stamp(f"warming {num_roots}-root chained batch...")
     levels = sync(run_roots(roots))  # warm every root's program instance
+    _stamp("warm done; timing repeats...")
 
+    if _behind(0.60) and repeats > 1:
+        _stamp(f"behind budget: repeats {repeats} -> 1")
+        repeats = 1
     times = []
     for i in range(repeats):
         if profile_dir and i == repeats - 1:
@@ -593,16 +693,64 @@ def main():
             t0 = time.perf_counter()
             levels = sync(run_roots(roots))
             times.append(time.perf_counter() - t0)
+        _stamp(f"repeat {i + 1}/{repeats}: {times[-1]:.3f}s")
     total = float(np.median(times))
     per_search = total / num_roots
+
+    teps = (directed_traversed / 2) / per_search
+    teps_directed_total = dg.num_edges / per_search
+
+    common = {
+        "device": str(jax.devices()[0]),
+        "engine": engine,
+        "num_vertices": dg.num_vertices,
+        "num_directed_edges": dg.num_edges,
+        "num_roots": num_roots,
+        "roots": roots,
+        "supersteps_last_root": levels,
+        "vertices_reached": int(reached_mask.sum()),
+        "teps_convention": (
+            "graph500: input undirected edges in traversed "
+            "component / mean time per search (K chained "
+            "searches, one sync)"
+        ),
+        "directed_edges_traversed": directed_traversed,
+        "teps_directed_total": teps_directed_total,
+        "seconds_per_search": per_search,
+        "batch_seconds_median": total,
+        "batch_times": times,
+    }
+
+    def emit(check_status, extra):
+        print(
+            json.dumps(
+                {
+                    "metric": f"rmat{scale}_ssbfs_teps",
+                    "value": teps,
+                    "unit": "TEPS",
+                    "vs_baseline": teps / BASELINE_TEPS,
+                    "details": {**common, "check": check_status, **extra},
+                }
+            ),
+            flush=True,
+        )
+
+    # Provisional headline IMMEDIATELY after the timed repeats (VERDICT r4
+    # #1a): if any later phase — profile, verification — dies or outlives
+    # the driver's timeout, the evidence line is already in the tail.  The
+    # final line (verification status filled in) follows and supersedes it.
+    emit("pending (final line follows)", {"provisional": True, **layout_detail})
+    _stamp("provisional headline emitted; starting diagnostics + checks")
 
     # Per-superstep dense/sparse decomposition of the first (hub) root —
     # untimed diagnostics, after the timed repeats (VERDICT r3 #2).
     if engine == "relay" and os.environ.get("BENCH_STEP_PROFILE", "1") != "0":
-        layout_detail["superstep_profile"] = _superstep_profile(eng, source)
-
-    teps = (directed_traversed / 2) / per_search
-    teps_directed_total = dg.num_edges / per_search
+        if _behind(0.65):
+            _stamp("behind budget: skipping superstep profile")
+            layout_detail["superstep_profile"] = "skipped (time budget)"
+        else:
+            layout_detail["superstep_profile"] = _superstep_profile(eng, source)
+            _stamp("superstep profile done")
 
     check_status = "skipped"
     if do_check:
@@ -612,7 +760,14 @@ def main():
         host_graph = Graph(dg.num_vertices, esrc, edst)
         inf = np.iinfo(np.int32).max
         to_check = roots[: max(1, check_roots)]
+        n_checked = 0
         for s in to_check:
+            if n_checked >= 1 and _behind(0.90):
+                _stamp(
+                    f"behind budget: stopping verification after "
+                    f"{n_checked}/{len(to_check)} roots"
+                )
+                break
             res = host_result(s)
             np.testing.assert_array_equal(
                 res.dist != inf, reached_mask,
@@ -623,40 +778,14 @@ def main():
                 raise SystemExit(
                     f"BFS invariant violations from root {s}: {violations[:5]}"
                 )
-        check_status = f"passed ({len(to_check)}/{num_roots} roots fully verified)"
+            n_checked += 1
+            _stamp(f"root {s} verified ({n_checked}/{len(to_check)})")
+        check_status = f"passed ({n_checked}/{num_roots} roots fully verified)"
+        if n_checked < len(to_check):
+            check_status += " [budget-limited]"
 
-    print(
-        json.dumps(
-            {
-                "metric": f"rmat{scale}_ssbfs_teps",
-                "value": teps,
-                "unit": "TEPS",
-                "vs_baseline": teps / BASELINE_TEPS,
-                "details": {
-                    "device": str(jax.devices()[0]),
-                    "engine": engine,
-                    "num_vertices": dg.num_vertices,
-                    "num_directed_edges": dg.num_edges,
-                    "num_roots": num_roots,
-                    "roots": roots,
-                    "supersteps_last_root": levels,
-                    "vertices_reached": int(reached_mask.sum()),
-                    "teps_convention": (
-                        "graph500: input undirected edges in traversed "
-                        "component / mean time per search (K chained "
-                        "searches, one sync)"
-                    ),
-                    "directed_edges_traversed": directed_traversed,
-                    "teps_directed_total": teps_directed_total,
-                    "check": check_status,
-                    "seconds_per_search": per_search,
-                    "batch_seconds_median": total,
-                    "batch_times": times,
-                    **layout_detail,
-                },
-            }
-        )
-    )
+    emit(check_status, layout_detail)
+    _stamp("final line emitted; done")
 
 
 if __name__ == "__main__":

@@ -18,9 +18,12 @@ Conventions shared with the C++ router and the XLA applier:
 
 from __future__ import annotations
 
+import atexit
 import contextlib
 import ctypes
 import os
+import signal
+import threading
 
 import numpy as np
 
@@ -109,8 +112,11 @@ def _reserve_hugepages(n: int) -> int | None:
 
     Raises the SYSTEM-WIDE ``/proc/sys/vm/nr_hugepages`` sysctl (~5 GB at
     net 2^28); :func:`route_std` restores the previous value after routing
-    (the router's hugetlb mappings are freed by then).  Returns the prior
-    value when the sysctl was raised, else None.  Set
+    (the router's hugetlb mappings are freed by then), with an
+    atexit + SIGTERM fallback restore for abnormal exits (ADVICE r4).  A
+    SIGKILL / OOM-kill can still strand the reservation — recovery is
+    ``echo 0 > /proc/sys/vm/nr_hugepages`` (or the prior value).  Returns
+    the prior value when the sysctl was raised, else None.  Set
     ``BFS_TPU_HUGEPAGES=0`` to skip entirely (the router falls back to 4KB
     pages).  Needs root; silently a no-op without it."""
     if os.environ.get("BFS_TPU_HUGEPAGES", "1") == "0":
@@ -138,8 +144,69 @@ def _restore_hugepages(prev: int | None) -> None:
         pass
 
 
+# One outstanding raised-sysctl value per process, guarded by a reentrant
+# lock (ADVICE r4: the bare _HOLD_DEPTH/_HOLD_PREV globals were not
+# thread-safe, and nothing restored the sysctl on SIGTERM/interpreter
+# exit).  _ACTIVE_PREV is the value to write back; the atexit + SIGTERM
+# hooks restore it on abnormal exits.
+_HP_LOCK = threading.RLock()
 _HOLD_DEPTH = 0
-_HOLD_PREV: int | None = None
+_HOLD_ACQUIRED = False  # the hold (not a frame) owns an acquired raise
+_ACTIVE_PREV: int | None = None
+_EMERGENCY_INSTALLED = False
+
+
+def _emergency_restore(*_args) -> None:
+    # Signal-handler-safe: a plain swap + file write, no locks.
+    global _ACTIVE_PREV
+    prev, _ACTIVE_PREV = _ACTIVE_PREV, None
+    _restore_hugepages(prev)
+
+
+def _install_emergency_restore() -> None:
+    global _EMERGENCY_INSTALLED
+    if _EMERGENCY_INSTALLED:
+        return
+    _EMERGENCY_INSTALLED = True
+    atexit.register(_emergency_restore)
+    try:
+        prev_handler = signal.getsignal(signal.SIGTERM)
+
+        def _on_sigterm(signum, frame):
+            _emergency_restore()
+            if callable(prev_handler):
+                prev_handler(signum, frame)
+            elif prev_handler is signal.SIG_IGN:
+                pass  # preserve the process's ignored-TERM disposition
+            else:
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                signal.raise_signal(signal.SIGTERM)
+
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except (ValueError, OSError):
+        pass  # signal handlers are only settable from the main thread
+
+
+def _acquire_hugepages(n: int) -> bool:
+    """Raise the sysctl for an ``n``-slot route.  Returns True iff THIS
+    call raised it (the caller must then :func:`_release_hugepages`)."""
+    global _ACTIVE_PREV
+    with _HP_LOCK:
+        if _ACTIVE_PREV is not None:
+            return False  # another caller in this process holds the raise
+        prev = _reserve_hugepages(n)
+        if prev is None:
+            return False
+        _ACTIVE_PREV = prev
+        _install_emergency_restore()
+        return True
+
+
+def _release_hugepages() -> None:
+    global _ACTIVE_PREV
+    with _HP_LOCK:
+        prev, _ACTIVE_PREV = _ACTIVE_PREV, None
+    _restore_hugepages(prev)
 
 
 @contextlib.contextmanager
@@ -152,17 +219,25 @@ def hugepage_reservation(n: int):
     reservation while a hold is active.  Same ``n >= 2^24`` gate as
     route_std's own reservation: small builds (test graphs) stay sysctl
     no-ops."""
-    global _HOLD_DEPTH, _HOLD_PREV
-    if _HOLD_DEPTH == 0:
-        _HOLD_PREV = _reserve_hugepages(n) if n >= (1 << 24) else None
-    _HOLD_DEPTH += 1
+    global _HOLD_DEPTH, _HOLD_ACQUIRED
+    with _HP_LOCK:
+        if _HOLD_DEPTH == 0 and n >= (1 << 24):
+            # Ownership lives in the shared hold state, NOT this frame:
+            # with overlapping holds from different threads the acquiring
+            # frame may exit first, and whichever frame brings the depth
+            # back to zero must do the release.
+            _HOLD_ACQUIRED = _acquire_hugepages(n)
+        _HOLD_DEPTH += 1
     try:
         yield
     finally:
-        _HOLD_DEPTH -= 1
-        if _HOLD_DEPTH == 0:
-            _restore_hugepages(_HOLD_PREV)
-            _HOLD_PREV = None
+        with _HP_LOCK:
+            _HOLD_DEPTH -= 1
+            release = _HOLD_DEPTH == 0 and _HOLD_ACQUIRED
+            if release:
+                _HOLD_ACQUIRED = False
+        if release:
+            _release_hugepages()
 
 
 def route_std(perm: np.ndarray, *, trusted: bool = False) -> np.ndarray:
@@ -178,14 +253,14 @@ def route_std(perm: np.ndarray, *, trusted: bool = False) -> np.ndarray:
     n = int(perm.shape[0])
     if n < 32 or n & (n - 1):
         raise ValueError(f"network size {n} is not a power of two >= 32")
-    reserve = n >= (1 << 24) and _HOLD_DEPTH == 0
-    prev_pages = _reserve_hugepages(n) if reserve else None
+    acquired = n >= (1 << 24) and _acquire_hugepages(n)
     try:
         words = n // 32
         masks = np.zeros(num_stages(n) * words, dtype=np.uint32)
         rc = lib.benes_route_i32_v2(n, perm, masks, int(trusted))
     finally:
-        _restore_hugepages(prev_pages)
+        if acquired:
+            _release_hugepages()
     if rc == -2:
         raise MemoryError(
             f"native router could not allocate its ~{20 * n >> 20} MiB "

@@ -111,6 +111,21 @@ def split_passes(table: tuple[StageSpec, ...], n: int, tile_rows: int = TILE_ROW
     """(prefix outer stages, local run, suffix outer stages, tr)."""
     r = n // 32 // LANES
     tr = min(tile_rows, max(r, 1))
+    # Env overrides (BFS_TPU_TILE_ROWS / BFS_TPU_OUTER_TT) must keep the
+    # grid exact: a tr that does not divide r makes ``grid = r // tr`` drop
+    # the tail rows and both local paths then silently produce a wrong
+    # permutation (ADVICE r4).  Fail loudly instead.
+    if r > 1:
+        if tr <= 0 or r % tr:
+            raise ValueError(
+                f"tile_rows={tr} does not divide the {r}-row network view; "
+                "pick a power-of-two BFS_TPU_TILE_ROWS that divides it"
+            )
+        tt = min(OUTER_TT, tr)
+        if tt <= 0 or tr % tt:
+            raise ValueError(
+                f"BFS_TPU_OUTER_TT={OUTER_TT} does not divide tile_rows={tr}"
+            )
     local = [i for i, st in enumerate(table) if st.d < tr * 4096]
     assert local, "no local stages — network too small for the fused path"
     lo, hi = local[0], local[-1] + 1

@@ -530,6 +530,13 @@ int32_t benes_route_i32_v2(int64_t n, const int32_t* perm,
   const size_t nb_pc = static_cast<size_t>(n) * sizeof(RouterV2::PC);
   HugeBuf a(nb_pc), b(nb_pc), inv(static_cast<size_t>(n) * 4);
   if (!a.p || !b.p || !inv.p) return -2;
+  // HugeBuf memory is uninitialized (mmap pages are zeroed, the
+  // posix_memalign fallback is not).  a/b/inv are fully rewritten per
+  // level for a BIJECTIVE perm, but with trusted=1 the bijection check is
+  // skipped and a caller bug would make the inv walk read garbage; zero
+  // inv once so that failure mode stays bounded (ADVICE r4 — 4n bytes,
+  // negligible vs routing time).
+  std::memset(inv.p, 0, static_cast<size_t>(n) * 4);
   RouterV2::PC* ap = static_cast<RouterV2::PC*>(a.p);
   for (int64_t j = 0; j < n; ++j) ap[j] = {perm[j], -1};
   RouterV2 r;
