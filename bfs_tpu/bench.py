@@ -41,8 +41,10 @@ when behind: the applier probe, extra repeats, the superstep profile and
 all-but-one verification roots are dropped rather than timing out with
 zero output.
 
-Env knobs: BENCH_TIME_BUDGET (seconds, default 1200), BENCH_SCALE
-(default 24), BENCH_EDGE_FACTOR (default 6 — exactly
+Env knobs: BENCH_TIME_BUDGET (seconds, default 1200), BENCH_PROBE
+(``fresh`` re-measures the applier probe instead of reusing the cached
+outcome), BFS_TPU_PROBE_BUDGET (probe wall budget, default 600 s),
+BENCH_SCALE (default 24), BENCH_EDGE_FACTOR (default 6 — exactly
 the BASELINE.json "100M-edge R-MAT scale-24" config), BENCH_ROOTS (8),
 BENCH_REPEATS (3), BENCH_ENGINE (relay|pull|push), BENCH_CHECK (1),
 BENCH_CHECK_ROOTS (default = BENCH_ROOTS), BENCH_APPLIER
@@ -149,6 +151,25 @@ def _generator_backend() -> str:
         return "native" if native_available() else "numpy"
     except Exception:
         return "numpy"
+
+
+def _measure_tunnel_mbs(probe_mb: int = 16) -> float:
+    """Host->device bandwidth through the axon tunnel, measured with one
+    ``probe_mb``-MB ship + 1-element value sync.  The tunnel's effective
+    bandwidth is time-varying by ORDERS OF MAGNITUDE (3 MB/s observed in
+    the window after round 4's driver timeout vs 100+ MB/s in healthy
+    windows), and the relay engine must ship ~1.4 GB of routing masks
+    before it can run at all — the difference between a 15-second init
+    and a 7-minute one.  Costs ~1 s healthy, ~5 s degraded."""
+    import jax.numpy as jnp
+
+    x = np.ones((probe_mb << 20) // 4, np.uint32)
+    t0 = time.perf_counter()
+    d = jnp.asarray(x)
+    _ = int(np.asarray(jax.device_get(d.ravel()[:1]))[0])
+    dt = time.perf_counter() - t0
+    del d
+    return probe_mb / max(dt, 1e-6)
 
 
 def load_or_build(scale: int, edge_factor: int, seed: int, block: int, backend: str):
@@ -333,21 +354,43 @@ def load_or_build_relay(dg, key: str):
     return _cached(f"relay_v{LAYOUT_VERSION}_{key}", unpack, build)
 
 
-def _component_and_numerator(result, dg):
-    inf = np.iinfo(np.int32).max
-    reached_mask = result.dist != inf
-    esrc, _ = unpad_edges(dg)
-    directed = int(np.count_nonzero(reached_mask[esrc]))
-    return reached_mask, directed
+def _reached_mask_packed(state, npad: int, remap=None):
+    """Component mask from a DEVICE result state via a packed-bit pull:
+    V/8 bytes through the tunnel instead of the 8 bytes/vertex of a full
+    dist+parent download (128 MB at s24 — minutes in the degraded-tunnel
+    windows that killed round 4's driver capture).  ``remap``: old->new id
+    table when the state lives in a relabeled space."""
+    from .ops.relay import pack_std
+
+    def _pack(d):
+        pad = (-d.shape[0]) % 32
+        if pad:
+            d = jnp.concatenate(
+                [d, jnp.full(pad, np.iinfo(np.int32).max, d.dtype)]
+            )
+        return pack_std(d != np.iinfo(np.int32).max)
+
+    packed = jax.jit(_pack)(state.dist)
+    words = np.asarray(jax.device_get(packed))
+    bits = (
+        (words[:, None] >> np.arange(32, dtype=np.uint32)) & 1
+    ).astype(bool).reshape(-1)[:npad]
+    return bits[remap] if remap is not None else bits
 
 
-def _superstep_profile(eng, source, *, max_steps: int = 64):
+def _superstep_profile(eng, source, *, max_steps: int = 64, passes: int = 3):
     """Stepped decomposition of one search: per-superstep wall time and the
     dense/sparse path decision, running the same superstep body the fused
     loop would pick for each frontier (RelayEngine.step_dispatch on the
     SPARSE_BV/BE predicate, decided from the measured stats).  Each entry's
     time includes one device sync; the measured empty round-trip is
-    reported as ``sync_overhead_seconds`` so the reader can subtract it."""
+    reported as ``sync_overhead_seconds`` so the reader can subtract it.
+
+    The decomposition runs ``passes`` times and reports the per-level
+    MEDIAN, with the [min, max] spread per entry and a ``contaminated``
+    flag when the spread exceeds 10x — a concurrent tenant on the shared
+    bench chip can poison any single draw by orders of magnitude (round
+    4's s25 capture shipped a 531 s entry; VERDICT r4 #8)."""
 
     tiny = jnp.zeros(8, jnp.uint32)
     sync_fn = jax.jit(lambda a: a + 1)
@@ -364,25 +407,49 @@ def _superstep_profile(eng, source, *, max_steps: int = 64):
     state = eng.init_state(source)
     eng.warm_step_bodies(state)
     _ = int(eng.step_dispatch(state)[0].level)
-    state = eng.init_state(source)
-    prof = []
-    while bool(state.changed) and len(prof) < max_steps:
-        fsize, fedges = eng.frontier_stats(state)
-        decide = eng.take_sparse(state)  # predicate round-trip untimed
-        t0 = time.perf_counter()
-        state, path = eng.step_dispatch(state, take_sparse=decide)
-        level = int(state.level)  # sync
-        dt = time.perf_counter() - t0
-        prof.append(
-            {
-                "level": level,
-                "frontier_vertices": fsize,
-                "frontier_edges": fedges,
-                "path": path,
-                "seconds_incl_sync": dt,
-            }
-        )
-    return {"sync_overhead_seconds": t_sync, "supersteps": prof}
+    runs = []
+    for _p in range(passes):
+        if runs and _behind(0.75):
+            # A contaminated window can stretch one pass by orders of
+            # magnitude; never let an untimed diagnostic eat the budget
+            # the verified final line needs (VERDICT r4 #1).
+            break
+        state = eng.init_state(source)
+        prof = []
+        while bool(state.changed) and len(prof) < max_steps:
+            fsize, fedges = eng.frontier_stats(state)
+            decide = eng.take_sparse(state)  # predicate round-trip untimed
+            t0 = time.perf_counter()
+            state, path = eng.step_dispatch(state, take_sparse=decide)
+            level = int(state.level)  # sync
+            dt = time.perf_counter() - t0
+            prof.append(
+                {
+                    "level": level,
+                    "frontier_vertices": fsize,
+                    "frontier_edges": fedges,
+                    "path": path,
+                    "seconds_incl_sync": dt,
+                }
+            )
+        runs.append(prof)
+    # The walk is deterministic (same levels/paths each pass); merge by
+    # index with a per-entry median + spread.
+    merged = []
+    for i, entry in enumerate(runs[0]):
+        ts = sorted(r[i]["seconds_incl_sync"] for r in runs if i < len(r))
+        med = float(ts[len(ts) // 2])
+        out = dict(entry)
+        out["seconds_incl_sync"] = med
+        out["seconds_spread"] = [float(ts[0]), float(ts[-1])]
+        if ts[0] > 0 and ts[-1] / max(ts[0], 1e-9) > 10.0:
+            out["contaminated"] = True
+        merged.append(out)
+    return {
+        "sync_overhead_seconds": t_sync,
+        "passes": len(runs),
+        "supersteps": merged,
+    }
 
 
 def _multi_source_bench(rg, eng, dg, source, *, num_sources, do_check,
@@ -405,8 +472,10 @@ def _multi_source_bench(rg, eng, dg, source, *, num_sources, do_check,
     from .oracle.bfs import check
 
     _stamp("multi-source bench: reference run (compile + warm)...")
-    ref = eng.run(source)
-    reached_mask, directed_per_tree = _component_and_numerator(ref, dg)
+    ref_state = eng.run_many_device([source])[0]
+    reached_mask = _reached_mask_packed(ref_state, rg.vr, remap=rg.old2new)
+    esrc_h, _ = unpad_edges(dg)
+    directed_per_tree = int(np.count_nonzero(reached_mask[esrc_h]))
 
     rng = np.random.default_rng(987)
     pool = np.flatnonzero(reached_mask)
@@ -559,11 +628,53 @@ def main():
     )
     backend = _generator_backend()
     seed, block = 42, 8 * 1024
+    layout_detail = {}
+
+    if engine == "relay":
+        # Tunnel-health scale fallback (insurance against the degraded
+        # windows that killed round 4's driver capture): measure the
+        # host->device bandwidth, estimate the ~mask-shipping cost at the
+        # requested scale, and if it alone would eat the budget, drop to a
+        # smaller scale whose caches are prebuilt.  An honest smaller-scale
+        # number in the capture beats rc=124 with nothing.  Disable with
+        # BENCH_FALLBACK_SCALES="".
+        fb_env = os.environ.get("BENCH_FALLBACK_SCALES", "22,20")
+        fb_scales = [int(s) for s in fb_env.split(",") if s.strip()]
+        fb_scales = [s for s in fb_scales if s < scale]
+        if fb_scales:
+            mbs = _measure_tunnel_mbs()
+            layout_detail["tunnel_mbs"] = mbs
+            _stamp(f"tunnel bandwidth ~{mbs:.1f} MB/s")
+
+            def est_ship_s(s):
+                # ~1.4 GB of device operands at s24, ~proportional to E.
+                return 1400.0 * 2.0 ** (s - 24) / max(mbs, 1e-6)
+
+            requested = scale
+            for cand in [scale] + fb_scales:
+                if est_ship_s(cand) < 0.35 * _budget():
+                    scale = cand
+                    break
+            else:
+                scale = fb_scales[-1]
+            if scale != requested:
+                _stamp(
+                    f"tunnel too slow for s{requested} "
+                    f"(~{est_ship_s(requested):.0f}s of shipping); "
+                    f"falling back to s{scale}"
+                )
+                layout_detail["scale_fallback"] = {
+                    "requested_scale": requested,
+                    "used_scale": scale,
+                    "reason": f"tunnel ~{mbs:.1f} MB/s; estimated "
+                    f"{est_ship_s(requested):.0f}s to ship s{requested} "
+                    f"device operands vs {_budget():.0f}s budget",
+                }
+
     graph_key = f"{backend}_s{scale}_ef{edge_factor}_seed{seed}_block{block}"
     _stamp("loading device graph (npz cache or rebuild)...")
     dg, source = load_or_build(scale, edge_factor, seed, block, backend)
     _stamp(f"device graph ready: V={dg.num_vertices} E={dg.num_edges}")
-    layout_detail = {}
 
     if engine == "relay":
         from .models.bfs import RelayEngine
@@ -572,6 +683,26 @@ def main():
         rg, build_seconds = load_or_build_relay(dg, graph_key)
         _stamp(f"relay layout ready (build_seconds={build_seconds:.1f})")
         applier = os.environ.get("BENCH_APPLIER", "auto")
+        # The probe ships ~2.5 GB of masks through the tunnel and times
+        # four programs — minutes of wall clock that round 4's driver
+        # capture died inside.  Its outcome is stable per graph layout, so
+        # a successful probe is CACHED and reused (BENCH_PROBE=fresh
+        # re-measures; the cached dict is shipped in the capture with a
+        # note so the evidence trail stays intact).
+        probe_cache = os.path.join(_CACHE_DIR, f"probe_{graph_key}.json")
+        if applier == "auto" and os.environ.get("BENCH_PROBE", "") != "fresh":
+            try:
+                with open(probe_cache) as f:
+                    cached_probe = json.load(f)
+                applier = cached_probe["selected"]
+                layout_detail["applier_probe"] = {
+                    **cached_probe,
+                    "note": "cached probe outcome (BENCH_PROBE=fresh "
+                    "re-measures)",
+                }
+                _stamp(f"using cached probe outcome: {applier}")
+            except (OSError, ValueError, KeyError):
+                pass
         if applier == "auto" and _behind(0.30):
             # The probe compiles + times several programs; behind budget we
             # take the applier that has won every recorded capture instead
@@ -580,6 +711,20 @@ def main():
             layout_detail["applier_probe"] = "skipped (time budget)"
         eng = RelayEngine(rg, sparse_hybrid=sparse, applier=applier)
         _stamp(f"engine init done (applier={eng.applier})")
+        if (
+            isinstance(eng.applier_probe, dict)
+            and "selected" in eng.applier_probe
+            # Only a COMPLETE probe (both appliers measured) is worth
+            # pinning: a budget-exhausted probe's selection is a default,
+            # not a measurement, and caching it would lock the default in
+            # across healthy windows too.
+            and "xla_net_apply_seconds" in eng.applier_probe
+        ):
+            os.makedirs(_CACHE_DIR, exist_ok=True)
+            tmp = f"{probe_cache}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(eng.applier_probe, f)
+            os.replace(tmp, probe_cache)
         if num_sources > 1:
             _multi_source_bench(
                 rg, eng, dg, source,
@@ -588,6 +733,7 @@ def main():
             )
             return
         layout_detail = {
+            **layout_detail,
             "applier": eng.applier,
             "applier_probe": eng.applier_probe
             or layout_detail.get("applier_probe"),
@@ -659,10 +805,20 @@ def main():
             )
 
     # ---- reference run: component, numerator, random roots -----------------
+    # The component mask comes down as packed bits (V/8 bytes), NOT a full
+    # dist+parent pull — 2 MB vs 128 MB at s24, minutes of difference in a
+    # degraded-tunnel window.
     _stamp("reference run (compile + warm)...")
-    ref = host_result(source)  # also compiles + warms
+    ref_state = run_roots([source])[0]  # device state; also compiles + warms
+    if engine == "relay":
+        reached_mask = _reached_mask_packed(
+            ref_state, eng.relay_graph.vr, remap=eng.relay_graph.old2new
+        )
+    else:
+        reached_mask = _reached_mask_packed(ref_state, dg.num_vertices)
     _stamp("reference run done; computing component + roots...")
-    reached_mask, directed_traversed = _component_and_numerator(ref, dg)
+    esrc_h, _ = unpad_edges(dg)
+    directed_traversed = int(np.count_nonzero(reached_mask[esrc_h]))
     rng = np.random.default_rng(4242)
     pool = np.flatnonzero(reached_mask)
     roots = [source] + [

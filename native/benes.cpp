@@ -26,6 +26,7 @@
 #include <cstdlib>
 #include <ctime>
 #include <cstring>
+#include <algorithm>
 #include <thread>
 #include <vector>
 
@@ -241,7 +242,10 @@ void transpose_stage(const uint32_t* in, uint32_t* out, int64_t n) {
 // then decides which segments flip, and one sequential pass applies flips.
 struct RouterV2 {
 #ifndef BENES_WALKERS
-#define BENES_WALKERS 32
+// 64 interleaved walks: measured best at n=2^26 on the build VM (color
+// 33 s at 32 walkers round 4 -> 14.5 s at 64; 128 adds only ~1 s more
+// while doubling the per-round bookkeeping scan).
+#define BENES_WALKERS 64
 #endif
   static constexpr int kWalkers = BENES_WALKERS;
   struct Con {
@@ -394,18 +398,147 @@ struct RouterV2 {
     return ts.tv_sec + 1e-9 * ts.tv_nsec;
   }
 
+  //: below this block size the depth-first tail takes over: a block's PC +
+  // scratch + inv working set (20 B/slot = 40 MB at 2^21) fits the build
+  // VM's 105 MB L3, so one DRAM pass routes ALL its remaining levels
+  // instead of re-streaming the whole array once per level (the
+  // breadth-first sweep's tail levels each cost a full-array pass;
+  // measured ~27% of route time at n=2^26).  2^21 (L3-resident regions)
+  // was tried and measured SLOWER (55.5 vs 50.8 s at n=2^26): walkers
+  // already hide the big-level latency, so early depth-first only trades
+  // streamed passes for worse mask-write locality.
+  static constexpr int64_t kDFMax = int64_t{1} << 15;
+  //: prefetch distance for the sequential-scan random-target loops (inv
+  // build, emit's pc[iv[q]] read) — far enough to cover a DRAM miss at
+  // ~4 B/cycle scan speed, near enough to stay in the L1 prefetch window.
+  static constexpr int64_t kPF = 24;
+
+  // Serial cycle walk (colors only; c low bit).  Correct for any block;
+  // used where the block is cache-resident.
+  static void serial_color(PC* pc, const int32_t* iv, int64_t m) {
+    const int64_t h = m / 2;
+    for (int64_t seed = 0; seed < m; ++seed) {
+      if (pc[seed].c != -1) continue;
+      int64_t j = seed;
+      int32_t c = 0;
+      while (pc[j].c == -1) {
+        pc[j].c = c;
+        const int64_t jp = (j < h) ? j + h : j - h;
+        if (pc[jp].c != -1) break;
+        pc[jp].c = 1 - c;
+        const int64_t i = pc[jp].p;
+        const int64_t ip = (i < h) ? i + h : i - h;
+        j = iv[ip];
+      }
+    }
+  }
+
+  // Switch bits + sub-perms in one pass.  In-stage switches read iv[q]/c
+  // sequentially+independently (overlappable misses) and accumulate mask
+  // words in registers — much faster than the random read-modify-write
+  // set_bit pattern for blocks >= 32.  ``base`` is the block's global slot
+  // offset (32-aligned whenever h >= 32).
+  void emit_level(const PC* pc, const int32_t* iv, PC* up, PC* lo,
+                  int64_t m, int64_t base, int32_t in_stage,
+                  int32_t out_stage, bool prefetch) {
+    const int64_t h = m / 2;
+    if ((h & 31) == 0) {
+      uint32_t* inw = masks + static_cast<int64_t>(in_stage) * words_per_stage;
+      uint32_t* outw =
+          masks + static_cast<int64_t>(out_stage) * words_per_stage;
+      for (int64_t q0 = 0; q0 < h; q0 += 32) {
+        uint32_t win = 0, wout = 0;
+        for (int64_t q = q0; q < q0 + 32; ++q) {
+          if (prefetch && q + kPF < h)
+            __builtin_prefetch(&pc[iv[q + kPF]], 0, 0);
+          if (pc[iv[q]].c & 1) win |= uint32_t{1} << (q - q0);
+          const int32_t cq = pc[q].c & 1;
+          if (cq) wout |= uint32_t{1} << (q - q0);
+          const int64_t j_up = cq == 0 ? q : q + h;
+          const int64_t j_lo = cq == 0 ? q + h : q;
+          const int32_t pu = pc[j_up].p;
+          const int32_t pl = pc[j_lo].p;
+          up[q] = {pu >= h ? pu - static_cast<int32_t>(h) : pu, -1};
+          lo[q] = {pl >= h ? pl - static_cast<int32_t>(h) : pl, -1};
+        }
+        if (win) inw[(base + q0) >> 5] |= win;
+        if (wout) outw[(base + q0) >> 5] |= wout;
+      }
+    } else {  // h < 32: bit-at-a-time
+      for (int64_t q = 0; q < h; ++q) {
+        if (pc[iv[q]].c & 1) set_bit(in_stage, base + q);
+        const int32_t cq = pc[q].c & 1;
+        if (cq) set_bit(out_stage, base + q);
+        const int64_t j_up = cq == 0 ? q : q + h;
+        const int64_t j_lo = cq == 0 ? q + h : q;
+        const int32_t pu = pc[j_up].p;
+        const int32_t pl = pc[j_lo].p;
+        up[q] = {pu >= h ? pu - static_cast<int32_t>(h) : pu, -1};
+        lo[q] = {pl >= h ? pl - static_cast<int32_t>(h) : pl, -1};
+      }
+    }
+  }
+
+  // Depth-first tail: route ONE kDFMax-or-smaller region across ALL its
+  // remaining levels while it is cache-resident.  ``pc`` holds the
+  // region's current sub-perms (level ``level0``), ``tmp`` is an
+  // m0-PC scratch, ``iv`` an m0-int32 scratch; ``gbase`` the region's
+  // global slot offset.  Mask bits for every remaining stage are emitted;
+  // the sub-perm buffers are dead afterwards.
+  void df_region(PC* pc, PC* tmp, int32_t* iv, int64_t m0, int32_t level0,
+                 int64_t gbase) {
+    PC* cur = pc;
+    PC* nxt = tmp;
+    int64_t m = m0;
+    for (int32_t lev = level0;; ++lev) {
+      if (m == 2) {  // final middle stage
+        for (int64_t sb = 0; sb < m0 / 2; ++sb) {
+          if (cur[sb * 2].p == 1) set_bit(lev, gbase + sb * 2);
+        }
+        return;
+      }
+      const int64_t h = m / 2;
+      const int32_t in_stage = lev;
+      const int32_t out_stage = 2 * k - 2 - lev;
+      for (int64_t sb = 0; sb < m0 / m; ++sb) {
+        PC* p = cur + sb * m;
+        int32_t* v = iv + sb * m;
+        for (int64_t j = 0; j < m; ++j) v[p[j].p] = static_cast<int32_t>(j);
+        // DF sub-blocks are L2-resident by construction (m <= kDFMax);
+        // the serial walk wins there — walker bookkeeping only pays for
+        // itself when the chase misses cache (see run()'s breadth loop).
+        serial_color(p, v, m);
+        emit_level(p, v, nxt + sb * m, nxt + sb * m + h, m,
+                   gbase + sb * m, in_stage, out_stage, false);
+      }
+      std::swap(cur, nxt);
+      m >>= 1;
+    }
+  }
+
   void run() {
-    //: blocks below this size are cache-resident; the serial walk is faster
-    // there than walker bookkeeping.
-    constexpr int64_t kWalkerMin = int64_t{1} << 20;
+    // Every breadth-loop block exceeds kDFMax, i.e. is beyond L2 — walker
+    // coloring always wins there (serial-walk misses dominated levels
+    // with m in [2^16, 2^20) under round 4's 2^20 walker threshold —
+    // measured 2.5 s for one m=2^19 level at n=2^26).  The DF tail owns
+    // every cache-resident size and walks serially.
     const bool timing = std::getenv("BENES_TIME") != nullptr;
+    std::vector<PC> dfscratch(static_cast<size_t>(std::min(n, kDFMax)));
+    std::vector<int32_t> dfiv(static_cast<size_t>(std::min(n, kDFMax)));
     for (int32_t level = 0; level < k; ++level) {
       const int64_t m = n >> level;
       const int64_t nblocks = int64_t{1} << level;
-      if (m == 2) {  // final middle stage: swap iff output 0 takes input 1
+      if (m <= kDFMax) {  // cache-blocked depth-first tail
+        const double t0 = timing ? now_s() : 0;
         for (int64_t blk = 0; blk < nblocks; ++blk) {
-          if (a[blk * 2].p == 1) set_bit(level, blk * 2);
+          df_region(a + blk * m, dfscratch.data(), dfiv.data(), m, level,
+                    blk * m);
         }
+        if (timing)
+          std::fprintf(stderr,
+                       "benes df tail from level %2d m=2^%d  %.2fs\n", level,
+                       63 - __builtin_clzll(static_cast<uint64_t>(m)),
+                       now_s() - t0);
         break;
       }
       const int64_t h = m / 2;
@@ -418,73 +551,22 @@ struct RouterV2 {
         int32_t* iv = inv + base;
         PC* up = b + base;
         PC* lo = b + base + h;
-        for (int64_t j = 0; j < m; ++j) iv[pc[j].p] = static_cast<int32_t>(j);
+        for (int64_t j = 0; j < m; ++j) {
+          if (j + kPF < m) __builtin_prefetch(&iv[pc[j + kPF].p], 1, 0);
+          iv[pc[j].p] = static_cast<int32_t>(j);
+        }
         if (timing) {
           const double t = now_s();
           t_inv += t - t0;
           t0 = t;
         }
-        if (m >= kWalkerMin) {
-          color_block_walkers(pc, iv, m);
-        } else {
-          // serial walk (colors only; c low bit)
-          for (int64_t seed = 0; seed < m; ++seed) {
-            if (pc[seed].c != -1) continue;
-            int64_t j = seed;
-            int32_t c = 0;
-            while (pc[j].c == -1) {
-              pc[j].c = c;
-              const int64_t jp = (j < h) ? j + h : j - h;
-              if (pc[jp].c != -1) break;
-              pc[jp].c = 1 - c;
-              const int64_t i = pc[jp].p;
-              const int64_t ip = (i < h) ? i + h : i - h;
-              j = iv[ip];
-            }
-          }
-        }
+        color_block_walkers(pc, iv, m);
         if (timing) {
           const double t = now_s();
           t_col += t - t0;
           t0 = t;
         }
-        // Switch bits + sub-perms in one pass.  In-stage switches read
-        // iv[q]/cl sequentially+independently (overlappable misses) and
-        // accumulate mask words in registers — much faster than the random
-        // read-modify-write set_bit pattern for blocks >= 32.
-        if ((h & 31) == 0) {
-          uint32_t* inw = masks + static_cast<int64_t>(in_stage) * words_per_stage;
-          uint32_t* outw =
-              masks + static_cast<int64_t>(out_stage) * words_per_stage;
-          for (int64_t q0 = 0; q0 < h; q0 += 32) {
-            uint32_t win = 0, wout = 0;
-            for (int64_t q = q0; q < q0 + 32; ++q) {
-              if (pc[iv[q]].c & 1) win |= uint32_t{1} << (q - q0);
-              const int32_t cq = pc[q].c & 1;
-              if (cq) wout |= uint32_t{1} << (q - q0);
-              const int64_t j_up = cq == 0 ? q : q + h;
-              const int64_t j_lo = cq == 0 ? q + h : q;
-              const int32_t pu = pc[j_up].p;
-              const int32_t pl = pc[j_lo].p;
-              up[q] = {pu >= h ? pu - static_cast<int32_t>(h) : pu, -1};
-              lo[q] = {pl >= h ? pl - static_cast<int32_t>(h) : pl, -1};
-            }
-            if (win) inw[(base + q0) >> 5] |= win;
-            if (wout) outw[(base + q0) >> 5] |= wout;
-          }
-        } else {  // h < 32: bit-at-a-time
-          for (int64_t q = 0; q < h; ++q) {
-            if (pc[iv[q]].c & 1) set_bit(in_stage, base + q);
-            const int32_t cq = pc[q].c & 1;
-            if (cq) set_bit(out_stage, base + q);
-            const int64_t j_up = cq == 0 ? q : q + h;
-            const int64_t j_lo = cq == 0 ? q + h : q;
-            const int32_t pu = pc[j_up].p;
-            const int32_t pl = pc[j_lo].p;
-            up[q] = {pu >= h ? pu - static_cast<int32_t>(h) : pu, -1};
-            lo[q] = {pl >= h ? pl - static_cast<int32_t>(h) : pl, -1};
-          }
-        }
+        emit_level(pc, iv, up, lo, m, base, in_stage, out_stage, true);
         if (timing) {
           const double t = now_s();
           t_emit += t - t0;

@@ -10,6 +10,7 @@
 // All functions are deterministic for a given seed (SplitMix64 / a counter-
 // free per-edge PRNG) so Python and future runs agree.
 
+#include <algorithm>
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
@@ -112,52 +113,91 @@ void sort_edges_by_dst(int64_t num_edges, int32_t* src, int32_t* dst) {
 
 // Stable sort of edge records by (key_hi, key_lo) with rank-within-hi-run
 // output — the layout build's replacement for np.lexsort + searchsorted
-// (each ~1-2 min at 2*10^8 edges on the 1-core VM; this is a few seconds).
+// (each ~1-2 min at 2*10^8 edges on the 1-core VM).
 // order_out[i] = original index of the i-th record in sorted order;
 // rank_out[i] = position of record i within its run of equal key_hi values
 // (in sorted order).  Keys must be non-negative int32.
+//
+// Bucket-by-hi + per-row sort: one counting pass over hi, one scatter into
+// row-grouped order, then a tiny sort per row over (lo, idx) packed u64s
+// (ties on lo resolve by original index ascending == LSD-radix stability).
+// The old 7-pass LSD radix re-streamed 12 B/record per pass (~34 GB of
+// traffic at s24, 72 s measured); this does one random scatter + cache-
+// local row sorts.
 void sort_rank_pairs(int64_t n, const int32_t* key_hi, const int32_t* key_lo,
                      int32_t* order_out, int32_t* rank_out) {
   if (n <= 0) return;
+  constexpr int64_t kPF = 24;
   const size_t sn = static_cast<size_t>(n);
-  // pack (hi, lo, idx) into u64 key + u32 payload; radix LSD over used bytes
-  std::vector<uint64_t> keys(sn), ktmp(sn);
-  std::vector<uint32_t> idx(sn), itmp(sn);
-  uint64_t or_all = 0;
-  for (size_t i = 0; i < sn; ++i) {
-    keys[i] = (static_cast<uint64_t>(static_cast<uint32_t>(key_hi[i])) << 31) |
-              static_cast<uint32_t>(key_lo[i]);
-    idx[i] = static_cast<uint32_t>(i);
-    or_all |= keys[i];
-  }
-  for (int shift = 0; shift < 64; shift += 8) {
-    if (((or_all >> shift) & 0xff) == 0) continue;
-    size_t count[257] = {0};
-    for (size_t i = 0; i < sn; ++i) ++count[((keys[i] >> shift) & 0xff) + 1];
-    bool single_bucket = false;
-    for (int b = 0; b < 256; ++b) {
-      if (count[b + 1] == sn) { single_bucket = true; break; }
-    }
-    if (single_bucket) continue;
-    for (int b = 0; b < 256; ++b) count[b + 1] += count[b];
+  int32_t max_hi = 0;
+  for (size_t i = 0; i < sn; ++i) max_hi = std::max(max_hi, key_hi[i]);
+  if (static_cast<int64_t>(max_hi) > 4 * n + 1024) {
+    // Sparse key_hi space: the bucket table would cost ~16 B per key
+    // VALUE, not per record (34 GB at key_hi near INT32_MAX).  Comparison
+    // sort keeps the O(n)-memory contract for such callers; the layout
+    // build's dense vertex-id keys always take the bucket path.
+    std::vector<std::pair<uint64_t, uint32_t>> rec(sn);
     for (size_t i = 0; i < sn; ++i) {
-      const size_t o = count[(keys[i] >> shift) & 0xff]++;
-      ktmp[o] = keys[i];
-      itmp[o] = idx[i];
+      rec[i] = {
+          (static_cast<uint64_t>(static_cast<uint32_t>(key_hi[i])) << 31) |
+              static_cast<uint32_t>(key_lo[i]),
+          static_cast<uint32_t>(i)};
     }
-    keys.swap(ktmp);
-    idx.swap(itmp);
+    std::sort(rec.begin(), rec.end());
+    int64_t run_start = 0;
+    uint64_t run_hi = rec.empty() ? 0 : (rec[0].first >> 31);
+    for (size_t i = 0; i < sn; ++i) {
+      const uint64_t hi = rec[i].first >> 31;
+      if (hi != run_hi) {
+        run_hi = hi;
+        run_start = static_cast<int64_t>(i);
+      }
+      order_out[i] = static_cast<int32_t>(rec[i].second);
+      rank_out[i] = static_cast<int32_t>(static_cast<int64_t>(i) - run_start);
+    }
+    return;
   }
-  int64_t run_start = 0;
-  uint64_t run_hi = keys.empty() ? 0 : (keys[0] >> 31);
+  const size_t nk = static_cast<size_t>(max_hi) + 1;
+  std::vector<int64_t> off(nk + 1, 0);
+  for (size_t i = 0; i < sn; ++i) ++off[static_cast<size_t>(key_hi[i]) + 1];
+  for (size_t k = 0; k < nk; ++k) off[k + 1] += off[k];
+  std::vector<int64_t> cur(off.begin(), off.end() - 1);
+  std::vector<uint64_t> buf(sn);
   for (size_t i = 0; i < sn; ++i) {
-    const uint64_t hi = keys[i] >> 31;
-    if (hi != run_hi) {
-      run_hi = hi;
-      run_start = static_cast<int64_t>(i);
+    if (i + kPF < sn)
+      __builtin_prefetch(&cur[key_hi[i + kPF]], 1, 0);
+    const int64_t o = cur[key_hi[i]]++;
+    buf[static_cast<size_t>(o)] =
+        (static_cast<uint64_t>(static_cast<uint32_t>(key_lo[i])) << 32) | i;
+  }
+  for (size_t k = 0; k < nk; ++k) {
+    uint64_t* lo = buf.data() + off[k];
+    uint64_t* hi = buf.data() + off[k + 1];
+    const int64_t len = hi - lo;
+    if (len > 1) {
+      if (len <= 24) {  // insertion sort: rows average ~E/V entries
+        for (uint64_t* p = lo + 1; p < hi; ++p) {
+          const uint64_t v = *p;
+          uint64_t* q = p;
+          while (q > lo && q[-1] > v) {
+            *q = q[-1];
+            --q;
+          }
+          *q = v;
+        }
+      } else {
+        std::sort(lo, hi);
+      }
     }
-    order_out[i] = static_cast<int32_t>(idx[i]);
-    rank_out[i] = static_cast<int32_t>(static_cast<int64_t>(i) - run_start);
+  }
+  for (size_t k = 0; k < nk; ++k) {
+    const int64_t s = off[k];
+    const int64_t e = off[k + 1];
+    for (int64_t i = s; i < e; ++i) {
+      order_out[i] = static_cast<int32_t>(buf[static_cast<size_t>(i)] &
+                                          0xffffffffULL);
+      rank_out[i] = static_cast<int32_t>(i - s);
+    }
   }
 }
 
@@ -165,14 +205,25 @@ void sort_rank_pairs(int64_t n, const int32_t* key_hi, const int32_t* key_lo,
 // the 1-core build VM while a simple loop lets the OoO core overlap the
 // random loads (~3x).  Used by the relay layout build's slot-assembly
 // phases (graph/relay.py), which are a chain of E-sized gathers.
+// Sequential-scan/random-target loops below all software-prefetch their
+// random line kPF iterations ahead (idx is sequential, so the target is
+// computable early) — measured ~2-3x on the DRAM-resident sizes.
+static constexpr int64_t kPFg = 24;
+
 void gather_i32(int64_t n, const int32_t* table, const int32_t* idx,
                 int32_t* out) {
-  for (int64_t i = 0; i < n; ++i) out[i] = table[idx[i]];
+  for (int64_t i = 0; i < n; ++i) {
+    if (i + kPFg < n) __builtin_prefetch(&table[idx[i + kPFg]], 0, 0);
+    out[i] = table[idx[i]];
+  }
 }
 
 void scatter_i32(int64_t n, const int32_t* idx, const int32_t* val,
                  int32_t* out) {
-  for (int64_t i = 0; i < n; ++i) out[idx[i]] = val[i];
+  for (int64_t i = 0; i < n; ++i) {
+    if (i + kPFg < n) __builtin_prefetch(&out[idx[i + kPFg]], 1, 0);
+    out[idx[i]] = val[i];
+  }
 }
 
 // out[i] = base[idx[i]] + rank[i] * stride[idx[i]] — the fused slot
@@ -180,6 +231,10 @@ void scatter_i32(int64_t n, const int32_t* idx, const int32_t* val,
 void slot_assign_i32(int64_t n, const int32_t* base, const int32_t* stride,
                      const int32_t* idx, const int32_t* rank, int32_t* out) {
   for (int64_t i = 0; i < n; ++i) {
+    if (i + kPFg < n) {
+      __builtin_prefetch(&base[idx[i + kPFg]], 0, 0);
+      __builtin_prefetch(&stride[idx[i + kPFg]], 0, 0);
+    }
     const int32_t v = idx[i];
     out[i] = base[v] + rank[i] * stride[v];
   }
@@ -196,13 +251,19 @@ void slot_assign_i32(int64_t n, const int32_t* base, const int32_t* stride,
 void rank_by_count(int64_t n, const int32_t* key, int64_t nk,
                    int32_t* rank_out) {
   std::vector<int32_t> cnt(static_cast<size_t>(nk), 0);
-  for (int64_t i = 0; i < n; ++i) rank_out[i] = cnt[key[i]]++;
+  for (int64_t i = 0; i < n; ++i) {
+    if (i + kPFg < n) __builtin_prefetch(&cnt[key[i + kPFg]], 1, 0);
+    rank_out[i] = cnt[key[i]]++;
+  }
 }
 
 // One-pass int32 bincount (numpy's runs ~10x slower on the 1-core VM).
 void bincount_i32(int64_t n, const int32_t* key, int64_t nk, int32_t* out) {
   std::memset(out, 0, static_cast<size_t>(nk) * sizeof(int32_t));
-  for (int64_t i = 0; i < n; ++i) ++out[key[i]];
+  for (int64_t i = 0; i < n; ++i) {
+    if (i + kPFg < n) __builtin_prefetch(&out[key[i + kPFg]], 1, 0);
+    ++out[key[i]];
+  }
 }
 
 // Counting-sort CSR fill: group edges by srcn WITHOUT sorting — the
@@ -214,7 +275,10 @@ void csr_fill(int64_t n, int64_t nk, const int32_t* srcn, const int32_t* dstn,
               const int32_t* slotv, int32_t* indptr_out, int32_t* adj_dst,
               int32_t* adj_slot) {
   std::vector<int32_t> off(static_cast<size_t>(nk), 0);
-  for (int64_t i = 0; i < n; ++i) ++off[srcn[i]];
+  for (int64_t i = 0; i < n; ++i) {
+    if (i + kPFg < n) __builtin_prefetch(&off[srcn[i + kPFg]], 1, 0);
+    ++off[srcn[i]];
+  }
   int32_t run = 0;
   for (int64_t k = 0; k < nk; ++k) {
     indptr_out[k] = run;
@@ -225,6 +289,7 @@ void csr_fill(int64_t n, int64_t nk, const int32_t* srcn, const int32_t* dstn,
   indptr_out[nk] = run;
   indptr_out[nk + 1] = run;
   for (int64_t i = 0; i < n; ++i) {
+    if (i + kPFg < n) __builtin_prefetch(&off[srcn[i + kPFg]], 1, 0);
     const int32_t o = off[srcn[i]]++;
     adj_dst[o] = dstn[i];
     adj_slot[o] = slotv[i];
@@ -233,7 +298,10 @@ void csr_fill(int64_t n, int64_t nk, const int32_t* srcn, const int32_t* dstn,
 
 // used[idx[i]] = 1 (uint8 scatter; numpy bool fancy-assign is ~10x slower).
 void mark_u8(int64_t n, const int32_t* idx, uint8_t* used) {
-  for (int64_t i = 0; i < n; ++i) used[idx[i]] = 1;
+  for (int64_t i = 0; i < n; ++i) {
+    if (i + kPFg < n) __builtin_prefetch(&used[idx[i + kPFg]], 1, 0);
+    used[idx[i]] = 1;
+  }
 }
 
 // Complete a partial mapping to a bijection, IDENTITY-FIRST (output j takes
