@@ -106,6 +106,14 @@ jax.config.update(
     ),
 )
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
+# Serialized-executable cache (models/bfs.py compile_exe_cached): jax's
+# persistent cache is inert under the axon remote-compile transport, and
+# the remote service takes tens of minutes for the bench-scale fused
+# programs — this cache turns every repeat compile into a ~seconds
+# deserialize.  BFS_TPU_EXE_CACHE="" disables.
+os.environ.setdefault(
+    "BFS_TPU_EXE_CACHE", os.path.join(_REPO_ROOT, ".bench_cache", "exe")
+)
 
 import jax.numpy as jnp
 import numpy as np

@@ -382,7 +382,13 @@ def run_cell(spec: dict) -> dict:
         # vertex slot) + the scalar termination all-reduce; per-shard static
         # layout bytes let "would N real chips win?" be modeled from data.
         if eng == "relay":
-            gwords = layout.num_shards * layout.block // 32
+            # Compact exchange (parallel/sharded._exchange_compact): only
+            # words holding real vertices travel — n_shards * kw words,
+            # ~V/8 bytes flat in shard count (the naive block-bit gather
+            # grew with per-shard class padding: VERDICT r4 weak #4).
+            from .parallel.sharded import _own_word_table
+
+            gwords = layout.num_shards * _own_word_table(layout).shape[1]
             exch = {
                 "exchange_bytes_per_superstep": gwords * 4,
                 "per_shard_net_mask_bytes": int(layout.net_masks.nbytes

@@ -18,12 +18,15 @@ Two execution modes (same math):
 from __future__ import annotations
 
 import functools
+import logging
 import sys
 from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+logger = logging.getLogger(__name__)
 
 from ..graph.csr import DeviceGraph, Graph, build_device_graph
 from ..graph.ell import PullGraph, build_pull_graph
@@ -435,6 +438,72 @@ def _relay_multi_fused_program(static, use_pallas: bool):
     return fused
 
 
+def compile_exe_cached(lowered, compiler_options):
+    """Compile a lowered program, going through the on-disk EXECUTABLE
+    cache when ``BFS_TPU_EXE_CACHE`` names a directory.
+
+    Needed because jax's persistent compilation cache is inert under the
+    axon remote-compile transport (verified: >5 s compiles write no
+    entries and fresh processes recompile), and the remote service takes
+    TENS OF MINUTES for the bench-scale fused programs — the direct cause
+    of round 4's rc=124 driver capture.  The key is a hash of the lowered
+    StableHLO + compiler options + platform version, so a code or backend
+    change can never load a stale executable; a deserialization failure
+    falls back to a fresh compile."""
+    import hashlib
+    import os
+    import pickle
+
+    cache_dir = os.environ.get("BFS_TPU_EXE_CACHE", "")
+    if not cache_dir or jax.default_backend() != "tpu":
+        return lowered.compile(compiler_options=compiler_options)
+    try:
+        hlo = lowered.as_text().encode()
+    except Exception:
+        return lowered.compile(compiler_options=compiler_options)
+    from jax._src import xla_bridge
+
+    salt = (
+        repr(sorted((compiler_options or {}).items()))
+        + jax.__version__
+        + getattr(xla_bridge.get_backend(), "platform_version", "")
+    ).encode()
+    digest = hashlib.sha256(hlo + salt).hexdigest()[:32]
+    path = os.path.join(cache_dir, f"exe_{digest}.pkl")
+    if os.path.exists(path):
+        try:
+            from jax.experimental.serialize_executable import (
+                deserialize_and_load,
+            )
+
+            with open(path, "rb") as f:
+                payload, in_tree, out_tree = pickle.load(f)
+            compiled = deserialize_and_load(payload, in_tree, out_tree)
+            logger.info("loaded cached executable %s", path)
+            return compiled
+        except Exception:
+            logger.warning(
+                "stale/corrupt executable cache %s; recompiling", path
+            )
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+    compiled = lowered.compile(compiler_options=compiler_options)
+    try:
+        from jax.experimental.serialize_executable import serialize
+
+        payload, in_tree, out_tree = serialize(compiled)
+        os.makedirs(cache_dir, exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as f:
+            pickle.dump((payload, in_tree, out_tree), f)
+        os.replace(tmp, path)
+    except Exception:
+        logger.warning("could not serialize executable", exc_info=True)
+    return compiled
+
+
 def _probe_appliers(rg, compiler_options, loops: int = 16) -> dict:
     """Time BOTH Beneš appliers on the engine's own big net masks and pick
     the faster — ground truth, not a bandwidth model.
@@ -530,10 +599,8 @@ def _probe_appliers(rg, compiler_options, loops: int = 16) -> dict:
 
         return jax.lax.fori_loop(0, k, body, x)
 
-    c_pal = (
-        jax.jit(loop_pallas)
-        .lower(k1, x0, *prepared)
-        .compile(compiler_options=compiler_options)
+    c_pal = compile_exe_cached(
+        jax.jit(loop_pallas).lower(k1, x0, *prepared), compiler_options
     )
     _pstamp("pallas compiled; warming + timing...")
     timed(c_pal, k1, x0, *prepared)  # warm
@@ -563,10 +630,8 @@ def _probe_appliers(rg, compiler_options, loops: int = 16) -> dict:
 
         return jax.lax.fori_loop(0, k, body, x)
 
-    c_xla = (
-        jax.jit(loop_xla)
-        .lower(k1, x0, flat)
-        .compile(compiler_options=compiler_options)
+    c_xla = compile_exe_cached(
+        jax.jit(loop_xla).lower(k1, x0, flat), compiler_options
     )
     timed(c_xla, k1, x0, flat)  # warm
     t_xla, k_xla = per_iter(c_xla, x0, flat)
@@ -595,10 +660,8 @@ def _probe_appliers(rg, compiler_options, loops: int = 16) -> dict:
 
         return jax.lax.fori_loop(0, k, body, jnp.uint32(1))
 
-    c_read = (
-        jax.jit(loop_read)
-        .lower(k1, flat)
-        .compile(compiler_options=compiler_options)
+    c_read = compile_exe_cached(
+        jax.jit(loop_read).lower(k1, flat), compiler_options
     )
     timed(c_read, k1, flat)
     t_read, k_read = per_iter(c_read, flat)
@@ -624,10 +687,8 @@ def _probe_appliers(rg, compiler_options, loops: int = 16) -> dict:
 
         return jax.lax.fori_loop(0, k, body, w)
 
-    c_write = (
-        jax.jit(loop_write)
-        .lower(k1, wb)
-        .compile(compiler_options=compiler_options)
+    c_write = compile_exe_cached(
+        jax.jit(loop_write).lower(k1, wb), compiler_options
     )
     timed(c_write, k1, wb)
     t_write, k_write = per_iter(c_write, wb)
@@ -803,6 +864,9 @@ class RelayEngine:
     #: flags), so fused programs are AOT-compiled with per-compile options.
     _COMPILER_OPTIONS = {"xla_tpu_scoped_vmem_limit_kib": "98304"}
 
+    def _compile_maybe_cached(self, lowered):
+        return compile_exe_cached(lowered, self._COMPILER_OPTIONS)
+
     def _fused(self, source_new, max_levels):
         fused = _relay_fused_program(
             self._static, self.sparse_hybrid, self._use_pallas()
@@ -813,8 +877,8 @@ class RelayEngine:
         key = ("fused", max_levels)
         compiled = self._compiled.get(key)
         if compiled is None:
-            compiled = fused.lower(*args, max_levels=max_levels).compile(
-                compiler_options=self._COMPILER_OPTIONS
+            compiled = self._compile_maybe_cached(
+                fused.lower(*args, max_levels=max_levels)
             )
             self._compiled[key] = compiled
         return compiled(*args)
@@ -866,9 +930,7 @@ class RelayEngine:
                 if jax.default_backend() == "tpu"
                 else None
             )
-            compiled = (
-                jax.jit(fn).lower(*args).compile(compiler_options=opts)
-            )
+            compiled = compile_exe_cached(jax.jit(fn).lower(*args), opts)
             self._compiled[key] = compiled
         return compiled
 
@@ -980,8 +1042,8 @@ class RelayEngine:
         key = ("multi", sources_new.shape[0], max_levels)
         compiled = self._compiled.get(key)
         if compiled is None:
-            compiled = fused.lower(*args, max_levels=max_levels).compile(
-                compiler_options=self._COMPILER_OPTIONS
+            compiled = self._compile_maybe_cached(
+                fused.lower(*args, max_levels=max_levels)
             )
             self._compiled[key] = compiled
         return compiled(*args)
@@ -1033,8 +1095,8 @@ class RelayEngine:
         key = ("elem", groups, max_levels)
         compiled = self._compiled.get(key)
         if compiled is None:
-            compiled = fused.lower(*args, max_levels=max_levels).compile(
-                compiler_options=self._COMPILER_OPTIONS
+            compiled = self._compile_maybe_cached(
+                fused.lower(*args, max_levels=max_levels)
             )
             self._compiled[key] = compiled
         return compiled(*args)

@@ -16,10 +16,45 @@ int32 gather lane-op and one min.
 
 from __future__ import annotations
 
+import os
+
 import jax
 import jax.numpy as jnp
 
 from .relax import INT32_MAX, BfsState, apply_candidates
+
+#: Row-chunk budget for the ELL gather (elements of the materialized
+#: [rows, K] gather, ~4 bytes each).  One whole-matrix gather materializes
+#: rows*K int32s as an HLO temp — ~3 GB at the LiveJournal-shape's 23M
+#: rows, which OOMed the single-chip pull cell (BENCHMARKS.md ERR;
+#: VERDICT r4 #7).  Levels larger than this are gathered in row chunks,
+#: bounding the temp at ~4*BUDGET bytes while leaving small graphs' (and
+#: every test's) program unchanged.
+_CHUNK_ELEMS = int(
+    float(os.environ.get("BFS_TPU_PULL_CHUNK_MB", "128")) * (1 << 20) / 4
+)
+
+
+def _rowmin_level(tab: jax.Array, mat: jax.Array) -> jax.Array:
+    """``min(take(tab, mat, axis=-1), axis=-1)`` with the gather chunked
+    over rows when the materialized [rows, K] temp would exceed the chunk
+    budget."""
+    rows, k = mat.shape[-2], mat.shape[-1]
+    # Leading batch axes of ``tab`` broadcast into the gather output
+    # ([B..., rows, K]); the budget bounds the whole temp, not one slice.
+    batch = 1
+    for d in tab.shape[:-1]:
+        batch *= int(d)
+    chunk_rows = max(_CHUNK_ELEMS // max(k * batch, 1), 1)
+    if rows <= chunk_rows:
+        return jnp.min(jnp.take(tab, mat, axis=-1), axis=-1)
+    outs = []
+    for a in range(0, rows, chunk_rows):
+        b = min(a + chunk_rows, rows)
+        outs.append(
+            jnp.min(jnp.take(tab, mat[..., a:b, :], axis=-1), axis=-1)
+        )
+    return jnp.concatenate(outs, axis=-1)
 
 
 def frontier_table(state: BfsState) -> jax.Array:
@@ -36,11 +71,11 @@ def pull_candidates(frontier_tab: jax.Array, ell0: jax.Array, folds) -> jax.Arra
     broadcast over leading axes.
     """
     num_vertices = frontier_tab.shape[-1] - 1
-    cand = jnp.min(jnp.take(frontier_tab, ell0, axis=-1), axis=-1)
+    cand = _rowmin_level(frontier_tab, ell0)
     for fold in folds:
         inf = jnp.full(cand.shape[:-1] + (1,), INT32_MAX, dtype=jnp.int32)
         cand_ext = jnp.concatenate([cand, inf], axis=-1)
-        cand = jnp.min(jnp.take(cand_ext, fold, axis=-1), axis=-1)
+        cand = _rowmin_level(cand_ext, fold)
     inf = jnp.full(cand.shape[:-1] + (1,), INT32_MAX, dtype=jnp.int32)
     return jnp.concatenate([cand[..., :num_vertices], inf], axis=-1)
 
@@ -52,11 +87,11 @@ def pull_candidates_rows(
     already carries its trailing INF slot (size = table + 1) and the result
     is the first ``num_rows`` row-mins (one per owned vertex), with no slot
     appended.  Broadcasts over leading axes of ``frontier_tab_ext``."""
-    cand = jnp.min(jnp.take(frontier_tab_ext, ell0, axis=-1), axis=-1)
+    cand = _rowmin_level(frontier_tab_ext, ell0)
     for fold in folds:
         inf = jnp.full(cand.shape[:-1] + (1,), INT32_MAX, dtype=jnp.int32)
         cand_ext = jnp.concatenate([cand, inf], axis=-1)
-        cand = jnp.min(jnp.take(cand_ext, fold, axis=-1), axis=-1)
+        cand = _rowmin_level(cand_ext, fold)
     return cand[..., :num_rows]
 
 

@@ -340,7 +340,7 @@ _SHARDED_AOT_CACHE_MAX = 8
     jax.jit, static_argnames=("mesh", "static", "max_levels")
 )
 def _bfs_sharded_relay_fused(
-    vperm_masks, net_masks, valid_words, source_new, *,
+    vperm_masks, net_masks, valid_words, own_words, source_new, *,
     mesh, static, max_levels,
 ):
     """Vertex-partitioned relay BFS (v4): per-shard Beneš layouts (one
@@ -355,10 +355,11 @@ def _bfs_sharded_relay_fused(
     block = static[0]
     nw = block // 32
 
-    def inner(vperm_blk, net_blk, valid_blk, source):
+    def inner(vperm_blk, net_blk, valid_blk, own_blk, own_all, source):
         vperm_blk = _strip_shard_dim(vperm_blk)
         net_blk = _strip_shard_dim(net_blk)
         valid_blk = valid_blk[0]
+        own_local = own_blk[0]
         dist, parent = _init_block_state(source, block)
         fwords = _packed_source_frontier(source, block, n)
 
@@ -375,9 +376,7 @@ def _bfs_sharded_relay_fused(
             level = level + 1
             dist = jnp.where(improved, level, dist)
             parent = jnp.where(improved, cand, parent)
-            fw = jax.lax.all_gather(
-                pack_std(improved), GRAPH_AXIS, tiled=True
-            )
+            fw = _exchange_compact(pack_std(improved), own_local, own_all, nw)
             changed = (
                 jax.lax.pmax(improved.any().astype(jnp.int32), GRAPH_AXIS) > 0
             )
@@ -395,6 +394,8 @@ def _bfs_sharded_relay_fused(
             _mask_specs(vperm_masks),
             _mask_specs(net_masks),
             P(GRAPH_AXIS, None),
+            P(GRAPH_AXIS, None),
+            P(),
             P(),
         ),
         out_specs=(P(GRAPH_AXIS), P(GRAPH_AXIS), P()),
@@ -405,14 +406,16 @@ def _bfs_sharded_relay_fused(
         # over batch; it is simply replicated along it.
         axis_names={GRAPH_AXIS, BATCH_AXIS},
     )
-    return fn(vperm_masks, net_masks, valid_words, source_new)
+    return fn(
+        vperm_masks, net_masks, valid_words, own_words, own_words, source_new
+    )
 
 
 @functools.partial(
     jax.jit, static_argnames=("mesh", "static", "max_levels")
 )
 def _bfs_sharded_relay_multi_fused(
-    vperm_masks, net_masks, valid_words, sources_new, *,
+    vperm_masks, net_masks, valid_words, own_words, sources_new, *,
     mesh, static, max_levels,
 ):
     """Batched multi-source relay BFS on a 2-D mesh: sources data-parallel
@@ -427,10 +430,11 @@ def _bfs_sharded_relay_multi_fused(
     block = static[0]
     nw = block // 32
 
-    def inner(vperm_blk, net_blk, valid_blk, sources_blk):
+    def inner(vperm_blk, net_blk, valid_blk, own_blk, own_all, sources_blk):
         vperm_blk = _strip_shard_dim(vperm_blk)
         net_blk = _strip_shard_dim(net_blk)
         valid_blk = valid_blk[0]
+        own_local = own_blk[0]
         s_l = sources_blk.shape[0]
         lo = jax.lax.axis_index(GRAPH_AXIS).astype(jnp.int32) * block
         ids_local = lo + jnp.arange(block, dtype=jnp.int32)
@@ -459,9 +463,7 @@ def _bfs_sharded_relay_multi_fused(
             level = level + 1
             dist = jnp.where(improved, level, dist)
             parent = jnp.where(improved, cand, parent)
-            fw = jax.lax.all_gather(
-                pack_std(improved), GRAPH_AXIS, tiled=True, axis=1
-            )
+            fw = _exchange_compact(pack_std(improved), own_local, own_all, nw)
             any_local = improved.any().astype(jnp.int32)
             changed = (
                 jax.lax.pmax(
@@ -483,12 +485,16 @@ def _bfs_sharded_relay_multi_fused(
             _mask_specs(vperm_masks),
             _mask_specs(net_masks),
             P(GRAPH_AXIS, None),
+            P(GRAPH_AXIS, None),
+            P(),
             P(BATCH_AXIS),
         ),
         out_specs=(P(BATCH_AXIS, GRAPH_AXIS), P(BATCH_AXIS, GRAPH_AXIS), P()),
         axis_names={GRAPH_AXIS, BATCH_AXIS},
     )
-    return fn(vperm_masks, net_masks, valid_words, sources_new)
+    return fn(
+        vperm_masks, net_masks, valid_words, own_words, own_words, sources_new
+    )
 
 
 def _prepare_relay(graph, mesh: Mesh):
@@ -505,6 +511,71 @@ def _prepare_relay(graph, mesh: Mesh):
             )
         return graph
     return build_sharded_relay_graph(graph, n)
+
+
+def _own_word_table(srg):
+    """Real-word index table for the COMPACT frontier exchange:
+    ``int32[n_shards, kw]`` of LOCAL word indices (within each shard's
+    ``block/32`` frontier words) that contain at least one real vertex,
+    right-padded by repeating the last real index.
+
+    The unified per-shard class structure pads every shard's class counts
+    to the max over shards, so the naive ``block``-bit all-gather ships
+    padding that GROWS with shard count (+27% at 8 shards on the
+    Pokec-shape — VERDICT r4 weak #4).  Gathering only real words keeps
+    the exchange flat at ~V/8 bytes: senders gather ``kw`` words through
+    this table, receivers scatter them back into the global padded word
+    space (pad duplicates rewrite identical values, so the scatter is
+    deterministic)."""
+    n, block = srg.num_shards, srg.block
+    nw = block // 32
+    real = (
+        (srg.new2old.reshape(n, block) != -1).reshape(n, nw, 32).any(axis=2)
+    )
+    kw = max(int(real.sum(axis=1).max()), 1)
+    rows = []
+    for s in range(n):
+        idx = np.flatnonzero(real[s]).astype(np.int32)
+        if idx.size == 0:
+            idx = np.zeros(1, np.int32)
+        rows.append(
+            np.concatenate([idx, np.full(kw - idx.size, idx[-1], np.int32)])
+        )
+    return np.stack(rows)
+
+
+def _own_word_table_dev(srg):
+    """Device-resident :func:`_own_word_table`, memoized on the layout
+    object: the host table is an O(V) scan + per-shard loop and must not
+    land inside a caller's timed repeats (it is layout data, like the
+    masks).  ``object.__setattr__`` because ShardedRelayGraph is frozen."""
+    cached = getattr(srg, "_own_words_dev", None)
+    if cached is None:
+        cached = jnp.asarray(_own_word_table(srg))
+        object.__setattr__(srg, "_own_words_dev", cached)
+    return cached
+
+
+def _exchange_compact(improved_words, own_local, own_all, nw: int):
+    """Compact frontier exchange: local packed words -> global packed
+    words.  ``improved_words``: uint32[..., nw] (this shard's new frontier
+    bits); ``own_local``: int32[kw] this shard's real-word indices;
+    ``own_all``: int32[n, kw] every shard's table (replicated).  Returns
+    uint32[..., n*nw] — the same global standard-packed frontier the full
+    all-gather produced, built from an ``n*kw``-word exchange."""
+    n = own_all.shape[0]
+    send = jnp.take(improved_words, own_local, axis=-1)
+    if send.ndim == 1:
+        gath = jax.lax.all_gather(send, GRAPH_AXIS)  # [n, kw]
+    else:
+        gath = jax.lax.all_gather(send, GRAPH_AXIS, axis=1)  # [s_l, n, kw]
+    base = (jnp.arange(n, dtype=jnp.int32) * nw)[:, None]
+    flat_idx = (own_all + base).reshape(-1)
+    lead = improved_words.shape[:-1]
+    out = jnp.zeros((*lead, n * nw), jnp.uint32)
+    return out.at[..., flat_idx].set(
+        gath.reshape(*lead, -1), unique_indices=False
+    )
 
 
 def _relay_valid_words(srg):
@@ -593,7 +664,10 @@ def bfs_sharded(
         use_pallas = _resolve_sharded_applier(applier)
         static = _sharded_relay_static(srg, _graph_shards(mesh), use_pallas)
         vperm_arg, net_arg = _sharded_relay_mask_args(srg, use_pallas)
-        args = (vperm_arg, net_arg, _relay_valid_words(srg), source_new)
+        args = (
+            vperm_arg, net_arg, _relay_valid_words(srg),
+            _own_word_table_dev(srg), source_new,
+        )
         if use_pallas:
             from ..models.bfs import RelayEngine
 
@@ -793,6 +867,7 @@ def bfs_sharded_multi(
             jnp.asarray(srg.vperm_masks),
             jnp.asarray(srg.net_masks),
             _relay_valid_words(srg),
+            _own_word_table_dev(srg),
             sources_new,
             mesh=mesh,
             static=_sharded_relay_static(srg, _graph_shards(mesh), False),
