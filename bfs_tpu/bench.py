@@ -416,6 +416,7 @@ def _superstep_profile(eng, source, *, max_steps: int = 64, passes: int = 3):
     eng.warm_step_bodies(state)
     _ = int(eng.step_dispatch(state)[0].level)
     runs = []
+    aborted = False
     for _p in range(passes):
         if runs and _behind(0.75):
             # A contaminated window can stretch one pass by orders of
@@ -425,6 +426,11 @@ def _superstep_profile(eng, source, *, max_steps: int = 64, passes: int = 3):
         state = eng.init_state(source)
         prof = []
         while bool(state.changed) and len(prof) < max_steps:
+            if _behind(0.85):
+                # Mid-pass guard: in a degraded-tunnel window each sync
+                # can take tens of seconds; keep whatever completed.
+                aborted = True
+                break
             fsize, fedges = eng.frontier_stats(state)
             decide = eng.take_sparse(state)  # predicate round-trip untimed
             t0 = time.perf_counter()
@@ -441,6 +447,12 @@ def _superstep_profile(eng, source, *, max_steps: int = 64, passes: int = 3):
                 }
             )
         runs.append(prof)
+        _stamp(
+            f"profile pass {len(runs)}/{passes}: {len(prof)} supersteps"
+            + (" [aborted: budget]" if aborted else "")
+        )
+        if aborted:
+            break
     # The walk is deterministic (same levels/paths each pass); merge by
     # index with a per-entry median + spread.
     merged = []
@@ -453,11 +465,14 @@ def _superstep_profile(eng, source, *, max_steps: int = 64, passes: int = 3):
         if ts[0] > 0 and ts[-1] / max(ts[0], 1e-9) > 10.0:
             out["contaminated"] = True
         merged.append(out)
-    return {
+    out = {
         "sync_overhead_seconds": t_sync,
         "passes": len(runs),
         "supersteps": merged,
     }
+    if aborted:
+        out["note"] = "aborted mid-pass on the time budget; entries partial"
+    return out
 
 
 def _multi_source_bench(rg, eng, dg, source, *, num_sources, do_check,

@@ -386,9 +386,9 @@ def run_cell(spec: dict) -> dict:
             # words holding real vertices travel — n_shards * kw words,
             # ~V/8 bytes flat in shard count (the naive block-bit gather
             # grew with per-shard class padding: VERDICT r4 weak #4).
-            from .parallel.sharded import _own_word_table
+            from .parallel.sharded import _own_word_table_dev
 
-            gwords = layout.num_shards * _own_word_table(layout).shape[1]
+            gwords = layout.num_shards * _own_word_table_dev(layout).shape[1]
             exch = {
                 "exchange_bytes_per_superstep": gwords * 4,
                 "per_shard_net_mask_bytes": int(layout.net_masks.nbytes

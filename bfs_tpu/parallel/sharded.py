@@ -355,11 +355,11 @@ def _bfs_sharded_relay_fused(
     block = static[0]
     nw = block // 32
 
-    def inner(vperm_blk, net_blk, valid_blk, own_blk, own_all, source):
+    def inner(vperm_blk, net_blk, valid_blk, own_all, source):
         vperm_blk = _strip_shard_dim(vperm_blk)
         net_blk = _strip_shard_dim(net_blk)
         valid_blk = valid_blk[0]
-        own_local = own_blk[0]
+        own_local = own_all[jax.lax.axis_index(GRAPH_AXIS)]
         dist, parent = _init_block_state(source, block)
         fwords = _packed_source_frontier(source, block, n)
 
@@ -394,7 +394,6 @@ def _bfs_sharded_relay_fused(
             _mask_specs(vperm_masks),
             _mask_specs(net_masks),
             P(GRAPH_AXIS, None),
-            P(GRAPH_AXIS, None),
             P(),
             P(),
         ),
@@ -406,9 +405,7 @@ def _bfs_sharded_relay_fused(
         # over batch; it is simply replicated along it.
         axis_names={GRAPH_AXIS, BATCH_AXIS},
     )
-    return fn(
-        vperm_masks, net_masks, valid_words, own_words, own_words, source_new
-    )
+    return fn(vperm_masks, net_masks, valid_words, own_words, source_new)
 
 
 @functools.partial(
@@ -430,11 +427,11 @@ def _bfs_sharded_relay_multi_fused(
     block = static[0]
     nw = block // 32
 
-    def inner(vperm_blk, net_blk, valid_blk, own_blk, own_all, sources_blk):
+    def inner(vperm_blk, net_blk, valid_blk, own_all, sources_blk):
         vperm_blk = _strip_shard_dim(vperm_blk)
         net_blk = _strip_shard_dim(net_blk)
         valid_blk = valid_blk[0]
-        own_local = own_blk[0]
+        own_local = own_all[jax.lax.axis_index(GRAPH_AXIS)]
         s_l = sources_blk.shape[0]
         lo = jax.lax.axis_index(GRAPH_AXIS).astype(jnp.int32) * block
         ids_local = lo + jnp.arange(block, dtype=jnp.int32)
@@ -485,16 +482,13 @@ def _bfs_sharded_relay_multi_fused(
             _mask_specs(vperm_masks),
             _mask_specs(net_masks),
             P(GRAPH_AXIS, None),
-            P(GRAPH_AXIS, None),
             P(),
             P(BATCH_AXIS),
         ),
         out_specs=(P(BATCH_AXIS, GRAPH_AXIS), P(BATCH_AXIS, GRAPH_AXIS), P()),
         axis_names={GRAPH_AXIS, BATCH_AXIS},
     )
-    return fn(
-        vperm_masks, net_masks, valid_words, own_words, own_words, sources_new
-    )
+    return fn(vperm_masks, net_masks, valid_words, own_words, sources_new)
 
 
 def _prepare_relay(graph, mesh: Mesh):
@@ -674,9 +668,14 @@ def bfs_sharded(
             key = ("single", static, mesh, max_levels)
             compiled = _SHARDED_AOT_CACHE.get(key)
             if compiled is None:
-                compiled = _bfs_sharded_relay_fused.lower(
-                    *args, mesh=mesh, static=static, max_levels=max_levels
-                ).compile(compiler_options=RelayEngine._COMPILER_OPTIONS)
+                from ..models.bfs import compile_exe_cached
+
+                compiled = compile_exe_cached(
+                    _bfs_sharded_relay_fused.lower(
+                        *args, mesh=mesh, static=static, max_levels=max_levels
+                    ),
+                    RelayEngine._COMPILER_OPTIONS,
+                )
                 while len(_SHARDED_AOT_CACHE) >= _SHARDED_AOT_CACHE_MAX:
                     _SHARDED_AOT_CACHE.pop(next(iter(_SHARDED_AOT_CACHE)))
                 _SHARDED_AOT_CACHE[key] = compiled

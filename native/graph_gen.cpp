@@ -165,7 +165,7 @@ void sort_rank_pairs(int64_t n, const int32_t* key_hi, const int32_t* key_lo,
   std::vector<uint64_t> buf(sn);
   for (size_t i = 0; i < sn; ++i) {
     if (i + kPF < sn)
-      __builtin_prefetch(&cur[key_hi[i + kPF]], 1, 0);
+      __builtin_prefetch(&cur[key_hi[i + kPF]], 1, 3);
     const int64_t o = cur[key_hi[i]]++;
     buf[static_cast<size_t>(o)] =
         (static_cast<uint64_t>(static_cast<uint32_t>(key_lo[i])) << 32) | i;
@@ -213,7 +213,7 @@ static constexpr int64_t kPFg = 24;
 void gather_i32(int64_t n, const int32_t* table, const int32_t* idx,
                 int32_t* out) {
   for (int64_t i = 0; i < n; ++i) {
-    if (i + kPFg < n) __builtin_prefetch(&table[idx[i + kPFg]], 0, 0);
+    if (i + kPFg < n) __builtin_prefetch(&table[idx[i + kPFg]], 0, 3);
     out[i] = table[idx[i]];
   }
 }
@@ -221,7 +221,7 @@ void gather_i32(int64_t n, const int32_t* table, const int32_t* idx,
 void scatter_i32(int64_t n, const int32_t* idx, const int32_t* val,
                  int32_t* out) {
   for (int64_t i = 0; i < n; ++i) {
-    if (i + kPFg < n) __builtin_prefetch(&out[idx[i + kPFg]], 1, 0);
+    if (i + kPFg < n) __builtin_prefetch(&out[idx[i + kPFg]], 1, 3);
     out[idx[i]] = val[i];
   }
 }
@@ -232,8 +232,8 @@ void slot_assign_i32(int64_t n, const int32_t* base, const int32_t* stride,
                      const int32_t* idx, const int32_t* rank, int32_t* out) {
   for (int64_t i = 0; i < n; ++i) {
     if (i + kPFg < n) {
-      __builtin_prefetch(&base[idx[i + kPFg]], 0, 0);
-      __builtin_prefetch(&stride[idx[i + kPFg]], 0, 0);
+      __builtin_prefetch(&base[idx[i + kPFg]], 0, 3);
+      __builtin_prefetch(&stride[idx[i + kPFg]], 0, 3);
     }
     const int32_t v = idx[i];
     out[i] = base[v] + rank[i] * stride[v];
@@ -252,7 +252,7 @@ void rank_by_count(int64_t n, const int32_t* key, int64_t nk,
                    int32_t* rank_out) {
   std::vector<int32_t> cnt(static_cast<size_t>(nk), 0);
   for (int64_t i = 0; i < n; ++i) {
-    if (i + kPFg < n) __builtin_prefetch(&cnt[key[i + kPFg]], 1, 0);
+    if (i + kPFg < n) __builtin_prefetch(&cnt[key[i + kPFg]], 1, 3);
     rank_out[i] = cnt[key[i]]++;
   }
 }
@@ -261,7 +261,7 @@ void rank_by_count(int64_t n, const int32_t* key, int64_t nk,
 void bincount_i32(int64_t n, const int32_t* key, int64_t nk, int32_t* out) {
   std::memset(out, 0, static_cast<size_t>(nk) * sizeof(int32_t));
   for (int64_t i = 0; i < n; ++i) {
-    if (i + kPFg < n) __builtin_prefetch(&out[key[i + kPFg]], 1, 0);
+    if (i + kPFg < n) __builtin_prefetch(&out[key[i + kPFg]], 1, 3);
     ++out[key[i]];
   }
 }
@@ -276,7 +276,7 @@ void csr_fill(int64_t n, int64_t nk, const int32_t* srcn, const int32_t* dstn,
               int32_t* adj_slot) {
   std::vector<int32_t> off(static_cast<size_t>(nk), 0);
   for (int64_t i = 0; i < n; ++i) {
-    if (i + kPFg < n) __builtin_prefetch(&off[srcn[i + kPFg]], 1, 0);
+    if (i + kPFg < n) __builtin_prefetch(&off[srcn[i + kPFg]], 1, 3);
     ++off[srcn[i]];
   }
   int32_t run = 0;
@@ -289,7 +289,7 @@ void csr_fill(int64_t n, int64_t nk, const int32_t* srcn, const int32_t* dstn,
   indptr_out[nk] = run;
   indptr_out[nk + 1] = run;
   for (int64_t i = 0; i < n; ++i) {
-    if (i + kPFg < n) __builtin_prefetch(&off[srcn[i + kPFg]], 1, 0);
+    if (i + kPFg < n) __builtin_prefetch(&off[srcn[i + kPFg]], 1, 3);
     const int32_t o = off[srcn[i]]++;
     adj_dst[o] = dstn[i];
     adj_slot[o] = slotv[i];
@@ -299,7 +299,7 @@ void csr_fill(int64_t n, int64_t nk, const int32_t* srcn, const int32_t* dstn,
 // used[idx[i]] = 1 (uint8 scatter; numpy bool fancy-assign is ~10x slower).
 void mark_u8(int64_t n, const int32_t* idx, uint8_t* used) {
   for (int64_t i = 0; i < n; ++i) {
-    if (i + kPFg < n) __builtin_prefetch(&used[idx[i + kPFg]], 1, 0);
+    if (i + kPFg < n) __builtin_prefetch(&used[idx[i + kPFg]], 1, 3);
     used[idx[i]] = 1;
   }
 }

@@ -54,7 +54,7 @@ for applier in ("pallas", "xla"):
     vperm_arg, net_arg = S._sharded_relay_mask_args(srg, use_pallas)
     valid = S._relay_valid_words(srg)
     src_new = jnp.int32(int(srg.old2new[source]))
-    args = (vperm_arg, net_arg, valid, jnp.asarray(S._own_word_table(srg)), src_new)
+    args = (vperm_arg, net_arg, valid, S._own_word_table_dev(srg), src_new)
     max_levels = srg.num_vertices
     t0 = time.perf_counter()
     from bfs_tpu.models.bfs import RelayEngine
