@@ -782,8 +782,9 @@ def main():
 
     elif engine == "pull":
         pg = load_or_build_pull(dg, graph_key)
-        ell0 = jnp.asarray(pg.ell0)
-        folds = tuple(jnp.asarray(f) for f in pg.folds)
+        from .graph.ell import device_ell
+
+        ell0, folds = device_ell(pg)
 
         def run_roots(roots):
             return [

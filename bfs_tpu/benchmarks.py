@@ -306,8 +306,9 @@ def run_cell(spec: dict) -> dict:
             run = lambda: eng._fused(s_new, rg.num_vertices)  # noqa: E731
         elif mode == "pull":
             pg = load_or_build_pull(dg, key)
-            ell0 = jnp.asarray(pg.ell0)
-            folds = tuple(jnp.asarray(f) for f in pg.folds)
+            from .graph.ell import device_ell
+
+            ell0, folds = device_ell(pg)
             run = lambda: _bfs_pull_fused(  # noqa: E731
                 ell0, folds, jnp.int32(source), pg.num_vertices, pg.num_vertices
             )

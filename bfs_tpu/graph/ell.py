@@ -65,6 +65,38 @@ class PullGraph:
         return int(self.ell0.size) + sum(int(f.size) for f in self.folds)
 
 
+def device_ell(pg: "PullGraph"):
+    """Device operands for the pull engine, TRANSPOSED to ``[K, rows]``.
+
+    TPU tiles 2-D int32 as (8, 128): the natural [rows, K=32] layout pads
+    its minor dimension 32 -> 128 — a 4.0x HBM expansion on the index
+    operands AND every gather temp (the LiveJournal-shape single-chip
+    pull cell OOMed at 15.92/15.75 GB from exactly this padding —
+    VERDICT r4 #7).  [K, rows] puts the huge dimension minor and the
+    row-min reduce over the MAJOR axis (ops/pull._rowmin_level)."""
+    import jax.numpy as jnp
+
+    ell0 = jnp.asarray(np.ascontiguousarray(np.asarray(pg.ell0).T))
+    folds = tuple(
+        jnp.asarray(np.ascontiguousarray(np.asarray(f).T)) for f in pg.folds
+    )
+    return ell0, folds
+
+
+def device_ell_sharded(spg: "ShardedPullGraph"):
+    """Sharded twin of :func:`device_ell`: [n, R, K] -> [n, K, R]."""
+    import jax.numpy as jnp
+
+    ell0 = jnp.asarray(
+        np.ascontiguousarray(np.asarray(spg.ell0).transpose(0, 2, 1))
+    )
+    folds = tuple(
+        jnp.asarray(np.ascontiguousarray(np.asarray(f).transpose(0, 2, 1)))
+        for f in spg.folds
+    )
+    return ell0, folds
+
+
 @dataclass(frozen=True)
 class ShardedPullGraph:
     """ELL pull layout partitioned by destination vertex over mesh shards.

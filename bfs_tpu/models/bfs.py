@@ -1219,9 +1219,12 @@ def bfs(
         pg = graph if isinstance(graph, PullGraph) else build_pull_graph(graph)
         check_sources(pg.num_vertices, source)
         max_levels = int(max_levels) if max_levels is not None else pg.num_vertices
+        from ..graph.ell import device_ell
+
+        ell0_t, folds_t = device_ell(pg)
         state = _bfs_pull_fused(
-            jnp.asarray(pg.ell0),
-            tuple(jnp.asarray(f) for f in pg.folds),
+            ell0_t,
+            folds_t,
             jnp.int32(source),
             pg.num_vertices,
             max_levels,
@@ -1294,8 +1297,9 @@ class SuperstepRunner:
         elif engine == "pull":
             pg = graph if isinstance(graph, PullGraph) else build_pull_graph(graph)
             self.num_vertices = pg.num_vertices
-            ell0 = jnp.asarray(pg.ell0)
-            folds = tuple(jnp.asarray(f) for f in pg.folds)
+            from ..graph.ell import device_ell
+
+            ell0, folds = device_ell(pg)
             self._step = jax.jit(lambda s: relax_pull_superstep(s, ell0, folds))
         elif engine == "relay":
             eng = RelayEngine(graph)

@@ -84,9 +84,12 @@ def bfs_multi_device(
         pg = graph if isinstance(graph, PullGraph) else build_pull_graph(graph)
         check_sources(pg.num_vertices, sources)
         max_levels = int(max_levels) if max_levels is not None else pg.num_vertices
+        from ..graph.ell import device_ell
+
+        ell0_t, folds_t = device_ell(pg)
         state = _bfs_multi_pull_fused(
-            jnp.asarray(pg.ell0),
-            tuple(jnp.asarray(f) for f in pg.folds),
+            ell0_t,
+            folds_t,
             jnp.asarray(sources),
             pg.num_vertices,
             max_levels,

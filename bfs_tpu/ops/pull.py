@@ -35,24 +35,34 @@ _CHUNK_ELEMS = int(
 )
 
 
-def _rowmin_level(tab: jax.Array, mat: jax.Array) -> jax.Array:
-    """``min(take(tab, mat, axis=-1), axis=-1)`` with the gather chunked
-    over rows when the materialized [rows, K] temp would exceed the chunk
-    budget."""
-    rows, k = mat.shape[-2], mat.shape[-1]
-    # Leading batch axes of ``tab`` broadcast into the gather output
-    # ([B..., rows, K]); the budget bounds the whole temp, not one slice.
+def _rowmin_level(tab: jax.Array, mat_t: jax.Array) -> jax.Array:
+    """Per-row min of gathered table values: ``mat_t`` is the TRANSPOSED
+    ELL index matrix ``int32[K, rows]`` and the result is
+    ``min_k tab[mat_t[k, r]]`` per row r (shape [..., rows]).
+
+    Why transposed: TPU tiles 2-D int32 as (8, 128), so the natural
+    [rows, K=32] layout pads its minor dimension 32 -> 128 — a 4.0x HBM
+    expansion on BOTH the index operand and the gather temp (the
+    LiveJournal-shape pull cell OOMed at 15.92/15.75 GB with "extra
+    memory due to padding: 4.0x expansion").  [K, rows] puts the huge
+    dimension minor (padded to 128 elements, negligible) and reduces over
+    the MAJOR axis.
+
+    The gather is additionally chunked over rows when the materialized
+    [K, rows] temp would exceed the chunk budget (~4*_CHUNK_ELEMS bytes;
+    batch axes of ``tab`` count against it)."""
+    k, rows = mat_t.shape[-2], mat_t.shape[-1]
     batch = 1
     for d in tab.shape[:-1]:
         batch *= int(d)
     chunk_rows = max(_CHUNK_ELEMS // max(k * batch, 1), 1)
     if rows <= chunk_rows:
-        return jnp.min(jnp.take(tab, mat, axis=-1), axis=-1)
+        return jnp.min(jnp.take(tab, mat_t, axis=-1), axis=-2)
     outs = []
     for a in range(0, rows, chunk_rows):
         b = min(a + chunk_rows, rows)
         outs.append(
-            jnp.min(jnp.take(tab, mat[..., a:b, :], axis=-1), axis=-1)
+            jnp.min(jnp.take(tab, mat_t[..., :, a:b], axis=-1), axis=-2)
         )
     return jnp.concatenate(outs, axis=-1)
 
@@ -68,7 +78,9 @@ def pull_candidates(frontier_tab: jax.Array, ell0: jax.Array, folds) -> jax.Arra
     """Min active in-neighbour id per vertex: int32[V+1] (slot V = INF).
 
     ``frontier_tab`` may be [V+1] or batched [..., V+1]; ELL gathers
-    broadcast over leading axes.
+    broadcast over leading axes.  ``ell0``/``folds`` are the TRANSPOSED
+    [K, rows] device matrices (:func:`bfs_tpu.graph.ell.device_ell` — see
+    :func:`_rowmin_level` for the TPU tile-padding rationale).
     """
     num_vertices = frontier_tab.shape[-1] - 1
     cand = _rowmin_level(frontier_tab, ell0)
@@ -86,7 +98,8 @@ def pull_candidates_rows(
     """Shard-local variant of :func:`pull_candidates`: ``frontier_tab_ext``
     already carries its trailing INF slot (size = table + 1) and the result
     is the first ``num_rows`` row-mins (one per owned vertex), with no slot
-    appended.  Broadcasts over leading axes of ``frontier_tab_ext``."""
+    appended.  Broadcasts over leading axes of ``frontier_tab_ext``;
+    ``ell0``/``folds`` are TRANSPOSED [K, rows] device matrices."""
     cand = _rowmin_level(frontier_tab_ext, ell0)
     for fold in folds:
         inf = jnp.full(cand.shape[:-1] + (1,), INT32_MAX, dtype=jnp.int32)

@@ -692,9 +692,12 @@ def bfs_sharded(
         spg = _prepare_pull(graph, mesh, vertex_block_multiple)
         check_sources(spg.num_vertices, source)
         max_levels = int(max_levels) if max_levels is not None else spg.num_vertices
+        from ..graph.ell import device_ell_sharded
+
+        ell0_t, folds_t = device_ell_sharded(spg)
         dist, parent, level = _bfs_sharded_pull_fused(
-            jnp.asarray(spg.ell0),
-            tuple(jnp.asarray(f) for f in spg.folds),
+            ell0_t,
+            folds_t,
             jnp.int32(source),
             mesh=mesh,
             block=spg.block,
@@ -882,9 +885,12 @@ def bfs_sharded_multi(
         spg = _prepare_pull(graph, mesh, vertex_block_multiple)
         check_sources(spg.num_vertices, sources)
         max_levels = int(max_levels) if max_levels is not None else spg.num_vertices
+        from ..graph.ell import device_ell_sharded
+
+        ell0_t, folds_t = device_ell_sharded(spg)
         dist, parent, level = _bfs_sharded_pull_multi_fused(
-            jnp.asarray(spg.ell0),
-            tuple(jnp.asarray(f) for f in spg.folds),
+            ell0_t,
+            folds_t,
             jnp.asarray(sources),
             mesh=mesh,
             block=spg.block,
