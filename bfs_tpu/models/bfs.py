@@ -476,10 +476,15 @@ def compile_exe_cached(lowered, compiler_options):
                 deserialize_and_load,
             )
 
+            print(
+                f"[exe-cache] loading {os.path.basename(path)} "
+                f"({os.path.getsize(path) >> 20} MB)...",
+                file=sys.stderr, flush=True,
+            )
             with open(path, "rb") as f:
                 payload, in_tree, out_tree = pickle.load(f)
             compiled = deserialize_and_load(payload, in_tree, out_tree)
-            logger.info("loaded cached executable %s", path)
+            print("[exe-cache] loaded", file=sys.stderr, flush=True)
             return compiled
         except Exception:
             logger.warning(
