@@ -628,6 +628,13 @@ def _multi_source_bench(rg, eng, dg, source, *, num_sources, do_check,
 
 
 def main():
+    # A cold driver run pays the full relay layout build; per-phase stderr
+    # stamps make a slow build diagnosable from the capture's tail instead
+    # of reading as a silent stall (BFS_TPU_BUILD_LOG=0 restores quiet
+    # builds).  Set here, not at module level: benchmarks.py and the tools
+    # import this module for its cache helpers and must not inherit the
+    # logging default from a mere import.
+    os.environ.setdefault("BFS_TPU_BUILD_LOG", "1")
     scale = int(os.environ.get("BENCH_SCALE", "24"))
     edge_factor = int(os.environ.get("BENCH_EDGE_FACTOR", "6"))
     repeats = int(os.environ.get("BENCH_REPEATS", "3"))

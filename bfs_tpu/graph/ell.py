@@ -108,6 +108,19 @@ def device_ell_sharded(spg: "ShardedPullGraph"):
     return ell0, folds
 
 
+def drop_device_operands(pg) -> None:
+    """Release the HBM operands memoized by :func:`device_ell` /
+    :func:`device_ell_sharded`.
+
+    The memo pins multi-GB device buffers for the lifetime of the host
+    layout object (at the LiveJournal-shape scale the full operand set is
+    most of a chip's HBM) — a long-lived process that keeps the layout
+    around but switches engines, or holds several graphs, calls this
+    between uses.  The next ``device_ell*`` call re-uploads."""
+    if getattr(pg, "_device_ell", None) is not None:
+        object.__setattr__(pg, "_device_ell", None)
+
+
 @dataclass(frozen=True)
 class ShardedPullGraph:
     """ELL pull layout partitioned by destination vertex over mesh shards.

@@ -53,12 +53,19 @@ logger = logging.getLogger(__name__)
 
 # BFS_TPU_BUILD_LOG=1 turns on the per-phase build timing logs without the
 # caller configuring logging (a bare handler at INFO on this module only).
-if __import__("os").environ.get("BFS_TPU_BUILD_LOG", "") not in ("", "0"):
-    if not logger.handlers:
-        _h = logging.StreamHandler()
-        _h.setFormatter(logging.Formatter("%(asctime)s %(message)s"))
-        logger.addHandler(_h)
-    logger.setLevel(logging.INFO)
+# Checked lazily at each build so callers that set the flag after this
+# module is first imported (e.g. a process that imports relay early and
+# decides on logging later, as bench.main does) still get the stamps.
+def _ensure_build_log():
+    if __import__("os").environ.get("BFS_TPU_BUILD_LOG", "") not in ("", "0"):
+        if not logger.handlers:
+            _h = logging.StreamHandler()
+            _h.setFormatter(logging.Formatter("%(asctime)s %(message)s"))
+            logger.addHandler(_h)
+        logger.setLevel(logging.INFO)
+
+
+_ensure_build_log()
 
 
 class _phase:
@@ -413,6 +420,7 @@ def build_relay_graph(graph: Graph | DeviceGraph) -> RelayGraph:
 
     Requires the native Beneš router; raises RuntimeError when unavailable.
     """
+    _ensure_build_log()
     if not benes.native_available():
         raise RuntimeError("relay engine requires the native benes router")
     if isinstance(graph, DeviceGraph):
@@ -652,6 +660,7 @@ def build_sharded_relay_graph(
     each shard so in-degree classes are contiguous; the global new-id space
     is the concatenation of shard blocks.
     """
+    _ensure_build_log()
     if not benes.native_available():
         raise RuntimeError("relay engine requires the native benes router")
     if num_shards < 1:
