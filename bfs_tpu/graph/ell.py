@@ -115,8 +115,14 @@ def drop_device_operands(pg) -> None:
     The memo pins multi-GB device buffers for the lifetime of the host
     layout object (at the LiveJournal-shape scale the full operand set is
     most of a chip's HBM) — a long-lived process that keeps the layout
-    around but switches engines, or holds several graphs, calls this
-    between uses.  The next ``device_ell*`` call re-uploads."""
+    around but switches engines, or holds several graphs (the serve
+    registry's eviction path, serve/registry.py), calls this between uses.
+    The next ``device_ell*`` call re-uploads.
+
+    NOTE: clearing the memo only removes THIS reference.  The HBM is freed
+    once callers ALSO drop their own references to the previously returned
+    ``(ell0, folds)`` tuple (and to anything derived that aliases it); a
+    caller that keeps the tuple alive keeps the buffers alive."""
     if getattr(pg, "_device_ell", None) is not None:
         object.__setattr__(pg, "_device_ell", None)
 

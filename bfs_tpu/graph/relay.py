@@ -56,13 +56,34 @@ logger = logging.getLogger(__name__)
 # Checked lazily at each build so callers that set the flag after this
 # module is first imported (e.g. a process that imports relay early and
 # decides on logging later, as bench.main does) still get the stamps.
+# Reversible: setting BFS_TPU_BUILD_LOG=0 (or unsetting it) before the next
+# build removes the handler and resets the level, and the install/remove is
+# lock-guarded so concurrent first builds cannot double-install the handler.
+_build_log_lock = __import__("threading").Lock()
+_build_log_handler: logging.Handler | None = None
+_build_log_prev_level: int | None = None
+
+
 def _ensure_build_log():
-    if __import__("os").environ.get("BFS_TPU_BUILD_LOG", "") not in ("", "0"):
-        if not logger.handlers:
-            _h = logging.StreamHandler()
-            _h.setFormatter(logging.Formatter("%(asctime)s %(message)s"))
-            logger.addHandler(_h)
-        logger.setLevel(logging.INFO)
+    global _build_log_handler, _build_log_prev_level
+    enabled = __import__("os").environ.get("BFS_TPU_BUILD_LOG", "") not in ("", "0")
+    with _build_log_lock:
+        if enabled:
+            if _build_log_handler is None:
+                _h = logging.StreamHandler()
+                _h.setFormatter(logging.Formatter("%(asctime)s %(message)s"))
+                logger.addHandler(_h)
+                _build_log_handler = _h
+                _build_log_prev_level = logger.level
+            logger.setLevel(logging.INFO)
+        elif _build_log_handler is not None:
+            # Only undo what this latch installed: remove OUR handler and
+            # restore the level the logger had before we raised it, so an
+            # application-configured handler/level is left untouched.
+            logger.removeHandler(_build_log_handler)
+            _build_log_handler = None
+            logger.setLevel(_build_log_prev_level)
+            _build_log_prev_level = None
 
 
 _ensure_build_log()

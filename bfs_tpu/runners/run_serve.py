@@ -1,0 +1,188 @@
+"""Serving driver: a long-lived BFS query server CLI (``bfs-tpu-serve``).
+
+Where ``run_parallel`` is the reference's one-shot ``BfsSpark.main`` parity
+driver, this is the serving-era entry point: build/ingest a graph ONCE,
+keep its layouts and compiled executables warm in a
+:class:`~bfs_tpu.serve.BfsServer`, and answer a stream of queries.
+
+Two modes:
+
+  * **demo** (default) — submit ``--queries`` random single/multi-source
+    queries through the micro-batcher, oracle-check a sample, and print the
+    serve report (p50/p99, batch sizes, cache hit rates).
+  * **--repl** — read queries from stdin, one per line (``3`` for
+    single-source 3; ``3,17,42`` for collapsed multi-source), answer with
+    reachable-vertex count / eccentricity / superstep count per query.
+
+Usage:
+    python -m bfs_tpu.runners.run_serve [--rmat SCALE | --gnm V E |
+        --graph FILE] [--engine pull|push|relay] [--max-batch B]
+        [--tick-ms T] [--queries N] [--repl] [--check]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+from ..graph.csr import INF_DIST
+from ..utils.logging import get_logger
+
+logger = get_logger(__name__)
+
+
+def build_graph(args):
+    if args.graph:
+        from ..graph.io import read_sedgewick
+
+        return read_sedgewick(args.graph), args.graph
+    if args.gnm:
+        from ..graph.generators import gnm_graph
+
+        v, e = args.gnm
+        return gnm_graph(v, e, seed=args.seed), f"gnm_{v}_{e}"
+    from ..graph.generators import rmat_graph
+
+    return (
+        rmat_graph(args.rmat, args.edge_factor, seed=args.seed),
+        f"rmat_s{args.rmat}_ef{args.edge_factor}",
+    )
+
+
+def make_server(args, metrics=None):
+    from ..serve import BfsServer, GraphRegistry
+
+    registry = GraphRegistry(
+        device_budget_bytes=(
+            args.budget_mb * (1 << 20) if args.budget_mb else None
+        ),
+        metrics=metrics,
+    )
+    return BfsServer(
+        registry,
+        engine=args.engine,
+        max_batch=args.max_batch,
+        tick_s=args.tick_ms / 1e3,
+        queue_depth=args.queue_depth,
+        oracle_max_vertices=args.oracle_max_vertices,
+        metrics=metrics,
+    )
+
+
+def _describe(reply) -> str:
+    dist = reply.dist if reply.dist.ndim == 1 else reply.dist.min(axis=0)
+    reached = int((dist != INF_DIST).sum())
+    ecc = int(dist[dist != INF_DIST].max(initial=0))
+    return (
+        f"sources={reply.sources.tolist()} reached={reached} "
+        f"eccentricity={ecc} supersteps={reply.num_levels} "
+        f"status={reply.record.status} batch={reply.record.batch_size} "
+        f"latency={reply.record.total_s * 1e3:.1f}ms"
+    )
+
+
+def repl(server, name: str, num_vertices: int) -> None:
+    print(
+        f"serving {name!r} (V={num_vertices}); enter a source id or a "
+        "comma-separated source list, Ctrl-D to quit",
+        flush=True,
+    )
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            sources = [int(tok) for tok in line.replace(",", " ").split()]
+            fut = (
+                server.query(name, sources[0])
+                if len(sources) == 1
+                else server.query_multi(name, sources)
+            )
+            print(_describe(fut.result(timeout=600)), flush=True)
+        except Exception as exc:
+            print(f"error: {exc}", file=sys.stderr, flush=True)
+
+
+def demo(server, name: str, graph, args) -> dict:
+    rng = np.random.default_rng(args.seed)
+    v = graph.num_vertices
+    futures = []
+    for _ in range(args.queries):
+        if rng.random() < args.multi_frac:
+            width = int(rng.integers(2, max(args.multi_width, 3)))
+            srcs = rng.integers(0, v, size=width).tolist()
+            futures.append((server.query_multi(name, srcs), srcs))
+        else:
+            s = int(rng.integers(0, v))
+            futures.append((server.query(name, s), [s]))
+    checked = wrong = 0
+    for fut, srcs in futures:
+        reply = fut.result(timeout=600)
+        if args.check:
+            from ..oracle.bfs import check, queue_bfs
+
+            # Both single and collapsed replies are 1-D multi-source trees.
+            od, _ = queue_bfs(graph, srcs)
+            ok = (
+                np.array_equal(reply.dist, od)
+                and check(graph, reply.dist, reply.parent, srcs) == []
+            )
+            checked += 1
+            wrong += 0 if ok else 1
+    report = server.report()
+    report["checked"] = checked
+    report["wrong"] = wrong
+    return report
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    src = ap.add_mutually_exclusive_group()
+    src.add_argument("--graph", help="Sedgewick-format problem file")
+    src.add_argument("--rmat", type=int, default=10, help="R-MAT scale")
+    src.add_argument("--gnm", type=int, nargs=2, metavar=("V", "E"))
+    ap.add_argument("--edge-factor", type=int, default=8)
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--engine", default="pull", choices=("pull", "push", "relay"))
+    ap.add_argument("--max-batch", type=int, default=32)
+    ap.add_argument("--tick-ms", type=float, default=2.0)
+    ap.add_argument("--queue-depth", type=int, default=1024)
+    ap.add_argument("--budget-mb", type=int, default=0,
+                    help="device layout budget in MiB (0 = unlimited)")
+    ap.add_argument("--oracle-max-vertices", type=int, default=0,
+                    help="serve graphs at/under this size sequentially")
+    ap.add_argument("--queries", type=int, default=64, help="demo query count")
+    ap.add_argument("--multi-frac", type=float, default=0.25)
+    ap.add_argument("--multi-width", type=int, default=4)
+    ap.add_argument("--check", action="store_true",
+                    help="oracle-check every demo reply")
+    ap.add_argument("--repl", action="store_true", help="interactive mode")
+    args = ap.parse_args(argv)
+
+    graph, name = build_graph(args)
+    logger.info(
+        "Registering %s: V=%d, E=%d (directed), engine=%s",
+        name, graph.num_vertices, graph.num_edges, args.engine,
+    )
+    with make_server(args) as server:
+        t0 = time.perf_counter()
+        server.register(name, graph)
+        server.query(name, 0).result(timeout=600)  # warm layout + first shape
+        logger.info(
+            "Graph registered and warm in %.2f s", time.perf_counter() - t0
+        )
+        if args.repl:
+            repl(server, name, graph.num_vertices)
+            report = server.report()
+        else:
+            report = demo(server, name, graph, args)
+        print(json.dumps(report, indent=2, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,47 @@
+"""bfs_tpu.serve — long-lived, in-process BFS query serving.
+
+The batch engines answer "run S searches now"; this package answers "keep
+answering searches forever": register a graph once (layout + device
+operands memoized, evicted LRU under an HBM budget), then stream
+single-source and multi-source queries through a micro-batcher that
+coalesces them into the batched multi-source engine and never recompiles
+in steady state.
+
+    from bfs_tpu.serve import BfsServer
+
+    server = BfsServer()
+    server.register("g", graph)
+    reply = server.query("g", 0).result()
+    reply.dist, reply.parent          # canonical min-parent BFS tree
+
+Components: :class:`GraphRegistry` (layout + residency),
+:class:`ExecutableCache` (compiled programs keyed by (graph, engine,
+batch shape)), :class:`BfsServer` (admission queue, micro-batching,
+deadlines, result LRU, oracle degradation).
+"""
+
+from .registry import ENGINES, GraphRegistry, RegisteredGraph
+from .executor import ExecutableCache, build_batch_runner, run_oracle_batch
+from .server import (
+    AdmissionError,
+    BfsServer,
+    QueryTimeout,
+    ServeError,
+    ServeReply,
+    ServerClosed,
+)
+
+__all__ = [
+    "ENGINES",
+    "GraphRegistry",
+    "RegisteredGraph",
+    "ExecutableCache",
+    "build_batch_runner",
+    "run_oracle_batch",
+    "AdmissionError",
+    "BfsServer",
+    "QueryTimeout",
+    "ServeError",
+    "ServeReply",
+    "ServerClosed",
+]

@@ -1,0 +1,164 @@
+"""Compiled-executable cache + per-engine batch runners.
+
+Steady-state serving must never recompile: the round-5 ledger puts the
+bench-scale compile at ~830 s, and even the CPU-mesh test programs cost
+hundreds of ms — per-tick compiles would dominate every latency percentile.
+The cache here is keyed ``(graph, engine, batch_shape)``: the server pads
+every tick's source batch to a power-of-two bucket so a handful of shapes
+cover any traffic mix, and after warmup every tick is a cache hit (the
+loadgen report asserts exactly this).
+
+For the pull/push engines the runner is an AOT artifact
+(``jit(...).lower(...).compile()``): the executable takes the device
+operands as ARGUMENTS, so registry eviction + re-upload of a graph's
+operands does not invalidate it — same shapes, new buffers.  The relay
+engine manages its own compiled programs internally (models/bfs.py); its
+runner is a closure and the first tick per shape counts as the miss.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+from ..models.multisource import MultiBfsResult
+
+
+class ExecutableCache:
+    """LRU of batch runners keyed ``(graph, engine, batch)``.
+
+    ``get`` returns the cached runner (a compile hit) or invokes ``build``
+    under the lock and records a miss.  Hit/miss totals feed the serve
+    report's ``compile_hit_rate``."""
+
+    def __init__(self, capacity: int = 64, metrics=None):
+        self.capacity = capacity
+        self.metrics = metrics
+        self._lock = threading.Lock()
+        self._cache: OrderedDict[tuple, object] = OrderedDict()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: tuple, build):
+        with self._lock:
+            runner = self._cache.get(key)
+            if runner is not None:
+                self._cache.move_to_end(key)
+                self.hits += 1
+                if self.metrics is not None:
+                    self.metrics.bump("compile_hits")
+                return runner, True
+        # Build outside the cache-wide lock: compiles are seconds-long and
+        # registration/metrics readers must not stall behind them.  The
+        # serving loop is single-threaded, so duplicate builds only happen
+        # with concurrent servers sharing a cache — harmless, last wins.
+        runner = build()
+        with self._lock:
+            runner = self._cache.setdefault(key, runner)
+            self._cache.move_to_end(key)
+            self.misses += 1
+            if self.metrics is not None:
+                self.metrics.bump("compile_misses")
+            while len(self._cache) > self.capacity:
+                self._cache.popitem(last=False)
+        return runner, False
+
+    def drop_graph(self, name: str) -> None:
+        with self._lock:
+            for key in [k for k in self._cache if k[0] == name]:
+                del self._cache[key]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._cache)
+
+
+def _state_to_result(state, sources: np.ndarray, num_vertices: int) -> MultiBfsResult:
+    import jax
+
+    state = jax.device_get(state)
+    return MultiBfsResult(
+        sources=sources,
+        dist=np.asarray(state.dist[:, :num_vertices]),
+        parent=np.asarray(state.parent[:, :num_vertices]),
+        num_levels=int(state.level),
+    )
+
+
+def build_batch_runner(registry, name: str, engine: str, batch: int):
+    """AOT-compile (or bind) the batched multi-source program for one
+    ``(graph, engine, batch)`` shape.  The returned callable maps a padded
+    int32[batch] source array to a host :class:`MultiBfsResult`."""
+    import jax.numpy as jnp
+
+    from ..models.multisource import _bfs_multi_fused, _bfs_multi_pull_fused
+
+    rec = registry.get(name)
+    v = rec.num_vertices
+
+    if engine == "pull":
+        ell0, folds = registry.acquire(name, engine)
+        compiled = _bfs_multi_pull_fused.lower(
+            ell0, folds, jnp.zeros((batch,), jnp.int32), v, v
+        ).compile()
+
+        def run(sources: np.ndarray) -> MultiBfsResult:
+            # Re-acquire per call: eviction may have dropped the operands,
+            # and acquire re-uploads same-shaped buffers the executable
+            # accepts unchanged.
+            ell0, folds = registry.acquire(name, engine)
+            state = compiled(ell0, folds, jnp.asarray(sources))
+            return _state_to_result(state, sources, v)
+
+        return run
+
+    if engine == "push":
+        src, dst = registry.acquire(name, engine)
+        compiled = _bfs_multi_fused.lower(
+            src, dst, jnp.zeros((batch,), jnp.int32), v, v
+        ).compile()
+
+        def run(sources: np.ndarray) -> MultiBfsResult:
+            src, dst = registry.acquire(name, engine)
+            state = compiled(src, dst, jnp.asarray(sources))
+            return _state_to_result(state, sources, v)
+
+        return run
+
+    if engine == "relay":
+        def run(sources: np.ndarray) -> MultiBfsResult:
+            eng = registry.acquire(name, engine)
+            if sources.shape[0] % 32 == 0:
+                # Element-major mode, 32 trees per uint32 element; falls
+                # back to the vmapped path automatically past 31 levels
+                # (models/bfs.py run_multi_elem).
+                return eng.run_multi_elem(sources)
+            return eng.run_multi(sources)
+
+        return run
+
+    raise ValueError(f"unknown engine {engine!r}")
+
+
+def run_oracle_batch(graph, sources: np.ndarray) -> MultiBfsResult:
+    """Sequential degradation path: per-source canonical BFS on the host.
+
+    Uses :func:`~bfs_tpu.oracle.bfs.canonical_bfs` (min-parent tie-break)
+    so the dist AND parent rows are bit-exact with the device engines —
+    a degraded-path reply is indistinguishable from a device reply."""
+    from ..oracle.bfs import canonical_bfs
+
+    dist_rows, parent_rows = [], []
+    for s in np.asarray(sources).tolist():
+        d, p = canonical_bfs(graph, int(s))
+        dist_rows.append(d)
+        parent_rows.append(p)
+    dist = np.stack(dist_rows)
+    return MultiBfsResult(
+        sources=np.asarray(sources, dtype=np.int32),
+        dist=dist,
+        parent=np.stack(parent_rows),
+        num_levels=int(dist[dist != np.iinfo(np.int32).max].max(initial=0)) + 1,
+    )

@@ -1,0 +1,253 @@
+"""Graph registry: build layouts once, keep device operands under a budget.
+
+The cold-path tax the serving layer exists to amortize is two-fold
+(VERDICT round 5: 434 s layout build + ~830 s compile before the first
+timed repeat): the HOST layout (ELL packing / dst-sorted edge arrays) and
+the DEVICE operand upload.  The registry owns both:
+
+  * host layouts are built once per ``(graph, engine)`` and memoized for
+    the registry's lifetime — they are cheap host RAM;
+  * device operands (the multi-GB HBM residents at bench scale) are
+    tracked in an LRU keyed ``(graph, engine)`` against an explicit byte
+    budget.  Evicting a pull entry calls
+    :func:`bfs_tpu.graph.ell.drop_device_operands` — the release hook that
+    was dead code until this subsystem — AND drops the registry's own
+    reference to the returned ``(ell0, folds)`` tuple, which is what
+    actually lets the runtime free the HBM.  The next
+    :meth:`GraphRegistry.acquire` re-uploads.
+
+The registry is synchronous and lock-guarded; the serving loop is its only
+hot caller, but registration can happen from any thread.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..graph.csr import DeviceGraph, Graph, build_device_graph
+from ..graph.ell import PullGraph, build_pull_graph, device_ell, drop_device_operands
+
+ENGINES = ("pull", "push", "relay")
+
+
+@dataclass
+class RegisteredGraph:
+    """One registered graph: the host graph plus lazily built layouts."""
+
+    name: str
+    graph: Graph | None  # host graph; None when registered from a layout
+    num_vertices: int = 0
+    num_edges: int = 0
+    layouts: dict = field(default_factory=dict)  # engine -> layout object
+
+
+def _pull_device_bytes(pg: PullGraph) -> int:
+    """HBM bytes :func:`device_ell` will pin for this layout (int32)."""
+    return 4 * pg.padded_slots
+
+
+def _push_device_bytes(dg: DeviceGraph) -> int:
+    return 4 * (int(np.asarray(dg.src).size) + int(np.asarray(dg.dst).size))
+
+
+class GraphRegistry:
+    """Named graphs + memoized layouts + budgeted device-operand residency.
+
+    ``device_budget_bytes`` caps the summed size of resident device
+    operands across all graphs/engines; ``None`` means unlimited (single
+    graph, the common case).  The budget never blocks the entry being
+    acquired — a single layout larger than the budget is allowed in alone,
+    everything else is evicted around it.
+    """
+
+    def __init__(self, *, device_budget_bytes: int | None = None, metrics=None):
+        self._lock = threading.RLock()
+        self._graphs: dict[str, RegisteredGraph] = {}
+        # (name, engine) -> (bytes, operands-ref); insertion order = LRU.
+        self._resident: OrderedDict[tuple[str, str], tuple[int, object]] = (
+            OrderedDict()
+        )
+        self.device_budget_bytes = device_budget_bytes
+        self.metrics = metrics
+        self.evictions = 0
+
+    # ------------------------------------------------------------- graphs --
+    def register(
+        self,
+        name: str,
+        graph: Graph | DeviceGraph | PullGraph,
+        *,
+        engines: tuple[str, ...] = (),
+    ) -> RegisteredGraph:
+        """Register ``graph`` under ``name``; optionally pre-build layouts.
+
+        Accepts a host :class:`Graph` (all engines available), or a prebuilt
+        :class:`PullGraph` / single-shard :class:`DeviceGraph` (that engine
+        only; no oracle fallback without the host graph)."""
+        with self._lock:
+            if name in self._graphs:
+                raise ValueError(f"graph {name!r} already registered")
+            if isinstance(graph, PullGraph):
+                rec = RegisteredGraph(
+                    name, None, graph.num_vertices, graph.num_edges,
+                    {"pull": graph},
+                )
+            elif isinstance(graph, DeviceGraph):
+                if graph.num_shards != 1:
+                    raise ValueError("serve registry takes single-shard graphs")
+                rec = RegisteredGraph(
+                    name, None, graph.num_vertices, graph.num_edges,
+                    {"push": graph},
+                )
+            elif isinstance(graph, Graph):
+                rec = RegisteredGraph(
+                    name, graph, graph.num_vertices, graph.num_edges
+                )
+            else:
+                raise TypeError(f"cannot register {type(graph).__name__}")
+            self._graphs[name] = rec
+        for engine in engines:
+            self.layout(name, engine)
+        return rec
+
+    def get(self, name: str) -> RegisteredGraph:
+        with self._lock:
+            try:
+                return self._graphs[name]
+            except KeyError:
+                raise KeyError(f"graph {name!r} is not registered") from None
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return list(self._graphs)
+
+    def unregister(self, name: str) -> None:
+        """Drop a graph entirely: evict its device operands, forget layouts.
+
+        On a :class:`~bfs_tpu.serve.BfsServer`, call ``server.unregister``
+        instead — the server also holds compiled executables and result-LRU
+        entries keyed by this name that must be invalidated with it."""
+        with self._lock:
+            for key in [k for k in self._resident if k[0] == name]:
+                self._evict(key)
+            self._graphs.pop(name, None)
+
+    # ------------------------------------------------------------ layouts --
+    def layout(self, name: str, engine: str):
+        """The memoized host layout for ``(graph, engine)``, built on first
+        use: :class:`PullGraph`, dst-sorted :class:`DeviceGraph`, or a
+        :class:`~bfs_tpu.models.bfs.RelayEngine`."""
+        if engine not in ENGINES:
+            raise ValueError(f"unknown engine {engine!r}; use one of {ENGINES}")
+        rec = self.get(name)
+        with self._lock:
+            layout = rec.layouts.get(engine)
+        if layout is not None:
+            return layout
+        if rec.graph is None:
+            raise ValueError(
+                f"graph {name!r} was registered as a prebuilt "
+                f"{list(rec.layouts)[0]!r} layout; engine {engine!r} needs "
+                "the host Graph"
+            )
+        if engine == "pull":
+            layout = build_pull_graph(rec.graph)
+        elif engine == "push":
+            layout = build_device_graph(rec.graph)
+        else:  # relay: the engine object IS the layout (it owns its tensors)
+            from ..models.bfs import RelayEngine
+
+            layout = RelayEngine(rec.graph)
+        with self._lock:
+            # Lost-race double build is possible without holding the lock
+            # through the (expensive) build; keep the first one stored.
+            layout = rec.layouts.setdefault(engine, layout)
+        return layout
+
+    # ---------------------------------------------------------- residency --
+    def acquire(self, name: str, engine: str):
+        """Device operands for ``(graph, engine)``, uploading within budget.
+
+        Returns the operand handle the executor passes to the compiled
+        program: ``(ell0, folds)`` for pull, ``(src, dst)`` device arrays
+        for push, the :class:`RelayEngine` itself for relay.  Marks the
+        entry most-recently-used and evicts LRU entries (via
+        :func:`drop_device_operands` for pull) until the budget holds."""
+        import jax.numpy as jnp
+
+        layout = self.layout(name, engine)
+        key = (name, engine)
+        with self._lock:
+            if key in self._resident:
+                self._resident.move_to_end(key)
+                return self._resident[key][1]
+            if engine == "pull":
+                nbytes = _pull_device_bytes(layout)
+            elif engine == "push":
+                nbytes = _push_device_bytes(layout)
+            else:
+                rg = layout.relay_graph
+                nbytes = int(rg.vperm_masks.nbytes + rg.net_masks.nbytes)
+            self._make_room(nbytes, keep=key)
+            if engine == "pull":
+                operands = device_ell(layout)
+            elif engine == "push":
+                operands = (jnp.asarray(layout.src), jnp.asarray(layout.dst))
+            else:
+                operands = layout  # tensors uploaded at engine init
+            self._resident[key] = (nbytes, operands)
+            return operands
+
+    def _make_room(self, incoming: int, *, keep) -> None:
+        if self.device_budget_bytes is None:
+            return
+        while (
+            self._resident
+            and self.resident_bytes() + incoming > self.device_budget_bytes
+        ):
+            victim = next(k for k in self._resident if k != keep)
+            self._evict(victim)
+
+    def _evict(self, key: tuple[str, str]) -> None:
+        name, engine = key
+        self._resident.pop(key)  # drops OUR reference to the operands
+        rec = self._graphs.get(name)
+        layout = rec.layouts.get(engine) if rec else None
+        if layout is None:
+            pass
+        elif engine == "pull":
+            drop_device_operands(layout)
+        elif engine == "relay":
+            # The engine object pins its mask tensors and compiled
+            # executables; rebuilding from the host graph is the release
+            # path (the RelayGraph host layout would be the thing to keep,
+            # but the engine memoizes it internally — drop the whole
+            # object and rebuild on next acquire).
+            rec.layouts.pop(engine, None)
+        # push: the device (src, dst) pair lived only in the resident entry.
+        self.evictions += 1
+        if self.metrics is not None:
+            self.metrics.bump("evictions")
+
+    def release(self, name: str, engine: str | None = None) -> None:
+        """Explicitly evict one graph's device operands (all engines when
+        ``engine`` is None).  Host layouts stay memoized."""
+        with self._lock:
+            for key in [
+                k
+                for k in self._resident
+                if k[0] == name and (engine is None or k[1] == engine)
+            ]:
+                self._evict(key)
+
+    def resident_bytes(self) -> int:
+        with self._lock:  # RLock: also safe from _make_room's hot path
+            return sum(b for b, _ in self._resident.values())
+
+    def resident_keys(self) -> list[tuple[str, str]]:
+        with self._lock:
+            return list(self._resident)

@@ -1,0 +1,467 @@
+"""Micro-batching BFS query server: coalesce, execute once, fan out.
+
+The serving model follows what BLEST-style batched traversal engines and
+the repo's own batched multi-source measurements (BENCHMARKS.md config 5)
+say about TPU BFS throughput: one batched program over S sources costs
+barely more than one source, so the way to serve a stream of independent
+queries is to admit them into a bounded queue, coalesce up to ``max_batch``
+sources per tick into ONE call of the batched engine, and fan the rows back
+out per request.  The whole loop is a single daemon thread; JAX dispatch
+stays single-threaded (device work parallelism comes from the batch axis,
+not host threads).
+
+Robustness semantics:
+
+  * **backpressure** — a full admission queue raises :class:`AdmissionError`
+    at submit time instead of queueing unboundedly;
+  * **deadlines** — a request whose deadline expires before its batch is
+    formed completes with :class:`QueryTimeout`; an expired-in-flight
+    request still gets its (correct) answer, since the batch was already
+    paid for — expiry can never yield a wrong answer, only a late or
+    missing one;
+  * **cancellation** — ``future.cancel()`` before batch formation works;
+    cancelled requests are skipped at batch time;
+  * **degradation** — graphs at or under ``oracle_max_vertices`` vertices,
+    and any batch whose device path raises, are served by the sequential
+    oracle (canonical min-parent, bit-exact with the engines) when the host
+    graph is available.
+
+Every reply carries a :class:`~bfs_tpu.utils.metrics.QueryRecord`; the
+server-level :class:`~bfs_tpu.utils.metrics.ServeMetrics` aggregates the
+latency/batching/cache statistics the loadgen prints.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..models.bfs import check_sources
+from ..models.multisource import MultiBfsResult, collapse_multi_source
+from ..utils.metrics import QueryRecord, ServeMetrics
+from .executor import ExecutableCache, build_batch_runner, run_oracle_batch
+from .registry import ENGINES, GraphRegistry
+
+
+class ServeError(RuntimeError):
+    """Base class for serving-layer failures."""
+
+
+class AdmissionError(ServeError):
+    """The bounded admission queue is full — retry later (backpressure)."""
+
+
+class QueryTimeout(ServeError):
+    """The request's deadline expired before its batch was formed."""
+
+
+class ServerClosed(ServeError):
+    """The server was shut down before the request could be served."""
+
+
+@dataclass
+class ServeReply:
+    """One served query.  ``dist``/``parent`` are int32[V] for single-source
+    and collapsed multi-source queries, int32[S, V] for ``mode='tree'``."""
+
+    graph: str
+    engine: str
+    mode: str
+    sources: np.ndarray
+    dist: np.ndarray
+    parent: np.ndarray
+    num_levels: int
+    record: QueryRecord
+
+
+@dataclass
+class _Request:
+    graph: str
+    engine: str
+    mode: str  # 'single' | 'tree' | 'collapse'
+    sources: np.ndarray
+    future: Future
+    submitted_at: float
+    deadline: float | None
+    oracle: bool  # tiny-graph degradation decided at admission
+    cache_key: tuple | None = None
+    record: QueryRecord = field(default_factory=QueryRecord)
+
+
+def _bucket(n: int) -> int:
+    """Pad a tick's source count to a power-of-two bucket so a handful of
+    shapes cover any traffic mix (the coalescing budget, not this function,
+    bounds ``n``; a single oversized multi-source query is allowed through
+    as its own batch)."""
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+class BfsServer:
+    """In-process BFS query-serving engine over a :class:`GraphRegistry`.
+
+    ``tick_s`` is the coalescing window: after the first request of a tick
+    arrives the batcher waits up to ``tick_s`` for more before executing
+    (0 = greedy drain of whatever is already queued, the test default).
+    """
+
+    def __init__(
+        self,
+        registry: GraphRegistry | None = None,
+        *,
+        engine: str = "pull",
+        max_batch: int = 32,
+        tick_s: float = 0.0,
+        queue_depth: int = 256,
+        result_cache_size: int = 256,
+        exe_cache_size: int = 64,
+        oracle_max_vertices: int = 0,
+        metrics: ServeMetrics | None = None,
+    ):
+        if engine not in ENGINES:
+            raise ValueError(f"unknown engine {engine!r}; use one of {ENGINES}")
+        self.metrics = metrics if metrics is not None else ServeMetrics()
+        self.registry = (
+            registry if registry is not None else GraphRegistry(metrics=self.metrics)
+        )
+        if self.registry.metrics is None:
+            self.registry.metrics = self.metrics
+        self.default_engine = engine
+        self.max_batch = int(max_batch)
+        self.tick_s = float(tick_s)
+        self.queue_depth = int(queue_depth)
+        self.oracle_max_vertices = int(oracle_max_vertices)
+        self.exe_cache = ExecutableCache(exe_cache_size, metrics=self.metrics)
+        self._result_cache: OrderedDict[tuple, tuple] = OrderedDict()
+        self._result_cache_size = int(result_cache_size)
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._pending: deque[_Request] = deque()
+        self._paused = False
+        self._closed = False
+        self._thread = threading.Thread(
+            target=self._serve_loop, name="bfs-serve", daemon=True
+        )
+        self._thread.start()
+
+    # ----------------------------------------------------------- lifecycle --
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def close(self) -> None:
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+        self._thread.join(timeout=30)
+        with self._cond:
+            while self._pending:
+                req = self._pending.popleft()
+                if req.future.set_running_or_notify_cancel():
+                    req.future.set_exception(ServerClosed("server closed"))
+
+    def pause(self) -> None:
+        """Hold batch formation (admission continues) — lets tests and
+        maintenance windows stage a known set of requests per tick."""
+        with self._cond:
+            self._paused = True
+
+    def resume(self) -> None:
+        with self._cond:
+            self._paused = False
+            self._cond.notify_all()
+
+    # ----------------------------------------------------------- admission --
+    def register(self, name: str, graph, **kw):
+        """Convenience passthrough to :meth:`GraphRegistry.register`."""
+        return self.registry.register(name, graph, **kw)
+
+    def unregister(self, name: str) -> None:
+        """Drop a graph AND every cache derived from it.  Use this (not
+        ``registry.unregister``) on a server: the compiled executables and
+        result LRU entries are keyed by graph name, and a later
+        re-registration under the same name must never serve answers — or
+        run programs — computed against the old graph."""
+        self.registry.unregister(name)
+        self.exe_cache.drop_graph(name)
+        with self._lock:
+            for key in [k for k in self._result_cache if k[0] == name]:
+                del self._result_cache[key]
+
+    def query(self, graph: str, source: int, **kw) -> Future:
+        """Single-source shortest-path query; reply rows are 1-D."""
+        return self.submit(graph, [int(source)], mode="single", **kw)
+
+    def query_multi(
+        self, graph: str, sources, *, collapse: bool = True, **kw
+    ) -> Future:
+        """Multi-source query: ``collapse=True`` serves the oracle's
+        multi-source semantics (``dist[v] = min_s dist_s[v]``), else
+        independent per-source trees (``mode='tree'``)."""
+        return self.submit(
+            graph, sources, mode="collapse" if collapse else "tree", **kw
+        )
+
+    def submit(
+        self,
+        graph: str,
+        sources,
+        *,
+        mode: str = "single",
+        engine: str | None = None,
+        timeout_s: float | None = None,
+    ) -> Future:
+        """Admit one query; returns a :class:`concurrent.futures.Future`
+        resolving to a :class:`ServeReply` (or raising
+        :class:`QueryTimeout` / :class:`ServerClosed`).
+
+        Raises :class:`AdmissionError` immediately when the bounded queue
+        is full, and ``ValueError``/``KeyError`` for malformed requests —
+        admission errors are the caller's, never the batcher's."""
+        if mode not in ("single", "tree", "collapse"):
+            raise ValueError(f"unknown mode {mode!r}")
+        engine = engine or self.default_engine
+        if engine not in ENGINES:
+            raise ValueError(f"unknown engine {engine!r}; use one of {ENGINES}")
+        rec = self.registry.get(graph)
+        sources = np.atleast_1d(np.asarray(sources, dtype=np.int32))
+        if sources.ndim != 1:
+            raise ValueError("sources must be a scalar or 1-D sequence")
+        if mode == "single" and sources.shape[0] != 1:
+            raise ValueError("mode='single' takes exactly one source")
+        check_sources(rec.num_vertices, sources)
+        now = time.monotonic()
+        future: Future = Future()
+        oracle = (
+            rec.graph is not None
+            and rec.num_vertices <= self.oracle_max_vertices
+        )
+        req = _Request(
+            graph=graph,
+            engine=engine,
+            mode=mode,
+            sources=sources,
+            future=future,
+            submitted_at=now,
+            deadline=(now + float(timeout_s)) if timeout_s is not None else None,
+            oracle=oracle,
+        )
+        req.cache_key = (graph, engine, mode, tuple(sources.tolist()))
+        cached = self._result_cache_get(req.cache_key)
+        if cached is not None:
+            dist, parent, num_levels = cached
+            self.metrics.bump("result_cache_hits")
+            rec_q = QueryRecord(
+                graph=graph,
+                engine=engine,
+                status="result_cache",
+                num_sources=int(sources.shape[0]),
+                result_cache_hit=True,
+            )
+            self.metrics.record_query(rec_q, ts=time.monotonic())
+            future.set_result(
+                ServeReply(graph, engine, mode, sources, dist, parent,
+                           num_levels, rec_q)
+            )
+            return future
+        self.metrics.bump("result_cache_misses")
+        with self._cond:
+            if self._closed:
+                raise ServerClosed("server is closed")
+            if len(self._pending) >= self.queue_depth:
+                self.metrics.bump("rejected")
+                raise AdmissionError(
+                    f"admission queue full ({self.queue_depth} pending)"
+                )
+            self._pending.append(req)
+            self._cond.notify_all()
+        return future
+
+    # --------------------------------------------------------- result cache --
+    def _result_cache_get(self, key):
+        with self._lock:
+            hit = self._result_cache.get(key)
+            if hit is not None:
+                self._result_cache.move_to_end(key)
+            return hit
+
+    def _result_cache_put(self, key, value) -> None:
+        if self._result_cache_size <= 0 or key is None:
+            return
+        with self._lock:
+            self._result_cache[key] = value
+            self._result_cache.move_to_end(key)
+            while len(self._result_cache) > self._result_cache_size:
+                self._result_cache.popitem(last=False)
+
+    # ------------------------------------------------------------- batching --
+    def _serve_loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._closed and (self._paused or not self._pending):
+                    self._cond.wait(timeout=0.1)
+                if self._closed:
+                    return
+                first = self._pending.popleft()
+            if self.tick_s > 0:
+                # Coalescing window: give concurrent submitters a tick to
+                # land in the same batch before the shapes are fixed.
+                time.sleep(self.tick_s)
+            batch = [first]
+            budget = self.max_batch - first.sources.shape[0]
+            with self._cond:
+                keep: deque[_Request] = deque()
+                while self._pending:
+                    req = self._pending.popleft()
+                    compatible = (
+                        req.graph == first.graph
+                        and req.engine == first.engine
+                        and req.oracle == first.oracle
+                        and req.sources.shape[0] <= budget
+                    )
+                    if compatible:
+                        batch.append(req)
+                        budget -= req.sources.shape[0]
+                    else:
+                        keep.append(req)
+                self._pending.extendleft(reversed(keep))
+            try:
+                self._execute_batch(batch)
+            except Exception as exc:  # defensive: the loop must survive
+                for req in batch:
+                    if not req.future.done():
+                        req.future.set_exception(exc)
+
+    def _execute_batch(self, batch: list[_Request]) -> None:
+        formed_at = time.monotonic()
+        live: list[_Request] = []
+        for req in batch:
+            if not req.future.set_running_or_notify_cancel():
+                self.metrics.bump("cancelled")
+                continue
+            if req.deadline is not None and formed_at > req.deadline:
+                self._finish_timeout(req, formed_at)
+                continue
+            live.append(req)
+        if not live:
+            return
+        first = live[0]
+        all_sources = np.concatenate([r.sources for r in live])
+        padded = _bucket(all_sources.shape[0])
+        rec = self.registry.get(first.graph)
+        compile_hit: bool | None = None
+        status = "ok"
+        t0 = time.monotonic()
+        try:
+            if first.oracle:
+                # Padding exists only for compiled-shape stability; the
+                # sequential path runs the real sources, nothing more.
+                result = run_oracle_batch(rec.graph, all_sources)
+                status = "oracle"
+                padded = all_sources.shape[0]
+                self.metrics.bump("oracle_served")
+            else:
+                sources_padded = np.concatenate(
+                    [all_sources,
+                     np.full(padded - all_sources.shape[0], all_sources[0],
+                             dtype=np.int32)]
+                )
+                runner, compile_hit = self.exe_cache.get(
+                    (first.graph, first.engine, padded),
+                    lambda: build_batch_runner(
+                        self.registry, first.graph, first.engine, padded
+                    ),
+                )
+                result = runner(sources_padded)
+        except Exception:
+            if rec.graph is None:
+                raise
+            # Device path failed (OOM, lowering, backend): degrade to the
+            # sequential oracle rather than failing the whole tick.
+            self.metrics.bump("device_errors")
+            result = run_oracle_batch(rec.graph, all_sources)
+            status = "oracle"
+            padded = all_sources.shape[0]
+            compile_hit = None
+        service_s = time.monotonic() - t0
+        self.metrics.bump("batches")
+
+        row = 0
+        for req in live:
+            s = req.sources.shape[0]
+            rows = slice(row, row + s)
+            row += s
+            sub = MultiBfsResult(
+                sources=req.sources,
+                dist=result.dist[rows],
+                parent=result.parent[rows],
+                num_levels=result.num_levels,
+            )
+            if req.mode == "collapse":
+                dist, parent = collapse_multi_source(sub)
+            elif req.mode == "single":
+                dist, parent = sub.dist[0], sub.parent[0]
+            else:
+                dist, parent = sub.dist, sub.parent
+            done = time.monotonic()
+            req.record = QueryRecord(
+                graph=req.graph,
+                engine=req.engine,
+                status=status,
+                num_sources=s,
+                batch_size=padded,
+                supersteps=result.num_levels,
+                queue_wait_s=formed_at - req.submitted_at,
+                service_s=service_s,
+                total_s=done - req.submitted_at,
+                compile_hit=compile_hit,
+            )
+            reply = ServeReply(
+                req.graph, req.engine, req.mode, req.sources,
+                dist, parent, result.num_levels, req.record,
+            )
+            self._result_cache_put(req.cache_key, (dist, parent, result.num_levels))
+            self.metrics.record_query(req.record, ts=done)
+            req.future.set_result(reply)
+
+    def _finish_timeout(self, req: _Request, now: float) -> None:
+        req.record = QueryRecord(
+            graph=req.graph,
+            engine=req.engine,
+            status="timeout",
+            num_sources=int(req.sources.shape[0]),
+            queue_wait_s=now - req.submitted_at,
+            total_s=now - req.submitted_at,
+        )
+        self.metrics.bump("timeouts")
+        self.metrics.record_query(req.record, ts=now)
+        req.future.set_exception(
+            QueryTimeout(
+                f"deadline expired after {req.record.total_s * 1e3:.1f} ms "
+                "in queue"
+            )
+        )
+
+    # -------------------------------------------------------------- reports --
+    def report(self) -> dict:
+        out = self.metrics.report()
+        out["registry"] = {
+            "graphs": self.registry.names(),
+            "resident_bytes": self.registry.resident_bytes(),
+            "resident": [list(k) for k in self.registry.resident_keys()],
+            "evictions": self.registry.evictions,
+            "budget_bytes": self.registry.device_budget_bytes,
+        }
+        out["executables_cached"] = len(self.exe_cache)
+        return out

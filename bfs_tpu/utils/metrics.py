@@ -6,11 +6,18 @@ state files themselves.  Here each superstep records frontier size, newly
 settled vertices, and wall time; the run-level summary reports traversed
 edges per second (TEPS, Graph500 convention: directed edge count / total BFS
 time), the metric named in BASELINE.json.
+
+The serving layer (``bfs_tpu.serve``) adds REQUEST-level metrics on top of
+the run-level ones: every admitted query leaves a :class:`QueryRecord`
+(queue wait, batch size it rode in, compile/result-cache hits, superstep
+count, end-to-end latency) and :class:`ServeMetrics` aggregates them into
+the throughput/latency report (p50/p99, queries/sec, cache hit rates).
 """
 
 from __future__ import annotations
 
 import json
+import threading
 from dataclasses import dataclass, field, asdict
 
 
@@ -64,3 +71,122 @@ class RunMetrics:
                 f"Elapsed time [{r.level}] ==> {r.seconds * 1e3:.3f} ms "
                 f"(frontier {r.frontier_size})"
             )
+
+
+def percentile(values, q: float) -> float:
+    """Linear-interpolation percentile (``q`` in [0, 100]) of a sequence;
+    0.0 on an empty input.  Dependency-free so report paths never pull in
+    numpy for a handful of scalars."""
+    vals = sorted(float(v) for v in values)
+    if not vals:
+        return 0.0
+    if len(vals) == 1:
+        return vals[0]
+    pos = (len(vals) - 1) * (q / 100.0)
+    lo = int(pos)
+    hi = min(lo + 1, len(vals) - 1)
+    frac = pos - lo
+    return vals[lo] * (1.0 - frac) + vals[hi] * frac
+
+
+@dataclass
+class QueryRecord:
+    """Per-request structured record, attached to every served reply.
+
+    ``status`` is one of ``'ok'`` (device batch), ``'result_cache'`` (LRU
+    hit, never queued), ``'oracle'`` (sequential degradation), ``'timeout'``
+    or ``'error'``.  ``compile_hit`` is None for paths that never reach the
+    executable cache (cache hits, oracle, failures before dispatch)."""
+
+    graph: str = ""
+    engine: str = ""
+    status: str = "ok"
+    num_sources: int = 1
+    batch_size: int = 0  # padded device batch the request rode in
+    supersteps: int = 0
+    queue_wait_s: float = 0.0  # admission -> batch formation
+    service_s: float = 0.0  # device (or oracle) execution, batch-shared
+    total_s: float = 0.0  # admission -> reply
+    compile_hit: bool | None = None
+    result_cache_hit: bool = False
+
+
+class ServeMetrics:
+    """Thread-safe aggregator for the serving layer.
+
+    Counters are free-form (``bump('evictions')``) and exact for the
+    process lifetime; query records feed the latency/batching statistics
+    and are kept in a BOUNDED window (``max_records``, default 100k) so a
+    server that "answers searches forever" cannot leak memory through its
+    own observability — percentiles are therefore over the most recent
+    window, which is what a serving dashboard wants anyway.  ``report()``
+    returns a JSON-ready dict — the loadgen and ``run_serve`` print it
+    verbatim."""
+
+    def __init__(self, max_records: int = 100_000):
+        from collections import deque
+
+        self._lock = threading.Lock()
+        self.records: deque[QueryRecord] = deque(maxlen=max_records)
+        self.counters: dict[str, int] = {}
+        self._first_ts: float | None = None
+        self._last_ts: float | None = None
+
+    def bump(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            self.counters[name] = self.counters.get(name, 0) + by
+
+    def count(self, name: str) -> int:
+        with self._lock:
+            return self.counters.get(name, 0)
+
+    def record_query(self, rec: QueryRecord, *, ts: float | None = None) -> None:
+        with self._lock:
+            self.records.append(rec)
+            if ts is not None:
+                if self._first_ts is None:
+                    self._first_ts = ts
+                self._last_ts = ts
+
+    @staticmethod
+    def _rate(counters: dict, hits: str, misses: str) -> float | None:
+        h, m = counters.get(hits, 0), counters.get(misses, 0)
+        return h / (h + m) if h + m else None
+
+    def report(self) -> dict:
+        with self._lock:
+            records = list(self.records)
+            counters = dict(self.counters)
+            span = (
+                (self._last_ts - self._first_ts)
+                if self._first_ts is not None and self._last_ts is not None
+                else 0.0
+            )
+        ok = [r for r in records if r.status in ("ok", "result_cache", "oracle")]
+        lat = [r.total_s for r in ok]
+        waits = [r.queue_wait_s for r in records if r.batch_size > 0]
+        batches = [r.batch_size for r in records if r.batch_size > 0]
+        out = {
+            "queries": len(records),
+            "served": len(ok),
+            "timeouts": sum(r.status == "timeout" for r in records),
+            "errors": sum(r.status == "error" for r in records),
+            "latency_p50_ms": percentile(lat, 50) * 1e3,
+            "latency_p99_ms": percentile(lat, 99) * 1e3,
+            "latency_mean_ms": (sum(lat) / len(lat) * 1e3) if lat else 0.0,
+            "queue_wait_p99_ms": percentile(waits, 99) * 1e3,
+            "batch_size_mean": (sum(batches) / len(batches)) if batches else 0.0,
+            "batch_size_max": max(batches, default=0),
+            "queries_per_sec": (len(ok) / span) if span > 0 else 0.0,
+            "counters": counters,
+        }
+        out["compile_hit_rate"] = self._rate(
+            counters, "compile_hits", "compile_misses"
+        )
+        out["result_cache_hit_rate"] = self._rate(
+            counters, "result_cache_hits", "result_cache_misses"
+        )
+        return out
+
+    def to_json(self) -> str:
+        return json.dumps(self.report(), indent=2, sort_keys=True)

@@ -1,0 +1,58 @@
+"""BFS_TPU_BUILD_LOG latch: reversible and idempotent (ADVICE.md round-5
+finding #3 — previously a one-way latch that could double-install the
+handler under concurrent first builds)."""
+
+import logging
+
+from bfs_tpu.graph import relay
+
+
+def _reset(monkeypatch):
+    monkeypatch.setattr(relay, "_build_log_handler", None)
+    monkeypatch.setattr(relay, "_build_log_prev_level", None)
+
+
+def test_enable_is_idempotent(monkeypatch):
+    _reset(monkeypatch)
+    handlers_before = list(relay.logger.handlers)
+    monkeypatch.setenv("BFS_TPU_BUILD_LOG", "1")
+    relay._ensure_build_log()
+    relay._ensure_build_log()  # second call must not add a second handler
+    added = [h for h in relay.logger.handlers if h not in handlers_before]
+    assert len(added) == 1
+    assert relay.logger.level == logging.INFO
+    monkeypatch.setenv("BFS_TPU_BUILD_LOG", "0")
+    relay._ensure_build_log()
+    assert relay.logger.handlers == handlers_before
+
+
+def test_disable_restores_previous_level(monkeypatch):
+    _reset(monkeypatch)
+    relay.logger.setLevel(logging.WARNING)  # application-configured level
+    try:
+        monkeypatch.setenv("BFS_TPU_BUILD_LOG", "1")
+        relay._ensure_build_log()
+        assert relay.logger.level == logging.INFO
+        monkeypatch.setenv("BFS_TPU_BUILD_LOG", "0")
+        relay._ensure_build_log()
+        assert relay.logger.level == logging.WARNING  # restored, not NOTSET
+        # Disabled and already clean: a further call is a no-op.
+        relay._ensure_build_log()
+        assert relay.logger.level == logging.WARNING
+    finally:
+        relay.logger.setLevel(logging.NOTSET)
+
+
+def test_off_flag_never_touches_foreign_config(monkeypatch):
+    _reset(monkeypatch)
+    foreign = logging.NullHandler()
+    relay.logger.addHandler(foreign)
+    try:
+        relay.logger.setLevel(logging.ERROR)
+        monkeypatch.setenv("BFS_TPU_BUILD_LOG", "0")
+        relay._ensure_build_log()
+        assert foreign in relay.logger.handlers
+        assert relay.logger.level == logging.ERROR
+    finally:
+        relay.logger.removeHandler(foreign)
+        relay.logger.setLevel(logging.NOTSET)

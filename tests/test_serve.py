@@ -1,0 +1,261 @@
+"""Serving-layer tests: every served result is oracle-checked (distances
+bit-exact vs queue_bfs, parents canonical min-parent / check() invariants),
+plus the batching, deadline, cache, and degradation semantics from the
+serve subsystem's contract."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from bfs_tpu.graph.generators import gnm_graph, path_graph
+from bfs_tpu.oracle.bfs import canonical_bfs, check, queue_bfs
+from bfs_tpu.serve import (
+    AdmissionError,
+    BfsServer,
+    GraphRegistry,
+    QueryTimeout,
+    ServerClosed,
+)
+
+TIMEOUT = 300  # generous future.result bound; CPU compiles are seconds
+
+
+@pytest.fixture(scope="module")
+def served_graph():
+    return gnm_graph(150, 400, seed=11)
+
+
+@pytest.fixture(scope="module")
+def server(served_graph):
+    with BfsServer(max_batch=8) as srv:
+        srv.register("g", served_graph)
+        yield srv
+
+
+def test_single_source_oracle_parity(server, served_graph):
+    for s in (0, 7, 149):
+        reply = server.query("g", s).result(TIMEOUT)
+        d, _ = queue_bfs(served_graph, s)
+        _, p = canonical_bfs(served_graph, s)
+        np.testing.assert_array_equal(reply.dist, d)
+        np.testing.assert_array_equal(reply.parent, p)
+        assert check(served_graph, reply.dist, reply.parent, s) == []
+
+
+def test_multi_source_collapse_parity(server, served_graph):
+    srcs = [3, 77, 140]
+    reply = server.query_multi("g", srcs).result(TIMEOUT)
+    od, _ = queue_bfs(served_graph, srcs)
+    np.testing.assert_array_equal(reply.dist, od)
+    assert check(served_graph, reply.dist, reply.parent, srcs) == []
+
+
+def test_multi_source_tree_rows_match_single(server, served_graph):
+    srcs = [5, 60]
+    reply = server.query_multi("g", srcs, collapse=False).result(TIMEOUT)
+    assert reply.dist.shape == (2, served_graph.num_vertices)
+    for i, s in enumerate(srcs):
+        d, _ = queue_bfs(served_graph, s)
+        _, p = canonical_bfs(served_graph, s)
+        np.testing.assert_array_equal(reply.dist[i], d)
+        np.testing.assert_array_equal(reply.parent[i], p)
+
+
+def test_batch_coalescing_across_concurrent_submitters(server):
+    # Stage concurrent submitters while batching is held, then release:
+    # all requests must ride ONE device batch.
+    server.pause()
+    futs = {}
+    threads = []
+
+    def submit(s):
+        futs[s] = server.query("g", s)
+
+    for s in range(100, 106):
+        t = threading.Thread(target=submit, args=(s,))
+        t.start()
+        threads.append(t)
+    for t in threads:
+        t.join()
+    batches_before = server.metrics.count("batches")
+    server.resume()
+    replies = [futs[s].result(TIMEOUT) for s in futs]
+    assert server.metrics.count("batches") == batches_before + 1
+    assert {r.record.batch_size for r in replies} == {8}  # 6 -> bucket 8
+    assert all(r.record.queue_wait_s >= 0 for r in replies)
+
+
+def test_executable_cache_hit_on_second_same_shape_batch(served_graph):
+    with BfsServer(max_batch=4, result_cache_size=0) as srv:
+        srv.register("g", served_graph)
+        first = srv.query("g", 1).result(TIMEOUT)
+        assert first.record.compile_hit is False
+        second = srv.query("g", 2).result(TIMEOUT)
+        assert second.record.compile_hit is True
+        assert srv.exe_cache.hits == 1 and srv.exe_cache.misses == 1
+
+
+def test_result_lru_cache_serves_repeats(served_graph):
+    with BfsServer(max_batch=4) as srv:
+        srv.register("g", served_graph)
+        r1 = srv.query("g", 9).result(TIMEOUT)
+        r2 = srv.query("g", 9).result(TIMEOUT)
+        assert r1.record.status == "ok"
+        assert r2.record.status == "result_cache"
+        np.testing.assert_array_equal(r1.dist, r2.dist)
+        np.testing.assert_array_equal(r1.parent, r2.parent)
+
+
+def test_deadline_expiry_returns_timeout_not_wrong_answer(server):
+    server.pause()
+    expired = server.query("g", 120, timeout_s=0.0)
+    live = server.query("g", 121, timeout_s=60.0)
+    time.sleep(0.02)  # let the zero deadline pass before batch formation
+    server.resume()
+    with pytest.raises(QueryTimeout):
+        expired.result(TIMEOUT)
+    reply = live.result(TIMEOUT)  # the live request still gets its answer
+    assert reply.record.status == "ok"
+    d, _ = queue_bfs(server.registry.get("g").graph, 121)
+    np.testing.assert_array_equal(reply.dist, d)
+
+
+def test_admission_queue_backpressure(served_graph):
+    with BfsServer(max_batch=4, queue_depth=2, result_cache_size=0) as srv:
+        srv.register("g", served_graph)
+        srv.pause()
+        srv.query("g", 1)
+        srv.query("g", 2)
+        with pytest.raises(AdmissionError):
+            srv.query("g", 3)
+        assert srv.metrics.count("rejected") == 1
+        srv.resume()
+
+
+def test_oracle_degradation_for_tiny_graphs(tiny_graph):
+    with BfsServer(oracle_max_vertices=100) as srv:
+        srv.register("t", tiny_graph)
+        reply = srv.query("t", 0).result(TIMEOUT)
+        assert reply.record.status == "oracle"
+        assert reply.dist.tolist() == [0, 1, 1, 2, 2, 1]
+        assert reply.parent.tolist() == [0, 0, 0, 2, 2, 0]  # canonical
+        # No executable was ever compiled for the degraded path.
+        assert len(srv.exe_cache) == 0
+
+
+def test_second_graph_evicts_first_under_capped_budget(served_graph):
+    other = gnm_graph(150, 400, seed=12)
+    registry = GraphRegistry(device_budget_bytes=1)
+    with BfsServer(registry, max_batch=4) as srv:
+        srv.register("a", served_graph)
+        srv.register("b", other)
+        ra = srv.query("a", 0).result(TIMEOUT)
+        pg_a = registry.layout("a", "pull")
+        assert getattr(pg_a, "_device_ell", None) is not None
+        rb = srv.query("b", 0).result(TIMEOUT)
+        # B displaced A via drop_device_operands (asserted on the memo).
+        assert getattr(pg_a, "_device_ell", None) is None
+        assert registry.resident_keys() == [("b", "pull")]
+        assert registry.evictions == 1
+        # A still serves correctly after re-upload, reusing its compiled
+        # executable (operands are arguments, not baked-in constants).
+        ra2 = srv.query("a", 3).result(TIMEOUT)
+        assert ra2.record.compile_hit is True
+        d, _ = queue_bfs(served_graph, 3)
+        np.testing.assert_array_equal(ra2.dist, d)
+        assert registry.evictions == 2
+
+
+def test_push_engine_parity(served_graph):
+    with BfsServer(engine="push", max_batch=4) as srv:
+        srv.register("g", served_graph)
+        reply = srv.query("g", 4).result(TIMEOUT)
+        d, _ = queue_bfs(served_graph, 4)
+        _, p = canonical_bfs(served_graph, 4)
+        np.testing.assert_array_equal(reply.dist, d)
+        np.testing.assert_array_equal(reply.parent, p)
+
+
+def test_relay_engine_parity(served_graph):
+    from bfs_tpu.graph.benes import native_available
+
+    if not native_available():
+        pytest.skip("native Beneš router unavailable")
+    with BfsServer(engine="relay", max_batch=4) as srv:
+        srv.register("g", served_graph)
+        reply = srv.query("g", 8).result(TIMEOUT)
+        d, _ = queue_bfs(served_graph, 8)
+        _, p = canonical_bfs(served_graph, 8)
+        np.testing.assert_array_equal(reply.dist, d)
+        np.testing.assert_array_equal(reply.parent, p)
+
+
+def test_device_error_degrades_to_oracle(served_graph, monkeypatch):
+    import bfs_tpu.serve.server as server_mod
+
+    def boom(*a, **k):
+        raise RuntimeError("simulated device failure")
+
+    monkeypatch.setattr(server_mod, "build_batch_runner", boom)
+    with BfsServer(max_batch=4) as srv:
+        srv.register("g", served_graph)
+        reply = srv.query("g", 2).result(TIMEOUT)
+        assert reply.record.status == "oracle"
+        assert srv.metrics.count("device_errors") == 1
+        d, _ = queue_bfs(served_graph, 2)
+        np.testing.assert_array_equal(reply.dist, d)
+
+
+def test_submit_validation(server):
+    with pytest.raises(KeyError):
+        server.query("nope", 0)
+    with pytest.raises(ValueError):
+        server.query("g", 150)  # out of range
+    with pytest.raises(ValueError):
+        server.submit("g", [1, 2], mode="single")
+    with pytest.raises(ValueError):
+        server.submit("g", [1], mode="bogus")
+    with pytest.raises(ValueError):
+        server.submit("g", [1], engine="bogus")
+
+
+def test_close_fails_pending_and_rejects_new(served_graph):
+    srv = BfsServer(max_batch=4)
+    srv.register("g", served_graph)
+    srv.pause()
+    fut = srv.query("g", 1)
+    srv.close()
+    with pytest.raises(ServerClosed):
+        fut.result(TIMEOUT)
+    with pytest.raises(ServerClosed):
+        srv.query("g", 2)
+
+
+def test_unregister_invalidates_caches(served_graph):
+    # Re-registering a DIFFERENT graph under the same name must never be
+    # served from executables or result rows computed on the old graph.
+    other = gnm_graph(150, 400, seed=13)
+    with BfsServer(max_batch=4) as srv:
+        srv.register("g", served_graph)
+        stale = srv.query("g", 0).result(TIMEOUT)
+        srv.unregister("g")
+        assert len(srv.exe_cache) == 0
+        srv.register("g", other)
+        fresh = srv.query("g", 0).result(TIMEOUT)
+        assert fresh.record.status == "ok"
+        assert fresh.record.result_cache_hit is False
+        d, _ = queue_bfs(other, 0)
+        np.testing.assert_array_equal(fresh.dist, d)
+        assert not np.array_equal(stale.dist, fresh.dist)
+
+
+def test_deep_graph_supersteps(server):
+    # A high-diameter graph through the same serving path: distances must
+    # be exact at every level (no truncation at any batching boundary).
+    g = path_graph(40)
+    server.register("path", g)
+    reply = server.query("path", 0).result(TIMEOUT)
+    np.testing.assert_array_equal(reply.dist, np.arange(40))
+    assert reply.num_levels == 40

@@ -1,0 +1,254 @@
+"""Load generator for the bfs_tpu.serve micro-batching query server.
+
+Replays a configurable single/multi-source query mix from concurrent
+submitter threads against an in-process :class:`~bfs_tpu.serve.BfsServer`,
+oracle-checks EVERY reply (distances bit-exact vs ``queue_bfs``, parents
+through the ported algs4 ``check()`` invariants — a wrong answer is a hard
+failure, same gating discipline as bench.py), and prints a
+throughput/latency report: p50/p99, queries/sec, batch-size distribution,
+and the steady-state compile-cache hit rate.
+
+The warmup phase deterministically compiles every power-of-two batch
+bucket (pause → stage b singles → resume = one batch of exactly b), so the
+steady phase must run at a 100% compile-cache hit rate — the acceptance
+gate this tool exists to demonstrate.  Exit code 1 on any wrong answer or
+a sub-100% steady-state hit rate.
+
+Usage (mirrors the tier-1 test platform: 8 virtual CPU devices):
+    JAX_PLATFORMS=cpu python tools/serve_loadgen.py --scale 10 \
+        --requests 200 --concurrency 8 --multi-frac 0.25
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+# Mirror tests/conftest.py: virtual 8-device CPU mesh, set BEFORE jax loads.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if "xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+from bfs_tpu.graph.generators import rmat_graph  # noqa: E402
+from bfs_tpu.oracle.bfs import check, queue_bfs  # noqa: E402
+from bfs_tpu.serve import AdmissionError, BfsServer, GraphRegistry  # noqa: E402
+from bfs_tpu.utils.metrics import percentile  # noqa: E402
+
+
+def make_queries(rng, v: int, n: int, args):
+    """The replayed mix: singles, collapsed multis, per-source-tree multis.
+    Sources are drawn from a limited pool so repeats exercise the result
+    LRU like real hot-key traffic would."""
+    pool = rng.integers(0, v, size=max(args.source_pool, 4))
+    queries = []
+    for _ in range(n):
+        r = rng.random()
+        if r < args.multi_frac:
+            width = int(rng.integers(2, args.multi_width + 1))
+            srcs = rng.choice(pool, size=width).tolist()
+            mode = "collapse" if rng.random() < 0.5 else "tree"
+            queries.append((srcs, mode))
+        else:
+            queries.append(([int(rng.choice(pool))], "single"))
+    return queries
+
+
+def oracle_check(graph, oracle_cache, srcs, mode, reply) -> list[str]:
+    """Every reply is verified; returns a list of violations (empty = OK)."""
+    key = tuple(sorted(set(srcs)))
+    if mode in ("single", "collapse"):
+        if key not in oracle_cache:
+            oracle_cache[key] = queue_bfs(graph, list(key))[0]
+        errs = []
+        if not np.array_equal(reply.dist, oracle_cache[key]):
+            errs.append(f"dist mismatch for sources {srcs}")
+        errs += check(graph, reply.dist, reply.parent, srcs)
+        return errs
+    errs = []
+    for i, s in enumerate(srcs):  # tree mode: each row is one source's tree
+        if (s,) not in oracle_cache:
+            oracle_cache[(s,)] = queue_bfs(graph, s)[0]
+        if not np.array_equal(reply.dist[i], oracle_cache[(s,)]):
+            errs.append(f"tree dist mismatch for source {s}")
+        errs += check(graph, reply.dist[i], reply.parent[i], s)
+    return errs
+
+
+def warmup(server, name: str, v: int, max_batch: int) -> int:
+    """Compile every power-of-two bucket ≤ max_batch deterministically:
+    stage exactly b singles while paused, resume, collect — one batch of b
+    per bucket.  Returns the number of warmup queries."""
+    total = 0
+    b = 1
+    while True:
+        stage = min(b, max_batch)  # a full tick covers the top bucket even
+        server.pause()             # when max_batch is not a power of two
+        # Distinct sources across rounds: a repeated source would hit the
+        # result LRU, never enqueue, and shrink the staged batch below b —
+        # leaving that bucket uncompiled for the steady phase.
+        futs = [server.query(name, (total + s) % v) for s in range(stage)]
+        server.resume()
+        for f in futs:
+            f.result(timeout=600)
+        total += stage
+        if b >= max_batch:
+            return total
+        b *= 2
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--scale", type=int, default=10, help="R-MAT scale")
+    ap.add_argument("--edge-factor", type=int, default=8)
+    ap.add_argument("--requests", type=int, default=200)
+    ap.add_argument("--concurrency", type=int, default=8)
+    ap.add_argument("--multi-frac", type=float, default=0.25)
+    ap.add_argument("--multi-width", type=int, default=4)
+    ap.add_argument("--source-pool", type=int, default=64,
+                    help="distinct sources in the mix (repeats hit the LRU)")
+    ap.add_argument("--engine", default="pull", choices=("pull", "push", "relay"))
+    ap.add_argument("--max-batch", type=int, default=16)
+    ap.add_argument("--tick-ms", type=float, default=2.0)
+    ap.add_argument("--timeout-s", type=float, default=120.0)
+    ap.add_argument("--queue-depth", type=int, default=4096)
+    ap.add_argument("--budget-mb", type=int, default=0)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--no-check", action="store_true")
+    args = ap.parse_args(argv)
+
+    rng = np.random.default_rng(args.seed)
+    t0 = time.perf_counter()
+    graph = rmat_graph(args.scale, args.edge_factor, seed=args.seed)
+    v = graph.num_vertices
+    print(
+        f"graph: R-MAT scale {args.scale} ef {args.edge_factor} "
+        f"(V={v}, E={graph.num_edges} directed) built in "
+        f"{time.perf_counter() - t0:.1f}s",
+        flush=True,
+    )
+
+    registry = GraphRegistry(
+        device_budget_bytes=args.budget_mb * (1 << 20) if args.budget_mb else None
+    )
+    name = f"rmat{args.scale}"
+    wrong: list[str] = []
+    latencies: list[float] = []
+    lock = threading.Lock()
+    oracle_cache: dict = {}
+
+    with BfsServer(
+        registry,
+        engine=args.engine,
+        max_batch=args.max_batch,
+        tick_s=args.tick_ms / 1e3,
+        queue_depth=args.queue_depth,
+    ) as server:
+        server.register(name, graph)
+        t0 = time.perf_counter()
+        nwarm = warmup(server, name, v, args.max_batch)
+        print(
+            f"warmup: {nwarm} queries compiled "
+            f"{server.report()['executables_cached']} batch shapes in "
+            f"{time.perf_counter() - t0:.1f}s",
+            flush=True,
+        )
+        pre = dict(server.metrics.report()["counters"])
+
+        queries = make_queries(rng, v, args.requests, args)
+        cursor = [0]
+
+        def one_request(i: int) -> None:
+            srcs, mode = queries[i]
+            t = time.perf_counter()
+            while True:
+                try:
+                    fut = server.submit(
+                        name, srcs, mode=mode, timeout_s=args.timeout_s
+                    )
+                    break
+                except AdmissionError:
+                    time.sleep(0.005)  # backpressure: retry later
+            reply = fut.result(timeout=args.timeout_s + 60)
+            lat = time.perf_counter() - t
+            errs = (
+                []
+                if args.no_check
+                else oracle_check(graph, oracle_cache, srcs, mode, reply)
+            )
+            with lock:
+                latencies.append(lat)
+                wrong.extend(errs)
+
+        def worker():
+            while True:
+                with lock:
+                    if cursor[0] >= len(queries):
+                        return
+                    i = cursor[0]
+                    cursor[0] += 1
+                try:
+                    one_request(i)
+                except Exception as exc:
+                    # An unanswered query (timeout, server error, dead
+                    # future) must fail the run, not silently kill this
+                    # worker thread and under-count the checked total.
+                    with lock:
+                        wrong.append(
+                            f"request {i} ({queries[i]}) failed: {exc!r}"
+                        )
+
+        t0 = time.perf_counter()
+        threads = [
+            threading.Thread(target=worker) for _ in range(args.concurrency)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        steady_s = time.perf_counter() - t0
+
+        report = server.report()
+        post = report["counters"]
+
+    hits = post.get("compile_hits", 0) - pre.get("compile_hits", 0)
+    misses = post.get("compile_misses", 0) - pre.get("compile_misses", 0)
+    steady_rate = hits / (hits + misses) if hits + misses else 1.0
+    out = {
+        "requests": args.requests,
+        "concurrency": args.concurrency,
+        "oracle_checked": 0 if args.no_check else args.requests,
+        "wrong_answers": len(wrong),
+        "steady_seconds": steady_s,
+        "queries_per_sec": args.requests / steady_s if steady_s > 0 else 0.0,
+        "latency_p50_ms": percentile(latencies, 50) * 1e3,
+        "latency_p99_ms": percentile(latencies, 99) * 1e3,
+        "steady_compile_hit_rate": steady_rate,
+        "server_report": report,
+    }
+    print(json.dumps(out, indent=2, sort_keys=True))
+    for msg in wrong[:10]:
+        print(f"WRONG: {msg}", file=sys.stderr)
+    if wrong:
+        return 1
+    if steady_rate < 1.0:
+        print(
+            f"FAIL: steady-state compile hit rate {steady_rate:.3f} < 1.0",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
