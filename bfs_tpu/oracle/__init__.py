@@ -1,2 +1,3 @@
 from .bfs import queue_bfs, canonical_bfs, check, has_path_to, dist_to, path_to  # noqa: F401
+from .device import COUNT_FIELDS, DeviceChecker  # noqa: F401
 from .native import native_bfs, native_available  # noqa: F401

@@ -1,0 +1,182 @@
+"""On-device BFS verification: ``check()`` without the 128 MB download.
+
+Host :func:`bfs_tpu.oracle.bfs.check` is the algs4 parity oracle
+(BreadthFirstPaths.java:172-221) and stays the ground truth — but running
+it per bench root means pulling the full dist+parent arrays through the
+axon tunnel (128 MB at s24; minutes in the degraded windows that killed
+the round-5 driver capture) and then sweeping 201 M edges on the host.
+
+The three invariants are embarrassingly data-parallel reductions over the
+edge set (VERDICT r5 "missing" #2), so this module evaluates them AS ONE
+XLA program over device-resident arrays and returns a six-counter verdict
+vector — the only thing that crosses the tunnel is 24 bytes:
+
+  counts[0] — sources with ``dist != 0``;
+  counts[1] — edges whose source is reached but destination is not;
+  counts[2] — edges with ``dist[dst] > dist[src] + 1``;
+  counts[3] — reached non-source vertices with no parent;
+  counts[4] — reached non-source vertices with ``dist != dist[parent]+1``;
+  counts[5] — reached non-source vertices whose ``(parent, w)`` tree edge
+              is not a graph edge.
+
+All zero <=> host ``check()`` returns no violations (asserted by
+tests/test_device_check.py on tinyCG/randomG, including corrupted-state
+cases).  The edge membership test (the host's sorted-key searchsorted)
+becomes an edge-side scatter: edge ``(u, w)`` covers ``w`` iff
+``parent[w] == u``; a reached non-source vertex left uncovered has a
+phantom tree edge.  One scatter per verification is fine — this is the
+once-per-root check, not the superstep hot path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..graph.csr import DeviceGraph, Graph, INF_DIST, NO_PARENT
+
+#: Human-readable names for the verdict vector, index-aligned.
+COUNT_FIELDS = (
+    "source_dist_nonzero",
+    "edge_dst_unreached",
+    "edge_dist_gap",
+    "reached_without_parent",
+    "tree_dist_mismatch",
+    "tree_edge_missing",
+)
+
+
+@functools.partial(jax.jit, static_argnames=("v",))
+def _check_counts(srcv, dstv, dist, parent, sources, v: int):
+    """The verdict program: int32[6] violation counts (see module doc).
+
+    ``srcv``/``dstv`` may contain sentinel padding (endpoint == v, inert);
+    ``dist``/``parent`` may carry the engines' sentinel slot (sliced off).
+    """
+    inf = jnp.int32(INF_DIST)
+    dist = jax.lax.slice_in_dim(dist, 0, v)
+    parent = jax.lax.slice_in_dim(parent, 0, v)
+    # One appended slot so clipped sentinel endpoints gather inert values.
+    dist_p = jnp.concatenate([dist, jnp.full((1,), inf, jnp.int32)])
+    par_p = jnp.concatenate([parent, jnp.full((1,), NO_PARENT, jnp.int32)])
+    si = jnp.minimum(srcv, v)
+    di = jnp.minimum(dstv, v)
+    real = (srcv < v) & (dstv < v)
+    ds, dd = dist_p[si], dist_p[di]
+
+    # Invariant 1 (BreadthFirstPaths.java:178-183): sources at distance 0.
+    c_src = (dist_p[jnp.minimum(sources, v)] != 0).sum(dtype=jnp.int32)
+
+    # Invariant 2 (:188-201): per directed edge, reachability agrees and
+    # the distance gap is at most one relaxation.
+    reach_s = real & (ds != inf)
+    reach_d = dd != inf
+    c_unreached = (reach_s & ~reach_d).sum(dtype=jnp.int32)
+    c_gap = (reach_s & reach_d & (dd > ds + 1)).sum(dtype=jnp.int32)
+
+    # Invariant 3 (:205-217): every reached non-source has a parent one
+    # level up, connected by a real graph edge.
+    srcmask = jnp.zeros(v + 1, bool).at[jnp.minimum(sources, v)].set(True)
+    reached = dist != inf
+    non_src = reached & ~srcmask[:v]
+    c_noparent = (non_src & (parent == NO_PARENT)).sum(dtype=jnp.int32)
+    hasp = non_src & (parent != NO_PARENT)
+    pc = jnp.clip(parent, 0, v - 1)
+    c_treedist = (hasp & (dist != dist[pc] + 1)).sum(dtype=jnp.int32)
+    tree_target = jnp.where(real & (par_p[di] == srcv), di, jnp.int32(v))
+    covered = jnp.zeros(v + 1, bool).at[tree_target].set(True)
+    c_missing = (hasp & ~covered[:v]).sum(dtype=jnp.int32)
+
+    return jnp.stack(
+        [c_src, c_unreached, c_gap, c_noparent, c_treedist, c_missing]
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("v",))
+def _packed_reached(dist, v: int):
+    """uint32[ceil(v/32)] reached-bit words (standard packing) from a
+    device dist array — the component signature for coverage comparison."""
+    from ..ops.relay import pack_std
+
+    reached = jax.lax.slice_in_dim(dist, 0, v) != jnp.int32(INF_DIST)
+    pad = (-v) % 32
+    if pad:
+        reached = jnp.concatenate([reached, jnp.zeros(pad, bool)])
+    return pack_std(reached)
+
+
+@functools.partial(jax.jit, static_argnames=("v",))
+def _coverage_mismatch(dist, ref_words, v: int):
+    """Scalar count of vertices whose reached-bit differs from the
+    reference component words (one int32 down the tunnel instead of the
+    per-root host ``assert_array_equal`` over V bools)."""
+    return (
+        jax.lax.population_count(_packed_reached(dist, v) ^ ref_words)
+        .sum(dtype=jnp.int32)
+    )
+
+
+class DeviceChecker:
+    """Device-resident verifier bound to one graph's edge arrays.
+
+    Ships the flat ``(src, dst)`` edge arrays once (or reuses arrays that
+    are already on device — the push engine's operands) and then verifies
+    any number of results for a handful of bytes each.  States from any
+    engine work, as long as dist/parent are in ORIGINAL id space — the
+    relay engine's :meth:`~bfs_tpu.models.bfs.RelayEngine.to_original_device`
+    produces exactly that without leaving the device.
+    """
+
+    def __init__(self, src, dst, num_vertices: int):
+        self.num_vertices = int(num_vertices)
+        self.src = jnp.asarray(src).reshape(-1)
+        self.dst = jnp.asarray(dst).reshape(-1)
+
+    @classmethod
+    def from_graph(cls, graph: Graph | DeviceGraph) -> "DeviceChecker":
+        """From a host :class:`Graph` or padded :class:`DeviceGraph`
+        (sentinel padding edges are inert in the verdict program)."""
+        return cls(graph.src, graph.dst, graph.num_vertices)
+
+    @property
+    def edge_bytes(self) -> int:
+        return int(self.src.size + self.dst.size) * 4
+
+    # ------------------------------------------------------------ verdicts --
+    def counts(self, dist, parent, sources) -> jax.Array:
+        """DEVICE int32[6] violation counters (see :data:`COUNT_FIELDS`);
+        nothing is transferred."""
+        sources = jnp.atleast_1d(jnp.asarray(sources, dtype=jnp.int32))
+        return _check_counts(
+            self.src, self.dst, dist, parent, sources, self.num_vertices
+        )
+
+    def check(self, dist, parent, sources) -> dict[str, int]:
+        """Named nonzero violation counts (empty dict == all invariants
+        hold) — the only host transfer is the 24-byte counter vector."""
+        host = np.asarray(jax.device_get(self.counts(dist, parent, sources)))
+        return {
+            name: int(n) for name, n in zip(COUNT_FIELDS, host.tolist()) if n
+        }
+
+    def ok(self, dist, parent, sources) -> bool:
+        return not self.check(dist, parent, sources)
+
+    # ------------------------------------------------------------ coverage --
+    def packed_reached(self, dist) -> jax.Array:
+        """Device reached-bit words for ``dist`` — compute once on a
+        reference result, compare against every root via
+        :meth:`coverage_mismatch`."""
+        return _packed_reached(dist, self.num_vertices)
+
+    def coverage_mismatch(self, dist, ref_words) -> int:
+        """Vertices whose reachability differs from ``ref_words``
+        (one int32 pull)."""
+        return int(
+            jax.device_get(
+                _coverage_mismatch(dist, ref_words, self.num_vertices)
+            )
+        )

@@ -104,6 +104,13 @@ def main(argv=None):
     ap.add_argument("--source", type=int, default=None)
     args = ap.parse_args(argv)
 
+    # Persistent compile caches, set before the first trace so the driver
+    # never re-pays a compile it has already done in a previous process
+    # (bfs_tpu/config.py; BFS_TPU_CACHE_DIR relocates everything).
+    from ..config import enable_compile_cache
+
+    logger.info("compile caches: %s", enable_compile_cache())
+
     cfg = (
         ServiceConfiguration.load(args.config)
         if os.path.exists(args.config)

@@ -61,6 +61,10 @@ def make_server(args, metrics=None):
             args.budget_mb * (1 << 20) if args.budget_mb else None
         ),
         metrics=metrics,
+        # Persistent layout bundles: a second serving process registering
+        # the same graph loads the finished layout from disk instead of
+        # rebuilding it (--cache-dir "" disables).
+        layout_cache=args.cache_dir or None,
     )
     return BfsServer(
         registry,
@@ -161,7 +165,18 @@ def main(argv=None) -> int:
     ap.add_argument("--check", action="store_true",
                     help="oracle-check every demo reply")
     ap.add_argument("--repl", action="store_true", help="interactive mode")
+    from ..config import layout_cache_dir
+
+    ap.add_argument("--cache-dir", default=layout_cache_dir(),
+                    help="persistent layout-bundle dir ('' disables; "
+                    "default: the shared artifact-cache root)")
     args = ap.parse_args(argv)
+
+    # Compile caches before the first trace: a restarted server re-loads
+    # its executables instead of re-compiling them (the serving cold path).
+    from ..config import enable_compile_cache
+
+    logger.info("compile caches: %s", enable_compile_cache())
 
     graph, name = build_graph(args)
     logger.info(

@@ -12,6 +12,13 @@ the run-level ones: every admitted query leaves a :class:`QueryRecord`
 (queue wait, batch size it rode in, compile/result-cache hits, superstep
 count, end-to-end latency) and :class:`ServeMetrics` aggregates them into
 the throughput/latency report (p50/p99, queries/sec, cache hit rates).
+
+The ARTIFACT caches (ISSUE 2: layout bundles, serialized executables) get
+process-global hit/miss counters here — :func:`bump_artifact` /
+:func:`artifact_report` — because their callers span layers (graph build,
+engine init, serve registry, bench) that share no metrics object; every
+report surface (bench details, serve report, cache_warm) includes them so
+a cold-path regression shows up as a miss count, not a silent stall.
 """
 
 from __future__ import annotations
@@ -19,6 +26,27 @@ from __future__ import annotations
 import json
 import threading
 from dataclasses import dataclass, field, asdict
+
+_artifact_lock = threading.Lock()
+_artifact_counters: dict[str, int] = {}
+
+
+def bump_artifact(name: str, by: int = 1) -> None:
+    """Count one artifact-cache event (e.g. ``layout_cache_hits``,
+    ``exe_cache_misses``).  Thread-safe, process-global."""
+    with _artifact_lock:
+        _artifact_counters[name] = _artifact_counters.get(name, 0) + by
+
+
+def artifact_report() -> dict:
+    """Snapshot of the artifact-cache counters plus derived hit rates
+    (``None`` when a cache saw no traffic this process)."""
+    with _artifact_lock:
+        out: dict = dict(_artifact_counters)
+    for cache in ("layout_cache", "exe_cache"):
+        h, m = out.get(f"{cache}_hits", 0), out.get(f"{cache}_misses", 0)
+        out[f"{cache}_hit_rate"] = h / (h + m) if h + m else None
+    return out
 
 
 @dataclass
@@ -186,6 +214,11 @@ class ServeMetrics:
         out["result_cache_hit_rate"] = self._rate(
             counters, "result_cache_hits", "result_cache_misses"
         )
+        # Process-global artifact caches (layout bundles, executables): a
+        # serving dashboard wants cold-path health next to the hot-path
+        # latencies — a second process re-registering a graph should show
+        # a layout_cache hit here, not a 434 s rebuild.
+        out["artifact_caches"] = artifact_report()
         return out
 
     def to_json(self) -> str:

@@ -1,0 +1,136 @@
+"""On-device check() tests (ISSUE 2 tentpole c): parity with the host
+parity-oracle ``check()`` on tinyCG/randomG — including deliberately
+corrupted state — and the transfer-free property (verification pulls a
+counter vector, never dist/parent arrays)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bfs_tpu.graph.csr import INF_DIST, NO_PARENT
+from bfs_tpu.oracle.bfs import canonical_bfs, check, queue_bfs
+from bfs_tpu.oracle.device import COUNT_FIELDS, DeviceChecker
+
+
+def _agree(graph, dist, parent, sources):
+    """Host check() and the device verdict must agree on validity."""
+    host = check(graph, dist, parent, sources)
+    dev = DeviceChecker.from_graph(graph).check(
+        jnp.asarray(dist), jnp.asarray(parent), sources
+    )
+    assert (host == []) == (dev == {}), (host, dev)
+    return host, dev
+
+
+@pytest.mark.parametrize("sources", [0, 3, [0, 3]])
+def test_parity_valid_results_tiny(tiny_graph, sources):
+    for bfs_fn in (queue_bfs, canonical_bfs):
+        dist, parent = bfs_fn(tiny_graph, sources)
+        host, dev = _agree(tiny_graph, dist, parent, sources)
+        assert host == [] and dev == {}
+
+
+def test_parity_valid_results_medium(medium_graph):
+    dist, parent = canonical_bfs(medium_graph, 0)
+    host, dev = _agree(medium_graph, dist, parent, 0)
+    assert host == [] and dev == {}
+
+
+def test_corrupted_parent_detected(medium_graph):
+    dist, parent = queue_bfs(medium_graph, 0)
+    bad = parent.copy()
+    # Point a reached non-source vertex at a non-neighbour: the classic
+    # "plausible-looking wrong parent" a broken slot mapping would produce.
+    reached = np.flatnonzero((dist != INF_DIST) & (dist > 0))
+    w = int(reached[-1])
+    non_neighbours = np.setdiff1d(
+        np.arange(medium_graph.num_vertices), medium_graph.adj(w)
+    )
+    bad[w] = int(non_neighbours[non_neighbours != w][0])
+    host, dev = _agree(medium_graph, dist, bad, 0)
+    assert host != [] and dev  # both flag it
+    assert "tree_edge_missing" in dev or "tree_dist_mismatch" in dev
+
+
+def test_parentless_reached_vertex_detected(tiny_graph):
+    dist, parent = queue_bfs(tiny_graph, 0)
+    bad = parent.copy()
+    w = int(np.flatnonzero(dist == 1)[0])
+    bad[w] = NO_PARENT
+    host, dev = _agree(tiny_graph, dist, bad, 0)
+    assert host != [] and dev.get("reached_without_parent") == 1
+
+
+def test_corrupted_dist_detected(medium_graph):
+    dist, parent = queue_bfs(medium_graph, 0)
+    bad = dist.copy()
+    w = int(np.flatnonzero(dist == 1)[0])
+    bad[w] = 7  # breaks the triangle inequality and the tree relation
+    host, dev = _agree(medium_graph, bad, parent, 0)
+    assert host != [] and dev
+
+
+def test_source_distance_invariant(tiny_graph):
+    dist, parent = queue_bfs(tiny_graph, 0)
+    bad = dist.copy()
+    bad[0] = 1
+    _, dev = _agree(tiny_graph, bad, parent, 0)
+    assert dev.get("source_dist_nonzero") == 1
+
+
+def test_coverage_mismatch_counts_bits(tiny_graph):
+    dist, _ = queue_bfs(tiny_graph, 0)
+    dc = DeviceChecker.from_graph(tiny_graph)
+    ref = dc.packed_reached(jnp.asarray(dist))
+    assert dc.coverage_mismatch(jnp.asarray(dist), ref) == 0
+    other = dist.copy()
+    other[4] = INF_DIST
+    assert dc.coverage_mismatch(jnp.asarray(other), ref) == 1
+
+
+def test_transfer_free_verification(monkeypatch, medium_graph):
+    """The whole point: verifying a result transfers COUNTERS, never the
+    dist/parent arrays.  Asserted by intercepting jax.device_get — every
+    pull during check()/coverage_mismatch must be a few elements."""
+    dist, parent = canonical_bfs(medium_graph, 0)
+    dc = DeviceChecker.from_graph(medium_graph)
+    dist_d, parent_d = jnp.asarray(dist), jnp.asarray(parent)
+    ref = dc.packed_reached(dist_d)
+
+    pulled_sizes = []
+    real_device_get = jax.device_get
+
+    def spying_device_get(x):
+        for leaf in jax.tree_util.tree_leaves(x):
+            pulled_sizes.append(int(np.asarray(getattr(leaf, "size", 1))))
+        return real_device_get(x)
+
+    monkeypatch.setattr(jax, "device_get", spying_device_get)
+    verdict = dc.check(dist_d, parent_d, 0)
+    mismatch = dc.coverage_mismatch(dist_d, ref)
+    monkeypatch.undo()
+    assert verdict == {} and mismatch == 0
+    assert pulled_sizes, "verification must have pulled the verdicts"
+    assert max(pulled_sizes) <= len(COUNT_FIELDS), pulled_sizes
+
+
+def test_relay_engine_to_original_device_parity(medium_graph):
+    """RelayEngine.to_original_device must match the host-side mapping
+    bit-for-bit, and its output must satisfy the on-device verifier."""
+    from bfs_tpu.graph import benes
+
+    if not benes.native_available():
+        pytest.skip("requires the native benes router")
+    from bfs_tpu.models.bfs import RelayEngine
+
+    eng = RelayEngine(medium_graph)
+    source = 0
+    state = eng.run_many_device([source])[0]
+    dist_d, parent_d = eng.to_original_device(state, source)
+    res = eng.run(source)
+    np.testing.assert_array_equal(np.asarray(dist_d), res.dist)
+    np.testing.assert_array_equal(np.asarray(parent_d), res.parent)
+    dc = DeviceChecker.from_graph(medium_graph)
+    assert dc.check(dist_d, parent_d, source) == {}
+    assert check(medium_graph, res.dist, res.parent, source) == []

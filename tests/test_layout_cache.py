@@ -1,0 +1,132 @@
+"""Persistent layout-bundle cache tests (ISSUE 2 tentpole a): round-trip
+bit-identity, corrupted/stale bundle rejection + rebuild, tag aliases, and
+hit/miss accounting."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from bfs_tpu.cache.layout import (
+    LayoutCache,
+    STORE_VERSION,
+    graph_content_hash,
+    load_or_build_pull,
+    load_or_build_relay,
+    pull_key,
+    relay_key,
+)
+from bfs_tpu.graph import benes
+from bfs_tpu.graph.ell import pull_to_arrays
+from bfs_tpu.graph.generators import gnm_graph
+from bfs_tpu.graph.relay import relay_to_arrays
+
+needs_router = pytest.mark.skipif(
+    not benes.native_available(), reason="requires the native benes router"
+)
+
+
+@pytest.fixture
+def cache(tmp_path):
+    return LayoutCache(str(tmp_path / "layout"))
+
+
+def test_content_hash_distinguishes_graphs(tiny_graph):
+    other = gnm_graph(100, 200, seed=7)
+    assert graph_content_hash(tiny_graph) != graph_content_hash(other)
+    # Memoized: second call returns the cached digest.
+    assert graph_content_hash(tiny_graph) == tiny_graph._content_hash
+
+
+def test_pull_round_trip_bit_identical(tiny_graph, cache):
+    pg, info = load_or_build_pull(tiny_graph, cache=cache)
+    assert info["cache"] == "miss"
+    pg2, info2 = load_or_build_pull(tiny_graph, cache=cache)
+    assert info2["cache"] == "hit"
+    # The recorded COLD build time rides along on every warm load.
+    assert info2["build_seconds"] == pytest.approx(info["build_seconds"])
+    a, b = pull_to_arrays(pg), pull_to_arrays(pg2)
+    assert set(a) == set(b)
+    for name in a:
+        np.testing.assert_array_equal(np.asarray(a[name]), np.asarray(b[name]))
+
+
+@needs_router
+def test_relay_round_trip_bit_identical(medium_graph, cache):
+    rg, info = load_or_build_relay(medium_graph, cache=cache)
+    assert info["cache"] == "miss"
+    rg2, info2 = load_or_build_relay(medium_graph, cache=cache)
+    assert info2["cache"] == "hit"
+    a, b = relay_to_arrays(rg), relay_to_arrays(rg2)
+    assert set(a) == set(b)
+    for name in a:
+        np.testing.assert_array_equal(
+            np.asarray(a[name]), np.asarray(b[name]), err_msg=name
+        )
+    # Static metadata (NamedTuples/dataclasses) reconstructs exactly.
+    assert rg2.net_table == rg.net_table
+    assert rg2.vperm_table == rg.vperm_table
+    assert rg2.in_classes == rg.in_classes
+    assert rg2.out_classes == rg.out_classes
+
+
+def test_corrupted_array_rejected_and_rebuilt(tiny_graph, cache):
+    _, info = load_or_build_pull(tiny_graph, cache=cache)
+    key = info["key"]
+    path = os.path.join(cache._dir(key), "ell0.npy")
+    arr = np.load(path)
+    arr[0, 0] += 1
+    np.save(path, arr)
+    # The tampered field fails its fingerprint; the bundle is dropped...
+    assert cache.load(key) is None
+    assert not cache.has(key)
+    # ...and the next load-or-build silently rebuilds a fresh one.
+    pg, info2 = load_or_build_pull(tiny_graph, cache=cache)
+    assert info2["cache"] == "miss"
+    assert cache.has(key)
+
+
+def test_truncated_bundle_rejected(tiny_graph, cache):
+    _, info = load_or_build_pull(tiny_graph, cache=cache)
+    key = info["key"]
+    os.remove(os.path.join(cache._dir(key), "ell0.npy"))
+    assert cache.load(key) is None
+
+
+def test_stale_store_version_rejected(tiny_graph, cache):
+    _, info = load_or_build_pull(tiny_graph, cache=cache)
+    key = info["key"]
+    meta_path = os.path.join(cache._dir(key), "meta.json")
+    with open(meta_path) as f:
+        doc = json.load(f)
+    doc["store_version"] = STORE_VERSION + 1
+    with open(meta_path, "w") as f:
+        json.dump(doc, f)
+    assert cache.load(key) is None  # dropped as stale
+    _, info2 = load_or_build_pull(tiny_graph, cache=cache)
+    assert info2["cache"] == "miss"
+
+
+def test_keys_cover_params_and_code_version(tiny_graph):
+    # Different layout params -> different keys (no aliasing).
+    assert pull_key(tiny_graph, 32, 64) != pull_key(tiny_graph, 16, 64)
+    assert relay_key(tiny_graph) != pull_key(tiny_graph, 32, 64)
+    from bfs_tpu.graph.relay import LAYOUT_VERSION
+
+    assert f"v{LAYOUT_VERSION}" in relay_key(tiny_graph)
+
+
+def test_tag_alias_probes_warmth(tiny_graph, cache):
+    assert cache.resolve_tag("bench_s10") is None
+    _, info = load_or_build_pull(tiny_graph, cache=cache, tag="bench_s10")
+    assert cache.resolve_tag("bench_s10") == info["key"]
+    # A tag whose bundle vanished resolves to None (cold), not a dangle.
+    cache.invalidate(info["key"])
+    assert cache.resolve_tag("bench_s10") is None
+
+
+def test_disabled_cache_builds_directly(tiny_graph):
+    pg, info = load_or_build_pull(tiny_graph, cache=None)
+    assert info["cache"] == "disabled"
+    assert pg.num_vertices == tiny_graph.num_vertices

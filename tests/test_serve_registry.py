@@ -123,6 +123,45 @@ def test_lru_order_tracks_use():
     assert ("b", "push") in reg.resident_keys()
 
 
+def test_second_registry_hits_disk_cache(tmp_path, tiny_graph, monkeypatch):
+    """ISSUE 2: a second process-level registration of the same graph must
+    load the finished layout from the persistent bundle store instead of
+    rebuilding (simulated here with two registry instances sharing a cache
+    dir, the second with the builder poisoned)."""
+    from bfs_tpu.utils.metrics import ServeMetrics
+
+    cache_dir = str(tmp_path / "layout")
+    m1 = ServeMetrics()
+    reg1 = GraphRegistry(layout_cache=cache_dir, metrics=m1)
+    reg1.register("g", tiny_graph)
+    pg1 = reg1.layout("g", "pull")
+    assert m1.count("layout_disk_misses") == 1
+
+    # "Second process": fresh registry, same disk cache; if it tried to
+    # rebuild, the poisoned builder would raise.
+    import bfs_tpu.graph.ell as ell_mod
+
+    def poisoned(*a, **k):
+        raise AssertionError("layout was rebuilt despite a warm disk cache")
+
+    monkeypatch.setattr(ell_mod, "build_pull_graph", poisoned)
+    m2 = ServeMetrics()
+    reg2 = GraphRegistry(layout_cache=cache_dir, metrics=m2)
+    reg2.register("g", tiny_graph)
+    pg2 = reg2.layout("g", "pull")
+    assert m2.count("layout_disk_hits") == 1
+    np.testing.assert_array_equal(np.asarray(pg2.ell0), np.asarray(pg1.ell0))
+    # The serve report surfaces the process-global artifact counters.
+    assert m2.report()["artifact_caches"]["layout_cache_hits"] >= 1
+
+
+def test_registry_without_cache_never_touches_disk(tiny_graph, tmp_path):
+    reg = GraphRegistry()  # layout_cache=None: in-process memoization only
+    assert reg.layout_cache is None
+    reg.register("g", tiny_graph)
+    reg.layout("g", "pull")
+
+
 def test_unregister_evicts(tiny_graph):
     reg = GraphRegistry()
     reg.register("t", tiny_graph)

@@ -125,6 +125,10 @@ def main(argv=None) -> int:
     ap.add_argument("--budget-mb", type=int, default=0)
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--no-check", action="store_true")
+    ap.add_argument("--cache-dir", default="",
+                    help="persistent layout-bundle dir (default off; pass "
+                    "a dir — e.g. .bench_cache/layout — to measure "
+                    "warm-vs-cold registration across runs)")
     args = ap.parse_args(argv)
 
     rng = np.random.default_rng(args.seed)
@@ -139,7 +143,8 @@ def main(argv=None) -> int:
     )
 
     registry = GraphRegistry(
-        device_budget_bytes=args.budget_mb * (1 << 20) if args.budget_mb else None
+        device_budget_bytes=args.budget_mb * (1 << 20) if args.budget_mb else None,
+        layout_cache=args.cache_dir or None,
     )
     name = f"rmat{args.scale}"
     wrong: list[str] = []
@@ -154,7 +159,18 @@ def main(argv=None) -> int:
         tick_s=args.tick_ms / 1e3,
         queue_depth=args.queue_depth,
     ) as server:
+        t_reg = time.perf_counter()
         server.register(name, graph)
+        server.query(name, 0).result(timeout=600)  # force the layout build
+        from bfs_tpu.utils.metrics import artifact_report
+
+        rep = artifact_report()
+        print(
+            f"register+layout: {time.perf_counter() - t_reg:.2f}s "
+            f"(layout cache: {rep.get('layout_cache_hits', 0)} hits / "
+            f"{rep.get('layout_cache_misses', 0)} misses)",
+            flush=True,
+        )
         t0 = time.perf_counter()
         nwarm = warmup(server, name, v, args.max_batch)
         print(
