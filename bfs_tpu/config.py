@@ -20,9 +20,9 @@ continues — a latent NPE factory we deliberately do not reproduce).
 
 This module also owns the ARTIFACT-CACHE directory layout (the cold-path
 killer, ISSUE 2): every persistent cache — relay/ELL layout bundles, JAX's
-persistent compilation cache, the serialized-executable cache — lives under
-one root so a driver, a serving process and ``tools/cache_warm.py`` all
-share warm artifacts.  Resolution order: explicit env knob per cache, then
+persistent compilation cache, the serialized-executable cache, and the
+crash-resume run journals (ISSUE 3) — lives under one root so a driver, a
+serving process and ``tools/cache_warm.py`` all share warm artifacts.  Resolution order: explicit env knob per cache, then
 ``BFS_TPU_CACHE_DIR``, then ``<repo>/.bench_cache`` (the directory the
 bench has always used, so pre-existing warm entries keep working).
 """
@@ -46,6 +46,17 @@ def cache_root() -> str:
 def layout_cache_dir() -> str:
     """On-disk layout-bundle store (:mod:`bfs_tpu.cache.layout`)."""
     return os.path.join(cache_root(), "layout")
+
+
+def journal_dir() -> str:
+    """Run-journal directory (:mod:`bfs_tpu.resilience.journal`):
+    ``BFS_TPU_JOURNAL_DIR`` wins when set (tests point it at a tmp dir so
+    kill/resume runs can share warm artifact caches but not journals),
+    else ``<cache root>/journal`` — resume state lives with the other
+    per-config artifacts it must stay consistent with."""
+    return os.environ.get(
+        "BFS_TPU_JOURNAL_DIR", os.path.join(cache_root(), "journal")
+    )
 
 
 def compile_cache_dir() -> str:

@@ -1,0 +1,126 @@
+"""Phase-boundary fault injection (``BFS_TPU_FAULT``) + corruption injectors.
+
+Instrumented code calls :func:`fault_point(name)` at every phase boundary
+(in the bench: right AFTER the phase's journal record lands, which is what
+"boundary" means for resume semantics — the phase is durably complete, the
+next one has not started).  The hook is inert unless ``BFS_TPU_FAULT`` is
+set:
+
+    BFS_TPU_FAULT=kill:<phase>[:nth]    SIGKILL the process (no cleanup,
+                                        no atexit, no signal handlers —
+                                        the honest crash)
+    BFS_TPU_FAULT=raise:<phase>[:nth]   raise FaultInjected (tests the
+                                        exception path / SIGTERM-ish exits)
+    BFS_TPU_FAULT=phase:<phase>[:nth]   alias for kill: (the spelling the
+                                        issue tracker uses)
+
+``nth`` (default 1) selects the nth arrival at that phase — so
+``kill:repeat:2`` dies after the second timed repeat.  Per-item boundaries
+are named ``family:<item>`` (``repeat:0``, ``verify:17``) and a spec phase
+matches either the exact boundary name or the family prefix, so
+``kill:verify:3`` means "the third verification boundary" without the
+caller knowing which root id that is.
+
+The corruption injectors simulate the non-crash failure modes the journal
+and checkpoint layers must reject: truncation (a torn write) and byte
+flips (bit rot / a torn page).  They are plain file edits so tests and
+``tools/chaos_run.py`` can damage artifacts without knowing formats.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+
+
+class FaultInjected(RuntimeError):
+    """Raised by :func:`fault_point` under ``BFS_TPU_FAULT=raise:...``."""
+
+
+_lock = threading.Lock()
+_counts: dict[str, int] = {}
+
+
+def reset() -> None:
+    """Forget arrival counts (tests)."""
+    with _lock:
+        _counts.clear()
+
+
+def fault_spec(env: str | None = None) -> tuple[str, str, int] | None:
+    """Parse ``BFS_TPU_FAULT`` into ``(action, phase, nth)`` or None.
+
+    ``action`` is ``'kill'`` or ``'raise'``; the documented ``phase:``
+    prefix is an alias for ``kill``."""
+    spec = env if env is not None else os.environ.get("BFS_TPU_FAULT", "")
+    spec = spec.strip()
+    if not spec:
+        return None
+    action, _, rest = spec.partition(":")
+    if action == "phase":
+        action = "kill"
+    if action not in ("kill", "raise") or not rest:
+        raise ValueError(
+            f"bad BFS_TPU_FAULT {spec!r}; use "
+            "kill:<phase>[:nth] | raise:<phase>[:nth] | phase:<phase>[:nth]"
+        )
+    phase, nth = rest, 1
+    head, _, tail = rest.rpartition(":")
+    # nth is 1-based; a trailing 0 (or any non-positive integer) is part
+    # of the phase NAME, not a count — so ``kill:repeat:0`` targets the
+    # exact boundary "repeat:0" (first arrival) rather than parsing as an
+    # nth=0 that could never fire.
+    if head and tail.isdigit() and int(tail) >= 1:
+        phase, nth = head, int(tail)
+    return action, phase, nth
+
+
+def fault_point(name: str) -> None:
+    """Mark a phase boundary; dies here iff ``BFS_TPU_FAULT`` targets the
+    nth arrival at ``name``.  Free when the env var is unset."""
+    spec = fault_spec()
+    if spec is None:
+        return
+    action, phase, nth = spec
+    if name != phase and not name.startswith(phase + ":"):
+        return
+    with _lock:
+        _counts[phase] = _counts.get(phase, 0) + 1
+        hit = _counts[phase] == nth
+    if not hit:
+        return
+    if action == "kill":
+        # The driver-timeout shape: instant death, nothing flushed beyond
+        # what is already durable.  stderr note first so a captured tail
+        # shows the kill was injected, not organic.
+        import sys
+
+        print(
+            f"[fault] SIGKILL at phase boundary {name!r}",
+            file=sys.stderr, flush=True,
+        )
+        os.kill(os.getpid(), signal.SIGKILL)
+    raise FaultInjected(f"injected fault at phase boundary {name!r}")
+
+
+def corrupt_file(path: str, *, mode: str = "truncate", at: int | None = None) -> None:
+    """Damage ``path`` in place: ``mode='truncate'`` cuts the file to
+    ``at`` bytes (default: half), ``mode='flip'`` XOR-flips the byte at
+    ``at`` (default: middle).  Used by tests to prove the journal /
+    checkpoint loaders reject damage instead of resuming from it."""
+    size = os.path.getsize(path)
+    if mode == "truncate":
+        cut = size // 2 if at is None else at
+        with open(path, "r+b") as f:
+            f.truncate(cut)
+        return
+    if mode == "flip":
+        pos = size // 2 if at is None else at
+        with open(path, "r+b") as f:
+            f.seek(pos)
+            b = f.read(1)
+            f.seek(pos)
+            f.write(bytes([b[0] ^ 0xFF]) if b else b"\xff")
+        return
+    raise ValueError(f"unknown corruption mode {mode!r}")

@@ -1,0 +1,300 @@
+"""RunJournal: append-only, crash-safe JSONL journal of phase results.
+
+One journal file per (bench config, graph) pair, content-addressed the
+same way :mod:`bfs_tpu.cache.layout` keys layout bundles: the file name is
+a blake2b over the canonical config JSON, and the journaled ``graph``
+phase carries the graph content hash so a resumed run can prove it is
+looking at the same graph before trusting any record.
+
+Disk format — one JSON object per line:
+
+    {"i": 3, "phase": "repeat:0", "t": 1722.4, "crc": "deadbeef",
+     "payload": {...}, "arrays": "s8_..._reference.npz"}
+
+  * ``i`` — strictly increasing record index (a splice or a lost write in
+    the middle breaks the sequence and invalidates the tail);
+  * ``crc`` — crc32 over the canonical JSON of ``(i, phase, payload)``;
+    a torn or bit-flipped record fails the check and invalidates the
+    TAIL from that record on (everything before it is still trusted —
+    an append-only log is only ever damaged at the end by a crash,
+    and anything else is corruption the injectors simulate);
+  * ``arrays`` — optional sidecar ``.npz`` (written atomically via
+    :func:`bfs_tpu.utils.checkpoint.save_npz_atomic`) for payloads that
+    are arrays rather than scalars (the reference run's reached-mask);
+    the record stores the file name plus a fingerprint, and a missing or
+    corrupt sidecar invalidates that record alone.
+
+Writes are append + flush + fsync, so a SIGKILL can lose at most the
+record being written — which the crc/partial-line check then trims on
+the next open.  Rewrites only happen on invalidation (config or graph
+mismatch), which rotates the whole file aside to ``*.stale.<n>`` and
+starts fresh; a journal is never edited in place.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+import zlib
+from typing import Any
+
+JOURNAL_VERSION = 1
+
+_HEADER_PHASE = "_header"
+
+
+def _canon(obj: Any) -> str:
+    return json.dumps(obj, sort_keys=True, separators=(",", ":"))
+
+
+def _crc(i: int, phase: str, payload: Any) -> str:
+    return f"{zlib.crc32(_canon([i, phase, payload]).encode()):08x}"
+
+
+def config_key(config: dict) -> str:
+    """blake2b-64 over the canonical config JSON — the journal's file
+    stem, so one config maps to one journal the way one graph maps to one
+    layout bundle."""
+    return hashlib.blake2b(_canon(config).encode(), digest_size=8).hexdigest()
+
+
+class RunJournal:
+    """Append-only phase journal for one run configuration.
+
+    ``get(phase)`` returns the payload of a completed phase (or None);
+    ``put(phase, payload, arrays=...)`` appends one durable record.
+    Phases are free-form strings; per-item phases use ``"name:<i>"``.
+    """
+
+    #: Seconds to wait for a draining predecessor's file lock before
+    #: failing; tests shrink it.
+    LOCK_TIMEOUT_S = 10.0
+
+    def __init__(self, path: str, config: dict):
+        self.path = path
+        self.config = dict(config)
+        self._records: dict[str, dict] = {}
+        self._arrays_cache: dict[str, dict | None] = {}
+        self._fh = None
+        self.resumed_phases: list[str] = []
+        self.invalidated: str | None = None
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._open()
+
+    @classmethod
+    def open_for(cls, root: str, config: dict) -> "RunJournal":
+        """The journal for ``config`` under ``root`` (the content-addressed
+        path: ``<root>/<config_key>.jsonl``)."""
+        return cls(os.path.join(root, f"{config_key(config)}.jsonl"), config)
+
+    # ----------------------------------------------------------- lifecycle --
+    def _flock(self, fh, timeout_s: float | None = None) -> None:
+        """Exclusive inter-process lock on the journal file: two live
+        processes with the same config (a driver re-invoking while the
+        previous run drains its SIGTERM handler) must never interleave
+        appends — an interleaved ``i`` sequence would make the next replay
+        trim validly-fsync'd records.  Waits briefly for a draining
+        predecessor, then fails loudly rather than corrupting."""
+        try:
+            import fcntl
+        except ImportError:  # non-POSIX: single-process use only
+            return
+        if timeout_s is None:
+            timeout_s = self.LOCK_TIMEOUT_S
+        deadline = time.monotonic() + timeout_s
+        while True:
+            try:
+                fcntl.flock(fh.fileno(), fcntl.LOCK_EX | fcntl.LOCK_NB)
+                return
+            except OSError:
+                if time.monotonic() > deadline:
+                    raise RuntimeError(
+                        f"journal {self.path} is locked by another live "
+                        "process; two runs of the same config cannot share "
+                        "a journal"
+                    )
+                time.sleep(0.1)
+
+    def _open(self) -> None:
+        # Lock BEFORE replaying: otherwise a concurrent process could
+        # append between our read and our first write.
+        self._fh = open(self.path, "ab")
+        self._flock(self._fh)
+        good_bytes, records = self._replay()
+        if records is None:  # header mismatch / corrupt header: fresh file
+            self._fh.close()  # releases the lock with the old inode
+            self._rotate()
+            self._fh = open(self.path, "ab")
+            self._flock(self._fh)
+            good_bytes, records = 0, {}
+        self._records = records
+        size = self._fh.tell()
+        if good_bytes < size:
+            # Torn tail from a mid-write crash (or injected corruption):
+            # trim to the last good record and continue appending.
+            self._fh.truncate(good_bytes)
+            self._fh.seek(good_bytes)
+        if not self._records:
+            self._append(_HEADER_PHASE, {
+                "journal_version": JOURNAL_VERSION,
+                "config": self.config,
+            })
+        self.resumed_phases = [
+            p for p in self._records if p != _HEADER_PHASE
+        ]
+
+    def _replay(self):
+        """``(good_byte_count, {phase: record})`` from the existing file;
+        ``records is None`` means the whole file is untrustworthy (missing
+        or mismatched header) and must be rotated aside."""
+        if not os.path.exists(self.path):
+            return 0, {}
+        records: dict[str, dict] = {}
+        good = 0
+        expect_i = 0
+        try:
+            with open(self.path, "rb") as f:
+                for raw in f:
+                    if not raw.endswith(b"\n"):
+                        break  # torn final record
+                    try:
+                        # Any malformed-but-parseable shape (non-object
+                        # line, a flipped byte landing in a key name,
+                        # wrong field types) must TRIM here like a torn
+                        # tail — never escape and wedge every future run
+                        # of this config on an unreadable journal.
+                        rec = json.loads(raw)
+                        ok = (
+                            isinstance(rec, dict)
+                            and rec.get("i") == expect_i
+                            and isinstance(rec.get("phase"), str)
+                            and _crc(rec["i"], rec["phase"], rec["payload"])
+                            == rec.get("crc")
+                        )
+                    except (ValueError, KeyError, TypeError):
+                        break
+                    if not ok:
+                        break
+                    if rec["phase"] == _HEADER_PHASE:
+                        hdr = rec["payload"]
+                        if (
+                            not isinstance(hdr, dict)
+                            or hdr.get("journal_version") != JOURNAL_VERSION
+                            or hdr.get("config") != self.config
+                        ):
+                            self.invalidated = "config mismatch"
+                            return 0, None
+                    records[rec["phase"]] = rec
+                    good += len(raw)
+                    expect_i += 1
+        except OSError:
+            return 0, None
+        if _HEADER_PHASE not in records and good:
+            return 0, None
+        return good, records
+
+    def _rotate(self) -> None:
+        """Move a stale/foreign journal aside (never delete: it is
+        evidence) and start fresh."""
+        if not os.path.exists(self.path):
+            return
+        n = 0
+        while os.path.exists(f"{self.path}.stale.{n}"):
+            n += 1
+        os.replace(self.path, f"{self.path}.stale.{n}")
+
+    def restart(self, reason: str) -> None:
+        """Invalidate everything (e.g. graph-hash mismatch): rotate the
+        file aside and begin a fresh journal for the same config."""
+        if self._fh is not None:
+            self._fh.close()
+        self._rotate()
+        self._records = {}
+        self._arrays_cache = {}
+        self.invalidated = reason
+        self._fh = open(self.path, "ab")
+        self._flock(self._fh)
+        self._append(_HEADER_PHASE, {
+            "journal_version": JOURNAL_VERSION,
+            "config": self.config,
+        })
+        self.resumed_phases = []
+
+    def close(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+            self._fh = None
+
+    # --------------------------------------------------------------- writes --
+    def _append(self, phase: str, payload: Any, arrays_name: str | None = None):
+        i = max((r["i"] for r in self._records.values()), default=-1) + 1
+        rec = {
+            "i": i,
+            "phase": phase,
+            "t": time.time(),
+            "crc": _crc(i, phase, payload),
+            "payload": payload,
+        }
+        if arrays_name is not None:
+            rec["arrays"] = arrays_name
+        line = (_canon(rec) + "\n").encode()
+        self._fh.write(line)
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+        self._records[phase] = rec
+
+    def put(self, phase: str, payload: Any, *, arrays: dict | None = None) -> None:
+        """Record phase completion durably (payload must be JSON-safe;
+        ``arrays`` go to an atomic sidecar ``.npz``)."""
+        arrays_name = None
+        self._arrays_cache.pop(phase, None)
+        if arrays:
+            from ..utils.checkpoint import save_npz_atomic
+
+            stem = os.path.basename(self.path).rsplit(".", 1)[0]
+            safe = "".join(c if (c.isalnum() or c in "._-") else "_" for c in phase)
+            arrays_name = f"{stem}_{safe}.npz"
+            save_npz_atomic(
+                os.path.join(os.path.dirname(self.path), arrays_name), **arrays
+            )
+        self._append(phase, payload, arrays_name)
+
+    # ---------------------------------------------------------------- reads --
+    def get(self, phase: str) -> Any | None:
+        """Payload of a completed phase, or None.  A record whose sidecar
+        arrays are missing/corrupt reads as NOT completed (the phase
+        re-runs — corruption costs time, never correctness)."""
+        rec = self._records.get(phase)
+        if rec is None:
+            return None
+        if rec.get("arrays") and self.load_arrays(phase) is None:
+            return None
+        return rec["payload"]
+
+    def load_arrays(self, phase: str) -> dict | None:
+        """The sidecar arrays of a completed phase (None if absent or
+        unreadable).  The loaded dict is cached: ``get()`` validates a
+        sidecar-bearing record by loading it, and the caller's own
+        ``load_arrays`` must not pay the archive read twice."""
+        if phase in self._arrays_cache:
+            return self._arrays_cache[phase]
+        rec = self._records.get(phase)
+        if rec is None or not rec.get("arrays"):
+            return None
+        from ..utils.checkpoint import CheckpointError, load_npz_strict
+
+        path = os.path.join(os.path.dirname(self.path), rec["arrays"])
+        try:
+            out = load_npz_strict(path)
+        except (CheckpointError, OSError):
+            out = None
+        self._arrays_cache[phase] = out
+        return out
+
+    def phases(self) -> list[str]:
+        return [p for p in self._records if p != _HEADER_PHASE]
+
+    def __contains__(self, phase: str) -> bool:
+        return self.get(phase) is not None

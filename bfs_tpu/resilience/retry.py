@@ -1,0 +1,159 @@
+"""Deadline-aware exponential backoff with jitter + error classification.
+
+The device path fails two ways and they must not be treated the same:
+
+  * **transient** — the axon tunnel drops a connection, a dispatch times
+    out, the backend reports UNAVAILABLE/ABORTED mid-window.  Round 4's
+    ledger shows the tunnel's bandwidth swinging by orders of magnitude
+    within minutes; a failure in a bad window often succeeds seconds
+    later.  These deserve a bounded retry with backoff before any
+    degradation.
+  * **permanent** — shape errors, lowering failures, OOM, plain bugs.
+    Retrying reruns the same deterministic failure; these must fall
+    through immediately (the serving layer degrades to the sequential
+    oracle exactly once, the bench fails loudly).
+
+:func:`default_classify` encodes that split; :func:`retry_call` is the
+wrapper both layers share.  Backoff is capped exponential with
+multiplicative jitter (so N clients retrying the same bad window do not
+re-synchronize), and the whole loop is bounded by both an attempt count
+and an optional wall-clock deadline — a serving tick with a 50 ms budget
+left does not sleep 500 ms to find out.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+
+class TransientError(RuntimeError):
+    """Marker: always classified transient (tests, stubs, wrappers)."""
+
+
+class PermanentError(RuntimeError):
+    """Marker: always classified permanent."""
+
+
+class RetryError(RuntimeError):
+    """All attempts exhausted (or deadline passed); ``__cause__`` is the
+    last underlying error and ``attempts`` the number made."""
+
+    def __init__(self, msg: str, attempts: int):
+        super().__init__(msg)
+        self.attempts = attempts
+
+
+#: Substrings that mark a transient device/transport failure.  Matched
+#: case-insensitively against ``repr(exc)`` so gRPC-style status names and
+#: plain-prose socket errors both hit.
+TRANSIENT_MARKERS = (
+    "unavailable",
+    "deadline_exceeded",
+    "deadline exceeded",
+    "aborted",
+    "cancelled",
+    "connection reset",
+    "connection refused",
+    "broken pipe",
+    "timed out",
+    "timeout",
+    "temporarily",
+    "tunnel",
+    "socket closed",
+    "transient",
+)
+
+
+def default_classify(exc: BaseException) -> str:
+    """``'transient'`` or ``'permanent'`` for one failure.
+
+    Marker classes win; then Python's own transport/timeout exception
+    types; then the :data:`TRANSIENT_MARKERS` message probe.  Everything
+    unrecognized is permanent — an unknown failure repeated is two
+    failures, not a recovery strategy."""
+    if isinstance(exc, TransientError):
+        return "transient"
+    if isinstance(exc, PermanentError):
+        return "permanent"
+    if isinstance(exc, (ConnectionError, TimeoutError, InterruptedError)):
+        return "transient"
+    if isinstance(exc, MemoryError):
+        return "permanent"
+    text = repr(exc).lower()
+    if any(m in text for m in TRANSIENT_MARKERS):
+        return "transient"
+    return "permanent"
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Shape of one retry loop.  ``deadline_s`` is a per-call wall budget
+    measured from the first attempt; callers with an external deadline
+    (a request in a serving tick) pass the tighter of the two to
+    :func:`retry_call` directly."""
+
+    max_attempts: int = 3
+    base_delay_s: float = 0.05
+    max_delay_s: float = 2.0
+    multiplier: float = 2.0
+    jitter: float = 0.5  # delay *= uniform(1, 1 + jitter)
+    deadline_s: float | None = None
+    classify: Callable[[BaseException], str] = field(default=default_classify)
+
+    def delay(self, attempt: int, rng: random.Random) -> float:
+        """Backoff before retry ``attempt`` (1-based)."""
+        d = min(self.base_delay_s * self.multiplier ** (attempt - 1),
+                self.max_delay_s)
+        return d * rng.uniform(1.0, 1.0 + self.jitter)
+
+
+def retry_call(
+    fn: Callable,
+    *,
+    policy: RetryPolicy | None = None,
+    deadline_s: float | None = None,
+    on_retry: Callable[[int, BaseException, float], None] | None = None,
+    describe: str = "",
+    _rng: random.Random | None = None,
+):
+    """Call ``fn()`` with transient-failure retries.
+
+    Permanent failures re-raise immediately and untouched.  Transient
+    failures back off and retry until ``policy.max_attempts`` or the
+    deadline (the tighter of ``policy.deadline_s`` and ``deadline_s``)
+    runs out, then raise :class:`RetryError` from the last failure.
+    ``on_retry(attempt, exc, delay)`` fires before each sleep — the hook
+    the metrics counters hang off."""
+    policy = policy or RetryPolicy()
+    rng = _rng or random.Random()
+    limits = [d for d in (policy.deadline_s, deadline_s) if d is not None]
+    deadline = (time.monotonic() + min(limits)) if limits else None
+    last: BaseException | None = None
+    for attempt in range(1, max(1, policy.max_attempts) + 1):
+        try:
+            return fn()
+        except BaseException as exc:
+            if policy.classify(exc) != "transient":
+                raise
+            last = exc
+            if attempt >= policy.max_attempts:
+                break
+            delay = policy.delay(attempt, rng)
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                delay = min(delay, remaining)
+            if on_retry is not None:
+                on_retry(attempt, exc, delay)
+            if delay > 0:
+                time.sleep(delay)
+    what = describe or getattr(fn, "__name__", "call")
+    raise RetryError(
+        f"{what}: transient failure persisted after {attempt} attempts: "
+        f"{last!r}",
+        attempt,
+    ) from last

@@ -8,7 +8,13 @@ checkpoints, and a final TEPS summary.
 
 Usage:
     python -m bfs_tpu.runners.run_parallel [service.properties] [--fused]
-        [--mesh-graph N] [--mesh-batch N] [--dump] [--source S]
+        [--mesh-graph N] [--mesh-batch N] [--dump] [--source S] [--resume]
+
+``--resume`` restarts a crashed stepped run from its newest valid
+``.ckpt_<level>.npz`` (checkpoints are written atomically and validated on
+load — bfs_tpu/utils/checkpoint.py — so a kill mid-dump can neither leave
+a half-written file under the final name nor poison the resumed state; a
+torn newest checkpoint falls back to the one before it).
 """
 
 from __future__ import annotations
@@ -24,7 +30,7 @@ from ..graph.vertex import serialize_state, initial_state_vertices
 from ..models.bfs import SuperstepRunner, bfs
 from ..oracle.bfs import check
 from ..parallel.sharded import bfs_sharded, make_mesh
-from ..utils.checkpoint import save_checkpoint
+from ..utils.checkpoint import load_latest_checkpoint, save_checkpoint
 from ..utils.logging import get_logger
 from ..utils.metrics import RunMetrics
 from ..utils.timing import Stopwatch
@@ -40,6 +46,7 @@ def run_problem_file(
     dump: bool = False,
     checkpoint_every: int = 0,
     work_dir: str = ".",
+    resume: bool = False,
 ) -> RunMetrics:
     """Stepped run over one problem file with full observability."""
     logger.info("Processing problem file: %s (engine=%s)", path, engine)
@@ -53,6 +60,22 @@ def run_problem_file(
             f.write("\n".join(v.serialize() for v in initial_state_vertices(graph, source)))
 
     state = runner.init(source)
+    resumed_at = None
+    if resume:
+        found = load_latest_checkpoint(
+            base, expect={"source": source, "engine": engine}
+        )
+        if found is not None:
+            state, resumed_at, ckpt_path = found
+            logger.info(
+                "Resuming from %s (superstep %d)", ckpt_path, resumed_at
+            )
+            if not bool(state.changed):
+                logger.info(
+                    "checkpoint state already converged; nothing to re-run"
+                )
+        else:
+            logger.info("No valid checkpoint under %s.ckpt_*; fresh run", base)
     sw = Stopwatch()
     while bool(state.changed):
         sw.reset().start()
@@ -68,17 +91,34 @@ def run_problem_file(
                     serialize_state(graph, dist, parent, frontier, source=source)
                 )
         if checkpoint_every and level % checkpoint_every == 0:
-            save_checkpoint(f"{base}.ckpt_{level}.npz", state)
+            save_checkpoint(
+                f"{base}.ckpt_{level}.npz", state,
+                source=source, engine=engine,
+            )
 
     for line in metrics.log_lines():
         logger.info("%s", line)
-    logger.info(
-        "Total %s: %d supersteps, %.3f ms, %.2f MTEPS",
-        os.path.basename(path),
-        metrics.num_levels,
-        metrics.total_seconds * 1e3,
-        metrics.teps() / 1e6,
-    )
+    if resumed_at is not None:
+        # Metrics cover only the post-resume tail: a full-run TEPS claim
+        # (num_edges / tail seconds) would be inflated by everything the
+        # checkpointed process already paid for, so report the segment as
+        # a segment.
+        logger.info(
+            "Total %s: resumed at superstep %d; segment of %d supersteps, "
+            "%.3f ms (segment-only timings, not a full-run TEPS)",
+            os.path.basename(path),
+            resumed_at,
+            metrics.num_levels,
+            metrics.total_seconds * 1e3,
+        )
+    else:
+        logger.info(
+            "Total %s: %d supersteps, %.3f ms, %.2f MTEPS",
+            os.path.basename(path),
+            metrics.num_levels,
+            metrics.total_seconds * 1e3,
+            metrics.teps() / 1e6,
+        )
     dist, parent, _ = runner.to_original(state, source=source)
     violations = check(graph, dist, parent, source)
     if violations:
@@ -102,6 +142,11 @@ def main(argv=None):
     ap.add_argument("--mesh-batch", type=int, default=None)
     ap.add_argument("--dump", action="store_true")
     ap.add_argument("--source", type=int, default=None)
+    ap.add_argument(
+        "--resume", action="store_true",
+        help="resume a stepped run from its newest valid checkpoint "
+        "(requires checkpoint-every > 0 in the config to have written any)",
+    )
     args = ap.parse_args(argv)
 
     # Persistent compile caches, set before the first trace so the driver
@@ -146,6 +191,7 @@ def main(argv=None):
                 dump=args.dump or cfg.dump_supersteps,
                 checkpoint_every=cfg.checkpoint_every,
                 work_dir=cfg.work_dir,
+                resume=args.resume,
             )
 
 

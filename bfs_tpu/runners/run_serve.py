@@ -54,7 +54,9 @@ def build_graph(args):
 
 
 def make_server(args, metrics=None):
-    from ..serve import BfsServer, GraphRegistry
+    import dataclasses
+
+    from ..serve import DEFAULT_RETRY_POLICY, BfsServer, GraphRegistry
 
     registry = GraphRegistry(
         device_budget_bytes=(
@@ -74,6 +76,15 @@ def make_server(args, metrics=None):
         queue_depth=args.queue_depth,
         oracle_max_vertices=args.oracle_max_vertices,
         metrics=metrics,
+        # Transient device-path failures retry with backoff before the
+        # oracle degradation kicks in (bfs_tpu/resilience/retry.py);
+        # --retries 1 restores the old degrade-on-first-failure behavior.
+        # Only the attempt count is tunable here — the delays stay the
+        # serving-tuned ones (short: backoff sleeps block the single
+        # scheduler thread, so every queued query on every graph waits).
+        retry_policy=dataclasses.replace(
+            DEFAULT_RETRY_POLICY, max_attempts=max(1, args.retries)
+        ),
     )
 
 
@@ -159,6 +170,9 @@ def main(argv=None) -> int:
                     help="device layout budget in MiB (0 = unlimited)")
     ap.add_argument("--oracle-max-vertices", type=int, default=0,
                     help="serve graphs at/under this size sequentially")
+    ap.add_argument("--retries", type=int, default=3,
+                    help="max device-path attempts per batch before oracle "
+                    "degradation (transient failures only; 1 = no retry)")
     ap.add_argument("--queries", type=int, default=64, help="demo query count")
     ap.add_argument("--multi-frac", type=float, default=0.25)
     ap.add_argument("--multi-width", type=int, default=4)

@@ -17,12 +17,14 @@ in steady state.
 Components: :class:`GraphRegistry` (layout + residency),
 :class:`ExecutableCache` (compiled programs keyed by (graph, engine,
 batch shape)), :class:`BfsServer` (admission queue, micro-batching,
-deadlines, result LRU, oracle degradation).
+deadlines, transient-failure retry with backoff
+(:mod:`bfs_tpu.resilience.retry`), result LRU, oracle degradation).
 """
 
 from .registry import ENGINES, GraphRegistry, RegisteredGraph
 from .executor import ExecutableCache, build_batch_runner, run_oracle_batch
 from .server import (
+    DEFAULT_RETRY_POLICY,
     AdmissionError,
     BfsServer,
     QueryTimeout,
@@ -32,6 +34,7 @@ from .server import (
 )
 
 __all__ = [
+    "DEFAULT_RETRY_POLICY",
     "ENGINES",
     "GraphRegistry",
     "RegisteredGraph",

@@ -65,6 +65,17 @@ class ExecutableCache:
                 self._cache.popitem(last=False)
         return runner, False
 
+    def put(self, key: tuple, runner) -> None:
+        """Install a runner directly (no miss counted).  The seam the
+        resilience tests use to serve a flaky/instrumented runner through
+        the real batch path, and a warm-handoff hook for preloaded
+        executables."""
+        with self._lock:
+            self._cache[key] = runner
+            self._cache.move_to_end(key)
+            while len(self._cache) > self.capacity:
+                self._cache.popitem(last=False)
+
     def drop_graph(self, name: str) -> None:
         with self._lock:
             for key in [k for k in self._cache if k[0] == name]:

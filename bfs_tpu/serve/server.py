@@ -21,10 +21,16 @@ Robustness semantics:
     missing one;
   * **cancellation** — ``future.cancel()`` before batch formation works;
     cancelled requests are skipped at batch time;
+  * **retry** — a TRANSIENT device-path failure (tunnel drop, dispatch
+    timeout, UNAVAILABLE window — the classifier lives in
+    :mod:`bfs_tpu.resilience.retry`) is retried with capped exponential
+    backoff + jitter, bounded by the batch's earliest request deadline,
+    before any degradation; a permanent failure (shape error, OOM, plain
+    bug) skips the retries entirely;
   * **degradation** — graphs at or under ``oracle_max_vertices`` vertices,
-    and any batch whose device path raises, are served by the sequential
-    oracle (canonical min-parent, bit-exact with the engines) when the host
-    graph is available.
+    and any batch whose device path fails permanently (or exhausts its
+    retries), are served by the sequential oracle (canonical min-parent,
+    bit-exact with the engines) when the host graph is available.
 
 Every reply carries a :class:`~bfs_tpu.utils.metrics.QueryRecord`; the
 server-level :class:`~bfs_tpu.utils.metrics.ServeMetrics` aggregates the
@@ -43,9 +49,17 @@ import numpy as np
 
 from ..models.bfs import check_sources
 from ..models.multisource import MultiBfsResult, collapse_multi_source
+from ..resilience.retry import RetryPolicy, retry_call
 from ..utils.metrics import QueryRecord, ServeMetrics
 from .executor import ExecutableCache, build_batch_runner, run_oracle_batch
 from .registry import ENGINES, GraphRegistry
+
+#: Default device-path retry shape: short delays (a serving tick is
+#: latency-bound) and few attempts; callers pass ``retry_policy`` for a
+#: different shape or ``RetryPolicy(max_attempts=1)`` to disable retries.
+DEFAULT_RETRY_POLICY = RetryPolicy(
+    max_attempts=3, base_delay_s=0.02, max_delay_s=0.5
+)
 
 
 class ServeError(RuntimeError):
@@ -124,6 +138,7 @@ class BfsServer:
         exe_cache_size: int = 64,
         oracle_max_vertices: int = 0,
         metrics: ServeMetrics | None = None,
+        retry_policy: RetryPolicy | None = None,
     ):
         if engine not in ENGINES:
             raise ValueError(f"unknown engine {engine!r}; use one of {ENGINES}")
@@ -138,6 +153,9 @@ class BfsServer:
         self.tick_s = float(tick_s)
         self.queue_depth = int(queue_depth)
         self.oracle_max_vertices = int(oracle_max_vertices)
+        self.retry_policy = (
+            retry_policy if retry_policy is not None else DEFAULT_RETRY_POLICY
+        )
         self.exe_cache = ExecutableCache(exe_cache_size, metrics=self.metrics)
         self._result_cache: OrderedDict[tuple, tuple] = OrderedDict()
         self._result_cache_size = int(result_cache_size)
@@ -377,18 +395,46 @@ class BfsServer:
                      np.full(padded - all_sources.shape[0], all_sources[0],
                              dtype=np.int32)]
                 )
-                runner, compile_hit = self.exe_cache.get(
-                    (first.graph, first.engine, padded),
-                    lambda: build_batch_runner(
-                        self.registry, first.graph, first.engine, padded
+
+                def _device_tick():
+                    nonlocal compile_hit
+                    runner, compile_hit = self.exe_cache.get(
+                        (first.graph, first.engine, padded),
+                        lambda: build_batch_runner(
+                            self.registry, first.graph, first.engine, padded
+                        ),
+                    )
+                    return runner(sources_padded)
+
+                retried = {"n": 0}
+
+                def _on_retry(attempt, exc, delay):
+                    retried["n"] += 1
+                    self.metrics.bump("device_retries")
+
+                # Transient failures (tunnel drop, UNAVAILABLE window) get
+                # a bounded backoff retry BEFORE any oracle degradation —
+                # previously one flake degraded the whole tick.  Bounded by
+                # the batch's earliest deadline: a tick with 50 ms left
+                # must not sleep 500 ms to find out.
+                deadlines = [r.deadline for r in live if r.deadline is not None]
+                result = retry_call(
+                    _device_tick,
+                    policy=self.retry_policy,
+                    deadline_s=(
+                        min(deadlines) - time.monotonic() if deadlines else None
                     ),
+                    on_retry=_on_retry,
+                    describe=f"device batch ({first.graph}/{first.engine})",
                 )
-                result = runner(sources_padded)
+                if retried["n"]:
+                    self.metrics.bump("device_retry_successes")
         except Exception:
             if rec.graph is None:
                 raise
-            # Device path failed (OOM, lowering, backend): degrade to the
-            # sequential oracle rather than failing the whole tick.
+            # Device path failed permanently (OOM, lowering, a real bug) or
+            # exhausted its transient retries: degrade to the sequential
+            # oracle EXACTLY ONCE rather than failing the whole tick.
             self.metrics.bump("device_errors")
             result = run_oracle_batch(rec.graph, all_sources)
             status = "oracle"

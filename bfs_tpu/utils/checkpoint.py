@@ -11,11 +11,20 @@ checkpointing is explicit and dual-format:
 
 Resume rebuilds a :class:`~bfs_tpu.ops.relax.BfsState` and re-enters the
 superstep loop — the carry IS the checkpoint (SURVEY.md §5).
+
+Durability contract (resilience round): every ``.npz`` dump is written to
+a same-directory temp file and renamed into place, so a kill mid-dump can
+never leave a half-written file under the final name; and loads verify the
+archive is complete (the zip end-record only exists once the whole file
+was written), raising :class:`CheckpointError` on truncation instead of
+poisoning a resume with garbage arrays.  The journal's sidecar arrays
+(:mod:`bfs_tpu.resilience.journal`) ride the same two helpers.
 """
 
 from __future__ import annotations
 
 import os
+import zipfile
 
 import jax.numpy as jnp
 import numpy as np
@@ -23,19 +32,74 @@ import numpy as np
 from ..ops.relax import BfsState
 
 
-def save_checkpoint(path: str | os.PathLike, state: BfsState) -> None:
-    np.savez(
+class CheckpointError(RuntimeError):
+    """A checkpoint/sidecar file is truncated or corrupt.  The clean
+    remedy is to delete it and resume from an earlier one (or from
+    scratch) — loading it would silently poison the resumed state."""
+
+
+def save_npz_atomic(path: str | os.PathLike, **arrays) -> str:
+    """``np.savez`` with crash atomicity: write to ``<path>.tmp.<pid>`` in
+    the same directory, fsync, then ``os.replace`` into place.  Returns
+    the final path (``.npz`` appended if missing, matching np.savez)."""
+    path = os.fspath(path)
+    if not path.endswith(".npz"):
+        path += ".npz"
+    tmp = f"{path}.tmp.{os.getpid()}"
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.remove(tmp)
+        except FileNotFoundError:
+            pass
+        raise
+    return path
+
+
+def load_npz_strict(path: str | os.PathLike) -> dict[str, np.ndarray]:
+    """Load an ``.npz`` as a plain dict, rejecting truncated/corrupt
+    archives with :class:`CheckpointError`.  A missing file raises
+    ``FileNotFoundError`` (a different condition: nothing to resume,
+    rather than a damaged resume)."""
+    path = os.fspath(path)
+    if not os.path.exists(path):
+        raise FileNotFoundError(path)
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            return {k: np.asarray(z[k]) for k in z.files}
+    except (zipfile.BadZipFile, ValueError, KeyError, EOFError, OSError) as exc:
+        raise CheckpointError(
+            f"checkpoint {path!r} is truncated or corrupt ({exc!r}); "
+            "delete it and resume from an earlier checkpoint"
+        ) from exc
+
+
+def save_checkpoint(path: str | os.PathLike, state: BfsState, **meta) -> str:
+    """Atomic dump of the loop carry; returns the written path.
+
+    ``meta`` scalars (source, engine, ...) are stored as ``meta_<k>``
+    fields so resume can refuse a checkpoint that belongs to a different
+    run configuration (see :func:`load_latest_checkpoint`)."""
+    return save_npz_atomic(
         path,
         dist=np.asarray(state.dist),
         parent=np.asarray(state.parent),
         frontier=np.asarray(state.frontier),
         level=np.asarray(state.level),
         changed=np.asarray(state.changed),
+        **{f"meta_{k}": np.asarray(v) for k, v in meta.items()},
     )
 
 
-def load_checkpoint(path: str | os.PathLike) -> BfsState:
-    with np.load(path) as z:
+def _state_from_npz(z: dict, path: str) -> BfsState:
+    """The BfsState carry from a loaded checkpoint dict (``meta_*``
+    fields ignored); :class:`CheckpointError` on a missing field."""
+    try:
         return BfsState(
             dist=jnp.asarray(z["dist"]),
             parent=jnp.asarray(z["parent"]),
@@ -43,6 +107,81 @@ def load_checkpoint(path: str | os.PathLike) -> BfsState:
             level=jnp.asarray(z["level"]),
             changed=jnp.asarray(z["changed"]),
         )
+    except KeyError as exc:
+        raise CheckpointError(
+            f"checkpoint {path!r} is missing field {exc}; "
+            "not a BfsState dump"
+        ) from exc
+
+
+def load_checkpoint(path: str | os.PathLike) -> BfsState:
+    return _state_from_npz(load_npz_strict(path), os.fspath(path))
+
+
+def _checkpoint_candidates(base: str) -> list[tuple[int, str]]:
+    """``[(level, path)]`` of every ``{base}.ckpt_<level>.npz``, newest
+    first."""
+    import glob
+
+    out = []
+    for path in glob.glob(f"{base}.ckpt_*.npz"):
+        stem = path[len(base) + len(".ckpt_"):-len(".npz")]
+        if stem.isdigit():
+            out.append((int(stem), path))
+    return sorted(out, reverse=True)
+
+
+def latest_checkpoint(base: str | os.PathLike) -> tuple[str, int] | None:
+    """``(path, level)`` of the newest valid ``{base}.ckpt_<level>.npz``,
+    skipping (and warning about) damaged ones — a torn final dump must
+    not block resuming from the one before it.  Thin probe over
+    :func:`load_latest_checkpoint`, which resuming callers should use
+    directly (it returns the state from the same single read)."""
+    found = load_latest_checkpoint(base)
+    return (found[2], found[1]) if found is not None else None
+
+
+def load_latest_checkpoint(
+    base: str | os.PathLike,
+    expect: dict | None = None,
+) -> tuple[BfsState, int, str] | None:
+    """``(state, level, path)`` from the newest valid checkpoint in ONE
+    read (resume startup at scale is I/O-bound; validating then
+    re-loading would pay it twice).  Damaged dumps are skipped with a
+    warning, same contract as :func:`latest_checkpoint`.
+
+    ``expect`` maps meta keys to required values (e.g. ``{"source": 5,
+    "engine": "push"}``): a checkpoint recording a DIFFERENT value for
+    one of them was written by another run configuration and is skipped
+    with a warning — resuming it would burn the whole tail before dying
+    at the final invariant check.  Checkpoints predating the metadata
+    (no ``meta_<k>`` field) are accepted for compatibility."""
+    import logging
+
+    log = logging.getLogger(__name__)
+    for level, path in _checkpoint_candidates(os.fspath(base)):
+        try:
+            z = load_npz_strict(path)
+        except CheckpointError as exc:
+            log.warning("skipping %s", exc)
+            continue
+        mismatch = None
+        for k, v in (expect or {}).items():
+            stored = z.get(f"meta_{k}")
+            if stored is not None and stored.item() != v:
+                mismatch = f"{k}={stored.item()!r} (this run: {v!r})"
+                break
+        if mismatch is not None:
+            log.warning(
+                "skipping %s: written by a different run config — %s",
+                path, mismatch,
+            )
+            continue
+        try:
+            return _state_from_npz(z, path), level, path
+        except CheckpointError as exc:
+            log.warning("skipping %s", exc)
+    return None
 
 
 def state_from_arrays(dist, parent, frontier, level: int) -> BfsState:

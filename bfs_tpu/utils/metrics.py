@@ -208,6 +208,16 @@ class ServeMetrics:
             "queries_per_sec": (len(ok) / span) if span > 0 else 0.0,
             "counters": counters,
         }
+        # Resilience counters, surfaced explicitly (not just inside the
+        # free-form counter dict): a dashboard needs retries-vs-degradations
+        # at a glance — rising device_retries with zero device_errors is a
+        # flaky-but-recovering transport; rising device_errors means the
+        # oracle is quietly serving what the device should.
+        out["retries"] = {
+            "device_retries": counters.get("device_retries", 0),
+            "device_retry_successes": counters.get("device_retry_successes", 0),
+            "device_errors": counters.get("device_errors", 0),
+        }
         out["compile_hit_rate"] = self._rate(
             counters, "compile_hits", "compile_misses"
         )

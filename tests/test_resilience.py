@@ -1,0 +1,383 @@
+"""Unit tests for the resilience layer (ISSUE 3): RunJournal crash-safety
+and invalidation, fault-injection spec parsing, retry/backoff
+classification and deadlines, atomic checkpoints, and the bench's
+SIGTERM flush handler.  Process-level kill/resume is covered by
+tests/test_bench_resume.py; these tests pin the building blocks."""
+
+import json
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from bfs_tpu.resilience.faults import (
+    FaultInjected,
+    corrupt_file,
+    fault_point,
+    fault_spec,
+    reset,
+)
+from bfs_tpu.resilience.journal import RunJournal, config_key
+from bfs_tpu.resilience.retry import (
+    PermanentError,
+    RetryError,
+    RetryPolicy,
+    TransientError,
+    default_classify,
+    retry_call,
+)
+from bfs_tpu.utils.checkpoint import (
+    CheckpointError,
+    latest_checkpoint,
+    load_npz_strict,
+    save_npz_atomic,
+)
+
+CFG = {"scale": 8, "engine": "push", "repeats": 2}
+
+
+# ------------------------------------------------------------------ journal --
+def test_journal_put_get_roundtrip(tmp_path):
+    jr = RunJournal.open_for(str(tmp_path), CFG)
+    assert jr.get("reference") is None
+    jr.put("reference", {"directed_traversed": 42})
+    jr.put("repeat:0", {"seconds": 0.5})
+    jr.close()
+
+    jr2 = RunJournal.open_for(str(tmp_path), CFG)
+    assert jr2.get("reference") == {"directed_traversed": 42}
+    assert jr2.get("repeat:0") == {"seconds": 0.5}
+    assert set(jr2.resumed_phases) == {"reference", "repeat:0"}
+    jr2.close()
+
+
+def test_journal_key_is_config_addressed(tmp_path):
+    a = RunJournal.open_for(str(tmp_path), CFG)
+    b = RunJournal.open_for(str(tmp_path), {**CFG, "repeats": 3})
+    assert a.path != b.path  # any knob change -> different journal
+    assert config_key(CFG) == config_key(dict(reversed(list(CFG.items()))))
+    a.close(), b.close()
+
+
+def test_journal_torn_tail_is_trimmed(tmp_path):
+    jr = RunJournal.open_for(str(tmp_path), CFG)
+    jr.put("reference", {"x": 1})
+    jr.put("roots", {"roots": [1, 2, 3]})
+    jr.close()
+    # Simulate a SIGKILL mid-append: the last record loses its newline+tail.
+    with open(jr.path, "r+b") as f:
+        f.truncate(os.path.getsize(jr.path) - 7)
+
+    jr2 = RunJournal.open_for(str(tmp_path), CFG)
+    assert jr2.get("reference") == {"x": 1}
+    assert jr2.get("roots") is None  # torn record reads as not-completed
+    jr2.put("roots", {"roots": [4]})  # and can be re-recorded cleanly
+    jr2.close()
+    jr3 = RunJournal.open_for(str(tmp_path), CFG)
+    assert jr3.get("roots") == {"roots": [4]}
+    jr3.close()
+
+
+def test_journal_crc_rejects_tampered_record(tmp_path):
+    jr = RunJournal.open_for(str(tmp_path), CFG)
+    jr.put("reference", {"directed_traversed": 42})
+    jr.put("roots", {"roots": [1]})
+    jr.close()
+    # Flip payload bytes of the "reference" line without touching its crc.
+    lines = open(jr.path, "rb").read().splitlines(keepends=True)
+    lines[1] = lines[1].replace(b"42", b"43")
+    with open(jr.path, "wb") as f:
+        f.writelines(lines)
+
+    jr2 = RunJournal.open_for(str(tmp_path), CFG)
+    # The tampered record AND everything after it are distrusted.
+    assert jr2.get("reference") is None
+    assert jr2.get("roots") is None
+    jr2.close()
+
+
+def test_journal_malformed_but_parseable_records_trim_not_crash(tmp_path):
+    # Valid JSON that is not a record (a byte flip landing in a key name,
+    # a non-object line) must trim the tail like a torn write — never
+    # escape __init__ and wedge every future run of this config.
+    for damage in (b"[1, 2, 3]\n", b'{"i": 1, "phase": 9, "payload": {}}\n'):
+        jr = RunJournal.open_for(str(tmp_path), CFG)
+        jr.put("reference", {"x": 1})
+        jr.put("roots", {"roots": [1]})
+        jr.close()
+        lines = open(jr.path, "rb").read().splitlines(keepends=True)
+        lines[1] = damage
+        with open(jr.path, "wb") as f:
+            f.writelines(lines)
+        jr2 = RunJournal.open_for(str(tmp_path), CFG)  # must not raise
+        assert jr2.get("reference") is None
+        assert jr2.get("roots") is None
+        jr2.put("reference", {"x": 2})  # and keeps working
+        jr2.close()
+        os.remove(jr.path)
+
+
+def test_journal_config_mismatch_rotates_fresh(tmp_path):
+    jr = RunJournal.open_for(str(tmp_path), CFG)
+    jr.put("reference", {"x": 1})
+    path = jr.path
+    jr.close()
+    # Same file path but a different config header (forced collision).
+    jr2 = RunJournal(path, {**CFG, "engine": "pull"})
+    assert jr2.invalidated == "config mismatch"
+    assert jr2.get("reference") is None
+    assert os.path.exists(path + ".stale.0")  # evidence kept, not deleted
+    jr2.close()
+
+
+def test_journal_restart_rotates(tmp_path):
+    jr = RunJournal.open_for(str(tmp_path), CFG)
+    jr.put("graph", {"content_hash": "aaa"})
+    jr.restart("graph-hash mismatch")
+    assert jr.get("graph") is None
+    jr.put("graph", {"content_hash": "bbb"})
+    jr.close()
+    jr2 = RunJournal.open_for(str(tmp_path), CFG)
+    assert jr2.get("graph") == {"content_hash": "bbb"}
+    jr2.close()
+
+
+def test_journal_refuses_concurrent_writer(tmp_path, monkeypatch):
+    pytest.importorskip("fcntl")
+    monkeypatch.setattr(RunJournal, "LOCK_TIMEOUT_S", 0.2)
+    jr = RunJournal.open_for(str(tmp_path), CFG)
+    # A second live process (here: a second open file description) with
+    # the same config must fail loudly, not interleave appends.
+    with pytest.raises(RuntimeError, match="locked by another"):
+        RunJournal.open_for(str(tmp_path), CFG)
+    jr.close()
+    jr2 = RunJournal.open_for(str(tmp_path), CFG)  # released on close
+    jr2.close()
+
+
+def test_journal_sidecar_arrays_roundtrip_and_corruption(tmp_path):
+    jr = RunJournal.open_for(str(tmp_path), CFG)
+    mask = np.packbits(np.arange(64) % 3 == 0)
+    jr.put("reference", {"n": 64}, arrays={"mask_packed": mask})
+    jr.close()
+
+    jr2 = RunJournal.open_for(str(tmp_path), CFG)
+    arrs = jr2.load_arrays("reference")
+    np.testing.assert_array_equal(arrs["mask_packed"], mask)
+    jr2.close()
+    # Corrupt the sidecar: the phase must read as NOT completed (re-run),
+    # never as completed-with-garbage.
+    sidecar = [p for p in os.listdir(tmp_path) if p.endswith(".npz")][0]
+    corrupt_file(str(tmp_path / sidecar), mode="truncate")
+    jr3 = RunJournal.open_for(str(tmp_path), CFG)
+    assert jr3.get("reference") is None
+    jr3.close()
+
+
+# ------------------------------------------------------------------- faults --
+def test_fault_spec_parsing():
+    assert fault_spec("") is None
+    assert fault_spec("kill:verify") == ("kill", "verify", 1)
+    assert fault_spec("raise:repeat:2") == ("raise", "repeat", 2)
+    assert fault_spec("phase:reference") == ("kill", "reference", 1)
+    # A trailing non-positive integer is part of the NAME (nth is 1-based
+    # and could never fire at 0): kill:repeat:0 targets the exact
+    # boundary "repeat:0", not a vacuous nth=0.
+    assert fault_spec("kill:repeat:0") == ("kill", "repeat:0", 1)
+    assert fault_spec("kill:repeat:0:2") == ("kill", "repeat:0", 2)
+    with pytest.raises(ValueError):
+        fault_spec("explode:reference")
+    with pytest.raises(ValueError):
+        fault_spec("kill:")
+
+
+def test_fault_point_raise_nth(monkeypatch):
+    monkeypatch.setenv("BFS_TPU_FAULT", "raise:repeat:2")
+    reset()
+    fault_point("repeat:0")  # first arrival in the family: no fault
+    with pytest.raises(FaultInjected):
+        fault_point("repeat:1")  # second arrival: boom
+    fault_point("repeat:2")  # nth is exact, not at-least
+    reset()
+
+
+def test_fault_point_inert_without_env(monkeypatch):
+    monkeypatch.delenv("BFS_TPU_FAULT", raising=False)
+    reset()
+    for _ in range(3):
+        fault_point("verify:0")
+
+
+def test_corrupt_file_modes(tmp_path):
+    p = tmp_path / "blob.bin"
+    p.write_bytes(b"x" * 100)
+    corrupt_file(str(p), mode="truncate")
+    assert p.stat().st_size == 50
+    before = p.read_bytes()
+    corrupt_file(str(p), mode="flip", at=10)
+    after = p.read_bytes()
+    assert before[10] != after[10] and len(after) == 50
+
+
+# -------------------------------------------------------------------- retry --
+def test_retry_transient_then_success():
+    calls = {"n": 0}
+    retried = []
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise TransientError("tunnel hiccup")
+        return "ok"
+
+    out = retry_call(
+        flaky,
+        policy=RetryPolicy(max_attempts=5, base_delay_s=0.0, jitter=0.0),
+        on_retry=lambda a, e, d: retried.append(a),
+    )
+    assert out == "ok" and calls["n"] == 3 and retried == [1, 2]
+
+
+def test_retry_permanent_raises_immediately():
+    calls = {"n": 0}
+
+    def broken():
+        calls["n"] += 1
+        raise ValueError("shape mismatch")  # classified permanent
+
+    with pytest.raises(ValueError):
+        retry_call(broken, policy=RetryPolicy(max_attempts=5, base_delay_s=0.0))
+    assert calls["n"] == 1
+
+
+def test_retry_exhaustion_raises_retry_error():
+    def always():
+        raise TransientError("still down")
+
+    with pytest.raises(RetryError) as ei:
+        retry_call(
+            always,
+            policy=RetryPolicy(max_attempts=3, base_delay_s=0.0, jitter=0.0),
+        )
+    assert ei.value.attempts == 3
+    assert isinstance(ei.value.__cause__, TransientError)
+
+
+def test_retry_respects_deadline():
+    import time as _time
+
+    calls = {"n": 0}
+
+    def always():
+        calls["n"] += 1
+        raise TransientError("down")
+
+    t0 = _time.monotonic()
+    with pytest.raises(RetryError):
+        retry_call(
+            always,
+            policy=RetryPolicy(max_attempts=100, base_delay_s=0.05, jitter=0.0),
+            deadline_s=0.12,
+        )
+    # Bounded by the deadline, not the 100 attempts.
+    assert _time.monotonic() - t0 < 2.0
+    assert calls["n"] < 100
+
+
+def test_default_classify():
+    assert default_classify(TransientError("x")) == "transient"
+    assert default_classify(PermanentError("x")) == "permanent"
+    assert default_classify(ConnectionResetError()) == "transient"
+    assert default_classify(TimeoutError()) == "transient"
+    assert default_classify(RuntimeError("backend UNAVAILABLE: retry")) == "transient"
+    assert default_classify(RuntimeError("tunnel write failed")) == "transient"
+    assert default_classify(ValueError("bad shape")) == "permanent"
+    assert default_classify(MemoryError()) == "permanent"
+
+
+# -------------------------------------------------------------- checkpoints --
+def test_save_npz_atomic_no_tmp_left(tmp_path):
+    p = save_npz_atomic(tmp_path / "ck", a=np.arange(5))
+    assert p.endswith(".npz") and os.path.exists(p)
+    assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+    z = load_npz_strict(p)
+    np.testing.assert_array_equal(z["a"], np.arange(5))
+
+
+def test_load_npz_strict_rejects_truncation(tmp_path):
+    p = save_npz_atomic(tmp_path / "ck", a=np.arange(1000))
+    corrupt_file(p, mode="truncate")
+    with pytest.raises(CheckpointError, match="truncated or corrupt"):
+        load_npz_strict(p)
+    with pytest.raises(FileNotFoundError):
+        load_npz_strict(tmp_path / "missing.npz")
+
+
+def test_load_latest_checkpoint_rejects_foreign_config(tmp_path):
+    from bfs_tpu.graph.generators import gnm_graph
+    from bfs_tpu.models.bfs import SuperstepRunner
+    from bfs_tpu.utils.checkpoint import load_latest_checkpoint, save_checkpoint
+
+    g = gnm_graph(40, 90, seed=3)
+    runner = SuperstepRunner(g)
+    state = runner.step(runner.init(0))
+    base = str(tmp_path / "g.txt")
+    save_checkpoint(f"{base}.ckpt_1.npz", state, source=0, engine="push")
+
+    # Matching config resumes; a different source/engine is refused (it
+    # would burn the whole tail before dying at the final check).
+    assert load_latest_checkpoint(base, expect={"source": 0, "engine": "push"})
+    assert (
+        load_latest_checkpoint(base, expect={"source": 5, "engine": "push"})
+        is None
+    )
+    assert (
+        load_latest_checkpoint(base, expect={"source": 0, "engine": "pull"})
+        is None
+    )
+    # Pre-metadata checkpoints (no meta_ fields) stay loadable.
+    save_checkpoint(f"{base}.ckpt_2.npz", state)
+    assert load_latest_checkpoint(base, expect={"source": 5})
+
+
+def test_latest_checkpoint_skips_corrupt(tmp_path):
+    base = str(tmp_path / "mediumG.txt")
+    for level in (2, 4, 6):
+        save_npz_atomic(
+            f"{base}.ckpt_{level}.npz",
+            dist=np.full(8, level, np.int32),
+            parent=np.full(8, -1, np.int32),
+            frontier=np.zeros(8, bool),
+            level=np.int32(level),
+            changed=np.bool_(True),
+        )
+    corrupt_file(f"{base}.ckpt_6.npz", mode="truncate")
+    found = latest_checkpoint(base)
+    assert found is not None
+    path, level = found
+    assert level == 4 and path.endswith(".ckpt_4.npz")
+    assert latest_checkpoint(str(tmp_path / "nothing")) is None
+
+
+# ------------------------------------------------------------ bench handler --
+def test_bench_sigterm_handler_flushes_partial(tmp_path, capsys):
+    from bfs_tpu import bench
+
+    jr = RunJournal.open_for(str(tmp_path), CFG)
+    emitted, exits = [], []
+    old = bench._PARTIAL.get("emit")
+    try:
+        bench._PARTIAL["emit"] = lambda status: emitted.append(status)
+        handler = bench._install_signal_handlers(jr, _exit=exits.append)
+        handler(signal.SIGTERM, None)
+    finally:
+        bench._PARTIAL["emit"] = old
+        signal.signal(signal.SIGTERM, signal.SIG_DFL)
+        signal.signal(signal.SIGALRM, signal.SIG_DFL)
+    assert exits == [128 + signal.SIGTERM]
+    assert emitted and "interrupted (SIGTERM)" in emitted[0]
+    # The journal tail records the interruption durably.
+    jr2 = RunJournal.open_for(str(tmp_path), CFG)
+    assert jr2.get("interrupted")["signal"] == "SIGTERM"
+    jr2.close()

@@ -1,0 +1,122 @@
+"""Serving-layer retry semantics (ISSUE 3): a TRANSIENT device-path
+failure must be retried with backoff — not instantly oracle-degraded, the
+pre-resilience behavior — while a PERMANENT failure must degrade to the
+sequential oracle exactly once, with the retry counters visible in
+``ServeMetrics.report``.  The flaky runner is injected through the real
+``ExecutableCache`` seam (``put``), so the whole batch path — coalescing,
+cache hit, retry loop, fan-out — is the code under test."""
+
+import numpy as np
+import pytest
+
+from bfs_tpu.graph.generators import gnm_graph
+from bfs_tpu.oracle.bfs import queue_bfs
+from bfs_tpu.resilience.retry import RetryPolicy, TransientError
+from bfs_tpu.serve import BfsServer
+from bfs_tpu.serve.executor import run_oracle_batch
+
+TIMEOUT = 300
+
+
+@pytest.fixture
+def graph():
+    return gnm_graph(60, 150, seed=7)
+
+
+def make_server(graph, **kw):
+    kw.setdefault(
+        "retry_policy",
+        RetryPolicy(max_attempts=3, base_delay_s=0.0, jitter=0.0),
+    )
+    srv = BfsServer(engine="pull", max_batch=4, **kw)
+    srv.register("g", graph)
+    return srv
+
+
+class FlakyRunner:
+    """Fails transiently ``fail_n`` times, then serves correct (oracle)
+    results forever.  Mimics a device runner whose transport recovers."""
+
+    def __init__(self, graph, fail_n, exc=TransientError("tunnel hiccup")):
+        self.graph = graph
+        self.fail_n = fail_n
+        self.exc = exc
+        self.calls = 0
+
+    def __call__(self, sources):
+        self.calls += 1
+        if self.calls <= self.fail_n:
+            raise self.exc
+        return run_oracle_batch(self.graph, sources)
+
+
+def test_transient_failure_is_retried_not_degraded(graph):
+    with make_server(graph) as srv:
+        flaky = FlakyRunner(graph, fail_n=2)
+        # Bucket for one single-source query is 1.
+        srv.exe_cache.put(("g", "pull", 1), flaky)
+        reply = srv.query("g", 5).result(TIMEOUT)
+
+        # Served by the (recovered) device path, not the oracle fallback.
+        assert reply.record.status == "ok"
+        assert flaky.calls == 3
+        d, _ = queue_bfs(graph, 5)
+        np.testing.assert_array_equal(reply.dist, d)
+
+        report = srv.report()
+        assert report["retries"]["device_retries"] == 2
+        assert report["retries"]["device_retry_successes"] == 1
+        assert report["retries"]["device_errors"] == 0
+        assert report["counters"]["device_retries"] == 2
+
+
+def test_permanent_failure_degrades_exactly_once(graph):
+    with make_server(graph) as srv:
+        broken = FlakyRunner(
+            graph, fail_n=10**9, exc=ValueError("lowering failed")
+        )
+        srv.exe_cache.put(("g", "pull", 1), broken)
+        reply = srv.query("g", 9).result(TIMEOUT)
+
+        # One attempt — permanent errors never burn retries — then the
+        # oracle serves the correct answer.
+        assert broken.calls == 1
+        assert reply.record.status == "oracle"
+        d, _ = queue_bfs(graph, 9)
+        np.testing.assert_array_equal(reply.dist, d)
+
+        report = srv.report()
+        assert report["retries"]["device_retries"] == 0
+        assert report["retries"]["device_errors"] == 1
+
+
+def test_transient_exhaustion_degrades_once_with_counts(graph):
+    with make_server(graph) as srv:
+        down = FlakyRunner(graph, fail_n=10**9)  # never recovers
+        srv.exe_cache.put(("g", "pull", 1), down)
+        reply = srv.query("g", 3).result(TIMEOUT)
+
+        # max_attempts=3 device tries, then ONE oracle degradation.
+        assert down.calls == 3
+        assert reply.record.status == "oracle"
+        d, _ = queue_bfs(graph, 3)
+        np.testing.assert_array_equal(reply.dist, d)
+
+        report = srv.report()
+        assert report["retries"]["device_retries"] == 2  # sleeps between tries
+        assert report["retries"]["device_retry_successes"] == 0
+        assert report["retries"]["device_errors"] == 1
+
+
+def test_retry_disabled_policy_matches_old_behavior(graph):
+    with make_server(
+        graph, retry_policy=RetryPolicy(max_attempts=1, base_delay_s=0.0)
+    ) as srv:
+        flaky = FlakyRunner(graph, fail_n=1)  # would recover on 2nd try
+        srv.exe_cache.put(("g", "pull", 1), flaky)
+        reply = srv.query("g", 2).result(TIMEOUT)
+        # max_attempts=1 restores degrade-on-first-failure.
+        assert flaky.calls == 1
+        assert reply.record.status == "oracle"
+        d, _ = queue_bfs(graph, 2)
+        np.testing.assert_array_equal(reply.dist, d)

@@ -1,0 +1,244 @@
+"""Chaos driver: kill a bench (or loadgen) subprocess on a randomized
+schedule and prove every resumed run converges to the golden result.
+
+The bench mode is the resilience subsystem's acceptance harness
+(docs/ARCHITECTURE.md §11): one uninterrupted golden run establishes the
+reference headline, then each iteration SIGKILLs a fresh run at a random
+phase boundary (``BFS_TPU_FAULT=kill:<phase>[:nth]``), re-invokes with the
+same config until it completes, and diffs the final headline against the
+golden on every deterministic field.  When the killed run had already
+emitted its provisional headline, the resumed value must additionally be
+BIT-IDENTICAL to it — the resumed run finishes the dead run's measurement,
+it does not take a new one.  Any divergence exits non-zero.
+
+The loadgen mode is simpler (the load generator owns no resume state):
+kill ``tools/serve_loadgen.py`` after a random delay, then run it to
+completion — its own oracle gate (exit 1 on any wrong answer or sub-100%
+steady-state compile hit rate) is the divergence check, and the kill
+proves a dead client never wedges or corrupts the serving artifacts
+(layout bundles, compile caches) it shares with the next run.
+
+Usage (CPU, tiny config — the tier-1-adjacent shape):
+    python tools/chaos_run.py --iterations 5 --seed 1
+    python tools/chaos_run.py --mode loadgen --iterations 3
+
+Heavier configs pass through the usual BENCH_* env knobs.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+#: Instrumented single-source bench phase families (resilience/faults.py
+#: family matching: "verify:2" = second verification boundary).
+BENCH_PHASES = [
+    "graph", "reference", "roots", "warm", "repeats_plan", "repeat",
+    "repeat:2", "provisional", "profile", "verify", "verify:2", "headline",
+]
+
+DETERMINISTIC_DETAILS = (
+    "roots", "directed_edges_traversed", "vertices_reached",
+    "supersteps_last_root", "num_vertices", "num_directed_edges",
+    "check", "engine",
+)
+
+
+def log(msg: str) -> None:
+    print(f"[chaos] {msg}", flush=True)
+
+
+def bench_env(args, journal_dir: str) -> dict:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.setdefault("BENCH_SCALE", str(args.scale))
+    env.setdefault("BENCH_EDGE_FACTOR", str(args.edge_factor))
+    env.setdefault("BENCH_ROOTS", str(args.roots))
+    env.setdefault("BENCH_REPEATS", str(args.repeats))
+    env.setdefault("BENCH_ENGINE", args.engine)
+    env.setdefault("BENCH_TIME_BUDGET", "600")
+    env["BFS_TPU_CACHE_DIR"] = args.cache_dir
+    env["BFS_TPU_JOURNAL_DIR"] = journal_dir
+    env.pop("BFS_TPU_FAULT", None)
+    return env
+
+
+def run_bench(args, journal_dir: str, fault: str | None = None):
+    env = bench_env(args, journal_dir)
+    if fault is not None:
+        env["BFS_TPU_FAULT"] = fault
+    proc = subprocess.run(
+        [sys.executable, "-m", "bfs_tpu.bench"],
+        capture_output=True, text=True, env=env, cwd=REPO_ROOT,
+        timeout=args.timeout,
+    )
+    lines = [
+        json.loads(l) for l in proc.stdout.splitlines() if l.startswith("{")
+    ]
+    return proc, lines
+
+
+def diff_headline(final: dict, golden: dict) -> list[str]:
+    bad = []
+    for k in ("metric", "unit"):
+        if final.get(k) != golden.get(k):
+            bad.append(f"{k}: {final.get(k)!r} != {golden.get(k)!r}")
+    for k in DETERMINISTIC_DETAILS:
+        if final["details"].get(k) != golden["details"].get(k):
+            bad.append(
+                f"details.{k}: {final['details'].get(k)!r} != "
+                f"{golden['details'].get(k)!r}"
+            )
+    return bad
+
+
+def chaos_bench(args, rng: random.Random) -> int:
+    with tempfile.TemporaryDirectory(prefix="chaos_golden_") as golden_dir:
+        log("golden run (uninterrupted)...")
+        proc, lines = run_bench(args, golden_dir)
+        if proc.returncode != 0 or not lines:
+            log(f"golden run failed rc={proc.returncode}")
+            sys.stderr.write(proc.stderr[-4000:])
+            return 2
+        golden = lines[-1]
+        log(f"golden headline: value={golden['value']:.1f} "
+            f"check={golden['details']['check']!r}")
+
+    # The profile boundary only exists on the relay path; picking it for
+    # other engines would silently burn the iteration without a kill.
+    engine = os.environ.get("BENCH_ENGINE", args.engine)
+    phases = [p for p in BENCH_PHASES if p != "profile" or engine == "relay"]
+    failures = 0
+    for it in range(args.iterations):
+        with tempfile.TemporaryDirectory(prefix="chaos_j_") as journal_dir:
+            provisional = None
+            kills = 0
+            # Randomized kill schedule: keep killing at random boundaries
+            # (possibly several in a row — each resume makes progress)
+            # until a run survives to completion.
+            while True:
+                fault = rng.choice(phases)
+                if kills >= args.max_kills_per_iteration:
+                    fault = None
+                proc, lines = run_bench(
+                    args, journal_dir,
+                    fault=f"kill:{fault}" if fault else None,
+                )
+                for l in lines:
+                    if l["details"].get("provisional"):
+                        provisional = l
+                if proc.returncode == 0:
+                    break
+                if proc.returncode != -signal.SIGKILL:
+                    log(f"iter {it}: unexpected rc={proc.returncode} "
+                        f"(fault={fault})")
+                    sys.stderr.write(proc.stderr[-4000:])
+                    return 2
+                kills += 1
+                log(f"iter {it}: killed at {fault!r} "
+                    f"(kill #{kills}); resuming...")
+            if not lines:
+                log(f"iter {it}: FAIL — completed run emitted no headline")
+                failures += 1
+                continue
+            final = lines[-1]
+            bad = diff_headline(final, golden)
+            if provisional is not None and final["value"] != provisional["value"]:
+                bad.append(
+                    f"value: resumed {final['value']!r} != provisional "
+                    f"{provisional['value']!r} (the resume re-timed instead "
+                    "of finishing the journaled measurement)"
+                )
+            if bad:
+                log(f"iter {it}: FAIL after {kills} kill(s):")
+                for b in bad:
+                    log(f"  - {b}")
+                failures += 1
+            else:
+                log(f"iter {it}: ok after {kills} kill(s) "
+                    f"(value={final['value']:.1f})")
+    log(f"bench chaos: {args.iterations - failures}/{args.iterations} ok")
+    return 1 if failures else 0
+
+
+def chaos_loadgen(args, rng: random.Random) -> int:
+    cmd = [
+        sys.executable, os.path.join(REPO_ROOT, "tools", "serve_loadgen.py"),
+        "--scale", str(args.scale), "--requests", str(args.requests),
+        "--cache-dir", args.cache_dir,
+    ]
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    failures = 0
+    for it in range(args.iterations):
+        delay = rng.uniform(1.0, args.loadgen_kill_max_s)
+        proc = subprocess.Popen(
+            cmd, env=env, cwd=REPO_ROOT,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        )
+        try:
+            proc.wait(timeout=delay)
+            log(f"iter {it}: loadgen finished before the {delay:.1f}s kill")
+        except subprocess.TimeoutExpired:
+            proc.kill()
+            proc.wait()
+            log(f"iter {it}: loadgen SIGKILLed at {delay:.1f}s")
+        # The next full run must pass its own oracle gate despite the
+        # shared on-disk artifacts a dead client just abandoned.
+        proc2 = subprocess.run(
+            cmd, env=env, cwd=REPO_ROOT, capture_output=True, text=True,
+            timeout=args.timeout,
+        )
+        if proc2.returncode != 0:
+            log(f"iter {it}: FAIL — post-kill loadgen rc={proc2.returncode}")
+            sys.stderr.write(proc2.stderr[-4000:])
+            failures += 1
+        else:
+            log(f"iter {it}: post-kill loadgen ok")
+    log(f"loadgen chaos: {args.iterations - failures}/{args.iterations} ok")
+    return 1 if failures else 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--mode", default="bench", choices=("bench", "loadgen"))
+    ap.add_argument("--iterations", type=int, default=5)
+    ap.add_argument("--seed", type=int, default=None,
+                    help="RNG seed for the kill schedule (default: time)")
+    ap.add_argument("--max-kills-per-iteration", type=int, default=3)
+    ap.add_argument("--timeout", type=float, default=600.0,
+                    help="per-subprocess wall bound")
+    # Bench shape (only used when the BENCH_* env knobs are unset).
+    ap.add_argument("--scale", type=int, default=8)
+    ap.add_argument("--edge-factor", type=int, default=4)
+    ap.add_argument("--roots", type=int, default=3)
+    ap.add_argument("--repeats", type=int, default=2)
+    ap.add_argument("--engine", default="push")
+    ap.add_argument("--cache-dir",
+                    default=os.path.join(tempfile.gettempdir(), "chaos_cache"),
+                    help="shared artifact cache across all runs (graph npz "
+                    "built once)")
+    # Loadgen shape.
+    ap.add_argument("--requests", type=int, default=200)
+    ap.add_argument("--loadgen-kill-max-s", type=float, default=20.0)
+    args = ap.parse_args(argv)
+
+    seed = args.seed if args.seed is not None else int(time.time())
+    log(f"kill-schedule seed: {seed}")
+    rng = random.Random(seed)
+    if args.mode == "bench":
+        return chaos_bench(args, rng)
+    return chaos_loadgen(args, rng)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
