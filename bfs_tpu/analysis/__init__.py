@@ -1,0 +1,108 @@
+"""bfs_tpu.analysis — project linter + runtime sanitizers.
+
+Static half (stdlib-only, never imports jax): three AST analyzer families
+over the repo's own sources —
+
+* **transfer/trace-safety** (TRC*): implicit host<->device syncs and
+  materializations inside declared hot regions;
+* **recompile drift** (RCD*): jit call sites whose callable identity or
+  static signature can change per call, and executable-cache keys that
+  under- or over-key their build closures;
+* **lock discipline** (LCK*): ``# guarded-by:`` annotated shared fields
+  must be accessed under their lock.
+
+Runtime half (:mod:`.runtime`): env-gated ``jax.transfer_guard`` regions
+and per-function retrace counters.
+
+CLI: ``python -m bfs_tpu.analysis`` (or ``tools/lint.py`` /
+``bfs-tpu-lint``).  Exit 0 = clean modulo the committed baseline.
+"""
+
+from __future__ import annotations
+
+import os
+
+from .core import RULES, Baseline, Finding, SourceFile
+from .locks import check_locks
+from .recompile import check_recompile
+from .runtime import (
+    format_retrace_report,
+    guarded_region,
+    hot_region,
+    retrace_report,
+    traced,
+    transfer_guard_level,
+)
+from .transfer import check_transfer
+
+__all__ = [
+    "RULES", "Baseline", "Finding", "SourceFile",
+    "analyze_file", "analyze_paths", "default_baseline_path",
+    "guarded_region", "hot_region", "traced",
+    "retrace_report", "format_retrace_report", "transfer_guard_level",
+]
+
+_CHECKERS = (check_transfer, check_recompile, check_locks)
+
+#: Directories never linted even when a parent is passed (generated
+#: artifacts, caches, VCS internals).  ``fixtures`` keeps deliberately
+#: broken test snippets out of a whole-repo run.
+_SKIP_DIRS = {
+    ".git", "__pycache__", ".bench_cache", "build", "dist",
+    "node_modules", ".eggs", "fixtures",
+}
+
+
+def default_baseline_path() -> str:
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)), "baseline.txt")
+
+
+def analyze_file(path: str, root: str, text: str | None = None) -> list[Finding]:
+    """All findings for one module; a syntax error becomes a single
+    error-severity finding rather than an analyzer crash."""
+    try:
+        src = SourceFile(path, root, text=text)
+    except SyntaxError as exc:
+        rel = os.path.relpath(os.path.abspath(path), root).replace(os.sep, "/")
+        return [
+            Finding(
+                rule="TRC000", path=rel, line=exc.lineno or 0, col=0,
+                message=f"could not parse: {exc.msg}", snippet="",
+            )
+        ]
+    findings: list[Finding] = []
+    for line, msg in src.pragma_problems:
+        if not src.suppressed(line, "PRG001"):
+            findings.append(
+                Finding(
+                    rule="PRG001", path=src.path, line=line, col=0,
+                    message=msg, snippet=src.snippet(line),
+                )
+            )
+    for checker in _CHECKERS:
+        findings.extend(checker(src))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def iter_python_files(paths: list[str]):
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+            continue
+        for dirpath, dirnames, filenames in os.walk(p):
+            dirnames[:] = sorted(
+                d for d in dirnames
+                if d not in _SKIP_DIRS and not d.startswith(".")
+            )
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def analyze_paths(paths: list[str], root: str) -> list[Finding]:
+    findings: list[Finding] = []
+    for path in iter_python_files(paths):
+        findings.extend(analyze_file(path, root))
+    return findings

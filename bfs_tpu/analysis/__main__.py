@@ -1,0 +1,149 @@
+"""CLI for the project linter: ``python -m bfs_tpu.analysis [paths...]``.
+
+Default target set is the shipped code (``bfs_tpu/``, ``tools/``, the
+repo-root ``bench.py``) relative to the repo root — tests are excluded by
+default because their fixtures deliberately trip rules.  Exit codes:
+
+* 0 — no unsuppressed error-severity findings (baseline-accepted ones
+  and warnings don't fail the run);
+* 1 — at least one new error;
+* 2 — usage/configuration problem.
+
+``--write-baseline`` rewrites the baseline file from the current
+findings (errors only, warnings never need baselining) with TODO
+justifications to fill in; ``--no-baseline`` shows everything.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import (
+    RULES,
+    Baseline,
+    analyze_paths,
+    default_baseline_path,
+)
+
+
+def _repo_root() -> str:
+    """The repo root: nearest ancestor of this package carrying the
+    project markers, else the package's grandparent (site installs)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    cand = os.path.dirname(os.path.dirname(here))  # .../repo (bfs_tpu/..)
+    for probe in (cand, os.getcwd()):
+        if os.path.exists(os.path.join(probe, "bfs_tpu")):
+            return probe
+    return cand
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m bfs_tpu.analysis",
+        description=__doc__.splitlines()[0],
+    )
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: bfs_tpu/ tools/ bench.py)")
+    ap.add_argument("--root", default=None,
+                    help="repo root for relative paths + default targets")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline file (default: bfs_tpu/analysis/baseline.txt)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: show every finding")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="rewrite the baseline from current error findings")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings on stdout")
+    ap.add_argument("--strict", action="store_true",
+                    help="warnings also fail the run")
+    ap.add_argument("--rules", action="store_true",
+                    help="print the rule catalog and exit")
+    args = ap.parse_args(argv)
+
+    if args.rules:
+        for rule, (sev, desc) in sorted(RULES.items()):
+            print(f"{rule}  [{sev:7s}] {desc}")
+        return 0
+
+    root = os.path.abspath(args.root) if args.root else _repo_root()
+    if args.paths:
+        paths = [os.path.abspath(p) for p in args.paths]
+    else:
+        paths = [
+            p for p in (
+                os.path.join(root, "bfs_tpu"),
+                os.path.join(root, "tools"),
+                os.path.join(root, "bench.py"),
+            ) if os.path.exists(p)
+        ]
+    if not paths:
+        print("analysis: nothing to lint", file=sys.stderr)
+        return 2
+
+    findings = analyze_paths(paths, root)
+
+    baseline_path = args.baseline or default_baseline_path()
+    baseline = (
+        Baseline(path=baseline_path)
+        if args.no_baseline
+        else Baseline.load(baseline_path)
+    )
+
+    if args.write_baseline:
+        errors = [f for f in findings if f.severity == "error"]
+        with open(baseline_path, "w", encoding="utf-8") as f:
+            f.write(Baseline.render(errors))
+        print(
+            f"analysis: wrote {len(errors)} accepted finding(s) to "
+            f"{baseline_path} — fill in the justifications"
+        )
+        return 0
+
+    fresh = [f for f in findings if not baseline.accepts(f)]
+    new_errors = [f for f in fresh if f.severity == "error"]
+    warnings = [f for f in fresh if f.severity == "warning"]
+    accepted = len(findings) - len(fresh)
+
+    if args.as_json:
+        print(json.dumps(
+            {
+                "findings": [
+                    {
+                        "rule": f.rule, "severity": f.severity,
+                        "path": f.path, "line": f.line, "col": f.col,
+                        "message": f.message,
+                        "fingerprint": f.fingerprint(),
+                    }
+                    for f in fresh
+                ],
+                "accepted_by_baseline": accepted,
+                "stale_baseline_entries": baseline.stale(),
+            },
+            indent=2,
+        ))
+    else:
+        for f in fresh:
+            print(f.render())
+        stale = baseline.stale()
+        summary = (
+            f"analysis: {len(new_errors)} error(s), {len(warnings)} "
+            f"warning(s), {accepted} baseline-accepted"
+        )
+        if stale:
+            summary += (
+                f", {len(stale)} STALE baseline entr"
+                f"{'y' if len(stale) == 1 else 'ies'} (fixed or edited — "
+                "prune them)"
+            )
+        print(summary, file=sys.stderr)
+
+    if new_errors or (args.strict and warnings):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

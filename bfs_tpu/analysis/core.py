@@ -1,0 +1,408 @@
+"""Shared infrastructure for the project linters: findings, pragma
+parsing, hot-region discovery, and the committed-baseline mechanism.
+
+Everything here is stdlib-only (``ast`` + ``tokenize``): the pass must run
+in tier-1 on a bare CPU image with no third-party linter installed, and it
+must never import jax — analyzing ``ops/relay_pallas.py`` should not cost
+a backend initialization.
+
+Pragma syntax (all live in comments, so they are invisible to runtime):
+
+``# bfs_tpu: hot``
+    Marks the NEXT ``def`` at or below the comment (or the ``def`` on the
+    same line) as a hot region: the transfer/trace-safety rules apply to
+    its whole body.  Functions decorated with ``jax.jit`` (including
+    ``functools.partial(jax.jit, ...)``) or with the
+    :func:`bfs_tpu.analysis.runtime.hot_region` decorator are hot
+    automatically.
+
+``# bfs_tpu: hot-start`` / ``# bfs_tpu: hot-end``
+    Bracket an arbitrary line range (e.g. the bench timed-repeat loop)
+    as hot without factoring it into a function.
+
+``# bfs_tpu: ok RULE[,RULE] [reason]``
+    Suppress the named rules on this line (and, when the comment stands
+    alone on its line, on the immediately following line).  ``ok *``
+    suppresses everything — use sparingly; prefer the baseline file,
+    which forces a justification.
+
+``# guarded-by: lockname[|alt ...]``
+    On a field assignment (``self.x = ...`` in a class, or a module-level
+    global), declares that every later read/write must happen inside a
+    ``with <lockname>`` block in the same class/module.  ``a|b`` means
+    either lock is sufficient (e.g. a ``Condition`` wrapping the lock).
+
+``# bfs_tpu: holds lockname[,lockname]``
+    On a ``def``, declares that callers invoke this helper with the named
+    locks already held (the ``@RequiresLock`` idiom) — the checker treats
+    them as held for the whole body.
+
+Baseline file: one accepted finding per line,
+``RULE<TAB>fingerprint<TAB>justification``.  The fingerprint hashes the
+rule, the repo-relative path and the stripped source line — NOT the line
+number — so unrelated edits above a finding don't invalidate the whole
+baseline, while any edit to the flagged line itself forces re-triage.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+import io
+import os
+import tokenize
+from dataclasses import dataclass, field
+
+SEVERITIES = ("error", "warning")
+
+#: rule id -> (severity, one-line description); the catalog the CLI prints.
+RULES: dict[str, tuple[str, str]] = {
+    # -- transfer / trace-safety ------------------------------------------
+    "TRC001": ("error", ".item() in a hot region forces a device->host sync"),
+    "TRC002": ("error",
+               "float()/int()/bool() on a non-constant in a hot region "
+               "forces a device->host sync"),
+    "TRC003": ("error",
+               "np.asarray/np.array in a hot region materializes a "
+               "device value on the host"),
+    "TRC004": ("error",
+               "jax.device_get/device_put in a hot region — transfers "
+               "must live outside the timed/traced path or carry an "
+               "explicit ok-pragma naming why"),
+    "TRC005": ("error",
+               "print() in a hot region syncs its device-array arguments "
+               "and stalls the dispatch pipeline"),
+    "TRC006": ("error",
+               "Python control flow on a traced value concretizes it at "
+               "trace time (use lax.cond/lax.while_loop/jnp.where)"),
+    # -- recompile drift --------------------------------------------------
+    "RCD001": ("error",
+               "jax.jit(lambda/local def) inside a function: a fresh "
+               "callable identity per call retraces every call"),
+    "RCD002": ("error",
+               "static_argnums/static_argnames/donate_* must be literal "
+               "— a computed value drifts the static signature between "
+               "call sites"),
+    "RCD003": ("error",
+               "jit()/lower()/compile() inside a loop body recompiles "
+               "per iteration"),
+    "RCD004": ("warning",
+               "compile-cache key element computed per call — confirm "
+               "the derivation buckets to a bounded shape set"),
+    "RCD005": ("error",
+               "executable-cache build closure reads a local that is not "
+               "part of the cache key (under-keyed executable)"),
+    # -- pragma hygiene ---------------------------------------------------
+    "PRG001": ("error",
+               "overlapping '# bfs_tpu: hot-start' — the previous span "
+               "was still open; a span silently dropped from hot "
+               "coverage is a policed region that isn't"),
+    # -- lock discipline --------------------------------------------------
+    "LCK001": ("error",
+               "guarded-by field accessed outside its declared lock"),
+    "LCK002": ("warning",
+               "shared mutable field in a lock-owning class has no "
+               "guarded-by annotation"),
+}
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str  # repo-relative, forward slashes
+    line: int
+    col: int
+    message: str
+    snippet: str = ""
+
+    @property
+    def severity(self) -> str:
+        return RULES.get(self.rule, ("error", ""))[0]
+
+    def fingerprint(self) -> str:
+        basis = f"{self.rule}|{self.path}|{self.snippet.strip()}"
+        return hashlib.blake2b(basis.encode(), digest_size=6).hexdigest()
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}:{self.col}: {self.rule} "
+            f"[{self.severity}] {self.message}"
+        )
+
+
+def _parse_pragma(text: str) -> tuple[str, str] | None:
+    """``'# bfs_tpu: hot-start'`` -> ``('hot-start', '')``;
+    ``'# guarded-by: _lock'`` -> ``('guarded-by', '_lock')``; else None."""
+    body = text.lstrip("#").strip()
+    if body.startswith("bfs_tpu:"):
+        rest = body[len("bfs_tpu:"):].strip()
+        if not rest:
+            return None
+        word, _, arg = rest.partition(" ")
+        return word, arg.strip()
+    if body.startswith("guarded-by:"):
+        return "guarded-by", body[len("guarded-by:"):].strip()
+    return None
+
+
+class SourceFile:
+    """One parsed module: AST + pragma maps, ready for the analyzers."""
+
+    def __init__(self, path: str, root: str, text: str | None = None):
+        self.abspath = os.path.abspath(path)
+        self.path = os.path.relpath(self.abspath, root).replace(os.sep, "/")
+        if text is None:
+            with open(self.abspath, encoding="utf-8") as f:
+                text = f.read()
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=self.path)
+        # line -> set of suppressed rules ({'*'} = all)
+        self.suppressions: dict[int, set[str]] = {}
+        # line -> guard spec string for guarded-by annotations
+        self.guard_decls: dict[int, str] = {}
+        # def-line pragmas: line -> True when '# bfs_tpu: hot traced'
+        # (the body executes under a trace even though the def itself is
+        # not jit-decorated — e.g. ops/ kernels called from jitted loops)
+        self.hot_pragma_lines: dict[int, bool] = {}
+        self.holds_decls: dict[int, list[str]] = {}
+        self.hot_spans: list[tuple[int, int]] = []
+        # (line, message) pragma-hygiene problems -> PRG* findings
+        self.pragma_problems: list[tuple[int, str]] = []
+        self._scan_comments()
+
+    # ------------------------------------------------------------ pragmas --
+    def _scan_comments(self) -> None:
+        open_start: int | None = None
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.text).readline)
+            comments = [
+                (t.start[0], t.start[1], t.string)
+                for t in tokens
+                if t.type == tokenize.COMMENT
+            ]
+        except tokenize.TokenError:
+            comments = []
+        for lineno, col, text in comments:
+            pragma = _parse_pragma(text)
+            if pragma is None:
+                continue
+            kind, arg = pragma
+            own_line = self.lines[lineno - 1].strip().startswith("#")
+            if kind == "ok":
+                rules = {
+                    r.strip()
+                    for r in arg.split(" ")[0].split(",")
+                    if r.strip()
+                } or {"*"}
+                self.suppressions.setdefault(lineno, set()).update(rules)
+                if own_line:  # standalone comment covers the next line too
+                    self.suppressions.setdefault(lineno + 1, set()).update(rules)
+            elif kind == "hot":
+                self.hot_pragma_lines[lineno] = arg.split(" ")[0] == "traced"
+            elif kind == "hot-start":
+                if open_start is not None:
+                    # Keep coverage (close the first span here) AND flag
+                    # it: a dropped span would un-police a timed region
+                    # with the self-lint still green.
+                    self.hot_spans.append((open_start, lineno))
+                    self.pragma_problems.append((
+                        lineno,
+                        f"hot-start while the span opened at line "
+                        f"{open_start} is still open (missing hot-end?)",
+                    ))
+                open_start = lineno
+            elif kind == "hot-end":
+                if open_start is not None:
+                    self.hot_spans.append((open_start, lineno))
+                    open_start = None
+            elif kind == "holds":
+                locks = [x.strip() for x in arg.replace(",", " ").split() if x.strip()]
+                self.holds_decls[lineno] = locks
+                if own_line:
+                    self.holds_decls.setdefault(lineno + 1, locks)
+            elif kind == "guarded-by":
+                self.guard_decls[lineno] = arg.split(" ")[0] if arg else ""
+        if open_start is not None:  # unclosed span: hot to EOF
+            self.hot_spans.append((open_start, len(self.lines)))
+
+    # ----------------------------------------------------------- utilities --
+    def snippet(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+    def suppressed(self, lineno: int, rule: str) -> bool:
+        rules = self.suppressions.get(lineno, ())
+        return "*" in rules or rule in rules
+
+    def finding(self, rule: str, node: ast.AST, message: str) -> Finding | None:
+        line = getattr(node, "lineno", 0)
+        if self.suppressed(line, rule):
+            return None
+        return Finding(
+            rule=rule,
+            path=self.path,
+            line=line,
+            col=getattr(node, "col_offset", 0),
+            message=message,
+            snippet=self.snippet(line),
+        )
+
+
+# --------------------------------------------------------------------------
+# Hot-region + jit-decorator discovery (shared by transfer + recompile).
+# --------------------------------------------------------------------------
+
+def dotted_name(node: ast.AST) -> str:
+    """``jax.lax.while_loop`` -> that string; '' for anything non-dotted."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+
+
+def is_jit_reference(node: ast.AST) -> bool:
+    """True when ``node`` refers to the jit transform itself (``jax.jit``)
+    or a partial of it (``functools.partial(jax.jit, ...)``)."""
+    if dotted_name(node) in _JIT_NAMES:
+        return True
+    if isinstance(node, ast.Call):
+        fn = dotted_name(node.func)
+        if fn in ("functools.partial", "partial") and node.args:
+            return is_jit_reference(node.args[0])
+        # shard_map/custom wrappers that take the jitted fn positionally
+        # are out of scope — name the region with a pragma instead.
+    return False
+
+
+def jit_decorated(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> bool:
+    return any(is_jit_reference(d) for d in fn.decorator_list)
+
+
+_HOT_DECORATORS = {"hot_region", "analysis.hot_region"}
+
+
+def _pragma_applies(src: SourceFile, fn: ast.FunctionDef) -> bool | None:
+    """A ``# bfs_tpu: hot`` comment marks the next def at/below it.
+    Returns None (no pragma) or the pragma's traced flag."""
+    first = min(
+        [d.lineno for d in fn.decorator_list] + [fn.lineno]
+    )
+    for line, traced in src.hot_pragma_lines.items():
+        if line == fn.lineno or (line < first and _no_def_between(src, line, first)):
+            return traced
+    return None
+
+
+def _no_def_between(src: SourceFile, lo: int, hi: int) -> bool:
+    """True when no OTHER def/class statement starts in (lo, hi) — the
+    pragma binds to the nearest following definition."""
+    for ln in range(lo + 1, hi):
+        stripped = src.lines[ln - 1].lstrip() if ln <= len(src.lines) else ""
+        if stripped.startswith(("def ", "async def ", "class ")):
+            return False
+    return True
+
+
+@dataclass
+class HotRegion:
+    """One region the transfer rules police.  ``traced`` regions (jit
+    bodies) additionally get the trace-concretization rule TRC006."""
+
+    start: int
+    end: int
+    traced: bool
+    name: str
+    node: ast.AST | None = None
+
+
+def hot_regions(src: SourceFile) -> list[HotRegion]:
+    regions: list[HotRegion] = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        traced = jit_decorated(node)
+        pragma = _pragma_applies(src, node)
+        marked = (
+            traced
+            or pragma is not None
+            or any(
+                dotted_name(d) in _HOT_DECORATORS
+                or (isinstance(d, ast.Call) and dotted_name(d.func) in _HOT_DECORATORS)
+                for d in node.decorator_list
+            )
+        )
+        if marked:
+            regions.append(
+                HotRegion(node.lineno, node.end_lineno or node.lineno,
+                          traced or bool(pragma), node.name, node)
+            )
+    for start, end in src.hot_spans:
+        regions.append(HotRegion(start, end, False, f"span@{start}"))
+    return regions
+
+
+# --------------------------------------------------------------------------
+# Baseline.
+# --------------------------------------------------------------------------
+
+@dataclass
+class Baseline:
+    """The committed accepted-findings file.  ``entries`` maps fingerprint
+    -> (rule, justification); ``used`` tracks which entries matched this
+    run so the CLI can warn about stale ones."""
+
+    path: str | None = None
+    entries: dict[str, tuple[str, str]] = field(default_factory=dict)
+    used: set[str] = field(default_factory=set)
+
+    @classmethod
+    def load(cls, path: str | None) -> "Baseline":
+        bl = cls(path=path)
+        if path is None or not os.path.exists(path):
+            return bl
+        with open(path, encoding="utf-8") as f:
+            for raw in f:
+                line = raw.strip()
+                if not line or line.startswith("#"):
+                    continue
+                parts = line.split(None, 2)
+                if len(parts) < 2:
+                    continue
+                rule, fp = parts[0], parts[1]
+                just = parts[2] if len(parts) > 2 else ""
+                bl.entries[fp] = (rule, just)
+        return bl
+
+    def accepts(self, finding: Finding) -> bool:
+        fp = finding.fingerprint()
+        if fp in self.entries:
+            self.used.add(fp)
+            return True
+        return False
+
+    def stale(self) -> list[str]:
+        return [fp for fp in self.entries if fp not in self.used]
+
+    @staticmethod
+    def render(findings: list[Finding], justification: str = "TODO: justify") -> str:
+        lines = [
+            "# bfs_tpu.analysis baseline — accepted findings.",
+            "# One per line: RULE  fingerprint  justification.",
+            "# Fingerprints hash (rule, path, source line) — line-number",
+            "# drift is fine; editing the flagged line forces re-triage.",
+        ]
+        for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+            lines.append(
+                f"{f.rule}  {f.fingerprint()}  "
+                f"[{f.path}:{f.line}] {justification}"
+            )
+        return "\n".join(lines) + "\n"

@@ -1,0 +1,315 @@
+"""Recompile-drift rules (RCD001–RCD005).
+
+The cost model: a bench-scale fused-program compile is ~830 s through the
+remote-compile service (round-5 ledger) and even the CPU-mesh test
+programs cost hundreds of ms — so any call path that can silently hand
+jit a NEW trace (fresh callable identity, drifting static argument,
+per-iteration ``.lower().compile()``) turns a steady-state serving tick
+into a compile storm.  The loadgen already FAILS on a <100% steady-state
+compile hit rate; these rules name the call sites that can cause it
+before it ships.
+
+RCD004/RCD005 police the serve-layer :class:`ExecutableCache` contract:
+the cache key must carry every value the build closure specializes on
+(RCD005, error — an under-keyed executable serves wrong-shape programs),
+and key elements computed per call (RCD004, warning) must provably bucket
+to a bounded set — the power-of-two batch bucket is the accepted example,
+recorded in the baseline with its bound.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, SourceFile, dotted_name, is_jit_reference
+
+_STATIC_KWARGS = (
+    "static_argnums", "static_argnames", "donate_argnums", "donate_argnames",
+)
+
+
+def _is_literal(node: ast.AST) -> bool:
+    """Literal tuples/lists/strings/ints (the hashable-by-construction
+    shapes jit kwargs should be)."""
+    if isinstance(node, ast.Constant):
+        return True
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return all(_is_literal(e) for e in node.elts)
+    return False
+
+
+def _enclosing_stack(tree: ast.AST) -> dict[int, list[ast.AST]]:
+    """Map id(node) -> chain of enclosing function/loop nodes."""
+    chains: dict[int, list[ast.AST]] = {}
+
+    def walk(node: ast.AST, stack: list[ast.AST]) -> None:
+        for child in ast.iter_child_nodes(node):
+            chains[id(child)] = stack
+            nested = stack
+            if isinstance(
+                child,
+                (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda,
+                 ast.For, ast.While, ast.ClassDef,
+                 ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp),
+            ):
+                nested = stack + [child]
+            walk(child, nested)
+
+    walk(tree, [])
+    return chains
+
+
+def _in_function(stack: list[ast.AST]) -> ast.AST | None:
+    for node in reversed(stack):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return node
+    return None
+
+
+def _in_loop_inside_same_function(stack: list[ast.AST]) -> bool:
+    """True when the innermost loop is closer than the innermost function
+    boundary — i.e. the call re-executes per iteration of a host loop."""
+    for node in reversed(stack):
+        if isinstance(
+            node,
+            (ast.For, ast.While,
+             ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp),
+        ):
+            return True
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            return False
+    return False
+
+
+def _local_defs(fn: ast.AST) -> set[str]:
+    """Names of defs nested directly inside ``fn`` (a jit of one of these
+    from inside ``fn`` re-creates the callable per call of ``fn``)."""
+    names: set[str] = set()
+    for child in ast.walk(fn):
+        if child is fn:
+            continue
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            names.add(child.name)
+    return names
+
+
+def check_recompile(src: SourceFile) -> list[Finding]:
+    findings: list[Finding] = []
+    chains = _enclosing_stack(src.tree)
+
+    def emit(rule: str, node: ast.AST, msg: str) -> None:
+        f = src.finding(rule, node, msg)
+        if f is not None:
+            findings.append(f)
+
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        stack = chains.get(id(node), [])
+        fname = dotted_name(node.func)
+
+        if is_jit_reference(node.func):
+            encl = _in_function(stack)
+            # RCD001: jit over a fresh callable identity, per enclosing call
+            if encl is not None and node.args:
+                target = _unwrap_decorator_calls(node.args[0])
+                fresh = isinstance(target, ast.Lambda) or (
+                    isinstance(target, ast.Name)
+                    and target.id in _local_defs(encl)
+                )
+                if fresh:
+                    emit(
+                        "RCD001", node,
+                        "jit() over a lambda/locally-defined function "
+                        "inside a function body: every call of the "
+                        "enclosing function hands jit a NEW callable and "
+                        "retraces — hoist to module level or cache the "
+                        "jitted callable",
+                    )
+            # RCD002: non-literal static/donate kwargs
+            for kw in node.keywords:
+                if kw.arg in _STATIC_KWARGS and not _is_literal(kw.value):
+                    emit(
+                        "RCD002", kw.value,
+                        f"{kw.arg} is computed, not literal: the static "
+                        "signature can drift between call sites and every "
+                        "drift is a silent retrace",
+                    )
+            # RCD003: jit inside a host loop
+            if _in_loop_inside_same_function(stack):
+                emit(
+                    "RCD003", node,
+                    "jit() in a loop body creates a fresh traced callable "
+                    "per iteration",
+                )
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in ("lower", "compile")
+            and _in_loop_inside_same_function(stack)
+            # .compile() on a regex/pattern etc. is fine; require the
+            # receiver chain to mention a lowering/jit shape.
+            and _looks_like_jax_compile(node)
+        ):
+            emit(
+                "RCD003", node,
+                f".{node.func.attr}() inside a loop body recompiles per "
+                "iteration — hoist or key through an executable cache",
+            )
+
+        # RCD004/RCD005: ExecutableCache.get(key, build) contracts.
+        if (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr == "get"
+            and _receiver_is_exe_cache(node.func.value)
+            and len(node.args) >= 2
+        ):
+            key_node, build_node = node.args[0], node.args[1]
+            key_names = {
+                n.id for n in ast.walk(key_node) if isinstance(n, ast.Name)
+            } | {
+                n.attr for n in ast.walk(key_node) if isinstance(n, ast.Attribute)
+            }
+            # All enclosing functions, innermost first: the get() call often
+            # sits in a nested closure while the key elements are assigned
+            # one or two frames out.
+            encl_fns = [
+                n for n in reversed(stack)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda))
+            ]
+            encl = encl_fns[0] if encl_fns else None
+            computed = _computed_key_elements(key_node, encl_fns)
+            for name, el in computed:
+                emit(
+                    "RCD004", el,
+                    f"compile-cache key element '{name}' is computed per "
+                    "call — confirm (and record in the baseline) that the "
+                    "derivation buckets to a bounded shape set",
+                )
+            if isinstance(build_node, ast.Lambda):
+                missing = _closure_reads_outside_key(
+                    build_node, key_names, encl
+                )
+                for name in sorted(missing):
+                    emit(
+                        "RCD005", build_node,
+                        f"build closure reads '{name}' which is not part "
+                        "of the cache key: two calls differing only in "
+                        f"'{name}' would share one executable",
+                    )
+    return findings
+
+
+def _unwrap_decorator_calls(node: ast.AST) -> ast.AST:
+    """Peel inline decorator applications off a jit target:
+    ``traced("x")(lambda s: ...)`` -> the lambda.  Without this, wrapping
+    a fresh lambda in the instrumentation decorator would hide it from
+    RCD001 — the wrapper call creates just as new an identity per call as
+    the bare lambda does."""
+    seen = 0
+    while (
+        isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Call)
+        and len(node.args) == 1
+        and not node.keywords
+        and seen < 8
+    ):
+        node = node.args[0]
+        seen += 1
+    return node
+
+
+def _looks_like_jax_compile(node: ast.Call) -> bool:
+    text = ""
+    cur: ast.AST = node.func
+    while isinstance(cur, (ast.Attribute, ast.Call)):
+        if isinstance(cur, ast.Attribute):
+            text = cur.attr + "." + text
+            cur = cur.value
+        else:
+            cur = cur.func
+    if isinstance(cur, ast.Name):
+        text = cur.id + "." + text
+    markers = ("jit", "lower", "pjit", "lowered", "compiled")
+    return any(m in text for m in markers)
+
+
+def _receiver_is_exe_cache(node: ast.AST) -> bool:
+    name = dotted_name(node)
+    return name.rsplit(".", 1)[-1] in ("exe_cache", "executable_cache")
+
+
+def _assigned_from_call(name: str, fn: ast.AST | None) -> ast.AST | None:
+    if fn is None:
+        return None
+    for n in ast.walk(fn):
+        if isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+            for tgt in n.targets:
+                if isinstance(tgt, ast.Name) and tgt.id == name:
+                    return n
+    return None
+
+
+def _computed_key_elements(key_node: ast.AST, encl_fns: list[ast.AST]):
+    out = []
+    elements = (
+        key_node.elts if isinstance(key_node, (ast.Tuple, ast.List)) else [key_node]
+    )
+    for el in elements:
+        if isinstance(el, ast.Name) and any(
+            _assigned_from_call(el.id, fn) is not None for fn in encl_fns
+        ):
+            out.append((el.id, el))
+    return out
+
+
+def _closure_reads_outside_key(
+    lam: ast.Lambda, key_names: set[str], fn: ast.AST | None
+) -> set[str]:
+    """Free variables of the build lambda that are PER-CALL assigned
+    locals of the enclosing function and absent from the key.  Bare
+    parameters (registry/server handles threaded through) are ambient
+    context, not specialization inputs — only values the function derives
+    per call can silently under-key the executable."""
+    if fn is None:
+        return set()
+    local_names: set[str] = set()
+    for n in ast.walk(fn):
+        targets: list[ast.AST] = []
+        if isinstance(n, ast.Assign):
+            targets = list(n.targets)
+        elif isinstance(n, (ast.AugAssign, ast.AnnAssign)):
+            targets = [n.target]
+        elif isinstance(n, ast.For):
+            targets = [n.target]
+        for tgt in targets:
+            for t in ast.walk(tgt):
+                if isinstance(t, ast.Name):
+                    local_names.add(t.id)
+    lam_params = {x.arg for x in lam.args.args + lam.args.kwonlyargs}
+    reads: set[str] = set()
+    for n in ast.walk(lam.body):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+            reads.add(n.id)
+        # attribute roots count as their base name read (first.graph -> first)
+    return {
+        r
+        for r in reads
+        if r in local_names
+        and r not in lam_params
+        and r not in key_names
+        and r not in ("self",)
+        and not _attr_of_read_in_key(lam, r, key_names)
+    }
+
+
+def _attr_of_read_in_key(lam: ast.Lambda, name: str, key_names: set[str]) -> bool:
+    """``first`` counts as keyed when the key carries ``first.<attr>`` for
+    every attribute the closure reads off it."""
+    attrs_read = {
+        n.attr
+        for n in ast.walk(lam.body)
+        if isinstance(n, ast.Attribute)
+        and isinstance(n.value, ast.Name)
+        and n.value.id == name
+    }
+    return bool(attrs_read) and attrs_read <= key_names

@@ -1,0 +1,177 @@
+"""Runtime sanitizers: the dynamic half of the analysis pass.
+
+Two families, both zero-overhead when disabled:
+
+**Transfer guard** — :func:`guarded_region` wraps a block in
+``jax.transfer_guard("disallow")`` when ``BFS_TPU_TRANSFER_GUARD`` is set,
+so an implicit device->host pull (``.item()``, ``float()``, ``__bool__``)
+inside the bench timed-repeat region or the serve device batch path raises
+at the offending line instead of silently costing a ~107 ms tunnel
+round-trip per superstep.  Explicit ``jax.device_get``/``device_put``
+remain allowed under ``disallow`` — that is the point: the hot paths are
+rewritten to make every intended transfer explicit, and the guard turns
+any remaining *implicit* one into a stack trace.  Env values: ``1``/
+``disallow`` (default), ``log`` (warn, don't raise), ``0``/unset (off —
+the tier-1 CPU default).
+
+**Retrace counter** — :func:`traced` is placed UNDER a ``jax.jit``
+decorator (or around a function handed to ``jit``): the wrapped Python
+body executes exactly once per trace, so the counter names which function
+retraced and how often.  The serve loadgen's "<100% steady-state compile
+hit rate" failure and bench recompile stalls become diagnosable:
+:func:`retrace_report` is printed by ``tools/serve_loadgen.py`` and
+``tools/chaos_run.py`` on exit, and any monitor can poll it.  Counting is
+lock-guarded and works under ``jit``, ``lower()``, grad, and vmap alike
+(anything that re-executes the traced body).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import functools
+import os
+import threading
+
+_lock = threading.Lock()
+_retrace_counts: dict[str, int] = {}  # guarded-by: _lock
+_hot_registry: dict[str, object] = {}  # guarded-by: _lock
+
+
+def transfer_guard_level() -> str | None:
+    """The configured guard level: ``'disallow'`` / ``'log'`` / None (off).
+
+    ``BFS_TPU_TRANSFER_GUARD`` accepts ``1``/``disallow``, ``log``, or any
+    explicit jax level name (``disallow_explicit`` for paranoia runs)."""
+    raw = os.environ.get("BFS_TPU_TRANSFER_GUARD", "").strip().lower()
+    if raw in ("", "0", "off", "false", "allow"):
+        return None
+    if raw in ("1", "on", "true", "disallow"):
+        return "disallow"
+    return raw
+
+
+@contextlib.contextmanager
+def guarded_region(name: str):
+    """Context manager for a no-implicit-transfers region.
+
+    No-op unless ``BFS_TPU_TRANSFER_GUARD`` is set; with it, an implicit
+    transfer inside raises ``jax.errors.JaxRuntimeError`` (re-raised with
+    the region name prepended so a bench log names the phase, not just
+    the line)."""
+    level = transfer_guard_level()
+    if level is None:
+        yield
+        return
+    import jax
+
+    try:
+        with jax.transfer_guard(level):
+            yield
+    except Exception as exc:
+        # Name the guarded region in the failure — but ONLY for actual
+        # guard violations ("Disallowed host-to-device transfer: ...");
+        # any other exception raised inside the region (OOM, a ValueError
+        # from the workload, a retry-path error) must pass through
+        # untouched or error classifiers downstream would misattribute
+        # it to a transfer.  Mutating args keeps the original type and
+        # traceback (some runtime error types don't re-construct from a
+        # bare string).
+        head = str(exc.args[0]) if exc.args else ""
+        if "Disallowed" in head and "transfer" in head:
+            exc.args = (
+                f"[transfer-guard:{name}] {head}",
+            ) + tuple(exc.args[1:])
+        raise
+
+
+def hot_region(fn=None, *, name: str | None = None):
+    """Decorator marking a function as a hot region.
+
+    The static pass treats the decorated body exactly like a
+    ``# bfs_tpu: hot`` pragma; at runtime the call is wrapped in
+    :func:`guarded_region` when the env guard is on (free otherwise).
+    Usable bare (``@hot_region``) or with a name (``@hot_region(name=...)``).
+    """
+
+    def deco(f):
+        region = name or f"{f.__module__}.{f.__qualname__}"
+        with _lock:
+            _hot_registry[region] = f
+
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            if transfer_guard_level() is None:
+                return f(*args, **kwargs)
+            with guarded_region(region):
+                return f(*args, **kwargs)
+
+        wrapper.__bfs_tpu_hot__ = region
+        return wrapper
+
+    return deco if fn is None else deco(fn)
+
+
+def hot_registry() -> dict[str, object]:
+    with _lock:
+        return dict(_hot_registry)
+
+
+# --------------------------------------------------------------------------
+# Retrace counting.
+# --------------------------------------------------------------------------
+
+def bump_retrace(name: str, by: int = 1) -> None:
+    with _lock:
+        _retrace_counts[name] = _retrace_counts.get(name, 0) + by
+
+
+def traced(name: str):
+    """Place UNDER ``jax.jit`` (or around the fn handed to ``jit``): the
+    wrapper body runs once per trace, so each execution IS one (re)trace.
+
+    ::
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        @traced("relax_superstep")
+        def relax_superstep(...): ...
+    """
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            bump_retrace(name)
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def retrace_report() -> dict[str, int]:
+    """Snapshot of per-function trace counts (name -> traces this
+    process).  Steady state should freeze every count; a count that moves
+    during the steady phase names the function whose signature drifted."""
+    with _lock:
+        return dict(_retrace_counts)
+
+
+def reset_retrace_counts() -> None:
+    with _lock:
+        _retrace_counts.clear()
+
+
+def format_retrace_report(baseline: dict[str, int] | None = None) -> str:
+    """Human-readable retrace table; with ``baseline`` (an earlier
+    snapshot) adds a drift column — any non-zero drift after warmup is a
+    recompile leak and names its function."""
+    now = retrace_report()
+    if not now:
+        return "retraces: none recorded (no @traced functions executed)"
+    lines = ["retraces (traces per function this process):"]
+    for name in sorted(now):
+        drift = ""
+        if baseline is not None:
+            d = now[name] - baseline.get(name, 0)
+            drift = f"  (+{d} since warmup)" if d else "  (steady)"
+        lines.append(f"  {now[name]:6d}  {name}{drift}")
+    return "\n".join(lines)

@@ -1,0 +1,181 @@
+"""Transfer / trace-safety rules (TRC001–TRC006).
+
+The failure mode these police is the paper's central one: a level-
+synchronous superstep only wins while it stays on the accelerator, and a
+single stray ``.item()`` / ``np.asarray`` / ``print`` inside the timed
+loop re-introduces the per-superstep host round-trip the whole design
+exists to delete (round-3 measured it at ~107 ms per sync through the
+tunnel — more than an entire dense superstep).
+
+Rules apply only inside HOT REGIONS (see :mod:`.core` for how regions are
+declared); the same constructs are perfectly fine in build/reporting code.
+TRC006 (Python control flow on traced values) additionally requires the
+region to be a *traced* function body (``jax.jit``-decorated): branching
+on a device value in host-timed code is a sync (TRC002 covers the
+conversions it goes through), but only under a trace does it become a
+concretization error.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, HotRegion, SourceFile, dotted_name, hot_regions
+
+#: Call targets that pull a device value to the host when given one.
+_MATERIALIZERS = {
+    "np.asarray", "np.array", "numpy.asarray", "numpy.array",
+    "np.copy", "numpy.copy",
+}
+_TRANSFER_CALLS = {
+    "jax.device_get", "device_get", "jax.device_put", "device_put",
+}
+#: jnp/lax namespaces whose call results are traced values inside a jit.
+_TRACED_NAMESPACES = ("jnp.", "jax.numpy.", "lax.", "jax.lax.")
+
+_CONST_TYPES = (ast.Constant,)
+
+
+def _is_constant_expr(node: ast.AST) -> bool:
+    """Literals and arithmetic over literals — ``int(1e9)`` is fine."""
+    return all(
+        isinstance(n, (ast.Constant, ast.BinOp, ast.UnaryOp, ast.operator,
+                       ast.unaryop, ast.expr_context))
+        for n in ast.walk(node)
+    )
+
+
+def _region_for(line: int, regions: list[HotRegion]) -> HotRegion | None:
+    best: HotRegion | None = None
+    for r in regions:
+        if r.start <= line <= r.end:
+            # innermost (largest start) wins so nested defs resolve right
+            if best is None or r.start > best.start:
+                best = r
+    return best
+
+
+class _TracedValueTracker(ast.NodeVisitor):
+    """Names assigned from jnp./lax. calls within one function body —
+    the cheap local dataflow TRC006 runs on."""
+
+    def __init__(self) -> None:
+        self.traced_names: set[str] = set()
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        if self._traced_rhs(node.value):
+            for tgt in node.targets:
+                for n in ast.walk(tgt):
+                    if isinstance(n, ast.Name):
+                        self.traced_names.add(n.id)
+        self.generic_visit(node)
+
+    def _traced_rhs(self, value: ast.AST) -> bool:
+        for n in ast.walk(value):
+            if isinstance(n, ast.Call):
+                name = dotted_name(n.func)
+                if any(name.startswith(ns) for ns in _TRACED_NAMESPACES):
+                    return True
+        return False
+
+
+def _expr_is_traced(node: ast.AST, traced_names: set[str]) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name) and n.id in traced_names:
+            return True
+        if isinstance(n, ast.Call):
+            name = dotted_name(n.func)
+            if any(name.startswith(ns) for ns in _TRACED_NAMESPACES):
+                return True
+    return False
+
+
+def check_transfer(src: SourceFile) -> list[Finding]:
+    regions = hot_regions(src)
+    if not regions:
+        return []
+    findings: list[Finding] = []
+
+    def emit(rule: str, node: ast.AST, msg: str) -> None:
+        f = src.finding(rule, node, msg)
+        if f is not None:
+            findings.append(f)
+
+    for node in ast.walk(src.tree):
+        line = getattr(node, "lineno", None)
+        if line is None:
+            continue
+        region = _region_for(line, regions)
+        if region is None:
+            continue
+
+        if isinstance(node, ast.Call):
+            fname = dotted_name(node.func)
+            # TRC001: .item() on anything
+            if isinstance(node.func, ast.Attribute) and node.func.attr == "item":
+                emit("TRC001", node,
+                     f"hot region '{region.name}': .item() forces a "
+                     "device->host sync per call")
+            # TRC002: float()/int()/bool() with ANY non-constant argument
+            # (``not all`` — one literal arg must not whitelist the call)
+            elif fname in ("float", "int", "bool") and node.args and not all(
+                _is_constant_expr(a) for a in node.args
+            ):
+                emit("TRC002", node,
+                     f"hot region '{region.name}': {fname}() on a device "
+                     "value syncs; hoist it out of the hot region or mark "
+                     "the intentional sync with an ok-pragma")
+            # TRC003: host materialization
+            elif fname in _MATERIALIZERS:
+                emit("TRC003", node,
+                     f"hot region '{region.name}': {fname}() materializes "
+                     "its argument on the host")
+            # TRC004: explicit transfer primitives
+            elif fname in _TRANSFER_CALLS:
+                emit("TRC004", node,
+                     f"hot region '{region.name}': {fname}() is a "
+                     "host<->device transfer inside the hot path")
+            # TRC005: print
+            elif fname == "print":
+                emit("TRC005", node,
+                     f"hot region '{region.name}': print() syncs device-"
+                     "array arguments and serializes dispatch")
+
+    # TRC006: per traced-function dataflow.
+    for region in regions:
+        if not region.traced or region.node is None:
+            continue
+        tracker = _TracedValueTracker()
+        for stmt in getattr(region.node, "body", []):
+            tracker.visit(stmt)
+        # Only names provably produced by jnp./lax. calls count as traced
+        # here: treating every parameter as traced flags the benign
+        # container iterations (``for fold in folds:``) and static-config
+        # branches (``if axis_name is not None:``) that pytree-shaped
+        # kernel signatures are full of — precision over recall for a rule
+        # that gates CI.
+        traced_names = tracker.traced_names
+        for n in ast.walk(region.node):
+            if isinstance(n, (ast.If, ast.While)):
+                if _expr_is_traced(n.test, traced_names):
+                    f = src.finding(
+                        "TRC006", n,
+                        f"traced function '{region.name}': Python "
+                        "if/while on a traced value concretizes at trace "
+                        "time — use lax.cond/lax.while_loop/jnp.where",
+                    )
+                    if f is not None:
+                        findings.append(f)
+            elif isinstance(n, ast.For):
+                if _expr_is_traced(n.iter, traced_names):
+                    f = src.finding(
+                        "TRC006", n,
+                        f"traced function '{region.name}': Python for "
+                        "over a traced value unrolls/concretizes — use "
+                        "lax.fori_loop or lax.scan",
+                    )
+                    if f is not None:
+                        findings.append(f)
+    return findings
+
+

@@ -22,9 +22,11 @@ from ..graph.csr import DeviceGraph, Graph, build_device_graph, INF_DIST, NO_PAR
 from ..graph.ell import PullGraph, build_pull_graph
 from ..ops.pull import relax_pull_superstep
 from ..ops.relax import BfsState, init_batched_state, relax_superstep_batched
+from ..analysis.runtime import traced
 
 
 @functools.partial(jax.jit, static_argnames=("num_vertices", "max_levels"))
+@traced("multisource._bfs_multi_fused")
 def _bfs_multi_fused(src, dst, sources, num_vertices: int, max_levels: int) -> BfsState:
     state = init_batched_state(num_vertices, sources)
 
@@ -38,6 +40,7 @@ def _bfs_multi_fused(src, dst, sources, num_vertices: int, max_levels: int) -> B
 
 
 @functools.partial(jax.jit, static_argnames=("num_vertices", "max_levels"))
+@traced("multisource._bfs_multi_pull_fused")
 def _bfs_multi_pull_fused(
     ell0, folds, sources, num_vertices: int, max_levels: int
 ) -> BfsState:

@@ -74,6 +74,7 @@ def frontier_table(state: BfsState) -> jax.Array:
     return jnp.where(state.frontier, ids, INT32_MAX)
 
 
+# bfs_tpu: hot traced
 def pull_candidates(frontier_tab: jax.Array, ell0: jax.Array, folds) -> jax.Array:
     """Min active in-neighbour id per vertex: int32[V+1] (slot V = INF).
 
@@ -127,6 +128,7 @@ def unpack_frontier_blocks(
     return unpack_std(words, num_blocks * num_words * 32) != 0
 
 
+# bfs_tpu: hot traced
 def relax_pull_superstep(
     state: BfsState,
     ell0: jax.Array,

@@ -67,6 +67,7 @@ def init_state(num_vertices: int, source, *, sentinel: bool = True) -> BfsState:
     return BfsState(dist, parent, frontier, jnp.int32(0), jnp.bool_(True))
 
 
+# bfs_tpu: hot traced
 def apply_candidates(
     state: BfsState,
     cand_parent: jax.Array,
@@ -88,6 +89,7 @@ def apply_candidates(
     return BfsState(dist, parent, improved, new_level, changed)
 
 
+# bfs_tpu: hot traced
 def relax_superstep(
     state: BfsState,
     src: jax.Array,
@@ -136,6 +138,7 @@ def init_batched_state(
     return BfsState(dist, parent, frontier, jnp.int32(0), jnp.bool_(True))
 
 
+# bfs_tpu: hot traced
 def relax_superstep_batched(
     state: BfsState,
     src: jax.Array,

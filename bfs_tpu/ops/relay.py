@@ -102,6 +102,7 @@ def _stage_slice(masks_flat: jax.Array, st: StageSpec) -> jax.Array:
 LANES = 128
 
 
+# bfs_tpu: hot traced
 def apply_benes_std(
     words: jax.Array, masks_flat: jax.Array, table: tuple[StageSpec, ...],
     n: int,
@@ -248,6 +249,7 @@ def _word_tournament(wv: jax.Array):
     return f[0], [pl[0] for pl in planes]
 
 
+# bfs_tpu: hot traced
 def rowmin_candidates(
     l1words: jax.Array, valid_words: jax.Array, in_classes, vr: int
 ) -> jax.Array:
@@ -312,6 +314,7 @@ def apply_relay_candidates(state: RelayState, cand: jax.Array) -> RelayState:
     return RelayState(dist, parent, fwords, new_level, newly.any())
 
 
+# bfs_tpu: hot traced
 def relay_superstep_words(
     state: RelayState,
     *,

@@ -115,6 +115,7 @@ def _stage_select(m: jax.Array, st: StageSpec, n: int) -> jax.Array:
     return jnp.uint32(0) - mb.astype(jnp.uint32)
 
 
+# bfs_tpu: hot traced
 def apply_benes_elem(
     x: jax.Array, masks_flat: jax.Array, table: tuple[StageSpec, ...], n: int
 ) -> jax.Array:
@@ -181,6 +182,7 @@ def _tournament(xv: jax.Array, axis_rows: int):
     return f[:, 0, :], [pl[:, 0, :] for pl in planes]
 
 
+# bfs_tpu: hot traced
 def rowmin_elem(
     l1: jax.Array, valid_words: jax.Array, in_classes, vr: int,
     plane_offsets, pt: int,
@@ -218,6 +220,7 @@ def rowmin_elem(
     return jnp.concatenate(found_parts, axis=1), rp
 
 
+# bfs_tpu: hot traced
 def elem_superstep(
     state: ElemState,
     *,

@@ -34,12 +34,12 @@ class ExecutableCache:
     report's ``compile_hit_rate``."""
 
     def __init__(self, capacity: int = 64, metrics=None):
-        self.capacity = capacity
-        self.metrics = metrics
+        self.capacity = capacity  # immutable after init
+        self.metrics = metrics  # ServeMetrics is internally locked
         self._lock = threading.Lock()
-        self._cache: OrderedDict[tuple, object] = OrderedDict()
-        self.hits = 0
-        self.misses = 0
+        self._cache: OrderedDict[tuple, object] = OrderedDict()  # guarded-by: _lock
+        self.hits = 0  # guarded-by: _lock
+        self.misses = 0  # guarded-by: _lock
 
     def get(self, key: tuple, build):
         with self._lock:
@@ -86,15 +86,41 @@ class ExecutableCache:
             return len(self._cache)
 
 
+def bucket_for(n: int) -> int:
+    """Pad a tick's source count to a power-of-two bucket so a handful of
+    shapes cover any traffic mix — the executable-cache key's shape
+    element.  O(1) via bit_length (the old linear doubling loop re-ran on
+    EVERY tick; recompile-drift rule RCD004 documents why a computed key
+    element is acceptable here at all: this derivation bounds the distinct
+    shape set to log2(max_batch)+1 buckets)."""
+    n = int(n)
+    return 1 if n <= 1 else 1 << (n - 1).bit_length()
+
+
+# bfs_tpu: hot
 def _state_to_result(state, sources: np.ndarray, num_vertices: int) -> MultiBfsResult:
+    """Materialize ONE reply pull: slice the padded device state down to
+    the real vertex range ON DEVICE, then make a single explicit
+    device_get of exactly (dist, parent, level).  The old path pulled the
+    ENTIRE padded state pytree (including frontier words the reply never
+    reads) and sliced on the host — the same forced-oversized-pull class
+    as the 128 MB bench.py:952 bug ISSUE 2 opened with.  The transfer is
+    the reply materialization itself, hence explicit and pragma-accepted:
+    """
     import jax
 
-    state = jax.device_get(state)
+    dist, parent, levels = jax.device_get(  # bfs_tpu: ok TRC004 the one intended reply pull, device-sliced
+        (
+            state.dist[:, :num_vertices],
+            state.parent[:, :num_vertices],
+            state.level,
+        )
+    )
     return MultiBfsResult(
         sources=sources,
-        dist=np.asarray(state.dist[:, :num_vertices]),
-        parent=np.asarray(state.parent[:, :num_vertices]),
-        num_levels=int(state.level),
+        dist=dist,
+        parent=parent,
+        num_levels=int(levels),  # bfs_tpu: ok TRC002 levels is host-side after the pull above
     )
 
 
@@ -102,12 +128,21 @@ def build_batch_runner(registry, name: str, engine: str, batch: int):
     """AOT-compile (or bind) the batched multi-source program for one
     ``(graph, engine, batch)`` shape.  The returned callable maps a padded
     int32[batch] source array to a host :class:`MultiBfsResult`."""
+    import jax
     import jax.numpy as jnp
 
+    from ..analysis.runtime import guarded_region
     from ..models.multisource import _bfs_multi_fused, _bfs_multi_pull_fused
 
     rec = registry.get(name)
     v = rec.num_vertices
+
+    # The per-tick source upload is EXPLICIT device_put, not an implicit
+    # jnp.asarray conversion: under the runtime transfer guard
+    # (BFS_TPU_TRANSFER_GUARD=1, jax.transfer_guard("disallow")) implicit
+    # host->device transfers raise while intended explicit ones pass —
+    # the serving tick declares its one upload and its one pull, and the
+    # guard proves there are no others.
 
     if engine == "pull":
         ell0, folds = registry.acquire(name, engine)
@@ -115,12 +150,14 @@ def build_batch_runner(registry, name: str, engine: str, batch: int):
             ell0, folds, jnp.zeros((batch,), jnp.int32), v, v
         ).compile()
 
+        # bfs_tpu: hot
         def run(sources: np.ndarray) -> MultiBfsResult:
             # Re-acquire per call: eviction may have dropped the operands,
             # and acquire re-uploads same-shaped buffers the executable
             # accepts unchanged.
             ell0, folds = registry.acquire(name, engine)
-            state = compiled(ell0, folds, jnp.asarray(sources))
+            with guarded_region(f"serve.device_batch/{name}/pull"):
+                state = compiled(ell0, folds, jax.device_put(sources))  # bfs_tpu: ok TRC004 explicit per-tick source upload
             return _state_to_result(state, sources, v)
 
         return run
@@ -131,9 +168,11 @@ def build_batch_runner(registry, name: str, engine: str, batch: int):
             src, dst, jnp.zeros((batch,), jnp.int32), v, v
         ).compile()
 
+        # bfs_tpu: hot
         def run(sources: np.ndarray) -> MultiBfsResult:
             src, dst = registry.acquire(name, engine)
-            state = compiled(src, dst, jnp.asarray(sources))
+            with guarded_region(f"serve.device_batch/{name}/push"):
+                state = compiled(src, dst, jax.device_put(sources))  # bfs_tpu: ok TRC004 explicit per-tick source upload
             return _state_to_result(state, sources, v)
 
         return run

@@ -76,14 +76,14 @@ class GraphRegistry:
         layout_cache=None,
     ):
         self._lock = threading.RLock()
-        self._graphs: dict[str, RegisteredGraph] = {}
+        self._graphs: dict[str, RegisteredGraph] = {}  # guarded-by: _lock
         # (name, engine) -> (bytes, operands-ref); insertion order = LRU.
         self._resident: OrderedDict[tuple[str, str], tuple[int, object]] = (
             OrderedDict()
-        )
-        self.device_budget_bytes = device_budget_bytes
-        self.metrics = metrics
-        self.evictions = 0
+        )  # guarded-by: _lock
+        self.device_budget_bytes = device_budget_bytes  # immutable after init
+        self.metrics = metrics  # guarded-by: _lock
+        self.evictions = 0  # guarded-by: _lock
         # Persistent layout bundles: a LayoutCache, a directory path, or
         # None (in-process memoization only — the default, so tests and
         # embedders opt in to disk writes explicitly).
@@ -186,11 +186,22 @@ class GraphRegistry:
             layout = rec.layouts.setdefault(engine, layout)
         return layout
 
+    def attach_metrics(self, metrics) -> None:
+        """Adopt a metrics sink unless one is already attached.  The
+        lock-guarded form of the ``if registry.metrics is None:
+        registry.metrics = ...`` handoff servers used to do bare — two
+        servers attaching to one shared registry raced it (LCK001)."""
+        with self._lock:
+            if self.metrics is None:
+                self.metrics = metrics
+
     def _note_disk(self, info: dict) -> None:
-        if self.metrics is not None and info.get("cache") == "hit":
-            self.metrics.bump("layout_disk_hits")
-        elif self.metrics is not None and info.get("cache") == "miss":
-            self.metrics.bump("layout_disk_misses")
+        with self._lock:  # metrics ref is shared; snapshot it under the lock
+            metrics = self.metrics
+        if metrics is not None and info.get("cache") == "hit":
+            metrics.bump("layout_disk_hits")
+        elif metrics is not None and info.get("cache") == "miss":
+            metrics.bump("layout_disk_misses")
 
     def _build_pull(self, graph: Graph) -> PullGraph:
         if self.layout_cache is None:
@@ -247,6 +258,7 @@ class GraphRegistry:
             self._resident[key] = (nbytes, operands)
             return operands
 
+    # bfs_tpu: holds _lock
     def _make_room(self, incoming: int, *, keep) -> None:
         if self.device_budget_bytes is None:
             return
@@ -257,6 +269,7 @@ class GraphRegistry:
             victim = next(k for k in self._resident if k != keep)
             self._evict(victim)
 
+    # bfs_tpu: holds _lock
     def _evict(self, key: tuple[str, str]) -> None:
         name, engine = key
         self._resident.pop(key)  # drops OUR reference to the operands

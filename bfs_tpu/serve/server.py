@@ -51,7 +51,12 @@ from ..models.bfs import check_sources
 from ..models.multisource import MultiBfsResult, collapse_multi_source
 from ..resilience.retry import RetryPolicy, retry_call
 from ..utils.metrics import QueryRecord, ServeMetrics
-from .executor import ExecutableCache, build_batch_runner, run_oracle_batch
+from .executor import (
+    ExecutableCache,
+    bucket_for,
+    build_batch_runner,
+    run_oracle_batch,
+)
 from .registry import ENGINES, GraphRegistry
 
 #: Default device-path retry shape: short delays (a serving tick is
@@ -107,15 +112,10 @@ class _Request:
     record: QueryRecord = field(default_factory=QueryRecord)
 
 
-def _bucket(n: int) -> int:
-    """Pad a tick's source count to a power-of-two bucket so a handful of
-    shapes cover any traffic mix (the coalescing budget, not this function,
-    bounds ``n``; a single oversized multi-source query is allowed through
-    as its own batch)."""
-    b = 1
-    while b < n:
-        b *= 2
-    return b
+# Batch padding lives with the executable cache it keys:
+# :func:`bfs_tpu.serve.executor.bucket_for` (the coalescing budget, not the
+# bucket function, bounds the input; a single oversized multi-source query
+# is allowed through as its own batch).
 
 
 class BfsServer:
@@ -146,8 +146,10 @@ class BfsServer:
         self.registry = (
             registry if registry is not None else GraphRegistry(metrics=self.metrics)
         )
-        if self.registry.metrics is None:
-            self.registry.metrics = self.metrics
+        # Lock-guarded handoff: registry.metrics is shared state and a
+        # second server attaching to the same registry raced the bare
+        # read-then-write this used to be (found by the LCK pass).
+        self.registry.attach_metrics(self.metrics)
         self.default_engine = engine
         self.max_batch = int(max_batch)
         self.tick_s = float(tick_s)
@@ -157,13 +159,13 @@ class BfsServer:
             retry_policy if retry_policy is not None else DEFAULT_RETRY_POLICY
         )
         self.exe_cache = ExecutableCache(exe_cache_size, metrics=self.metrics)
-        self._result_cache: OrderedDict[tuple, tuple] = OrderedDict()
-        self._result_cache_size = int(result_cache_size)
         self._lock = threading.Lock()
-        self._cond = threading.Condition(self._lock)
-        self._pending: deque[_Request] = deque()
-        self._paused = False
-        self._closed = False
+        self._cond = threading.Condition(self._lock)  # holding _cond == holding _lock
+        self._result_cache: OrderedDict[tuple, tuple] = OrderedDict()  # guarded-by: _lock
+        self._result_cache_size = int(result_cache_size)  # immutable after init
+        self._pending: deque[_Request] = deque()  # guarded-by: _lock
+        self._paused = False  # guarded-by: _lock
+        self._closed = False  # guarded-by: _lock
         self._thread = threading.Thread(
             target=self._serve_loop, name="bfs-serve", daemon=True
         )
@@ -376,7 +378,7 @@ class BfsServer:
             return
         first = live[0]
         all_sources = np.concatenate([r.sources for r in live])
-        padded = _bucket(all_sources.shape[0])
+        padded = bucket_for(all_sources.shape[0])
         rec = self.registry.get(first.graph)
         compile_hit: bool | None = None
         status = "ok"

@@ -28,7 +28,7 @@ import threading
 from dataclasses import dataclass, field, asdict
 
 _artifact_lock = threading.Lock()
-_artifact_counters: dict[str, int] = {}
+_artifact_counters: dict[str, int] = {}  # guarded-by: _artifact_lock
 
 
 def bump_artifact(name: str, by: int = 1) -> None:
@@ -155,10 +155,10 @@ class ServeMetrics:
         from collections import deque
 
         self._lock = threading.Lock()
-        self.records: deque[QueryRecord] = deque(maxlen=max_records)
-        self.counters: dict[str, int] = {}
-        self._first_ts: float | None = None
-        self._last_ts: float | None = None
+        self.records: deque[QueryRecord] = deque(maxlen=max_records)  # guarded-by: _lock
+        self.counters: dict[str, int] = {}  # guarded-by: _lock
+        self._first_ts: float | None = None  # guarded-by: _lock
+        self._last_ts: float | None = None  # guarded-by: _lock
 
     def bump(self, name: str, by: int = 1) -> None:
         with self._lock:

@@ -1,0 +1,668 @@
+"""Tests for bfs_tpu.analysis: the static rules (each must trip on a
+fixture and stay quiet on its near-miss), the committed-baseline
+mechanism, the repo self-lint (tier-1's "the tree is clean modulo
+baseline" gate), the CLI exit codes, and the runtime sanitizers
+(transfer guard + retrace counters) under JAX_PLATFORMS=cpu."""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from bfs_tpu.analysis import (
+    Baseline,
+    analyze_file,
+    analyze_paths,
+    default_baseline_path,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def lint(tmp_path, code: str, name: str = "snippet.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(code))
+    return analyze_file(str(p), str(tmp_path))
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+# ---------------------------------------------------------------------------
+# Transfer / trace-safety rules.
+# ---------------------------------------------------------------------------
+
+def test_trc001_item_in_hot_region(tmp_path):
+    fs = lint(tmp_path, """
+        # bfs_tpu: hot
+        def tick(x):
+            return x.item()
+        """)
+    assert rules_of(fs) == ["TRC001"]
+
+
+def test_trc001_near_miss_outside_hot_region(tmp_path):
+    fs = lint(tmp_path, """
+        def report(x):
+            return x.item()
+        """)
+    assert fs == []
+
+
+def test_trc002_conversion_trips_constant_does_not(tmp_path):
+    fs = lint(tmp_path, """
+        # bfs_tpu: hot
+        def tick(x):
+            return float(x)
+
+        # bfs_tpu: hot
+        def sized(x):
+            return int(1e9)
+
+        # bfs_tpu: hot
+        def mixed(x):
+            return int(x, 10)
+        """)
+    # One literal argument must not whitelist a mixed call (``int(x, 10)``).
+    assert [(f.rule, f.line) for f in fs] == [("TRC002", 4), ("TRC002", 12)]
+
+
+def test_trc003_materializer(tmp_path):
+    fs = lint(tmp_path, """
+        import numpy as np
+
+        # bfs_tpu: hot
+        def tick(x):
+            return np.asarray(x)
+        """)
+    assert rules_of(fs) == ["TRC003"]
+
+
+def test_trc004_device_get_and_ok_pragma(tmp_path):
+    fs = lint(tmp_path, """
+        import jax
+
+        # bfs_tpu: hot
+        def tick(x):
+            return jax.device_get(x)
+
+        # bfs_tpu: hot
+        def tock(x):
+            return jax.device_get(x)  # bfs_tpu: ok TRC004 intended reply pull
+        """)
+    assert [f.rule for f in fs] == ["TRC004"]
+    assert fs[0].line == 6  # the unsuppressed one
+
+
+def test_trc005_print_in_hot_span(tmp_path):
+    fs = lint(tmp_path, """
+        def bench(run, roots):
+            # bfs_tpu: hot-start
+            for _ in range(3):
+                out = run(roots)
+                print(out)
+            # bfs_tpu: hot-end
+            print("done")  # outside the span: fine
+        """)
+    assert [f.rule for f in fs] == ["TRC005"]
+    assert fs[0].line == 6
+
+
+def test_prg001_overlapping_hot_start_flagged_and_covered(tmp_path):
+    # A duplicated hot-start (or deleted hot-end) must not silently drop
+    # the first span from coverage: the span still polices (TRC003 below
+    # fires in BOTH halves) and PRG001 names the malformed pragma.
+    fs = lint(tmp_path, """
+        import numpy as np
+
+        def bench(x):
+            # bfs_tpu: hot-start
+            a = np.asarray(x)
+            # bfs_tpu: hot-start
+            b = np.asarray(x)
+            # bfs_tpu: hot-end
+            return a, b
+        """)
+    assert rules_of(fs) == ["PRG001", "TRC003"]
+    assert sum(f.rule == "TRC003" for f in fs) == 2
+
+
+def test_trc006_python_branch_on_traced_value(tmp_path):
+    fs = lint(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def step(x):
+            m = jnp.any(x)
+            if m:
+                return x + 1
+            return x
+        """)
+    assert rules_of(fs) == ["TRC006"]
+
+
+def test_trc006_near_miss_container_iteration(tmp_path):
+    # Iterating a pytree container param / static-config branches is the
+    # bread and butter of kernel signatures — must NOT trip.
+    fs = lint(tmp_path, """
+        import jax
+
+        @jax.jit
+        def step(x, folds, axis_name=None):
+            for fold in folds:
+                x = x + fold
+            if axis_name is not None:
+                x = jax.lax.pmin(x, axis_name)
+            return x
+        """)
+    assert fs == []
+
+
+def test_hot_traced_pragma_enables_trc006(tmp_path):
+    fs = lint(tmp_path, """
+        import jax.numpy as jnp
+
+        # bfs_tpu: hot traced
+        def kernel(x):
+            m = jnp.any(x)
+            while m:
+                x = x - 1
+            return x
+        """)
+    assert "TRC006" in rules_of(fs)
+
+
+# ---------------------------------------------------------------------------
+# Recompile-drift rules.
+# ---------------------------------------------------------------------------
+
+def test_rcd001_jit_lambda_in_function(tmp_path):
+    fs = lint(tmp_path, """
+        import jax
+
+        def serve_tick(x):
+            f = jax.jit(lambda a: a + 1)
+            return f(x)
+        """)
+    assert rules_of(fs) == ["RCD001"]
+
+
+def test_rcd001_sees_through_inline_decorator_wrap(tmp_path):
+    # jit(traced("x")(lambda ...)) is exactly as fresh an identity per
+    # call as the bare lambda — the wrapper must not hide it.
+    fs = lint(tmp_path, """
+        import jax
+
+        def serve_tick(x):
+            f = jax.jit(traced("tick")(lambda a: a + 1))
+            return f(x)
+        """)
+    assert rules_of(fs) == ["RCD001"]
+
+
+def test_rcd001_near_miss_module_level(tmp_path):
+    fs = lint(tmp_path, """
+        import jax
+
+        f = jax.jit(lambda a: a + 1)
+
+        def serve_tick(x):
+            return f(x)
+        """)
+    assert fs == []
+
+
+def test_rcd002_computed_static_argnames(tmp_path):
+    fs = lint(tmp_path, """
+        import jax
+
+        def build(fn, names):
+            return jax.jit(fn, static_argnames=tuple(names))
+
+        def build_ok(fn):
+            return jax.jit(fn, static_argnames=("num_vertices",))
+        """)
+    assert [f.rule for f in fs] == ["RCD002"]
+    assert fs[0].line == 5
+
+
+def test_rcd003_jit_in_loop(tmp_path):
+    fs = lint(tmp_path, """
+        import jax
+
+        def sweep(fns, x):
+            outs = []
+            for fn in fns:
+                outs.append(jax.jit(fn)(x))
+            return outs
+        """)
+    assert "RCD003" in rules_of(fs)
+
+
+def test_rcd004_computed_key_element(tmp_path):
+    fs = lint(tmp_path, """
+        def tick(exe_cache, build, n, graph):
+            padded = bucket_for(n)
+            runner, hit = exe_cache.get((graph, padded), build)
+            return runner
+        """)
+    assert rules_of(fs) == ["RCD004"]
+    assert fs[0].severity == "warning"
+
+
+def test_rcd005_underkeyed_build_closure(tmp_path):
+    # ``engine`` is derived per call but missing from the key — two calls
+    # differing only in engine would share one executable.
+    fs = lint(tmp_path, """
+        def tick(exe_cache, registry, graph, n, engine_cfg):
+            padded = n
+            engine = pick_engine(engine_cfg)
+            runner, hit = exe_cache.get(
+                (graph, padded),
+                lambda: build_batch_runner(registry, graph, engine, padded),
+            )
+            return runner
+        """)
+    assert "RCD005" in rules_of(fs)
+    assert any("engine" in f.message for f in fs if f.rule == "RCD005")
+
+
+def test_rcd005_near_miss_fully_keyed(tmp_path):
+    # Same closure with engine in the key — and the ambient ``registry``
+    # handle (a bare parameter) never counts as a key obligation.
+    fs = lint(tmp_path, """
+        def tick(exe_cache, registry, graph, n, engine_cfg):
+            padded = n
+            engine = pick_engine(engine_cfg)
+            runner, hit = exe_cache.get(
+                (graph, engine, padded),
+                lambda: build_batch_runner(registry, graph, engine, padded),
+            )
+            return runner
+        """)
+    assert "RCD005" not in rules_of(fs)
+
+
+# ---------------------------------------------------------------------------
+# Lock-discipline rules.
+# ---------------------------------------------------------------------------
+
+_LOCK_CLASS = """
+    import threading
+
+    class Cache:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._entries = {{}}  # guarded-by: _lock
+
+        def get(self, k):
+            {get_body}
+
+        def put(self, k, v):
+            with self._lock:
+                self._entries[k] = v
+"""
+
+
+def test_lck001_unguarded_access(tmp_path):
+    fs = lint(tmp_path, _LOCK_CLASS.format(get_body="return self._entries.get(k)"))
+    assert rules_of(fs) == ["LCK001"]
+    assert "Cache.get()" in fs[0].message
+
+
+def test_lck001_near_miss_guarded(tmp_path):
+    fs = lint(
+        tmp_path,
+        _LOCK_CLASS.format(
+            get_body="with self._lock:\n                return self._entries.get(k)"
+        ),
+    )
+    assert fs == []
+
+
+def test_lck001_condition_alias_counts_as_lock(tmp_path):
+    fs = lint(tmp_path, """
+        import threading
+
+        class Q:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._cond = threading.Condition(self._lock)
+                self._items = []  # guarded-by: _lock
+
+            def pop(self):
+                with self._cond:
+                    return self._items.pop()
+        """)
+    assert fs == []
+
+
+def test_lck001_holds_pragma(tmp_path):
+    fs = lint(tmp_path, """
+        import threading
+
+        class R:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._resident = {}  # guarded-by: _lock
+
+            # bfs_tpu: holds _lock
+            def _evict(self, k):
+                self._resident.pop(k)
+
+            def release(self, k):
+                with self._lock:
+                    self._evict(k)
+        """)
+    assert fs == []
+
+
+def test_lck001_module_level_global(tmp_path):
+    fs = lint(tmp_path, """
+        import threading
+
+        _lock = threading.Lock()
+        _counters = {}  # guarded-by: _lock
+
+        def bump(name):
+            _counters[name] = _counters.get(name, 0) + 1
+
+        def bump_ok(name):
+            with _lock:
+                _counters[name] = _counters.get(name, 0) + 1
+        """)
+    # One finding per (line, field): bump()'s read+write share a line.
+    assert [f.rule for f in fs] == ["LCK001"]
+    assert "bump()" in fs[0].message
+
+
+def test_lck002_unannotated_mutable_field(tmp_path):
+    fs = lint(tmp_path, """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.pending = []
+        """)
+    assert rules_of(fs) == ["LCK002"]
+    assert fs[0].severity == "warning"
+
+
+def test_lck002_near_miss_no_lock_owned(tmp_path):
+    fs = lint(tmp_path, """
+        class Plain:
+            def __init__(self):
+                self.items = []
+        """)
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# Baseline mechanism.
+# ---------------------------------------------------------------------------
+
+def test_baseline_accepts_and_reports_stale(tmp_path):
+    fs = lint(tmp_path, """
+        # bfs_tpu: hot
+        def tick(x):
+            return x.item()
+        """)
+    [f] = fs
+    bl_path = tmp_path / "baseline.txt"
+    bl_path.write_text(
+        f"{f.rule}  {f.fingerprint()}  accepted for the test\n"
+        "TRC001  deadbeef0000  a stale entry\n"
+    )
+    bl = Baseline.load(str(bl_path))
+    assert bl.accepts(f)
+    assert bl.stale() == ["deadbeef0000"]
+
+
+def test_baseline_fingerprint_survives_line_drift(tmp_path):
+    [f1] = lint(tmp_path, """
+        # bfs_tpu: hot
+        def tick(x):
+            return x.item()
+        """, name="a.py")
+    [f2] = lint(tmp_path, """
+        # a new comment block
+        # pushing everything down
+
+        # bfs_tpu: hot
+        def tick(x):
+            return x.item()
+        """, name="a.py")
+    assert f1.line != f2.line
+    assert f1.fingerprint() == f2.fingerprint()
+
+
+# ---------------------------------------------------------------------------
+# Self-lint: the shipped tree is clean modulo the committed baseline.
+# ---------------------------------------------------------------------------
+
+def test_repo_self_lint_clean_modulo_baseline():
+    paths = [
+        os.path.join(REPO, "bfs_tpu"),
+        os.path.join(REPO, "tools"),
+        os.path.join(REPO, "bench.py"),
+    ]
+    findings = analyze_paths([p for p in paths if os.path.exists(p)], REPO)
+    baseline = Baseline.load(default_baseline_path())
+    fresh_errors = [
+        f for f in findings
+        if f.severity == "error" and not baseline.accepts(f)
+    ]
+    assert fresh_errors == [], "\n".join(f.render() for f in fresh_errors)
+
+
+def test_repo_has_expected_hot_coverage():
+    """The regions the ISSUE names must actually be declared hot —
+    a deleted pragma should fail loudly here, not silently shrink
+    coverage."""
+    from bfs_tpu.analysis.core import SourceFile, hot_regions
+
+    expectations = {
+        "bfs_tpu/ops/relax.py": "relax_superstep",
+        "bfs_tpu/ops/pull.py": "relax_pull_superstep",
+        "bfs_tpu/serve/executor.py": "_state_to_result",
+    }
+    for rel, fn_name in expectations.items():
+        src = SourceFile(os.path.join(REPO, rel), REPO)
+        names = {r.name for r in hot_regions(src)}
+        assert fn_name in names, (rel, sorted(names))
+    bench = SourceFile(os.path.join(REPO, "bfs_tpu/bench.py"), REPO)
+    spans = [r for r in hot_regions(bench) if r.name.startswith("span@")]
+    assert len(spans) >= 2, "bench timed-repeat hot spans went missing"
+
+
+# ---------------------------------------------------------------------------
+# CLI.
+# ---------------------------------------------------------------------------
+
+def _run_cli(args, cwd=REPO):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"), *args],
+        capture_output=True, text=True, cwd=cwd, timeout=120,
+    )
+
+
+def test_cli_exit_zero_on_repo():
+    proc = _run_cli([])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_exit_nonzero_on_each_rule_fixture(tmp_path):
+    fixtures = {
+        "trc001.py": "# bfs_tpu: hot\ndef f(x):\n    return x.item()\n",
+        "trc002.py": "# bfs_tpu: hot\ndef f(x):\n    return float(x)\n",
+        "trc003.py": "import numpy as np\n# bfs_tpu: hot\ndef f(x):\n    return np.asarray(x)\n",
+        "trc004.py": "import jax\n# bfs_tpu: hot\ndef f(x):\n    return jax.device_get(x)\n",
+        "trc005.py": "def f(x):\n    # bfs_tpu: hot-start\n    print(x)\n    # bfs_tpu: hot-end\n",
+        "trc006.py": (
+            "import jax\nimport jax.numpy as jnp\n@jax.jit\ndef f(x):\n"
+            "    m = jnp.any(x)\n    if m:\n        return x\n    return x + 1\n"
+        ),
+        "rcd001.py": "import jax\ndef f(x):\n    return jax.jit(lambda a: a)(x)\n",
+        "rcd002.py": (
+            "import jax\ndef f(fn, names):\n"
+            "    return jax.jit(fn, static_argnames=tuple(names))\n"
+        ),
+        "rcd003.py": (
+            "import jax\ndef f(fns, x):\n    return [jax.jit(g)(x) for g in fns]\n"
+        ),
+        "rcd005.py": (
+            "def f(exe_cache, g, cfg, n):\n    padded = n\n    eng = pick(cfg)\n"
+            "    return exe_cache.get((g, padded), lambda: build(g, eng, padded))\n"
+        ),
+        "lck001.py": (
+            "import threading\nclass C:\n    def __init__(self):\n"
+            "        self._lock = threading.Lock()\n"
+            "        self.d = {}  # guarded-by: _lock\n"
+            "    def g(self):\n        return self.d\n"
+        ),
+    }
+    assert len(fixtures) >= 8
+    for name, code in fixtures.items():
+        p = tmp_path / name
+        p.write_text(code)
+        proc = _run_cli([str(p), "--root", str(tmp_path), "--no-baseline"])
+        assert proc.returncode == 1, (name, proc.stdout, proc.stderr)
+        # RCD003's list-comp fixture legitimately also reports RCD001.
+        expected = name.split(".")[0].upper()
+        assert expected in proc.stdout, (name, proc.stdout)
+
+
+def test_cli_rules_catalog():
+    proc = _run_cli(["--rules"])
+    assert proc.returncode == 0
+    for rule in ("TRC001", "TRC006", "RCD001", "RCD005", "LCK001", "LCK002"):
+        assert rule in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# Runtime sanitizers (CPU jax).
+# ---------------------------------------------------------------------------
+
+def test_transfer_guard_off_by_default(monkeypatch):
+    monkeypatch.delenv("BFS_TPU_TRANSFER_GUARD", raising=False)
+    import jax.numpy as jnp
+
+    from bfs_tpu.analysis.runtime import guarded_region, transfer_guard_level
+
+    assert transfer_guard_level() is None
+    with guarded_region("test"):
+        assert jnp.arange(4)[0].item() == 0  # no guard: sync is allowed
+
+
+def test_transfer_guard_catches_item(monkeypatch):
+    monkeypatch.setenv("BFS_TPU_TRANSFER_GUARD", "1")
+    import jax.numpy as jnp
+
+    from bfs_tpu.analysis.runtime import guarded_region
+
+    a = jnp.arange(8)
+    with pytest.raises(Exception, match="transfer-guard:deliberate"):
+        with guarded_region("deliberate"):
+            a[0].item()
+    # The guard is scoped: the same conversion outside raises nothing.
+    assert a[0].item() == 0
+
+
+def test_transfer_guard_leaves_unrelated_errors_alone(monkeypatch):
+    """Only genuine guard violations get the region-name relabel; a
+    workload error raised inside the region must pass through untouched
+    (downstream error classifiers match on message text)."""
+    monkeypatch.setenv("BFS_TPU_TRANSFER_GUARD", "1")
+    from bfs_tpu.analysis.runtime import guarded_region
+
+    with pytest.raises(ValueError) as exc_info:
+        with guarded_region("some-region"):
+            raise ValueError("workload exploded")
+    assert str(exc_info.value) == "workload exploded"
+
+
+def test_transfer_guard_allows_explicit_transfers(monkeypatch):
+    monkeypatch.setenv("BFS_TPU_TRANSFER_GUARD", "1")
+    import jax
+    import numpy as np
+
+    from bfs_tpu.analysis.runtime import guarded_region
+
+    with guarded_region("explicit-ok"):
+        dev = jax.device_put(np.arange(4))
+        # NB ``dev * 2`` would implicitly upload the host scalar 2 and
+        # trip the guard — the eager op must stay device-only.
+        host = jax.device_get(dev + dev)
+    assert list(host) == [0, 2, 4, 6]
+
+
+def test_serve_batch_path_guard_clean(monkeypatch):
+    """The serve device batch path must run transfer-clean under the
+    guard: one explicit upload, one explicit device-sliced pull."""
+    monkeypatch.setenv("BFS_TPU_TRANSFER_GUARD", "1")
+    import numpy as np
+
+    from bfs_tpu.graph.generators import rmat_graph
+    from bfs_tpu.oracle.bfs import queue_bfs
+    from bfs_tpu.serve import BfsServer
+
+    graph = rmat_graph(6, 4, seed=3)
+    with BfsServer(engine="pull", max_batch=4) as server:
+        server.register("g", graph)
+        reply = server.query("g", 0).result(timeout=120)
+    expect = queue_bfs(graph, 0)[0]
+    assert np.array_equal(reply.dist, expect)
+
+
+def test_retrace_counter_names_function():
+    import jax
+    import jax.numpy as jnp
+
+    from bfs_tpu.analysis.runtime import (
+        format_retrace_report,
+        retrace_report,
+        traced,
+    )
+
+    @jax.jit
+    @traced("test.retrace_probe")
+    def f(x):
+        return x * 2
+
+    before = retrace_report().get("test.retrace_probe", 0)
+    f(jnp.arange(4))
+    f(jnp.arange(4))  # same shape: cached, no retrace
+    mid = retrace_report()["test.retrace_probe"]
+    assert mid == before + 1
+    f(jnp.arange(8))  # new shape: one more trace
+    after = retrace_report()["test.retrace_probe"]
+    assert after == mid + 1
+    report = format_retrace_report(baseline={"test.retrace_probe": before})
+    assert "test.retrace_probe" in report
+    assert f"+{after - before}" in report
+
+
+def test_hot_region_decorator_registers_and_statically_hot(tmp_path):
+    from bfs_tpu.analysis.runtime import hot_region, hot_registry
+
+    @hot_region(name="test.region")
+    def fn(x):
+        return x
+
+    assert fn(3) == 3
+    assert "test.region" in hot_registry()
+    fs = lint(tmp_path, """
+        from bfs_tpu.analysis.runtime import hot_region
+
+        @hot_region
+        def tick(x):
+            return x.item()
+        """)
+    assert rules_of(fs) == ["TRC001"]

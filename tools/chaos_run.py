@@ -235,9 +235,20 @@ def main(argv=None) -> int:
     seed = args.seed if args.seed is not None else int(time.time())
     log(f"kill-schedule seed: {seed}")
     rng = random.Random(seed)
-    if args.mode == "bench":
-        return chaos_bench(args, rng)
-    return chaos_loadgen(args, rng)
+    rc = chaos_bench(args, rng) if args.mode == "bench" else chaos_loadgen(args, rng)
+    # Retrace-counter report (bfs_tpu.analysis runtime sanitizer): the
+    # driver itself runs no traced programs — a non-empty table here means
+    # an in-process leak; the bench/loadgen SUBPROCESSES print their own
+    # tables in the captured logs above.  Importing tools/lint.py installs
+    # its stub bfs_tpu parent package (ONE shared bootstrap), so printing
+    # the table doesn't pay the engine-stack jax import at exit.
+    sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+    import lint  # noqa: F401  (side effect: stub parent package)
+
+    from bfs_tpu.analysis.runtime import format_retrace_report
+
+    log(format_retrace_report())
+    return rc
 
 
 if __name__ == "__main__":

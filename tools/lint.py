@@ -1,0 +1,30 @@
+"""Fast project-lint entry point: ``python tools/lint.py`` ==
+``python -m bfs_tpu.analysis``, minus the jax import.
+
+The analyzers are stdlib-only (ast + tokenize), but ``python -m`` has to
+execute the parent ``bfs_tpu/__init__`` first, which imports the engine
+stack (~1.5 s of jax).  This wrapper installs a stub parent package so
+``bfs_tpu.analysis`` loads alone — the lint stays sub-100ms, which is
+what makes it cheap enough to run on every commit.  All flags pass
+through.
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import sys
+import types
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+if "bfs_tpu" not in sys.modules:
+    sys.path.insert(0, ROOT)
+    _pkg = types.ModuleType("bfs_tpu")
+    _pkg.__path__ = [os.path.join(ROOT, "bfs_tpu")]
+    sys.modules["bfs_tpu"] = _pkg
+
+main = importlib.import_module("bfs_tpu.analysis.__main__").main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -180,6 +180,10 @@ def main(argv=None) -> int:
             flush=True,
         )
         pre = dict(server.metrics.report()["counters"])
+        from bfs_tpu.analysis.runtime import format_retrace_report, retrace_report
+
+        retrace_warm = retrace_report()  # post-warmup snapshot: steady
+        # state must not move any of these counters
 
         queries = make_queries(rng, v, args.requests, args)
         cursor = [0]
@@ -253,6 +257,10 @@ def main(argv=None) -> int:
         "server_report": report,
     }
     print(json.dumps(out, indent=2, sort_keys=True))
+    # Name the function that retraced: a sub-100% hit rate plus a non-zero
+    # drift line turns "something recompiled" into "THIS program recompiled"
+    # (bfs_tpu.analysis runtime sanitizer).
+    print(format_retrace_report(baseline=retrace_warm), file=sys.stderr)
     for msg in wrong[:10]:
         print(f"WRONG: {msg}", file=sys.stderr)
     if wrong:
