@@ -305,18 +305,29 @@ def run_cell(spec: dict) -> dict:
             s_new = jnp.int32(int(rg.old2new[source]))
             run = lambda: eng._fused(s_new, rg.num_vertices)  # noqa: E731
         elif mode == "pull":
+            from .ops.packed import packed_parent_fits, resolve_packed
+
             pg = load_or_build_pull(dg, key)
             from .graph.ell import device_ell
 
             ell0, folds = device_ell(pg)
+            # Packed fused-word carry when V fits (ops/packed.py); a
+            # >62-level cell would fail its oracle assertion rather than
+            # ship silently truncated numbers.
             run = lambda: _bfs_pull_fused(  # noqa: E731
-                ell0, folds, jnp.int32(source), pg.num_vertices, pg.num_vertices
+                ell0, folds, jnp.int32(source), pg.num_vertices,
+                pg.num_vertices,
+                resolve_packed(packed_parent_fits(pg.num_vertices)),
             )
         else:
+            from .ops.packed import packed_parent_fits, resolve_packed
+
             src = jnp.asarray(dg.src)
             dst = jnp.asarray(dg.dst)
             run = lambda: _bfs_fused(  # noqa: E731
-                src, dst, jnp.int32(source), dg.num_vertices, dg.num_vertices
+                src, dst, jnp.int32(source), dg.num_vertices,
+                dg.num_vertices,
+                resolve_packed(packed_parent_fits(dg.num_vertices)),
             )
         state = run()
         levels = int(state.level)  # sync

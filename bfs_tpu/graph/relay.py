@@ -623,7 +623,10 @@ class ShardedRelayGraph:
     broadcast, Beneš edge net and src-id tables — while all shards share the
     SAME static shapes (class slices, network sizes, stage tables), so one
     `shard_map` program runs everywhere and only the mask/table DATA differs
-    per device (stacked on axis 0).  The per-superstep exchange is the
+    per device (stacked on axis 0).  Ownership is CLASS-BALANCED (each
+    in-degree class dealt across shards — see the builder), so the shared
+    shapes are ~1/n of the single-chip layout instead of approaching it on
+    skewed graphs.  The per-superstep exchange is the
     bit-packed frontier all-gather (1 bit/vertex over ICI); with v4's
     standard packing the gathered words ARE the global standard-packed
     frontier (relabeling is shard-major), so they feed each shard's vperm
@@ -676,10 +679,24 @@ def build_sharded_relay_graph(
 ) -> ShardedRelayGraph:
     """Build per-shard relay layouts (v4) with a unified static structure.
 
-    Vertices are partitioned into ``num_shards`` contiguous original-id
-    ranges (the sharded pull engine's ownership rule), then relabeled within
-    each shard so in-degree classes are contiguous; the global new-id space
-    is the concatenation of shard blocks.
+    Ownership is CLASS-BALANCED (per-shard class structure): each
+    in-degree class is dealt across the shards in equal contiguous chunks
+    (ascending original id within a chunk), so every shard's per-width
+    class count is within 1 of ``count/n`` and the shared static envelope
+    (max over shards) is TIGHT.  The old contiguous-original-id partition
+    let a skewed degree distribution concentrate a class in one shard,
+    making the unified max-over-shards counts approach the SINGLE-CHIP
+    class sizes — every shard then padded, routed and row-minned close to
+    the whole graph's slot space, the x8 padded-work amplification behind
+    the non-monotone sharded scaling of BENCHMARKS row 12 (VERDICT r5
+    weak #5).  With balanced classes, per-shard slots shrink ~1/n and the
+    compact frontier exchange stays flat (it ships real words only,
+    parallel/sharded._own_word_table).
+
+    Vertices are relabeled within each shard so in-degree classes are
+    contiguous; the global new-id space is the concatenation of shard
+    blocks (ownership itself is an arbitrary bijection — every consumer
+    goes through ``old2new``/``new2old``).
     """
     _ensure_build_log()
     if not benes.native_available():
@@ -697,13 +714,22 @@ def build_sharded_relay_graph(
     v = graph.num_vertices
     e = int(src.shape[0])
     n = num_shards
-    vblock = max((v + n - 1) // n, 1)
-    shard_of_old = np.minimum(np.arange(v, dtype=np.int64) // vblock, n - 1)
 
     indeg = np.bincount(dst, minlength=v)
     in_w = _class_width(indeg)
 
+    # ---- class-balanced ownership (see docstring) --------------------------
+    shard_of_old = np.empty(v, dtype=np.int64)
+    order_v = np.argsort(in_w, kind="stable")
+    pos = 0
+    for wv, cnt in zip(*np.unique(in_w, return_counts=True)):
+        ids = order_v[pos : pos + cnt]
+        shard_of_old[ids] = (np.arange(cnt, dtype=np.int64) * n) // cnt
+        pos += cnt
+    assert pos == v
+
     # ---- unified in-classes: per-width counts maxed over shards ------------
+    # (The max is now within 1 of the mean by construction.)
     widths_all = np.unique(in_w)
     counts = np.stack(
         [
@@ -742,9 +768,18 @@ def build_sharded_relay_graph(
             old2new[ids] = newids
             pos += cnt
 
-    # ---- edge shard slices (dst-sorted, contiguous original ownership) -----
-    bounds = np.searchsorted(dst, np.arange(n + 1, dtype=np.int64) * vblock)
-    bounds[-1] = e
+    # ---- edge shard slices: grouped by the OWNER of the destination --------
+    # Ownership is class-balanced (not contiguous in original ids), so the
+    # per-shard edge sets come from a stable group-by instead of a
+    # searchsorted over the dst-sorted array; dst order is preserved
+    # within each shard's slice.
+    owner_e = shard_of_old[dst]
+    order_e = np.argsort(owner_e, kind="stable")
+    src = src[order_e]
+    dst = dst[order_e]
+    bounds = np.concatenate(
+        [[0], np.cumsum(np.bincount(owner_e, minlength=n))]
+    ).astype(np.int64)
 
     # ---- unified out-classes over per-shard out-degrees --------------------
     out_sparse = []

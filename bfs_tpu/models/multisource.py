@@ -25,9 +25,34 @@ from ..ops.relax import BfsState, init_batched_state, relax_superstep_batched
 from ..analysis.runtime import traced
 
 
-@functools.partial(jax.jit, static_argnames=("num_vertices", "max_levels"))
+@functools.partial(
+    jax.jit, static_argnames=("num_vertices", "max_levels", "packed")
+)
 @traced("multisource._bfs_multi_fused")
-def _bfs_multi_fused(src, dst, sources, num_vertices: int, max_levels: int) -> BfsState:
+def _bfs_multi_fused(
+    src, dst, sources, num_vertices: int, max_levels: int,
+    packed: bool = False,
+) -> BfsState:
+    """``packed`` carries the fused ``level:6|parent:26`` word state
+    (ops/packed.py) through the loop — half the per-superstep dist/parent
+    HBM bytes — capped at PACKED_MAX_LEVELS and unpacked ONCE at loop
+    exit, so the returned BfsState is identical wherever the cap was not
+    hit (callers detect a cap exit via ``packed_truncated``)."""
+    if packed:
+        from ..ops.packed import packed_cap
+        from ..ops.relax import (
+            init_packed_batched_state,
+            relax_superstep_batched_packed,
+            unpack_bfs_state,
+        )
+
+        cap = packed_cap(max_levels)
+        out = jax.lax.while_loop(
+            lambda s: s.changed & (s.level < cap),
+            lambda s: relax_superstep_batched_packed(s, src, dst),
+            init_packed_batched_state(num_vertices, sources),
+        )
+        return unpack_bfs_state(out)
     state = init_batched_state(num_vertices, sources)
 
     def cond(s: BfsState):
@@ -39,14 +64,30 @@ def _bfs_multi_fused(src, dst, sources, num_vertices: int, max_levels: int) -> B
     return jax.lax.while_loop(cond, body, state)
 
 
-@functools.partial(jax.jit, static_argnames=("num_vertices", "max_levels"))
+@functools.partial(
+    jax.jit, static_argnames=("num_vertices", "max_levels", "packed")
+)
 @traced("multisource._bfs_multi_pull_fused")
 def _bfs_multi_pull_fused(
-    ell0, folds, sources, num_vertices: int, max_levels: int
+    ell0, folds, sources, num_vertices: int, max_levels: int,
+    packed: bool = False,
 ) -> BfsState:
     """Batched pull: the frontier table carries a leading sources axis and
     the ELL gathers broadcast over it (ops/pull.py pull_candidates), so all
-    S trees advance in lock-step supersteps of one compiled loop."""
+    S trees advance in lock-step supersteps of one compiled loop.
+    ``packed`` as in :func:`_bfs_multi_fused`."""
+    if packed:
+        from ..ops.packed import packed_cap
+        from ..ops.pull import relax_pull_superstep_packed
+        from ..ops.relax import init_packed_batched_state, unpack_bfs_state
+
+        cap = packed_cap(max_levels)
+        out = jax.lax.while_loop(
+            lambda s: s.changed & (s.level < cap),
+            lambda s: relax_pull_superstep_packed(s, ell0, folds),
+            init_packed_batched_state(num_vertices, sources),
+        )
+        return unpack_bfs_state(out)
     state = init_batched_state(num_vertices, sources)
 
     def cond(s: BfsState):
@@ -75,18 +116,27 @@ def bfs_multi_device(
     engine: str = "pull",
     max_levels: int | None = None,
     block: int = 1024,
+    packed: bool | None = None,
 ):
     """DEVICE-resident half of :func:`bfs_multi` for pull/push: returns the
     raw batched BfsState without any host transfer (``int(state.level)`` is
     the cheap sync — the benchmark timing path).  The relay analogue is
-    :meth:`RelayEngine.run_multi_device`."""
+    :meth:`RelayEngine.run_multi_device`.
+
+    ``packed=None`` runs the fused-word carry whenever parent ids fit its
+    26-bit field; the loop then caps at PACKED_MAX_LEVELS and raw-device
+    callers must test ``state.changed`` at the cap (:func:`bfs_multi`
+    does, and falls back automatically)."""
     sources = np.atleast_1d(np.asarray(sources, dtype=np.int32))
+    from ..ops.packed import packed_parent_fits, resolve_packed
     from .bfs import check_sources
 
     if engine == "pull":
         pg = graph if isinstance(graph, PullGraph) else build_pull_graph(graph)
         check_sources(pg.num_vertices, sources)
         max_levels = int(max_levels) if max_levels is not None else pg.num_vertices
+        if packed is None:
+            packed = resolve_packed(packed_parent_fits(pg.num_vertices))
         from ..graph.ell import device_ell
 
         ell0_t, folds_t = device_ell(pg)
@@ -96,6 +146,7 @@ def bfs_multi_device(
             jnp.asarray(sources),
             pg.num_vertices,
             max_levels,
+            packed,
         )
         return state, pg.num_vertices
     if engine != "push":
@@ -105,9 +156,11 @@ def bfs_multi_device(
         raise ValueError("sharded DeviceGraph requires the parallel engine")
     check_sources(dg.num_vertices, sources)
     max_levels = int(max_levels) if max_levels is not None else dg.num_vertices
+    if packed is None:
+        packed = resolve_packed(packed_parent_fits(dg.num_vertices))
     state = _bfs_multi_fused(
         jnp.asarray(dg.src), jnp.asarray(dg.dst), jnp.asarray(sources),
-        dg.num_vertices, max_levels,
+        dg.num_vertices, max_levels, packed,
     )
     return state, dg.num_vertices
 
@@ -123,16 +176,32 @@ def bfs_multi(
     """Batched multi-source BFS on one chip.  Engines as in
     :func:`bfs_tpu.models.bfs.bfs` — ``'pull'`` (default), ``'push'``, or
     ``'relay'`` (via :meth:`RelayEngine.run_multi`); all produce bit-exact
-    dist AND parent (canonical min-parent)."""
+    dist AND parent (canonical min-parent).  Runs the packed fused-word
+    carry by default and re-runs unpacked past its 62-level cap."""
+    from ..ops.packed import (
+        packed_parent_fits,
+        packed_truncated,
+        resolve_packed,
+    )
+
     sources = np.atleast_1d(np.asarray(sources, dtype=np.int32))
     if engine == "relay":
         from .bfs import RelayEngine
 
         return RelayEngine(graph).run_multi(sources, max_levels=max_levels)
+    requested = max_levels if max_levels is not None else graph.num_vertices
+    packed = resolve_packed(packed_parent_fits(graph.num_vertices))
     state, v = bfs_multi_device(
-        graph, sources, engine=engine, max_levels=max_levels, block=block
+        graph, sources, engine=engine, max_levels=max_levels, block=block,
+        packed=packed,
     )
     state = jax.device_get(state)
+    if packed and packed_truncated(state.changed, state.level, int(requested)):
+        state, v = bfs_multi_device(
+            graph, sources, engine=engine, max_levels=max_levels,
+            block=block, packed=False,
+        )
+        state = jax.device_get(state)
     return MultiBfsResult(
         sources=sources,
         dist=np.asarray(state.dist[:, :v]),

@@ -1,0 +1,170 @@
+"""The packed BFS state word: ``level:6 | parent:26`` in one uint32.
+
+Round-5 profiling put the relay superstep ~1.9x off its own mask-stream
+roofline, and the largest non-mask term is the per-superstep dist/parent
+state update: two int32[V] arrays read AND written every superstep (128 MB
+of HBM traffic per superstep at s24).  Level-synchronous BFS never needs
+their full range at once — a vertex's distance is at most the superstep
+count and its parent is fixed the superstep it is reached — so both fuse
+into ONE 32-bit word per vertex:
+
+    bits 31..26   level   (6 bits; 0..PACKED_MAX_LEVELS)
+    bits 25..0    parent  (26 bits; engine-specific meaning, below)
+
+with all-ones (``PACKED_SENTINEL``) as the unreached value.  The packing
+is chosen so the state update degenerates to a single unsigned
+``min(state, candidate)``:
+
+  * the level field is MAJOR, so an already-reached vertex (smaller level)
+    always wins against a later candidate — the ``(cand != INF) &
+    (dist == INF)`` improvement test disappears into the min;
+  * the parent field is MINOR, so among same-superstep candidates the min
+    picks the smallest parent value — exactly the canonical min-parent
+    tie-break every engine and the oracle share (the reducer monoid of
+    BfsSpark.java:90-108 as one lattice ``min``);
+  * the sentinel is the lattice top: ``min(SENTINEL, x) == x`` for any
+    candidate, and ``x | level_bits`` leaves the sentinel intact
+    (all-ones absorbs), so no masking is needed to build candidates.
+
+Per-superstep dist/parent HBM traffic is thereby HALVED (one uint32 word
+per vertex instead of two int32s, read and write sides both), and the
+row-min's tie-break becomes one lexicographic ``min`` over packed words.
+
+Parent-field meaning per engine (the 26-bit budget):
+
+  * push/pull engines: the parent VERTEX id — fits iff ``V <= 2^26``
+    (:func:`packed_parent_fits`).
+  * relay engine: the parent's within-row RANK in the vertex's degree
+    class (slot = base + rank*stride, graph/relay._vertex_tables) — fits
+    iff the largest class width is <= 2^26 (:func:`packed_rank_fits`).
+    The rank is what the row-min tournament natively produces; the global
+    L1 slot is reconstructed ONCE per run at unpack time.
+
+Level capacity is ``PACKED_MAX_LEVELS`` (62; 63 is the sentinel's level
+field).  Engines run packed by default and FALL BACK to the unpacked
+int32 state when a search fails to converge under the cap
+(:func:`packed_truncated`) — the same detect-and-fallback contract the
+element-major engine uses for its 31-level distance planes
+(models/bfs.py ``run_multi_elem``).  Oracle results and wire formats are
+unchanged: every fused program unpacks once at loop exit, on device.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# NumPy (not jnp) scalar, same convention as ops/relax.py: a module-level
+# jnp constant would initialize the JAX backend at import time.  Defined
+# here (not imported from relax) so relax.py can import this module
+# without a cycle.
+INT32_MAX = np.int32(2**31 - 1)
+
+#: Field widths.  Level is MAJOR so the min-merge prefers earlier levels.
+LEVEL_BITS = 6
+PARENT_BITS = 26
+PARENT_MASK = np.uint32((1 << PARENT_BITS) - 1)
+
+#: Unreached sentinel: all ones.  Its level field (63) is reserved, so the
+#: deepest representable level is 62.
+PACKED_SENTINEL = np.uint32(0xFFFFFFFF)
+PACKED_MAX_LEVELS = (1 << LEVEL_BITS) - 2  # 62
+
+
+def packed_parent_fits(num_vertices: int) -> bool:
+    """Can a parent VERTEX id (push/pull engines) fit the 26-bit field?"""
+    return int(num_vertices) <= (1 << PARENT_BITS)
+
+
+def packed_rank_fits(in_classes) -> bool:
+    """Can every relay parent RANK fit the 26-bit field?  Ranks are
+    bounded by the class width (strictly below it)."""
+    widths = [int(c.width) for c in in_classes]
+    return (max(widths) if widths else 1) <= (1 << PARENT_BITS)
+
+
+def resolve_packed(fits: bool) -> bool:
+    """``BFS_TPU_PACKED=0/1`` forces the carry flavor; otherwise run
+    packed exactly when the layout fits."""
+    import os
+
+    env = os.environ.get("BFS_TPU_PACKED", "")
+    if env in ("0", "1"):
+        return env == "1"
+    return bool(fits)
+
+
+def packed_cap(max_levels: int) -> int:
+    """The level bound a packed fused loop may run to."""
+    return min(int(max_levels), PACKED_MAX_LEVELS)
+
+
+def packed_truncated(changed, level, max_levels: int) -> bool:
+    """Host-side: did the packed loop stop on its level capacity rather
+    than converging or hitting the caller's own ``max_levels``?  True
+    means the caller must re-run on the unpacked path."""
+    return (
+        bool(changed)
+        and int(level) >= PACKED_MAX_LEVELS
+        and int(max_levels) > PACKED_MAX_LEVELS
+    )
+
+
+# ------------------------------------------------------------------ device --
+
+def level_word(level) -> jax.Array:
+    """``level`` (int32 scalar/array) -> the uint32 level-field bits.
+    OR-ing these onto a parent value (or onto the sentinel, which absorbs)
+    builds a candidate word."""
+    return level.astype(jnp.uint32) << np.uint32(PARENT_BITS)
+
+
+def merge_packed(packed: jax.Array, cand: jax.Array) -> jax.Array:
+    """THE state update: one lexicographic (level, parent) min per word."""
+    return jnp.minimum(packed, cand)
+
+
+def packed_dist(packed: jax.Array) -> jax.Array:
+    """int32 distances from packed words (INT32_MAX where unreached)."""
+    return jnp.where(
+        packed == PACKED_SENTINEL,
+        jnp.int32(INT32_MAX),
+        (packed >> np.uint32(PARENT_BITS)).astype(jnp.int32),
+    )
+
+
+def packed_parent(packed: jax.Array) -> jax.Array:
+    """int32 parent field from packed words (-1 where unreached)."""
+    return jnp.where(
+        packed == PACKED_SENTINEL,
+        jnp.int32(-1),
+        (packed & PARENT_MASK).astype(jnp.int32),
+    )
+
+
+# -------------------------------------------------------------------- host --
+
+def pack_host(dist: np.ndarray, parent: np.ndarray) -> np.ndarray:
+    """NumPy twin: (dist, parent) -> packed words (tests / fixtures)."""
+    dist = np.asarray(dist)
+    parent = np.asarray(parent)
+    unreached = dist == INT32_MAX
+    word = (dist.astype(np.uint32) << np.uint32(PARENT_BITS)) | (
+        parent.astype(np.uint32) & PARENT_MASK
+    )
+    return np.where(unreached, PACKED_SENTINEL, word).astype(np.uint32)
+
+
+def unpack_host(packed: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """NumPy twin of :func:`packed_dist` / :func:`packed_parent`."""
+    packed = np.asarray(packed, dtype=np.uint32)
+    unreached = packed == PACKED_SENTINEL
+    dist = np.where(
+        unreached, np.int32(INT32_MAX),
+        (packed >> np.uint32(PARENT_BITS)).astype(np.int32),
+    )
+    parent = np.where(
+        unreached, np.int32(-1), (packed & PARENT_MASK).astype(np.int32)
+    )
+    return dist.astype(np.int32), parent.astype(np.int32)

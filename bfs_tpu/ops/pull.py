@@ -21,7 +21,13 @@ import os
 import jax
 import jax.numpy as jnp
 
-from .relax import INT32_MAX, BfsState, apply_candidates
+from .relax import (
+    INT32_MAX,
+    BfsState,
+    PackedBfsState,
+    apply_candidates,
+    apply_candidates_packed,
+)
 
 #: Row-chunk budget for the ELL gather (elements of the materialized
 #: [rows, K] gather, ~4 bytes each).  One whole-matrix gather materializes
@@ -67,9 +73,11 @@ def _rowmin_level(tab: jax.Array, mat_t: jax.Array) -> jax.Array:
     return jnp.concatenate(outs, axis=-1)
 
 
-def frontier_table(state: BfsState) -> jax.Array:
-    """``F[u] = u`` if u is on the frontier else INF — int32[V+1]."""
-    n = state.dist.shape[-1]
+def frontier_table(state) -> jax.Array:
+    """``F[u] = u`` if u is on the frontier else INF — int32[V+1].
+    Accepts either carry (BfsState or the packed one): only the frontier
+    field is read."""
+    n = state.frontier.shape[-1]
     ids = jnp.arange(n, dtype=jnp.int32)
     return jnp.where(state.frontier, ids, INT32_MAX)
 
@@ -148,3 +156,24 @@ def relax_pull_superstep(
     if axis_name is not None:
         cand_parent = jax.lax.pmin(cand_parent, axis_name)
     return apply_candidates(state, cand_parent, batch_axis_name=batch_axis_name)
+
+
+# bfs_tpu: hot traced
+def relax_pull_superstep_packed(
+    state: PackedBfsState,
+    ell0: jax.Array,
+    folds,
+    *,
+    axis_name: str | None = None,
+    batch_axis_name: str | None = None,
+) -> PackedBfsState:
+    """Packed twin of :func:`relax_pull_superstep`: identical gather +
+    row-min candidates, one min-merge state update on the fused
+    ``level:6|parent:26`` words (ops/packed.py) — half the dist/parent
+    HBM bytes per superstep."""
+    cand_parent = pull_candidates(frontier_table(state), ell0, folds)
+    if axis_name is not None:
+        cand_parent = jax.lax.pmin(cand_parent, axis_name)
+    return apply_candidates_packed(
+        state, cand_parent, batch_axis_name=batch_axis_name
+    )

@@ -106,17 +106,160 @@ def relax_superstep(
     dist/parent/frontier replicated without further collectives.
     """
     num_segments = state.dist.shape[0]
-    active = state.frontier[src]
-    # Min source id among active in-edges per destination; INT32_MAX where none.
-    cand_parent = jax.ops.segment_min(
+    cand_parent = _push_candidates(state.frontier, src, dst, num_segments)
+    if axis_name is not None:
+        cand_parent = jax.lax.pmin(cand_parent, axis_name)
+    return apply_candidates(state, cand_parent)
+
+
+def _push_candidates(frontier, src, dst, num_segments: int) -> jax.Array:
+    """Min source id among active in-edges per destination; INT32_MAX where
+    none (the mapper + reducer monoid as one segmented min)."""
+    active = frontier[src]
+    return jax.ops.segment_min(
         jnp.where(active, src, INT32_MAX),
         dst,
         num_segments=num_segments,
         indices_are_sorted=True,
     )
+
+
+# ----------------------------------------------------------- packed state --
+# The ``level:6 | parent:26`` fused-word carry (ops/packed.py): dist and
+# parent collapse into one uint32 per vertex, halving the per-superstep
+# state-update HBM bytes, and the improvement test + canonical min-parent
+# tie-break collapse into one unsigned ``min``.  Engines run this by
+# default (V permitting) and fall back to BfsState past PACKED_MAX_LEVELS.
+
+
+class PackedBfsState(NamedTuple):
+    """Packed loop carry: ``packed`` is uint32[V+1] (``level:6|parent:26``,
+    all-ones unreached — ops/packed.py); other fields as in BfsState."""
+
+    packed: jax.Array  # uint32[V+1]
+    frontier: jax.Array  # bool[V+1]
+    level: jax.Array
+    changed: jax.Array
+
+
+def init_packed_state(
+    num_vertices: int, source, *, sentinel: bool = True
+) -> PackedBfsState:
+    """Packed twin of :func:`init_state`: source at level 0 with itself as
+    parent (word ``0<<26 | source``), everything else the sentinel."""
+    from .packed import PACKED_SENTINEL
+
+    n = num_vertices + (1 if sentinel else 0)
+    source = jnp.asarray(source, dtype=jnp.int32)
+    packed = (
+        jnp.full((n,), PACKED_SENTINEL, dtype=jnp.uint32)
+        .at[source]
+        .set(source.astype(jnp.uint32))
+    )
+    frontier = jnp.zeros((n,), dtype=bool).at[source].set(True)
+    return PackedBfsState(packed, frontier, jnp.int32(0), jnp.bool_(True))
+
+
+def init_packed_batched_state(num_vertices: int, sources) -> PackedBfsState:
+    """Packed twin of :func:`init_batched_state` ([S, V+1] fields)."""
+    from .packed import PACKED_SENTINEL
+
+    n = num_vertices + 1
+    sources = jnp.asarray(sources, dtype=jnp.int32)
+    s = sources.shape[0]
+    rows = jnp.arange(s)
+    packed = (
+        jnp.full((s, n), PACKED_SENTINEL, dtype=jnp.uint32)
+        .at[rows, sources]
+        .set(sources.astype(jnp.uint32))
+    )
+    frontier = jnp.zeros((s, n), dtype=bool).at[rows, sources].set(True)
+    return PackedBfsState(packed, frontier, jnp.int32(0), jnp.bool_(True))
+
+
+# bfs_tpu: hot traced
+def apply_candidates_packed(
+    state: PackedBfsState,
+    cand_parent: jax.Array,
+    *,
+    batch_axis_name: str | None = None,
+) -> PackedBfsState:
+    """Packed tail of the push/pull supersteps: the candidate parent ids
+    (int32, INT32_MAX where none) become packed words at ``level+1`` and
+    merge with ONE lexicographic min — half the dist/parent HBM bytes of
+    :func:`apply_candidates`, same canonical tie-break."""
+    from .packed import PACKED_SENTINEL, level_word, merge_packed
+
+    lev = level_word(state.level + 1)
+    cand = jnp.where(
+        cand_parent == INT32_MAX,
+        jnp.uint32(PACKED_SENTINEL),
+        cand_parent.astype(jnp.uint32) | lev,
+    )
+    packed = merge_packed(state.packed, cand)
+    improved = packed != state.packed
+    changed = improved.any()
+    if batch_axis_name is not None:
+        changed = jax.lax.pmax(changed.astype(jnp.int32), batch_axis_name) > 0
+    return PackedBfsState(packed, improved, state.level + 1, changed)
+
+
+# bfs_tpu: hot traced
+def relax_superstep_packed(
+    state: PackedBfsState,
+    src: jax.Array,
+    dst: jax.Array,
+    *,
+    axis_name: str | None = None,
+) -> PackedBfsState:
+    """Packed twin of :func:`relax_superstep` (same candidates, min-merge
+    state update)."""
+    num_segments = state.packed.shape[0]
+    cand_parent = _push_candidates(state.frontier, src, dst, num_segments)
     if axis_name is not None:
         cand_parent = jax.lax.pmin(cand_parent, axis_name)
-    return apply_candidates(state, cand_parent)
+    return apply_candidates_packed(state, cand_parent)
+
+
+# bfs_tpu: hot traced
+def relax_superstep_batched_packed(
+    state: PackedBfsState,
+    src: jax.Array,
+    dst: jax.Array,
+    *,
+    axis_name: str | None = None,
+    batch_axis_name: str | None = None,
+) -> PackedBfsState:
+    """Packed twin of :func:`relax_superstep_batched`."""
+    num_segments = state.packed.shape[-1]
+
+    def seg(cand):
+        return jax.ops.segment_min(
+            cand, dst, num_segments=num_segments, indices_are_sorted=True
+        )
+
+    active = state.frontier[:, src]
+    cand_parent = jax.vmap(seg)(jnp.where(active, src, INT32_MAX))
+    if axis_name is not None:
+        cand_parent = jax.lax.pmin(cand_parent, axis_name)
+    return apply_candidates_packed(
+        state, cand_parent, batch_axis_name=batch_axis_name
+    )
+
+
+def unpack_bfs_state(state: PackedBfsState) -> BfsState:
+    """The ONCE-PER-RUN unpack at fused-loop exit (on device): packed words
+    back to the int32 dist/parent contract every downstream consumer
+    (oracle check, wire format, serve replies) already speaks."""
+    from .packed import packed_dist, packed_parent
+
+    return BfsState(
+        dist=packed_dist(state.packed),
+        parent=packed_parent(state.packed),
+        frontier=state.frontier,
+        level=state.level,
+        changed=state.changed,
+    )
 
 
 def init_batched_state(

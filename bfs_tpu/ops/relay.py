@@ -29,14 +29,20 @@ from .relax import INT32_MAX
 
 __all__ = [
     "RelayState",
+    "PackedRelayState",
     "init_relay_state",
+    "init_packed_relay_state",
     "pack_std",
     "unpack_std",
     "apply_benes_std",
     "broadcast_l2",
     "rowmin_candidates",
+    "rowmin_ranks",
     "apply_relay_candidates",
+    "apply_relay_candidates_packed",
+    "unpack_relay_packed",
     "relay_superstep_words",
+    "relay_superstep_words_packed",
 ]
 
 
@@ -47,11 +53,28 @@ class RelayState(NamedTuple):
     index of the parent edge (-1 unreached; the source's self-entry holds its
     relabeled id and is fixed up host-side); ``fwords``: uint32[vr/32]
     frontier bits, standard packing — fed to the vperm network directly.
+
+    This is the UNPACKED carry: the observability path (SuperstepRunner)
+    and the >PACKED_MAX_LEVELS fallback run it; the fused hot path carries
+    :class:`PackedRelayState` and unpacks to this shape once at loop exit.
     """
 
     dist: jax.Array
     parent: jax.Array
     fwords: jax.Array
+    level: jax.Array
+    changed: jax.Array
+
+
+class PackedRelayState(NamedTuple):
+    """Packed loop carry (the hot path): ``packed`` is uint32[vr] of
+    ``level:6 | parent_rank:26`` words (ops/packed.py) — the parent field
+    holds the within-row RANK the row-min tournament natively produces
+    (slot = base + rank*stride, graph/relay._vertex_tables), reconstructed
+    to L1 slots once per run by :func:`unpack_relay_packed`."""
+
+    packed: jax.Array  # uint32[vr]
+    fwords: jax.Array  # uint32[vr/32]
     level: jax.Array
     changed: jax.Array
 
@@ -66,6 +89,27 @@ def init_relay_state(vr: int, source_new) -> RelayState:
         .set(jnp.uint32(1) << (source_new & 31).astype(jnp.uint32))
     )
     return RelayState(dist, parent, fwords, jnp.int32(0), jnp.bool_(True))
+
+
+def init_packed_relay_state(vr: int, source_new) -> PackedRelayState:
+    """Packed twin of :func:`init_relay_state`: the source's word is
+    ``level 0 | rank 0`` (any non-sentinel parent works there — callers fix
+    the source's self-parent up host-side exactly as on the unpacked
+    path)."""
+    from .packed import PACKED_SENTINEL
+
+    source_new = jnp.asarray(source_new, dtype=jnp.int32)
+    packed = (
+        jnp.full((vr,), PACKED_SENTINEL, jnp.uint32)
+        .at[source_new]
+        .set(jnp.uint32(0))
+    )
+    fwords = (
+        jnp.zeros((vr // 32,), jnp.uint32)
+        .at[source_new >> 5]
+        .set(jnp.uint32(1) << (source_new & 31).astype(jnp.uint32))
+    )
+    return PackedRelayState(packed, fwords, jnp.int32(0), jnp.bool_(True))
 
 
 def pack_std(bits: jax.Array) -> jax.Array:
@@ -249,57 +293,104 @@ def _word_tournament(wv: jax.Array):
     return f[0], [pl[0] for pl in planes]
 
 
+def _masked_class_words(l1words, valid_words, cs):
+    """One class's routed slot words ANDed with its valid-slot words — the
+    MASKED row-min reads: the validity mask is applied per class slice, so
+    the scan touches valid slot storage only (padded in-row slots read as
+    zero, and the identity tail beyond the last class is never read at
+    all).  Class slot ranges are 32-aligned by construction
+    (graph/relay._build_classes), so the word slice is exact."""
+    a, b = cs.sa // 32, cs.sb // 32
+    return jax.lax.slice_in_dim(l1words, a, b) & jax.lax.slice_in_dim(
+        valid_words, a, b
+    )
+
+
+def _class_found_rank(lw, cs):
+    """(found bool[count], rank int32[count]) for one class from its masked
+    slot words ``lw``: the min active RANK per vertex — ranks within a dst
+    row ascend by ORIGINAL src id (graph/relay.py sort order), so min rank
+    == canonical min-parent.  Rank values are meaningful only where
+    ``found``."""
+    if not cs.vertex_major:
+        cw = cs.count // 32
+        wv = lw.reshape(cs.width, cw)
+        found_w, plane_w = _word_tournament(wv)
+        rank = jnp.zeros(cs.count, jnp.int32)
+        for j in range(len(plane_w)):
+            rank = rank | (
+                unpack_std(plane_w[j], cs.count).astype(jnp.int32) << j
+            )
+        found = unpack_std(found_w, cs.count) != 0
+        return found, rank
+    ww = cs.width // 32
+    wv = lw.reshape(cs.count, ww)
+    nz = wv != 0
+    widx = jnp.min(
+        jnp.where(nz, jnp.arange(ww, dtype=jnp.int32)[None, :], ww),
+        axis=1,
+    )
+    word = jnp.take_along_axis(
+        wv, jnp.clip(widx, 0, ww - 1)[:, None], axis=1
+    )[:, 0]
+    rank = widx * 32 + _ctz32(jnp.maximum(word, 1))
+    return widx < ww, rank
+
+
+def _class_slot(cs, rank):
+    """rank -> global L1 slot for one class (the static slot formula:
+    rank-major ``sa + r*count + p``, vertex-major ``sa + p*width + r``)."""
+    p = jnp.arange(cs.count, dtype=jnp.int32)
+    if not cs.vertex_major:
+        return cs.sa + rank * cs.count + p
+    return cs.sa + p * cs.width + rank
+
+
 # bfs_tpu: hot traced
 def rowmin_candidates(
     l1words: jax.Array, valid_words: jax.Array, in_classes, vr: int
 ) -> jax.Array:
     """Min active L1 slot per relabeled vertex: int32[vr], INT32_MAX where
-    none.  Slots within a dst row ascend by ORIGINAL src id (graph/relay.py
-    sort order), so min active slot == canonical min-parent."""
-    lw = l1words & valid_words
+    none.  The unpacked-path flavor: rank from the masked per-class
+    tournament, then the static slot formula."""
     cands = []
     covered = 0
     for cs in sorted(in_classes, key=lambda c: c.va):
         assert cs.va == covered, "in_classes must tile the vertex space"
-        if not cs.vertex_major:
-            cw = cs.count // 32
-            wv = jax.lax.slice_in_dim(
-                lw, cs.sa // 32, cs.sa // 32 + cs.width * cw
-            ).reshape(cs.width, cw)
-            found_w, plane_w = _word_tournament(wv)
-            nb = len(plane_w)
-            minr = jnp.zeros(cs.count, jnp.int32)
-            for j in range(nb):
-                minr = minr | (
-                    unpack_std(plane_w[j], cs.count).astype(jnp.int32) << j
-                )
-            found = unpack_std(found_w, cs.count) != 0
-            p = jnp.arange(cs.count, dtype=jnp.int32)
-            cand = jnp.where(
-                found, cs.sa + minr * cs.count + p, INT32_MAX
-            )
-        else:
-            ww = cs.width // 32
-            wv = jax.lax.slice_in_dim(
-                lw, cs.sa // 32, cs.sa // 32 + cs.count * ww
-            ).reshape(cs.count, ww)
-            nz = wv != 0
-            widx = jnp.min(
-                jnp.where(nz, jnp.arange(ww, dtype=jnp.int32)[None, :], ww),
-                axis=1,
-            )
-            word = jnp.take_along_axis(
-                wv, jnp.clip(widx, 0, ww - 1)[:, None], axis=1
-            )[:, 0]
-            r = widx * 32 + _ctz32(jnp.maximum(word, 1))
-            p = jnp.arange(cs.count, dtype=jnp.int32)
-            cand = jnp.where(
-                widx < ww, cs.sa + p * cs.width + r, INT32_MAX
-            )
-        cands.append(cand)
+        found, rank = _class_found_rank(
+            _masked_class_words(l1words, valid_words, cs), cs
+        )
+        cands.append(jnp.where(found, _class_slot(cs, rank), INT32_MAX))
         covered = cs.vb
     if covered < vr:
         cands.append(jnp.full(vr - covered, INT32_MAX, jnp.int32))
+    return jnp.concatenate(cands)
+
+
+# bfs_tpu: hot traced
+def rowmin_ranks(
+    l1words: jax.Array, valid_words: jax.Array, in_classes, vr: int
+) -> jax.Array:
+    """Min active RANK per relabeled vertex: uint32[vr], PACKED_SENTINEL
+    where none — the packed-path flavor.  This is what the tournament
+    natively produces; no slot arithmetic at all, and the sentinel is
+    exactly the packed-word lattice top, so the output feeds
+    :func:`apply_relay_candidates_packed` with one OR."""
+    from .packed import PACKED_SENTINEL
+
+    cands = []
+    covered = 0
+    for cs in sorted(in_classes, key=lambda c: c.va):
+        assert cs.va == covered, "in_classes must tile the vertex space"
+        found, rank = _class_found_rank(
+            _masked_class_words(l1words, valid_words, cs), cs
+        )
+        cands.append(
+            jnp.where(found, rank.astype(jnp.uint32), PACKED_SENTINEL)
+        )
+        covered = cs.vb
+    if covered < vr:
+        cands.append(jnp.full(vr - covered, PACKED_SENTINEL, jnp.uint32))
     return jnp.concatenate(cands)
 
 
@@ -312,6 +403,51 @@ def apply_relay_candidates(state: RelayState, cand: jax.Array) -> RelayState:
     parent = jnp.where(newly, cand, state.parent)
     fwords = pack_std(newly)
     return RelayState(dist, parent, fwords, new_level, newly.any())
+
+
+# bfs_tpu: hot traced
+def apply_relay_candidates_packed(
+    state: PackedRelayState, rank_or_sent: jax.Array
+) -> PackedRelayState:
+    """Packed state update: one lexicographic ``min`` over
+    ``level:6|rank:26`` words — HALF the dist/parent HBM bytes of
+    :func:`apply_relay_candidates` (one uint32 read + one written per
+    vertex instead of two int32s each way).  The improvement test is
+    implicit: an already-reached vertex has a smaller level field, so the
+    min keeps it; the sentinel absorbs the level OR, so unreached
+    candidates stay the lattice top."""
+    from .packed import level_word, merge_packed
+
+    cand = rank_or_sent | level_word(state.level + 1)
+    packed = merge_packed(state.packed, cand)
+    newly = packed != state.packed
+    fwords = pack_std(newly)
+    return PackedRelayState(packed, fwords, state.level + 1, newly.any())
+
+
+def unpack_relay_packed(packed: jax.Array, in_classes, vr: int):
+    """The ONCE-PER-RUN unpack at fused-loop exit (on device): packed
+    ``level:6|rank:26`` words -> ``(dist int32[vr], parent int32[vr])``
+    with parent as the global L1 SLOT index — the exact contract the
+    unpacked RelayState carries, so every downstream consumer
+    (slots_to_parent, to_original_device, the sharded map-back) is
+    unchanged.  The rank -> slot reconstruction is the static per-class
+    formula; it runs once per run, not once per superstep."""
+    from .packed import PARENT_MASK, PACKED_SENTINEL, packed_dist
+
+    dist = packed_dist(packed)
+    rank = (packed & PARENT_MASK).astype(jnp.int32)
+    parts = []
+    covered = 0
+    for cs in sorted(in_classes, key=lambda c: c.va):
+        r = jax.lax.slice_in_dim(rank, cs.va, cs.vb)
+        parts.append(_class_slot(cs, r))
+        covered = cs.vb
+    if covered < vr:
+        parts.append(jnp.full(vr - covered, -1, jnp.int32))
+    slots = jnp.concatenate(parts)
+    parent = jnp.where(packed == PACKED_SENTINEL, jnp.int32(-1), slots)
+    return dist, parent
 
 
 # bfs_tpu: hot traced
@@ -339,3 +475,31 @@ def relay_superstep_words(
     l1 = apply_benes_std(l2, net_masks, net_table, net_size)
     cand = rowmin_candidates(l1, valid_words, in_classes, vr)
     return apply_relay_candidates(state, cand)
+
+
+# bfs_tpu: hot traced
+def relay_superstep_words_packed(
+    state: PackedRelayState,
+    *,
+    vperm_masks: jax.Array,
+    vperm_table: tuple[StageSpec, ...],
+    vperm_size: int,
+    out_classes,
+    out_space: int,
+    net_masks: jax.Array,
+    net_table: tuple[StageSpec, ...],
+    net_size: int,
+    in_classes,
+    valid_words: jax.Array,
+    vr: int,
+) -> PackedRelayState:
+    """Packed twin of :func:`relay_superstep_words`: identical routing
+    pipeline, rank row-min + packed min-merge state update."""
+    fw = jnp.concatenate(
+        [state.fwords, jnp.zeros((vperm_size - vr) // 32, jnp.uint32)]
+    )
+    y = apply_benes_std(fw, vperm_masks, vperm_table, vperm_size)
+    l2 = broadcast_l2(y, out_classes, net_size, out_space)
+    l1 = apply_benes_std(l2, net_masks, net_table, net_size)
+    cand = rowmin_ranks(l1, valid_words, in_classes, vr)
+    return apply_relay_candidates_packed(state, cand)

@@ -191,17 +191,24 @@ def rowmin_elem(
 
     Returns ``(found uint32[G, vr], rank_planes uint32[G, PT])`` — rank
     planes only meaningful at bits where ``found`` is set.
+
+    MASKED row-min: the valid-slot mask is expanded and applied PER CLASS
+    SLICE (class slot ranges are 32-aligned), so the scan touches valid
+    slot storage only — the old whole-array expansion materialized one
+    uint32 select per slot over the FULL net including the identity tail
+    beyond the last class, 4 bytes/slot of pure padding traffic at net
+    sizes where m1 < n.
     """
     g = l1.shape[0]
-    vbits = jnp.uint32(0) - unpack_std(valid_words, l1.shape[1]).astype(
-        jnp.uint32
-    )
-    lw = l1 & vbits[None, :]
     found_parts = []
     rp = jnp.zeros((g, pt), jnp.uint32)
     covered = 0
     for cs in sorted(in_classes, key=lambda c: c.va):
-        seg = jax.lax.slice_in_dim(lw, cs.sa, cs.sb, axis=1)
+        vw = jax.lax.slice_in_dim(valid_words, cs.sa // 32, cs.sb // 32)
+        vsel = jnp.uint32(0) - unpack_std(vw, cs.sb - cs.sa).astype(
+            jnp.uint32
+        )
+        seg = jax.lax.slice_in_dim(l1, cs.sa, cs.sb, axis=1) & vsel[None, :]
         if not cs.vertex_major:
             xv = seg.reshape(g, cs.width, cs.count)
         else:

@@ -223,9 +223,12 @@ def _relay_candidates_shard(
 ):
     """One shard's gather-free candidate pipeline (v4): global standard-
     packed frontier words -> this shard's per-owned-vertex min active L1
-    slot.  With v4's standard packing the all-gathered words ARE the global
-    frontier in vperm element order (relabeling is shard-major), so they
-    feed the butterflies directly with no repacking.
+    slot (unpacked path) or min active within-row RANK as
+    ``uint32 | PACKED_SENTINEL`` (packed path — ops/relay.rowmin_ranks,
+    the masked row-min over valid slots only).  With v4's standard packing
+    the all-gathered words ARE the global frontier in vperm element order
+    (relabeling is shard-major), so they feed the butterflies directly
+    with no repacking.
 
     With ``use_pallas`` in ``static`` the networks run as the SAME fused
     3-pass Pallas kernels as the single-chip engine (ops/relay_pallas.py) —
@@ -236,7 +239,7 @@ def _relay_candidates_shard(
     from ..ops import relay as R
 
     (block, vperm_size, vperm_table, out_classes, out_space, net_table,
-     net_size, in_classes, n, use_pallas) = static
+     net_size, in_classes, n, use_pallas, packed) = static
     if use_pallas:
         from ..ops import relay_pallas as RP
     nw = block // 32
@@ -257,14 +260,17 @@ def _relay_candidates_shard(
         )
     else:
         l1 = R.apply_benes_std(l2, net_blk, net_table, net_size)
+    if packed:
+        return R.rowmin_ranks(l1, valid_blk, in_classes, block)
     return R.rowmin_candidates(l1, valid_blk, in_classes, block)
 
 
-def _sharded_relay_static(srg, n: int, use_pallas: bool = False):
+def _sharded_relay_static(srg, n: int, use_pallas: bool = False,
+                          packed: bool = False):
     return (
         srg.block, srg.vperm_size, srg.vperm_table, tuple(srg.out_classes),
         srg.out_space, srg.net_table, srg.net_size, tuple(srg.in_classes), n,
-        use_pallas,
+        use_pallas, packed,
     )
 
 
@@ -348,24 +354,68 @@ def _bfs_sharded_relay_fused(
     bit-packed all-gather (1 bit/vertex over ICI per superstep).  State
     lives in the GLOBAL RELABELED space — dist/parent fully distributed,
     parent VALUES are per-shard L1 slot indices (converted to original src
-    ids on the host, bfs_sharded)."""
-    from ..ops.relay import pack_std
+    ids on the host, bfs_sharded).
+
+    With ``packed`` in ``static`` each shard carries ONE uint32
+    ``level:6|rank:26`` word per owned vertex (half the per-superstep
+    state HBM bytes), the update is one lexicographic min, and the
+    dist/parent-slot outputs are unpacked once at loop exit — the
+    exchange is untouched (it ships frontier bits either way).  The loop
+    caps at PACKED_MAX_LEVELS; ``changed`` is returned so the host
+    wrapper can detect a cap exit and re-run unpacked."""
+    from ..ops.packed import PACKED_SENTINEL, level_word, packed_cap
+    from ..ops.relay import pack_std, unpack_relay_packed
 
     n = mesh.shape[GRAPH_AXIS]
     block = static[0]
+    in_classes = static[7]
+    packed = static[-1]
     nw = block // 32
+    cap = packed_cap(max_levels) if packed else max_levels
 
     def inner(vperm_blk, net_blk, valid_blk, own_all, source):
         vperm_blk = _strip_shard_dim(vperm_blk)
         net_blk = _strip_shard_dim(net_blk)
         valid_blk = valid_blk[0]
         own_local = own_all[jax.lax.axis_index(GRAPH_AXIS)]
-        dist, parent = _init_block_state(source, block)
         fwords = _packed_source_frontier(source, block, n)
 
         def cond(carry):
-            _, _, _, level, changed = carry
-            return changed & (level < max_levels)
+            level, changed = carry[-2], carry[-1]
+            return changed & (level < cap)
+
+        if packed:
+            lo = jax.lax.axis_index(GRAPH_AXIS).astype(jnp.int32) * block
+            ids_local = lo + jnp.arange(block, dtype=jnp.int32)
+            pk0 = jnp.where(
+                ids_local == source, jnp.uint32(0), PACKED_SENTINEL
+            )
+
+            def body(carry):
+                pk, fw, level, _ = carry
+                cand = _relay_candidates_shard(
+                    fw, vperm_blk, net_blk, valid_blk, static=static
+                )
+                pk2 = jnp.minimum(pk, cand | level_word(level + 1))
+                improved = pk2 != pk
+                fw = _exchange_compact(
+                    pack_std(improved), own_local, own_all, nw
+                )
+                changed = (
+                    jax.lax.pmax(
+                        improved.any().astype(jnp.int32), GRAPH_AXIS
+                    )
+                    > 0
+                )
+                return pk2, fw, level + 1, changed
+
+            pk, _, level, changed = jax.lax.while_loop(
+                cond, body, (pk0, fwords, jnp.int32(0), jnp.bool_(True))
+            )
+            dist, parent = unpack_relay_packed(pk, in_classes, block)
+            return dist, parent, level, changed
+
+        dist, parent = _init_block_state(source, block)
 
         def body(carry):
             dist, parent, fw, level, _ = carry
@@ -382,10 +432,10 @@ def _bfs_sharded_relay_fused(
             )
             return dist, parent, fw, level, changed
 
-        dist, parent, _, level, _ = jax.lax.while_loop(
+        dist, parent, _, level, changed = jax.lax.while_loop(
             cond, body, (dist, parent, fwords, jnp.int32(0), jnp.bool_(True))
         )
-        return dist, parent, level
+        return dist, parent, level, changed
 
     fn = _shard_map(
         inner,
@@ -397,7 +447,7 @@ def _bfs_sharded_relay_fused(
             P(),
             P(),
         ),
-        out_specs=(P(GRAPH_AXIS), P(GRAPH_AXIS), P()),
+        out_specs=(P(GRAPH_AXIS), P(GRAPH_AXIS), P(), P()),
         # Fully manual over BOTH mesh axes: a partially-manual program (the
         # batch axis left in auto mode) would require the SPMD partitioner
         # to partition the Mosaic custom calls over the auto axis, which it
@@ -420,12 +470,17 @@ def _bfs_sharded_relay_multi_fused(
     ``graph``.  The per-superstep exchange is one frontier-word all-gather
     PER LOCAL TREE; the routing masks are read once per superstep per shard
     and shared by every tree in the local batch (the amortization config 5
-    is about)."""
-    from ..ops.relay import pack_std
+    is about).  ``packed`` in ``static`` as in the single-source variant:
+    one fused word per (tree, owned vertex), unpacked per tree at exit."""
+    from ..ops.packed import PACKED_SENTINEL, level_word, packed_cap
+    from ..ops.relay import pack_std, unpack_relay_packed
 
     n = mesh.shape[GRAPH_AXIS]
     block = static[0]
+    in_classes = static[7]
+    packed = static[-1]
     nw = block // 32
+    cap = packed_cap(max_levels) if packed else max_levels
 
     def inner(vperm_blk, net_blk, valid_blk, own_all, sources_blk):
         vperm_blk = _strip_shard_dim(vperm_blk)
@@ -436,8 +491,6 @@ def _bfs_sharded_relay_multi_fused(
         lo = jax.lax.axis_index(GRAPH_AXIS).astype(jnp.int32) * block
         ids_local = lo + jnp.arange(block, dtype=jnp.int32)
         is_src = ids_local[None, :] == sources_blk[:, None]
-        dist = jnp.where(is_src, jnp.int32(0), INT32_MAX)
-        parent = jnp.where(is_src, sources_blk[:, None], jnp.int32(-1))
         fwords = (
             jnp.zeros((s_l, n * nw), jnp.uint32)
             .at[jnp.arange(s_l), sources_blk >> 5]
@@ -446,16 +499,50 @@ def _bfs_sharded_relay_multi_fused(
         fwords = jax.lax.pcast(fwords, (GRAPH_AXIS,), to="varying")
 
         def cond(carry):
-            _, _, _, level, changed = carry
-            return changed & (level < max_levels)
+            level, changed = carry[-2], carry[-1]
+            return changed & (level < cap)
 
-        def body(carry):
-            dist, parent, fw, level, _ = carry
-            cand = jax.vmap(
+        def candidates(fw):
+            return jax.vmap(
                 lambda f: _relay_candidates_shard(
                     f, vperm_blk, net_blk, valid_blk, static=static
                 )
             )(fw)
+
+        if packed:
+            pk0 = jnp.where(is_src, jnp.uint32(0), PACKED_SENTINEL)
+
+            def body(carry):
+                pk, fw, level, _ = carry
+                cand = candidates(fw)
+                pk2 = jnp.minimum(pk, cand | level_word(level + 1))
+                improved = pk2 != pk
+                fw = _exchange_compact(
+                    pack_std(improved), own_local, own_all, nw
+                )
+                any_local = improved.any().astype(jnp.int32)
+                changed = (
+                    jax.lax.pmax(
+                        jax.lax.pmax(any_local, GRAPH_AXIS), BATCH_AXIS
+                    )
+                    > 0
+                )
+                return pk2, fw, level + 1, changed
+
+            pk, _, level, changed = jax.lax.while_loop(
+                cond, body, (pk0, fwords, jnp.int32(0), jnp.bool_(True))
+            )
+            dist, parent = jax.vmap(
+                lambda p: unpack_relay_packed(p, in_classes, block)
+            )(pk)
+            return dist, parent, level, changed
+
+        dist = jnp.where(is_src, jnp.int32(0), INT32_MAX)
+        parent = jnp.where(is_src, sources_blk[:, None], jnp.int32(-1))
+
+        def body(carry):
+            dist, parent, fw, level, _ = carry
+            cand = candidates(fw)
             improved = (cand != INT32_MAX) & (dist == INT32_MAX)
             level = level + 1
             dist = jnp.where(improved, level, dist)
@@ -470,10 +557,10 @@ def _bfs_sharded_relay_multi_fused(
             )
             return dist, parent, fw, level, changed
 
-        dist, parent, _, level, _ = jax.lax.while_loop(
+        dist, parent, _, level, changed = jax.lax.while_loop(
             cond, body, (dist, parent, fwords, jnp.int32(0), jnp.bool_(True))
         )
-        return dist, parent, level
+        return dist, parent, level, changed
 
     fn = _shard_map(
         inner,
@@ -485,7 +572,12 @@ def _bfs_sharded_relay_multi_fused(
             P(),
             P(BATCH_AXIS),
         ),
-        out_specs=(P(BATCH_AXIS, GRAPH_AXIS), P(BATCH_AXIS, GRAPH_AXIS), P()),
+        out_specs=(
+            P(BATCH_AXIS, GRAPH_AXIS),
+            P(BATCH_AXIS, GRAPH_AXIS),
+            P(),
+            P(),
+        ),
         axis_names={GRAPH_AXIS, BATCH_AXIS},
     )
     return fn(vperm_masks, net_masks, valid_words, own_words, sources_new)
@@ -651,39 +743,58 @@ def bfs_sharded(
     """
     mesh = mesh if mesh is not None else make_mesh()
     if engine == "relay":
+        from ..ops.packed import (
+            packed_rank_fits,
+            packed_truncated,
+            resolve_packed,
+        )
+
         srg = _prepare_relay(graph, mesh)
         check_sources(srg.num_vertices, source)
         max_levels = int(max_levels) if max_levels is not None else srg.num_vertices
         source_new = jnp.int32(int(srg.old2new[source]))
         use_pallas = _resolve_sharded_applier(applier)
-        static = _sharded_relay_static(srg, _graph_shards(mesh), use_pallas)
         vperm_arg, net_arg = _sharded_relay_mask_args(srg, use_pallas)
         args = (
             vperm_arg, net_arg, _relay_valid_words(srg),
             _own_word_table_dev(srg), source_new,
         )
-        if use_pallas:
-            from ..models.bfs import RelayEngine
 
-            key = ("single", static, mesh, max_levels)
-            compiled = _SHARDED_AOT_CACHE.get(key)
-            if compiled is None:
-                from ..models.bfs import compile_exe_cached
+        def run_prog(packed: bool):
+            static = _sharded_relay_static(
+                srg, _graph_shards(mesh), use_pallas, packed
+            )
+            if use_pallas:
+                from ..models.bfs import RelayEngine
 
-                compiled = compile_exe_cached(
-                    _bfs_sharded_relay_fused.lower(
-                        *args, mesh=mesh, static=static, max_levels=max_levels
-                    ),
-                    RelayEngine._COMPILER_OPTIONS,
-                )
-                while len(_SHARDED_AOT_CACHE) >= _SHARDED_AOT_CACHE_MAX:
-                    _SHARDED_AOT_CACHE.pop(next(iter(_SHARDED_AOT_CACHE)))
-                _SHARDED_AOT_CACHE[key] = compiled
-            dist, parent, level = compiled(*args)
-        else:
-            dist, parent, level = _bfs_sharded_relay_fused(
+                key = ("single", static, mesh, max_levels)
+                compiled = _SHARDED_AOT_CACHE.get(key)
+                if compiled is None:
+                    from ..models.bfs import compile_exe_cached
+
+                    compiled = compile_exe_cached(
+                        _bfs_sharded_relay_fused.lower(
+                            *args, mesh=mesh, static=static,
+                            max_levels=max_levels,
+                        ),
+                        RelayEngine._COMPILER_OPTIONS,
+                    )
+                    while len(_SHARDED_AOT_CACHE) >= _SHARDED_AOT_CACHE_MAX:
+                        _SHARDED_AOT_CACHE.pop(next(iter(_SHARDED_AOT_CACHE)))
+                    _SHARDED_AOT_CACHE[key] = compiled
+                return compiled(*args)
+            return _bfs_sharded_relay_fused(
                 *args, mesh=mesh, static=static, max_levels=max_levels
             )
+
+        packed = resolve_packed(packed_rank_fits(srg.in_classes))
+        dist, parent, level, changed = run_prog(packed)
+        if packed and packed_truncated(
+            jax.device_get(changed), jax.device_get(level), max_levels
+        ):
+            # Deeper than the packed level field: re-run unpacked (same
+            # contract as the single-chip engine and elem mode).
+            dist, parent, level, changed = run_prog(False)
         dist, parent = _relay_map_back(
             srg, jax.device_get(dist), jax.device_get(parent), source
         )
@@ -857,24 +968,41 @@ def bfs_sharded_multi(
     if sources.shape[0] % nb != 0:
         raise ValueError(f"{sources.shape[0]} sources not divisible by batch axis {nb}")
     if engine == "relay":
+        from ..ops.packed import (
+            packed_rank_fits,
+            packed_truncated,
+            resolve_packed,
+        )
+
         srg = _prepare_relay(graph, mesh)
         check_sources(srg.num_vertices, sources)
         max_levels = int(max_levels) if max_levels is not None else srg.num_vertices
         sources_new = jnp.asarray(srg.old2new[sources])
+
         # The batched variant vmaps the candidate pipeline over local trees;
         # it stays on the per-stage XLA appliers (vmap over the fused Pallas
         # calls is not exercised — the element-major engine is the batched
         # fast path on real hardware, models/bfs.run_multi_elem_device).
-        dist, parent, level = _bfs_sharded_relay_multi_fused(
-            jnp.asarray(srg.vperm_masks),
-            jnp.asarray(srg.net_masks),
-            _relay_valid_words(srg),
-            _own_word_table_dev(srg),
-            sources_new,
-            mesh=mesh,
-            static=_sharded_relay_static(srg, _graph_shards(mesh), False),
-            max_levels=max_levels,
-        )
+        def run_prog(packed: bool):
+            return _bfs_sharded_relay_multi_fused(
+                jnp.asarray(srg.vperm_masks),
+                jnp.asarray(srg.net_masks),
+                _relay_valid_words(srg),
+                _own_word_table_dev(srg),
+                sources_new,
+                mesh=mesh,
+                static=_sharded_relay_static(
+                    srg, _graph_shards(mesh), False, packed
+                ),
+                max_levels=max_levels,
+            )
+
+        packed = resolve_packed(packed_rank_fits(srg.in_classes))
+        dist, parent, level, changed = run_prog(packed)
+        if packed and packed_truncated(
+            jax.device_get(changed), jax.device_get(level), max_levels
+        ):
+            dist, parent, level, changed = run_prog(False)
         dist, parent = _relay_map_back(
             srg, jax.device_get(dist), jax.device_get(parent), sources
         )

@@ -144,11 +144,57 @@ def build_batch_runner(registry, name: str, engine: str, batch: int):
     # the serving tick declares its one upload and its one pull, and the
     # guard proves there are no others.
 
+    # Packed fused-word state (ops/packed.py) whenever parent ids fit its
+    # 26-bit field: half the per-superstep dist/parent HBM bytes per tick.
+    # A graph deeper than the packed 62-level cap is detected on the FIRST
+    # truncated reply (state.changed still set at the cap), latched, and
+    # every subsequent tick runs the lazily-compiled unpacked executable —
+    # one extra compile once per (graph, batch) shape, never a wrong reply.
+    from ..ops.packed import (
+        PACKED_MAX_LEVELS,
+        packed_parent_fits,
+        packed_truncated,
+        resolve_packed,
+    )
+
+    want_packed = resolve_packed(packed_parent_fits(v))
+    # A graph shallower than the cap can never truncate — skip the
+    # per-tick flag pull entirely (the common case; v-vertex BFS depth
+    # is bounded by v).
+    needs_depth_check = want_packed and v > PACKED_MAX_LEVELS
+
+    def _packed_runner_pair(lower):
+        """(packed executable, lazy unpacked executable holder)."""
+        state = {
+            "packed": lower(True) if want_packed else None,
+            "unpacked": None if want_packed else lower(False),
+            "use_packed": want_packed,
+        }
+
+        def call(*operands):
+            if state["use_packed"]:
+                out = state["packed"](*operands)
+                if not needs_depth_check:
+                    return out
+                # ONE combined pull (not two syncs) ahead of the reply
+                # pull — only on graphs deep enough to possibly truncate.
+                changed, level = jax.device_get((out.changed, out.level))
+                if not packed_truncated(changed, level, v):
+                    return out
+                state["use_packed"] = False  # latch: deeper than the cap
+            if state["unpacked"] is None:
+                state["unpacked"] = lower(False)
+            return state["unpacked"](*operands)
+
+        return call
+
     if engine == "pull":
         ell0, folds = registry.acquire(name, engine)
-        compiled = _bfs_multi_pull_fused.lower(
-            ell0, folds, jnp.zeros((batch,), jnp.int32), v, v
-        ).compile()
+        compiled = _packed_runner_pair(
+            lambda p: _bfs_multi_pull_fused.lower(
+                ell0, folds, jnp.zeros((batch,), jnp.int32), v, v, p
+            ).compile()
+        )
 
         # bfs_tpu: hot
         def run(sources: np.ndarray) -> MultiBfsResult:
@@ -164,9 +210,11 @@ def build_batch_runner(registry, name: str, engine: str, batch: int):
 
     if engine == "push":
         src, dst = registry.acquire(name, engine)
-        compiled = _bfs_multi_fused.lower(
-            src, dst, jnp.zeros((batch,), jnp.int32), v, v
-        ).compile()
+        compiled = _packed_runner_pair(
+            lambda p: _bfs_multi_fused.lower(
+                src, dst, jnp.zeros((batch,), jnp.int32), v, v, p
+            ).compile()
+        )
 
         # bfs_tpu: hot
         def run(sources: np.ndarray) -> MultiBfsResult:

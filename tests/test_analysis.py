@@ -469,14 +469,29 @@ def test_repo_has_expected_hot_coverage():
     from bfs_tpu.analysis.core import SourceFile, hot_regions
 
     expectations = {
-        "bfs_tpu/ops/relax.py": "relax_superstep",
-        "bfs_tpu/ops/pull.py": "relax_pull_superstep",
-        "bfs_tpu/serve/executor.py": "_state_to_result",
+        "bfs_tpu/ops/relax.py": (
+            "relax_superstep",
+            # the packed fused-word kernels (ISSUE 5) must keep
+            # transfer-guard coverage — deleting a pragma fails here
+            "relax_superstep_packed",
+            "apply_candidates_packed",
+        ),
+        "bfs_tpu/ops/pull.py": (
+            "relax_pull_superstep",
+            "relax_pull_superstep_packed",
+        ),
+        "bfs_tpu/ops/relay.py": (
+            "rowmin_ranks",
+            "apply_relay_candidates_packed",
+            "relay_superstep_words_packed",
+        ),
+        "bfs_tpu/serve/executor.py": ("_state_to_result",),
     }
-    for rel, fn_name in expectations.items():
+    for rel, fn_names in expectations.items():
         src = SourceFile(os.path.join(REPO, rel), REPO)
         names = {r.name for r in hot_regions(src)}
-        assert fn_name in names, (rel, sorted(names))
+        for fn_name in fn_names:
+            assert fn_name in names, (rel, fn_name, sorted(names))
     bench = SourceFile(os.path.join(REPO, "bfs_tpu/bench.py"), REPO)
     spans = [r for r in hot_regions(bench) if r.name.startswith("span@")]
     assert len(spans) >= 2, "bench timed-repeat hot spans went missing"

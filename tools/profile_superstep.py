@@ -1,103 +1,46 @@
-"""Per-piece timing of the v4 relay superstep on the real TPU (K-loop
-amortized — the tunnel costs ~107ms per sync)."""
-import os, sys, time
+"""Per-phase timing of the v4 relay superstep on the real TPU.
+
+Thin CLI over the shared phase ledger (bfs_tpu/profiling.py — the same
+phase-isolated K-loop jits the bench ships as details.superstep_phases):
+vperm / broadcast / net-apply / masked row-min / state-update (both
+layouts, with the analytic dist/parent byte halving) + the full dense
+superstep cross-check.  P_SCALE / P_EF select the cached bench graph.
+"""
+import json
+import os
+import sys
+
 sys.path.insert(0, "/root/repo")
-import jax, jax.numpy as jnp, numpy as np
+import jax
+
 jax.config.update("jax_compilation_cache_dir", "/root/repo/.bench_cache/xla")
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 5.0)
 
 from bfs_tpu.bench import load_or_build, load_or_build_relay
-from bfs_tpu.models.bfs import RelayEngine, _superstep_fn, _relay_static
-from bfs_tpu.ops import relay as R
-from bfs_tpu.ops import relay_pallas as RP
+from bfs_tpu.models.bfs import RelayEngine
+from bfs_tpu.profiling import superstep_phase_ledger
 
 scale = int(os.environ.get("P_SCALE", "20"))
 ef = int(os.environ.get("P_EF", "16"))
+loops = int(os.environ.get("P_LOOPS", "16"))
 dg, source = load_or_build(scale, ef, 42, 8192, "native")
 key = f"native_s{scale}_ef{ef}_seed42_block8192"
 rg, _ = load_or_build_relay(dg, key)
 eng = RelayEngine(rg)
-static = eng._static
-K = 16
-OPTS = {"xla_tpu_scoped_vmem_limit_kib": "65536"}
 
-def timeit(make_fn, args, label):
-    fn = jax.jit(make_fn)
-    c = fn.lower(*args).compile(compiler_options=OPTS)
-    r = c(*args); _ = np.asarray(jax.device_get(jax.tree_util.tree_leaves(r)[0])).ravel()[0]
-    ts = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        r = c(*args)
-        _ = np.asarray(jax.device_get(jax.tree_util.tree_leaves(r)[0])).ravel()[0]
-        ts.append(time.perf_counter() - t0)
-    t = (min(ts) - 0.107) / K  # remove tunnel latency, amortize K
-    print(f"{label:28s}: {t*1000:7.2f} ms/iter  (raw {min(ts)*1000:.0f} ms)")
-    return t
-
-vperm_m, net_m, valid = eng._tensors
-vp_static = RP.pass_static(rg.vperm_table, rg.vperm_size) if isinstance(vperm_m, tuple) else None
-net_static = RP.pass_static(rg.net_table, rg.net_size) if isinstance(net_m, tuple) else None
-print("pallas vperm:", vp_static is not None, " pallas net:", net_static is not None)
-
-nwv = rg.vr // 32
-fw0 = jnp.zeros(rg.vperm_size // 32, jnp.uint32).at[0].set(1)
-
-def k_net(l2, *m):
-    def body(i, x):
-        y = RP.apply_benes_fused(x, m, net_static, rg.net_size) if net_static else R.apply_benes_std(x, m[0], rg.net_table, rg.net_size)
-        return y ^ (x & 1)
-    return jax.lax.fori_loop(0, K, body, l2)
-
-l2_0 = jnp.zeros(rg.net_size // 32, jnp.uint32)
-net_args = (l2_0, *net_m) if isinstance(net_m, tuple) else (l2_0, net_m)
-timeit(k_net, net_args, "big net (fused passes)")
-
-def k_vperm(x, *m):
-    def body(i, x):
-        y = RP.apply_benes_fused(x, m, vp_static, rg.vperm_size) if vp_static else R.apply_benes_std(x, m[0], rg.vperm_table, rg.vperm_size)
-        return y ^ (x & 1)
-    return jax.lax.fori_loop(0, K, body, x)
-
-vp_args = (fw0, *vperm_m) if isinstance(vperm_m, tuple) else (fw0, vperm_m)
-timeit(k_vperm, vp_args, "vperm (fused passes)")
-
-def k_bcast(y):
-    def body(i, c):
-        l2 = R.broadcast_l2(y ^ c, rg.out_classes, rg.net_size, rg.out_space)
-        return c ^ (l2[:y.shape[0]] & 1)
-    return jax.lax.fori_loop(0, K, body, jnp.zeros_like(y))
-
-y0 = jnp.zeros(rg.vperm_size // 32, jnp.uint32)
-timeit(k_bcast, (y0,), "broadcast (XLA tiles)")
-
-def k_rowmin(l1, valid):
-    def body(i, c):
-        cand = R.rowmin_candidates(l1 ^ c[: l1.shape[0]], valid, rg.in_classes, rg.vr)
-        return c.at[: cand.shape[0]].set(c[: cand.shape[0]] ^ (cand.astype(jnp.uint32) & 1))
-    return jax.lax.fori_loop(0, K, body, jnp.zeros(max(l1.shape[0], rg.vr), jnp.uint32))
-
-l1_0 = jnp.zeros(rg.net_size // 32, jnp.uint32)
-timeit(k_rowmin, (l1_0, valid), "rowmin (XLA classes)")
-
-# full dense superstep
-superstep = _superstep_fn(static, eng._use_pallas())
-def k_step(dist, parent, fwords, *m):
-    vm = m[:len(vperm_m)] if isinstance(vperm_m, tuple) else m[0]
-    nm = m[len(vperm_m):-1] if isinstance(vperm_m, tuple) else m[1]
-    vv = m[-1]
-    st0 = R.RelayState(dist, parent, fwords, jnp.int32(0), jnp.bool_(True))
-    def body(i, st):
-        s2 = superstep(st, vm if isinstance(vperm_m, tuple) else m[0],
-                       nm if isinstance(net_m, tuple) else m[1], vv)
-        return R.RelayState(s2.dist, s2.parent, s2.fwords, st.level, st.changed)
-    out = jax.lax.fori_loop(0, K, body, st0)
-    return out.dist
-d0 = jnp.full(rg.vr, np.int32(2**31-1), jnp.int32)
-p0 = jnp.full(rg.vr, -1, jnp.int32)
-f0 = jnp.zeros(nwv, jnp.uint32).at[0].set(1)
-if isinstance(vperm_m, tuple):
-    args = (d0, p0, f0, *vperm_m, *net_m, valid)
-else:
-    args = (d0, p0, f0, vperm_m, net_m, valid)
-timeit(k_step, args, "FULL dense superstep")
+ledger = superstep_phase_ledger(eng, loops=loops, repeats=3)
+for name, ph in ledger["phases"].items():
+    print(f"{name:16s}: {ph['seconds'] * 1e3:8.2f} ms/superstep")
+su = ledger["phases"]["state_update"]
+print(
+    f"state update packed {su['packed']['seconds'] * 1e3:.2f} ms "
+    f"({su['packed']['bytes']['total'] >> 20} MB) vs unpacked "
+    f"{su['unpacked']['seconds'] * 1e3:.2f} ms "
+    f"({su['unpacked']['bytes']['total'] >> 20} MB) — dist/parent bytes "
+    f"ratio {su['dist_parent_bytes_ratio']:.1f}x"
+)
+print(
+    f"sum of phases {ledger['sum_of_phases_seconds'] * 1e3:.2f} ms vs "
+    f"full superstep {ledger['full_superstep_seconds'] * 1e3:.2f} ms"
+)
+print(json.dumps(ledger))
