@@ -24,6 +24,7 @@ import os
 
 from .core import RULES, Baseline, Finding, SourceFile
 from .locks import check_locks
+from .obs import check_obs
 from .recompile import check_recompile
 from .runtime import (
     format_retrace_report,
@@ -42,7 +43,7 @@ __all__ = [
     "retrace_report", "format_retrace_report", "transfer_guard_level",
 ]
 
-_CHECKERS = (check_transfer, check_recompile, check_locks)
+_CHECKERS = (check_transfer, check_recompile, check_locks, check_obs)
 
 #: Directories never linted even when a parent is passed (generated
 #: artifacts, caches, VCS internals).  ``fixtures`` keeps deliberately

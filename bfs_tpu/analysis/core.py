@@ -92,6 +92,11 @@ RULES: dict[str, tuple[str, str]] = {
     "RCD005": ("error",
                "executable-cache build closure reads a local that is not "
                "part of the cache key (under-keyed executable)"),
+    # -- observability discipline -----------------------------------------
+    "OBS001": ("error",
+               "telemetry/metrics read inside a declared hot region — "
+               "telemetry rides the loop carry and is pulled once at "
+               "loop exit (one device_get), never mid-loop"),
     # -- pragma hygiene ---------------------------------------------------
     "PRG001": ("error",
                "overlapping '# bfs_tpu: hot-start' — the previous span "

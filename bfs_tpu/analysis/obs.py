@@ -1,0 +1,65 @@
+"""Observability discipline (OBS001).
+
+The telemetry contract (bfs_tpu/obs/telemetry.py): device telemetry is
+carried as ``while_loop`` state and pulled EXACTLY ONCE at loop exit —
+one ``jax.device_get`` of the ~1 KB accumulators.  Any telemetry or
+metrics READ inside a declared hot region (a jitted loop body, a
+timed-repeat span, a serve batch runner) would either sync the device
+per superstep (the ~107 ms tunnel round-trip the whole design deletes)
+or concretize a traced value.  The same goes for the registry/exporter
+surfaces: ``snapshot()``, ``artifact_report()``, ``retrace_report()``,
+``span_report()``, ``chrome_trace()`` are reporting-path calls — legal
+anywhere EXCEPT a hot region.
+
+Span/counter WRITES (``span(...)``, ``instant(...)``, ``bump(...)``) are
+not flagged: they are host-side appends with no device interaction, and
+wrapping a hot region in a span is the intended usage.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import Finding, SourceFile, dotted_name, hot_regions
+from .transfer import _region_for
+
+#: Call names (the dotted tail) that READ telemetry/metrics state.
+_OBS_READ_CALLS = {
+    "read_telemetry",
+    "snapshot",
+    "artifact_report",
+    "retrace_report",
+    "span_report",
+    "chrome_trace",
+    "stitch_journal_trace",
+    "to_prometheus",
+}
+
+
+def check_obs(src: SourceFile) -> list[Finding]:
+    regions = hot_regions(src)
+    if not regions:
+        return []
+    findings: list[Finding] = []
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        line = getattr(node, "lineno", None)
+        if line is None:
+            continue
+        region = _region_for(line, regions)
+        if region is None:
+            continue
+        name = dotted_name(node.func)
+        tail = name.rsplit(".", 1)[-1] if name else ""
+        if tail in _OBS_READ_CALLS:
+            f = src.finding(
+                "OBS001", node,
+                f"hot region '{region.name}': telemetry/metrics read "
+                f"{tail}() inside the hot path — carry the accumulator "
+                "through the loop and pull it once at loop exit (one "
+                "device_get)",
+            )
+            if f is not None:
+                findings.append(f)
+    return findings

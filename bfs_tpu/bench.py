@@ -66,7 +66,14 @@ BENCH_DEVICE_CHECK (default 1 — verify on device; the multi-source path
 verifies every tree through the same DeviceChecker via per-tree
 on-device extraction), BENCH_PHASE_LEDGER (default 1 — ship the
 per-phase superstep ledger, bfs_tpu/profiling.py, as
-details.superstep_phases), BFS_TPU_PACKED (0/1 forces the packed
+details.superstep_phases), BENCH_LEVEL_CURVE (default 1 — ship
+details.level_curve from one UNTIMED telemetry-carrying fused run:
+per-level frontier occupancy/out-edges measured on device and pulled
+once at loop exit, bfs_tpu/obs/telemetry.py), BENCH_TRACE (path —
+override where the stitched Chrome-trace JSON lands; default
+``<journal>.trace.json``; ``bfs-tpu-obs trace`` re-exports),
+BFS_TPU_SPANS (default 1 — phase spans, bfs_tpu/obs/spans.py),
+BFS_TPU_PACKED (0/1 forces the packed
 fused-word state off/on — ops/packed.py; default: packed whenever the
 layout fits), BFS_TPU_CACHE_DIR (artifact-cache root for layout
 bundles / compile caches, default .bench_cache — see bfs_tpu/config.py;
@@ -132,6 +139,7 @@ def _behind(frac: float) -> bool:
 # record, and _boundary() marks the phase boundary (where BFS_TPU_FAULT can
 # inject a crash and where a resumed run picks up).  See module docstring.
 
+from .obs.spans import span as obs_span
 from .resilience.faults import fault_point
 
 #: Set once the provisional headline is computable: a zero-arg-to-status
@@ -197,6 +205,19 @@ def _install_signal_handlers(jr, _exit=os._exit):
                 )
             except Exception:
                 pass
+        # Flush the open span stack (each still-open phase span gets its
+        # real duration so far + a "signal:<name>" marker) and journal this
+        # generation's events, so an interrupted run leaves a USABLE trace
+        # — the resumed run's spans land in the next spans:<k> record and
+        # stitch_journal_trace re-assembles the full timeline.
+        try:
+            from .obs.spans import flush_open_spans, journal_spans
+
+            flush_open_spans(f"signal:{name}")
+            if jr is not None:
+                journal_spans(jr)
+        except Exception:
+            pass
         if jr is not None:
             try:
                 jr.put(
@@ -205,6 +226,10 @@ def _install_signal_handlers(jr, _exit=os._exit):
                 jr.close()
             except Exception:
                 pass
+        try:
+            _write_stitched_trace(jr)
+        except Exception:
+            pass
         try:
             sys.stdout.flush()
             sys.stderr.flush()
@@ -215,6 +240,48 @@ def _install_signal_handlers(jr, _exit=os._exit):
     for sig in (signal.SIGTERM, signal.SIGALRM):
         signal.signal(sig, _handler)
     return _handler
+
+
+def _write_stitched_trace(jr) -> str | None:
+    """Write the Perfetto-loadable Chrome trace for this run: stitched
+    from every generation's journaled span records when a journal exists
+    (default path: ``<journal>.trace.json``; BENCH_TRACE overrides), else
+    the in-process buffer to BENCH_TRACE.  Returns the path written."""
+    from .obs import spans as _spans
+
+    override = os.environ.get("BENCH_TRACE", "")
+    if jr is not None:
+        out = override or (os.path.splitext(jr.path)[0] + ".trace.json")
+        doc = _spans.stitch_journal_trace(jr.path)
+        tmp = f"{out}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+        os.replace(tmp, out)
+    elif override:
+        out = _spans.export_chrome_trace(override)
+    else:
+        return None
+    _stamp(f"trace: wrote {out}")
+    return out
+
+
+def _finish_obs(jr) -> None:
+    """End-of-run observability flush (shared by both bench paths): this
+    generation's spans journaled (BEFORE the journal closes), the journal
+    closed, and the stitched trace written next to it."""
+    try:
+        from .obs.spans import journal_spans
+
+        if jr is not None:
+            journal_spans(jr)
+    except Exception as exc:
+        _stamp(f"span journaling failed ({exc!r})")
+    if jr is not None:
+        jr.close()
+    try:
+        _write_stitched_trace(jr)
+    except Exception as exc:
+        _stamp(f"trace export failed ({exc!r})")
 
 # Persistent compile caches (config.enable_compile_cache): jax's own
 # persistent cache for the ~minutes-long remote compiles, plus the
@@ -609,8 +676,11 @@ def _multi_source_bench(rg, eng, dg, source, *, num_sources, do_check,
         _stamp("journal: multi-source reference restored; skipping re-run")
     else:
         _stamp("multi-source bench: reference run (compile + warm)...")
-        ref_state = eng.run_many_device([source])[0]
-        reached_mask = _reached_mask_packed(ref_state, rg.vr, remap=rg.old2new)
+        with obs_span("bench.reference"):
+            ref_state = eng.run_many_device([source])[0]
+            reached_mask = _reached_mask_packed(
+                ref_state, rg.vr, remap=rg.old2new
+            )
         esrc_h, _ = unpad_edges(dg)
         directed_per_tree = int(np.count_nonzero(reached_mask[esrc_h]))
         _boundary(
@@ -724,9 +794,10 @@ def _multi_source_bench(rg, eng, dg, source, *, num_sources, do_check,
     # dispatch, one intended sync, nothing else touches the host.
     for i in range(len(times), repeats):
         t0 = time.perf_counter()
-        with guarded_region("bench.timed_repeat_multi"):
-            state = run_batch(padded)
-        levels = [int(state.level)]  # bfs_tpu: ok TRC002 the one intended sync per repeat
+        with obs_span("bench.repeat", i=i):
+            with guarded_region("bench.timed_repeat_multi"):
+                state = run_batch(padded)
+            levels = [int(state.level)]  # bfs_tpu: ok TRC002 the one intended sync per repeat
         times.append(time.perf_counter() - t0)
         _stamp(f"batch repeat: {times[-1]:.3f}s")
         _boundary(jr, f"repeat:{i}", {"seconds": times[-1]})
@@ -888,20 +959,21 @@ def _multi_source_bench(rg, eng, dg, source, *, num_sources, do_check,
                 })
             return n
 
-        if os.environ.get("BENCH_DEVICE_CHECK", "1") != "0":
-            try:
-                n_checked = device_tree_verify()
-                mode = "on-device check"
-            except SystemExit:
-                raise  # real invariant violation: the run must fail
-            except Exception as exc:
-                _stamp(
-                    f"on-device tree check unavailable ({exc!r}); "
-                    "host fallback"
-                )
+        with obs_span("bench.verify", trees=num_sources):
+            if os.environ.get("BENCH_DEVICE_CHECK", "1") != "0":
+                try:
+                    n_checked = device_tree_verify()
+                    mode = "on-device check"
+                except SystemExit:
+                    raise  # real invariant violation: the run must fail
+                except Exception as exc:
+                    _stamp(
+                        f"on-device tree check unavailable ({exc!r}); "
+                        "host fallback"
+                    )
+                    n_checked = host_tree_verify()
+            else:
                 n_checked = host_tree_verify()
-        else:
-            n_checked = host_tree_verify()
         check_status = (
             f"passed ({n_checked}/{num_sources} trees fully verified, "
             f"{mode})"
@@ -914,7 +986,7 @@ def _multi_source_bench(rg, eng, dg, source, *, num_sources, do_check,
     doc = emit(check_status, {"artifact_caches": artifact_report()})
     if jr is not None:
         jr.put("headline", {"headline": doc})
-        jr.close()
+    _finish_obs(jr)
     fault_point("headline")
     from .analysis.runtime import format_retrace_report
 
@@ -1109,7 +1181,8 @@ def main():
 
     graph_key = f"{backend}_s{scale}_ef{edge_factor}_seed{seed}_block{block}"
     _stamp("loading device graph (npz cache or rebuild)...")
-    dg, source = load_or_build(scale, edge_factor, seed, block, backend)
+    with obs_span("bench.load_graph", scale=scale):
+        dg, source = load_or_build(scale, edge_factor, seed, block, backend)
     _stamp(f"device graph ready: V={dg.num_vertices} E={dg.num_edges}")
     if jr is not None:
         # Journal invalidation rule: same config but different graph bytes
@@ -1147,6 +1220,7 @@ def main():
             # rotated the journal and falls through to a fresh run).
             _stamp("journal: run already complete; replaying final headline")
             print(json.dumps(done["headline"]), flush=True)
+            _finish_obs(jr)
             return
     else:
         fault_point("graph")
@@ -1155,7 +1229,8 @@ def main():
         from .models.bfs import RelayEngine
 
         _stamp("loading relay layout (npz cache or rebuild)...")
-        rg, build_seconds = load_or_build_relay(dg, graph_key)
+        with obs_span("bench.layout", kind="relay"):
+            rg, build_seconds = load_or_build_relay(dg, graph_key)
         _stamp(f"relay layout ready (build_seconds={build_seconds:.1f})")
         _boundary(jr, "layout", {
             "build_seconds": build_seconds,
@@ -1202,18 +1277,19 @@ def main():
         # (resilience/retry.py classifier).
         from .resilience.retry import RetryPolicy, retry_call
 
-        eng = retry_call(
-            lambda: RelayEngine(rg, sparse_hybrid=sparse, applier=applier),
-            policy=RetryPolicy(
-                max_attempts=int(os.environ.get("BENCH_INIT_RETRIES", "2")),
-                base_delay_s=2.0, max_delay_s=30.0,
-            ),
-            on_retry=lambda a, e, d: _stamp(
-                f"engine init failed transiently (attempt {a}: {e!r}); "
-                f"retrying in {d:.1f}s"
-            ),
-            describe="relay engine init",
-        )
+        with obs_span("bench.engine_init"):
+            eng = retry_call(
+                lambda: RelayEngine(rg, sparse_hybrid=sparse, applier=applier),
+                policy=RetryPolicy(
+                    max_attempts=int(os.environ.get("BENCH_INIT_RETRIES", "2")),
+                    base_delay_s=2.0, max_delay_s=30.0,
+                ),
+                on_retry=lambda a, e, d: _stamp(
+                    f"engine init failed transiently (attempt {a}: {e!r}); "
+                    f"retrying in {d:.1f}s"
+                ),
+                describe="relay engine init",
+            )
         _stamp(f"engine init done (applier={eng.applier})")
         if jr is not None:
             # BENCH_APPLIER=auto can RESOLVE differently across processes
@@ -1363,13 +1439,14 @@ def main():
         )
     else:
         _stamp("reference run (compile + warm)...")
-        ref_state = run_roots([source])[0]  # device state; also compiles + warms
-        if engine == "relay":
-            reached_mask = _reached_mask_packed(
-                ref_state, eng.relay_graph.vr, remap=eng.relay_graph.old2new
-            )
-        else:
-            reached_mask = _reached_mask_packed(ref_state, dg.num_vertices)
+        with obs_span("bench.reference"):
+            ref_state = run_roots([source])[0]  # device state; also compiles + warms
+            if engine == "relay":
+                reached_mask = _reached_mask_packed(
+                    ref_state, eng.relay_graph.vr, remap=eng.relay_graph.old2new
+                )
+            else:
+                reached_mask = _reached_mask_packed(ref_state, dg.num_vertices)
         _stamp("reference run done; computing component + roots...")
         esrc_h, _ = unpad_edges(dg)
         directed_traversed = int(np.count_nonzero(reached_mask[esrc_h]))
@@ -1421,31 +1498,32 @@ def main():
     warm_rec = jr.get("warm") if jr is not None else None
     if len(times) < repeats or warm_rec is None:
         _stamp(f"warming {num_roots}-root chained batch...")
-        states = run_roots(roots)  # warm every root's program instance
-        levels = sync(states)
-        # Packed-cap guard (untimed, code-review finding): if ANY warm
-        # root stopped on the packed 62-level cap, disable the packed
-        # carry and re-warm unpacked — the timed repeats must never ship
-        # truncated supersteps, even when verification is later skipped
-        # on budget or disabled.  Zero cost on shallow graphs (the level
-        # test short-circuits the flag pulls).
-        from .ops.packed import PACKED_MAX_LEVELS
+        with obs_span("bench.warm", roots=num_roots):
+            states = run_roots(roots)  # warm every root's program instance
+            levels = sync(states)
+            # Packed-cap guard (untimed, code-review finding): if ANY warm
+            # root stopped on the packed 62-level cap, disable the packed
+            # carry and re-warm unpacked — the timed repeats must never ship
+            # truncated supersteps, even when verification is later skipped
+            # on budget or disabled.  Zero cost on shallow graphs (the level
+            # test short-circuits the flag pulls).
+            from .ops.packed import PACKED_MAX_LEVELS
 
-        if levels >= PACKED_MAX_LEVELS:
-            flags = jax.device_get([(s.changed, s.level) for s in states])
-            if any(
-                bool(c) and int(l) >= PACKED_MAX_LEVELS for c, l in flags
-            ):
-                _stamp(
-                    "warm run hit the packed 62-level cap: disabling "
-                    "packed state and re-warming unpacked"
-                )
-                if engine == "relay":
-                    eng.packed = False
-                else:
-                    packed_flag["on"] = False
-                levels = sync(run_roots(roots))
-        del states
+            if levels >= PACKED_MAX_LEVELS:
+                flags = jax.device_get([(s.changed, s.level) for s in states])
+                if any(
+                    bool(c) and int(l) >= PACKED_MAX_LEVELS for c, l in flags
+                ):
+                    _stamp(
+                        "warm run hit the packed 62-level cap: disabling "
+                        "packed state and re-warming unpacked"
+                    )
+                    if engine == "relay":
+                        eng.packed = False
+                    else:
+                        packed_flag["on"] = False
+                    levels = sync(run_roots(roots))
+            del states
         if engine == "relay":
             # The fused program for this exact config is now in the exe
             # cache; the scale-fallback estimator keys its compile estimate
@@ -1470,15 +1548,17 @@ def main():
         if profile_dir and i == repeats - 1:
             with jax.profiler.trace(profile_dir):
                 t0 = time.perf_counter()
-                with guarded_region("bench.timed_repeat"):
-                    states = run_roots(roots)
-                levels = sync(states)
+                with obs_span("bench.repeat", i=i):
+                    with guarded_region("bench.timed_repeat"):
+                        states = run_roots(roots)
+                    levels = sync(states)
                 times.append(time.perf_counter() - t0)
         else:
             t0 = time.perf_counter()
-            with guarded_region("bench.timed_repeat"):
-                states = run_roots(roots)
-            levels = sync(states)
+            with obs_span("bench.repeat", i=i):
+                with guarded_region("bench.timed_repeat"):
+                    states = run_roots(roots)
+                levels = sync(states)
             times.append(time.perf_counter() - t0)
         _stamp(f"repeat {i + 1}/{repeats}: {times[-1]:.3f}s")
         _boundary(jr, f"repeat:{i}", {"seconds": times[-1]})
@@ -1549,7 +1629,10 @@ def main():
                 "superstep_profile": "skipped (time budget)",
             })
         else:
-            layout_detail["superstep_profile"] = _superstep_profile(eng, source)
+            with obs_span("bench.superstep_profile"):
+                layout_detail["superstep_profile"] = _superstep_profile(
+                    eng, source
+                )
             _stamp("superstep profile done")
             _boundary(jr, "profile", {
                 "superstep_profile": layout_detail["superstep_profile"],
@@ -1574,11 +1657,73 @@ def main():
             from .profiling import superstep_phase_ledger
 
             _stamp("superstep phase ledger (phase-isolated jits)...")
-            layout_detail["superstep_phases"] = superstep_phase_ledger(eng)
+            with obs_span("bench.phase_ledger"):
+                layout_detail["superstep_phases"] = superstep_phase_ledger(eng)
             _stamp("superstep phase ledger done")
             _boundary(jr, "phase_ledger", {
                 "superstep_phases": layout_detail["superstep_phases"],
             })
+
+    # Device level curve (ISSUE 6 tentpole b): one UNTIMED fused search
+    # carrying the obs/telemetry accumulator as extra while_loop state —
+    # per-level frontier occupancy (+ out-edges on relay), pulled once at
+    # loop exit.  Ships as details.level_curve; its occupancy sum is
+    # cross-checked against the reference component size, and with the
+    # superstep profile's per-level seconds it yields per-level TEPS.
+    # This is the direction-switching input for ROADMAP item 2.
+    if os.environ.get("BENCH_LEVEL_CURVE", "1") != "0":
+        curve_rec = jr.get("level_curve") if jr is not None else None
+        if curve_rec is not None:
+            layout_detail["level_curve"] = curve_rec["level_curve"]
+            _stamp("journal: level curve restored")
+        elif _behind(0.80):
+            _stamp("behind budget: skipping level curve")
+            layout_detail["level_curve"] = "skipped (time budget)"
+            _boundary(jr, "level_curve", {
+                "level_curve": "skipped (time budget)",
+            })
+        else:
+            _stamp("level curve (telemetry-carrying fused run)...")
+            with obs_span("bench.level_curve"):
+                reference = int(reached_mask.sum())
+                if engine == "relay":
+                    curve = eng.run_level_curve(
+                        source, reference_reached=reference
+                    )
+                else:
+                    from .models.bfs import bfs_level_curve
+
+                    curve = bfs_level_curve(
+                        pg if engine == "pull" else dg, source,
+                        engine=engine, reference_reached=reference,
+                    )
+            prof = layout_detail.get("superstep_profile")
+            fe = curve.get("frontier_edges")
+            if isinstance(prof, dict) and fe:
+                # Edges traversed DURING the superstep that settled level l
+                # are the out-edges of the level l-1 frontier.
+                per_level = {}
+                sync_s = float(prof.get("sync_overhead_seconds", 0.0))
+                for e in prof.get("supersteps", []):
+                    l = int(e["level"])
+                    s = float(e["seconds_incl_sync"]) - sync_s
+                    if 1 <= l <= len(fe) and s > 0:
+                        per_level[str(l)] = fe[l - 1] / s
+                curve["per_level_teps"] = per_level
+            if not curve["occupancy_sum_matches_reference"]:
+                _stamp(
+                    "WARNING: level-curve occupancy sum "
+                    f"{curve['reachable']} != reference component "
+                    f"{curve['reference_reached']}"
+                )
+            layout_detail["level_curve"] = curve
+            _stamp(
+                f"level curve done: {curve['levels']} levels, peak "
+                f"{curve['peak_occupancy']} at L{curve['peak_level']}, "
+                f"occupancy sum matches reference: "
+                f"{curve['occupancy_sum_matches_reference']}"
+            )
+            _boundary(jr, "level_curve", {"level_curve": curve})
 
     check_status = "skipped"
     if do_check and _behind(0.90):
@@ -1713,17 +1858,20 @@ def main():
                 _mark_root(s, "on-device check")
             return n
 
-        if os.environ.get("BENCH_DEVICE_CHECK", "1") != "0":
-            try:
-                n_checked = device_verify()
-                mode = "on-device check"
-            except SystemExit:
-                raise  # real invariant violation: the run must fail
-            except Exception as exc:
-                _stamp(f"on-device check unavailable ({exc!r}); host fallback")
+        with obs_span("bench.verify", roots=len(to_check)):
+            if os.environ.get("BENCH_DEVICE_CHECK", "1") != "0":
+                try:
+                    n_checked = device_verify()
+                    mode = "on-device check"
+                except SystemExit:
+                    raise  # real invariant violation: the run must fail
+                except Exception as exc:
+                    _stamp(
+                        f"on-device check unavailable ({exc!r}); host fallback"
+                    )
+                    n_checked = host_verify()
+            else:
                 n_checked = host_verify()
-        else:
-            n_checked = host_verify()
         check_status = f"passed ({n_checked}/{num_roots} roots, {mode})"
         if n_checked < len(to_check):
             check_status += " [budget-limited]"
@@ -1737,7 +1885,7 @@ def main():
     # costs the next invocation a re-emit from already-journaled phases.
     if jr is not None:
         jr.put("headline", {"headline": doc})
-        jr.close()
+    _finish_obs(jr)
     fault_point("headline")
     from .analysis.runtime import format_retrace_report
 

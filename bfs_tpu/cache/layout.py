@@ -275,16 +275,20 @@ def _load_or_build(graph, *, cache, tag, kind, key_fn, build_fn, to_arrays,
     ``build_seconds`` — on a hit the COLD build time recorded when the
     bundle was written, so every warm run can report its warm-vs-cold
     speedup."""
+    from ..obs.spans import span as obs_span
+
     if cache is None:
         t0 = time.perf_counter()
-        obj = build_fn()
+        with obs_span("layout.build", kind=kind):
+            obj = build_fn()
         return obj, {
             "cache": "disabled",
             "build_seconds": time.perf_counter() - t0,
         }
     t0 = time.perf_counter()
     key = key_fn()
-    loaded = cache.load(key)
+    with obs_span("layout.bundle_load", kind=kind):
+        loaded = cache.load(key)
     if loaded is not None:
         doc, arrays = loaded
         obj = from_arrays(arrays)
@@ -299,20 +303,22 @@ def _load_or_build(graph, *, cache, tag, kind, key_fn, build_fn, to_arrays,
         }
     bump_artifact("layout_cache_misses")
     t1 = time.perf_counter()
-    obj = build_fn()
+    with obs_span("layout.build", kind=kind):
+        obj = build_fn()
     build_seconds = time.perf_counter() - t1
     t2 = time.perf_counter()
-    cache.save(
-        key,
-        to_arrays(obj),
-        {
-            "kind": kind,
-            "build_seconds": build_seconds,
-            "num_vertices": int(obj.num_vertices),
-            "num_edges": int(obj.num_edges),
-        },
-        tag=tag,
-    )
+    with obs_span("layout.bundle_save", kind=kind):
+        cache.save(
+            key,
+            to_arrays(obj),
+            {
+                "kind": kind,
+                "build_seconds": build_seconds,
+                "num_vertices": int(obj.num_vertices),
+                "num_edges": int(obj.num_edges),
+            },
+            tag=tag,
+        )
     return obj, {
         "cache": "miss",
         "key": key,

@@ -29,6 +29,7 @@ import numpy as np
 logger = logging.getLogger(__name__)
 
 from ..analysis.runtime import traced
+from ..obs.spans import span as obs_span
 from ..graph.csr import DeviceGraph, Graph, build_device_graph
 from ..graph.ell import PullGraph, build_pull_graph
 from ..ops.pull import relax_pull_superstep
@@ -54,8 +55,27 @@ def check_sources(num_vertices: int, sources) -> None:
         )
 
 
+def _loop_with_acc(cond, body, state, acc, record):
+    """``while_loop`` carrying ``(state, acc)``: the shared shape of every
+    fused program's telemetry variant (obs/telemetry.py).  ``record(acc,
+    new_state)`` runs ON DEVICE inside the loop body; the accumulator is
+    pulled once at loop exit by the CALLER (the OBS001 contract).  Returns
+    ``(final_state, final_acc)``."""
+
+    def cond2(carry):
+        return cond(carry[0])
+
+    def body2(carry):
+        st, a = carry
+        st2 = body(st)
+        return st2, record(a, st2)
+
+    return jax.lax.while_loop(cond2, body2, (state, acc))
+
+
 @functools.partial(
-    jax.jit, static_argnames=("num_vertices", "max_levels", "packed")
+    jax.jit,
+    static_argnames=("num_vertices", "max_levels", "packed", "telemetry"),
 )
 @traced("bfs._bfs_fused")
 def _bfs_fused(
@@ -65,13 +85,26 @@ def _bfs_fused(
     num_vertices: int,
     max_levels: int,
     packed: bool = False,
-) -> BfsState:
+    telemetry: bool = False,
+):
     """With ``packed``, the loop carries the fused ``level:6|parent:26``
     word state (ops/packed.py — half the per-superstep dist/parent HBM
     bytes), capped at PACKED_MAX_LEVELS and unpacked ONCE at loop exit, so
     the returned BfsState is shape- and value-identical to the unpacked
     path wherever the cap was not hit.  Callers detect a cap exit via
-    ``packed_truncated`` and re-run unpacked."""
+    ``packed_truncated`` and re-run unpacked.
+
+    With ``telemetry`` (static), the loop additionally carries the
+    per-level occupancy accumulator and returns ``(BfsState, acc)`` —
+    pulled once at loop exit by the caller (obs/telemetry.py)."""
+    if telemetry:
+        from ..obs import telemetry as T
+
+        acc0 = T.init_level_acc()
+
+        def rec(a, s):
+            return T.record_frontier_bools(a, s.frontier, s.level)
+
     if packed:
         from ..ops.packed import packed_cap
         from ..ops.relax import (
@@ -82,12 +115,17 @@ def _bfs_fused(
 
         cap = packed_cap(max_levels)
         pstate = init_packed_state(num_vertices, source)
-        out = jax.lax.while_loop(
-            lambda s: s.changed & (s.level < cap),
-            lambda s: relax_superstep_packed(s, src, dst),
-            pstate,
-        )
-        return unpack_bfs_state(out)
+
+        def pcond(s):
+            return s.changed & (s.level < cap)
+
+        def pbody(s):
+            return relax_superstep_packed(s, src, dst)
+
+        if telemetry:
+            out, acc = _loop_with_acc(pcond, pbody, pstate, acc0, rec)
+            return unpack_bfs_state(out), acc
+        return unpack_bfs_state(jax.lax.while_loop(pcond, pbody, pstate))
     state = init_state(num_vertices, source)
 
     def cond(s: BfsState):
@@ -96,6 +134,8 @@ def _bfs_fused(
     def body(s: BfsState):
         return relax_superstep(s, src, dst)
 
+    if telemetry:
+        return _loop_with_acc(cond, body, state, acc0, rec)
     return jax.lax.while_loop(cond, body, state)
 
 
@@ -127,7 +167,8 @@ class BfsResult:
 
 
 @functools.partial(
-    jax.jit, static_argnames=("num_vertices", "max_levels", "packed")
+    jax.jit,
+    static_argnames=("num_vertices", "max_levels", "packed", "telemetry"),
 )
 @traced("bfs._bfs_pull_fused")
 def _bfs_pull_fused(
@@ -137,9 +178,19 @@ def _bfs_pull_fused(
     num_vertices: int,
     max_levels: int,
     packed: bool = False,
-) -> BfsState:
+    telemetry: bool = False,
+):
     """``packed`` as in :func:`_bfs_fused`: fused-word carry, one unpack
-    at loop exit, PACKED_MAX_LEVELS cap."""
+    at loop exit, PACKED_MAX_LEVELS cap.  ``telemetry`` as in
+    :func:`_bfs_fused`: returns ``(BfsState, acc)``."""
+    if telemetry:
+        from ..obs import telemetry as T
+
+        acc0 = T.init_level_acc()
+
+        def rec(a, s):
+            return T.record_frontier_bools(a, s.frontier, s.level)
+
     if packed:
         from ..ops.packed import packed_cap
         from ..ops.pull import relax_pull_superstep_packed
@@ -147,12 +198,17 @@ def _bfs_pull_fused(
 
         cap = packed_cap(max_levels)
         pstate = init_packed_state(num_vertices, source)
-        out = jax.lax.while_loop(
-            lambda s: s.changed & (s.level < cap),
-            lambda s: relax_pull_superstep_packed(s, ell0, folds),
-            pstate,
-        )
-        return unpack_bfs_state(out)
+
+        def pcond(s):
+            return s.changed & (s.level < cap)
+
+        def pbody(s):
+            return relax_pull_superstep_packed(s, ell0, folds)
+
+        if telemetry:
+            out, acc = _loop_with_acc(pcond, pbody, pstate, acc0, rec)
+            return unpack_bfs_state(out), acc
+        return unpack_bfs_state(jax.lax.while_loop(pcond, pbody, pstate))
     state = init_state(num_vertices, source)
 
     def cond(s: BfsState):
@@ -161,6 +217,8 @@ def _bfs_pull_fused(
     def body(s: BfsState):
         return relax_pull_superstep(s, ell0, folds)
 
+    if telemetry:
+        return _loop_with_acc(cond, body, state, acc0, rec)
     return jax.lax.while_loop(cond, body, state)
 
 
@@ -381,9 +439,9 @@ def _take_sparse(st, outdeg, vr: int):
     return (fsize <= SPARSE_BV) & (fedges <= jnp.uint32(SPARSE_BE))
 
 
-@functools.lru_cache(maxsize=8)
+@functools.lru_cache(maxsize=16)
 def _relay_fused_program(static, sparse: bool, use_pallas: bool,
-                         packed: bool = False):
+                         packed: bool = False, telemetry: bool = False):
     """Jitted relay BFS loop (v4), cached per static layout shape.
 
     With ``sparse``, small frontiers (under the SPARSE_BV/BE budgets) take
@@ -403,7 +461,15 @@ def _relay_fused_program(static, sparse: bool, use_pallas: bool,
     gather work at the TPU's scalar-gather rate, so hybrid-on still
     measured 149 vs 103 ms/search — but the structure is strictly less
     overhead wherever the hybrid IS right (CPU backends, high-diameter
-    tails)."""
+    tails).
+
+    With ``telemetry`` (static), the carry additionally holds the
+    per-level accumulators (obs/telemetry.py): frontier occupancy
+    (int32[TEL_SLOTS]) and frontier out-edges (float32 — ``outdeg`` is
+    already a loop operand), recorded after every dense AND sparse
+    superstep and returned alongside the finished state for ONE pull at
+    loop exit — the Beamer-style direction-switching input (ROADMAP
+    item 2) without a per-superstep host sync."""
     (vr, vperm_size, vperm_table, out_classes, out_space, net_table,
      net_size, in_classes) = static
     from ..ops import relay as R
@@ -439,23 +505,75 @@ def _relay_fused_program(static, sparse: bool, use_pallas: bool,
                 dist, parent, out.fwords, out.level, out.changed
             )
 
+        if telemetry:
+            from ..obs import telemetry as T
+            from ..ops.relax import INT32_MAX
+
+            # In-loop carry: ONLY the popcount occupancy accumulator
+            # (measured free next to a superstep).  The out-edge curve is
+            # derived in one pass at loop exit from the final levels —
+            # a per-superstep masked outdeg sum cost ~25% of a CPU
+            # superstep, violating the <2% telemetry budget.
+            acc0 = T.init_level_acc()
+
+            def rec(fv, st):
+                return T.record_frontier_words(fv, st.fwords, st.level)
+
+            def finish_tel(out, fv):
+                st = finish(out)
+                fe = T.edge_curve_from_levels(
+                    st.dist, outdeg, st.dist == INT32_MAX
+                )
+                return st, (fv, fe)
+
         if not sparse:
+            if telemetry:
+                out, fv = _loop_with_acc(live, dense, state, acc0, rec)
+                return finish_tel(out, fv)
             return finish(jax.lax.while_loop(live, dense, state))
 
         def small(st):
             return _take_sparse(st, outdeg, vr)
 
+        def sparse_step(st):
+            return _sparse_superstep(
+                st, adj_indptr, adj_dst, adj_slot, vr=vr, packed=packed
+            )
+
         def sparse_phase(st):
             return jax.lax.while_loop(
-                lambda s: live(s) & small(s),
-                lambda s: _sparse_superstep(
-                    s, adj_indptr, adj_dst, adj_slot, vr=vr, packed=packed
-                ),
-                st,
+                lambda s: live(s) & small(s), sparse_step, st
             )
 
         def body(st):
             return sparse_phase(dense(st))
+
+        if telemetry:
+            # Same nested-while structure, carry extended with the acc:
+            # dense and sparse supersteps both record, so the curve covers
+            # every level regardless of which path settled it.
+            def sparse_step_t(c):
+                st, fv = c
+                st2 = sparse_step(st)
+                return st2, rec(fv, st2)
+
+            def sparse_phase_t(c):
+                return jax.lax.while_loop(
+                    lambda cc: live(cc[0]) & small(cc[0]), sparse_step_t, c
+                )
+
+            def dense_t(c):
+                st, fv = c
+                st2 = dense(st)
+                return st2, rec(fv, st2)
+
+            def body_t(c):
+                return sparse_phase_t(dense_t(c))
+
+            out, fv = jax.lax.while_loop(
+                lambda cc: live(cc[0]), body_t, sparse_phase_t((state, acc0))
+            )
+            return finish_tel(out, fv)
 
         return finish(jax.lax.while_loop(live, body, sparse_phase(state)))
 
@@ -596,7 +714,8 @@ def compile_exe_cached(lowered, compiler_options):
 
     cache_dir = os.environ.get("BFS_TPU_EXE_CACHE", "")
     if not cache_dir or jax.default_backend() != "tpu":
-        return lowered.compile(compiler_options=compiler_options)
+        with obs_span("compile"):
+            return lowered.compile(compiler_options=compiler_options)
     try:
         hlo = lowered.as_text().encode()
     except Exception:
@@ -638,7 +757,8 @@ def compile_exe_cached(lowered, compiler_options):
             except OSError:
                 pass
     bump_artifact("exe_cache_misses")
-    compiled = lowered.compile(compiler_options=compiler_options)
+    with obs_span("compile", exe_cache="miss"):
+        compiled = lowered.compile(compiler_options=compiler_options)
     try:
         from jax.experimental.serialize_executable import serialize
 
@@ -1038,6 +1158,13 @@ class RelayEngine:
                 print(f"[engine] {msg}", file=sys.stderr, flush=True)
 
         self._istamp = _istamp
+        # Span the whole init (mask prep + shipping dominate it at scale);
+        # entered/exited manually — a `with` would reindent the body, and
+        # an init that raises leaves the span open for flush_open_spans.
+        _init_span = obs_span(
+            "engine_init", engine="relay", vr=int(rg.vr), applier=applier
+        )
+        _init_span.__enter__()
         _istamp(f"init: resolving applier ({applier!r})...")
         self.applier = self._resolve_applier(applier)
         # Device-resident layout tensors are passed as jit ARGUMENTS — a
@@ -1113,6 +1240,7 @@ class RelayEngine:
             )
         self._static = _relay_static(rg)
         self._compiled = {}
+        _init_span.__exit__(None, None, None)
         _istamp("init done")
 
     def _resolve_applier(self, applier: str) -> str:
@@ -1130,9 +1258,10 @@ class RelayEngine:
             return applier
         if not _net_uses_pallas(self.relay_graph.net_size):
             return "xla"  # too small for the fused passes; nothing to probe
-        probe, net_arg = _probe_appliers(
-            self.relay_graph, self._COMPILER_OPTIONS
-        )
+        with obs_span("applier_probe", net_size=int(self.relay_graph.net_size)):
+            probe, net_arg = _probe_appliers(
+                self.relay_graph, self._COMPILER_OPTIONS
+            )
         self.applier_probe = probe
         self._probe_net_arg = net_arg
         return probe["selected"]
@@ -1185,18 +1314,20 @@ class RelayEngine:
             self._sparse_alt = alt
         return alt
 
-    def _fused(self, source_new, max_levels, packed: bool | None = None):
+    def _fused(self, source_new, max_levels, packed: bool | None = None,
+               telemetry: bool = False):
         if packed is None:
             packed = self.packed
         fused = _relay_fused_program(
-            self._static, self.sparse_hybrid, self._use_pallas(), packed
+            self._static, self.sparse_hybrid, self._use_pallas(), packed,
+            telemetry,
         )
         args = (
             source_new, *self._tensors, *self._sparse_tensors_for(packed)
         )
         if not self._use_pallas():
             return fused(*args, max_levels=max_levels)
-        key = ("fused", max_levels, packed)
+        key = ("fused", max_levels, packed, telemetry)
         compiled = self._compiled.get(key)
         if compiled is None:
             compiled = self._compile_maybe_cached(
@@ -1500,6 +1631,48 @@ class RelayEngine:
             )
         return self._to_result(state, source)
 
+    def run_level_curve(self, source: int = 0, *,
+                        max_levels: int | None = None,
+                        reference_reached: int | None = None) -> dict:
+        """One UNTIMED fused search with the device telemetry accumulator
+        (obs/telemetry.py) carried as extra loop state; returns the
+        JSON-ready level curve — per-level frontier occupancy + out-edge
+        counts, packed-cap proximity.
+
+        Transfer cost: ONE ``device_get`` of the ~1 KB accumulators plus
+        the loop-exit scalars — the 128 MB dist/parent stay on device
+        (the whole point: the curve is the direction-switching input for
+        ROADMAP item 2 and must be readable without breaking the
+        hot-region transfer rules)."""
+        from ..obs.telemetry import level_curve, read_telemetry
+        from ..ops.packed import PACKED_MAX_LEVELS, packed_truncated
+
+        rg = self.relay_graph
+        check_sources(rg.num_vertices, source)
+        max_levels = int(max_levels) if max_levels is not None else rg.vr
+        src = jax.device_put(np.int32(rg.old2new[source]))
+        state, (fv_d, fe_d) = self._fused(src, max_levels, telemetry=True)
+        fv, fe, changed, level = read_telemetry(
+            (fv_d, fe_d, state.changed, state.level)
+        )
+        packed_run = self.packed
+        if packed_run and packed_truncated(changed, level, max_levels):
+            # Deeper than the packed level field: the curve would be
+            # truncated at the cap — re-run unpacked, same contract as run().
+            state, (fv_d, fe_d) = self._fused(
+                src, max_levels, packed=False, telemetry=True
+            )
+            fv, fe, changed, level = read_telemetry(
+                (fv_d, fe_d, state.changed, state.level)
+            )
+            packed_run = False
+        # The loop's REAL cap: the packed level field AND the caller's
+        # max_levels both bound it — reporting the raw 62 would hide a
+        # caller-limit truncation behind a healthy-looking proximity.
+        cap = min(PACKED_MAX_LEVELS, max_levels) if packed_run else max_levels
+        return level_curve(fv, fe, cap=cap,
+                           reference_reached=reference_reached)
+
     def run_many_device(self, sources, *, max_levels: int | None = None):
         """Graph500-style batched timing path: dispatch one fused BFS per
         source WITHOUT syncing in between (a synchronized round-trip through
@@ -1793,6 +1966,79 @@ def bfs(
         parent=np.asarray(state.parent[:num_vertices]),
         num_levels=int(state.level),
     )
+
+
+def bfs_level_curve(
+    graph: Graph | DeviceGraph | PullGraph,
+    source: int = 0,
+    *,
+    engine: str = "pull",
+    max_levels: int | None = None,
+    block: int = 1024,
+    reference_reached: int | None = None,
+) -> dict:
+    """The level curve (per-level frontier occupancy, obs/telemetry.py)
+    of one single-source search — :func:`bfs`'s telemetry twin for the
+    push/pull engines, pulling ONE ~0.5 KB accumulator instead of the
+    V-sized result arrays.  Relay callers use
+    :meth:`RelayEngine.run_level_curve` (it also carries per-level
+    frontier out-edges)."""
+    from ..obs.telemetry import level_curve, read_telemetry
+    from ..ops.packed import (
+        PACKED_MAX_LEVELS,
+        packed_parent_fits,
+        packed_truncated,
+        resolve_packed,
+    )
+    from ..graph.relay import RelayGraph
+
+    if engine == "relay" or isinstance(graph, RelayGraph):
+        return RelayEngine(graph).run_level_curve(
+            source, max_levels=max_levels,
+            reference_reached=reference_reached,
+        )
+    if engine == "pull":
+        pg = graph if isinstance(graph, PullGraph) else build_pull_graph(graph)
+        check_sources(pg.num_vertices, source)
+        n = pg.num_vertices
+        limit = int(max_levels) if max_levels is not None else n
+        from ..graph.ell import device_ell
+
+        ell0_t, folds_t = device_ell(pg)
+
+        def run(packed):
+            return _bfs_pull_fused(
+                ell0_t, folds_t, jnp.int32(source), n, limit, packed, True
+            )
+
+        packed = resolve_packed(packed_parent_fits(n))
+    elif engine == "push":
+        dg = (
+            graph
+            if isinstance(graph, DeviceGraph)
+            else build_device_graph(graph, block=block)
+        )
+        check_sources(dg.num_vertices, source)
+        n = dg.num_vertices
+        limit = int(max_levels) if max_levels is not None else n
+        src_t, dst_t = jnp.asarray(dg.src), jnp.asarray(dg.dst)
+
+        def run(packed):
+            return _bfs_fused(
+                src_t, dst_t, jnp.int32(source), n, limit, packed, True
+            )
+
+        packed = resolve_packed(packed_parent_fits(n))
+    else:
+        raise ValueError(f"unknown engine {engine!r}; use relay/pull/push")
+    state, acc = run(packed)
+    fv, changed, level = read_telemetry((acc, state.changed, state.level))
+    if packed and packed_truncated(changed, level, limit):
+        state, acc = run(False)
+        fv, changed, level = read_telemetry((acc, state.changed, state.level))
+        packed = False
+    cap = min(PACKED_MAX_LEVELS, limit) if packed else limit
+    return level_curve(fv, cap=cap, reference_reached=reference_reached)
 
 
 class SuperstepRunner:

@@ -26,18 +26,34 @@ from ..analysis.runtime import traced
 
 
 @functools.partial(
-    jax.jit, static_argnames=("num_vertices", "max_levels", "packed")
+    jax.jit,
+    static_argnames=("num_vertices", "max_levels", "packed", "telemetry"),
 )
 @traced("multisource._bfs_multi_fused")
 def _bfs_multi_fused(
     src, dst, sources, num_vertices: int, max_levels: int,
-    packed: bool = False,
-) -> BfsState:
+    packed: bool = False, telemetry: bool = False,
+):
     """``packed`` carries the fused ``level:6|parent:26`` word state
     (ops/packed.py) through the loop — half the per-superstep dist/parent
     HBM bytes — capped at PACKED_MAX_LEVELS and unpacked ONCE at loop
     exit, so the returned BfsState is identical wherever the cap was not
-    hit (callers detect a cap exit via ``packed_truncated``)."""
+    hit (callers detect a cap exit via ``packed_truncated``).
+
+    With ``telemetry`` (static) the loop additionally carries the
+    per-level occupancy accumulator (summed over the sources axis —
+    the GLOBAL curve) and returns ``(BfsState, acc)`` for one pull at
+    loop exit (obs/telemetry.py)."""
+    from .bfs import _loop_with_acc
+
+    if telemetry:
+        from ..obs import telemetry as T
+
+        acc0 = T.init_level_acc(sources.shape[0], wide=True)
+
+        def rec(a, s):
+            return T.record_frontier_bools(a, s.frontier, s.level)
+
     if packed:
         from ..ops.packed import packed_cap
         from ..ops.relax import (
@@ -47,12 +63,18 @@ def _bfs_multi_fused(
         )
 
         cap = packed_cap(max_levels)
-        out = jax.lax.while_loop(
-            lambda s: s.changed & (s.level < cap),
-            lambda s: relax_superstep_batched_packed(s, src, dst),
-            init_packed_batched_state(num_vertices, sources),
-        )
-        return unpack_bfs_state(out)
+        pstate = init_packed_batched_state(num_vertices, sources)
+
+        def pcond(s):
+            return s.changed & (s.level < cap)
+
+        def pbody(s):
+            return relax_superstep_batched_packed(s, src, dst)
+
+        if telemetry:
+            out, acc = _loop_with_acc(pcond, pbody, pstate, acc0, rec)
+            return unpack_bfs_state(out), acc
+        return unpack_bfs_state(jax.lax.while_loop(pcond, pbody, pstate))
     state = init_batched_state(num_vertices, sources)
 
     def cond(s: BfsState):
@@ -61,33 +83,52 @@ def _bfs_multi_fused(
     def body(s: BfsState):
         return relax_superstep_batched(s, src, dst)
 
+    if telemetry:
+        return _loop_with_acc(cond, body, state, acc0, rec)
     return jax.lax.while_loop(cond, body, state)
 
 
 @functools.partial(
-    jax.jit, static_argnames=("num_vertices", "max_levels", "packed")
+    jax.jit,
+    static_argnames=("num_vertices", "max_levels", "packed", "telemetry"),
 )
 @traced("multisource._bfs_multi_pull_fused")
 def _bfs_multi_pull_fused(
     ell0, folds, sources, num_vertices: int, max_levels: int,
-    packed: bool = False,
-) -> BfsState:
+    packed: bool = False, telemetry: bool = False,
+):
     """Batched pull: the frontier table carries a leading sources axis and
     the ELL gathers broadcast over it (ops/pull.py pull_candidates), so all
     S trees advance in lock-step supersteps of one compiled loop.
-    ``packed`` as in :func:`_bfs_multi_fused`."""
+    ``packed`` and ``telemetry`` as in :func:`_bfs_multi_fused`."""
+    from .bfs import _loop_with_acc
+
+    if telemetry:
+        from ..obs import telemetry as T
+
+        acc0 = T.init_level_acc(sources.shape[0], wide=True)
+
+        def rec(a, s):
+            return T.record_frontier_bools(a, s.frontier, s.level)
+
     if packed:
         from ..ops.packed import packed_cap
         from ..ops.pull import relax_pull_superstep_packed
         from ..ops.relax import init_packed_batched_state, unpack_bfs_state
 
         cap = packed_cap(max_levels)
-        out = jax.lax.while_loop(
-            lambda s: s.changed & (s.level < cap),
-            lambda s: relax_pull_superstep_packed(s, ell0, folds),
-            init_packed_batched_state(num_vertices, sources),
-        )
-        return unpack_bfs_state(out)
+        pstate = init_packed_batched_state(num_vertices, sources)
+
+        def pcond(s):
+            return s.changed & (s.level < cap)
+
+        def pbody(s):
+            return relax_pull_superstep_packed(s, ell0, folds)
+
+        if telemetry:
+            out, acc = _loop_with_acc(pcond, pbody, pstate, acc0, rec)
+            return unpack_bfs_state(out), acc
+        return unpack_bfs_state(jax.lax.while_loop(pcond, pbody, pstate))
     state = init_batched_state(num_vertices, sources)
 
     def cond(s: BfsState):
@@ -96,6 +137,8 @@ def _bfs_multi_pull_fused(
     def body(s: BfsState):
         return relax_pull_superstep(s, ell0, folds)
 
+    if telemetry:
+        return _loop_with_acc(cond, body, state, acc0, rec)
     return jax.lax.while_loop(cond, body, state)
 
 
@@ -117,6 +160,7 @@ def bfs_multi_device(
     max_levels: int | None = None,
     block: int = 1024,
     packed: bool | None = None,
+    telemetry: bool = False,
 ):
     """DEVICE-resident half of :func:`bfs_multi` for pull/push: returns the
     raw batched BfsState without any host transfer (``int(state.level)`` is
@@ -126,7 +170,11 @@ def bfs_multi_device(
     ``packed=None`` runs the fused-word carry whenever parent ids fit its
     26-bit field; the loop then caps at PACKED_MAX_LEVELS and raw-device
     callers must test ``state.changed`` at the cap (:func:`bfs_multi`
-    does, and falls back automatically)."""
+    does, and falls back automatically).
+
+    With ``telemetry`` the state comes back as ``(BfsState, acc)`` —
+    the device-resident level accumulator, pulled once at loop exit
+    (:func:`bfs_multi_level_curve` is the host-side convenience)."""
     sources = np.atleast_1d(np.asarray(sources, dtype=np.int32))
     from ..ops.packed import packed_parent_fits, resolve_packed
     from .bfs import check_sources
@@ -147,6 +195,7 @@ def bfs_multi_device(
             pg.num_vertices,
             max_levels,
             packed,
+            telemetry,
         )
         return state, pg.num_vertices
     if engine != "push":
@@ -160,7 +209,7 @@ def bfs_multi_device(
         packed = resolve_packed(packed_parent_fits(dg.num_vertices))
     state = _bfs_multi_fused(
         jnp.asarray(dg.src), jnp.asarray(dg.dst), jnp.asarray(sources),
-        dg.num_vertices, max_levels, packed,
+        dg.num_vertices, max_levels, packed, telemetry,
     )
     return state, dg.num_vertices
 
@@ -208,6 +257,46 @@ def bfs_multi(
         parent=np.asarray(state.parent[:, :v]),
         num_levels=int(state.level),
     )
+
+
+def bfs_multi_level_curve(
+    graph: Graph | DeviceGraph | PullGraph,
+    sources,
+    *,
+    engine: str = "pull",
+    max_levels: int | None = None,
+    block: int = 1024,
+) -> dict:
+    """The GLOBAL level curve of a batched multi-source run (occupancy
+    summed over the sources axis; its total is the summed per-tree
+    reachable counts).  One accumulator pull — the [S, V] dist/parent
+    stay on device.  Packed runs past the 62-level cap re-run unpacked,
+    same contract as :func:`bfs_multi`."""
+    from ..obs.telemetry import level_curve, read_telemetry
+    from ..ops.packed import (
+        PACKED_MAX_LEVELS,
+        packed_parent_fits,
+        packed_truncated,
+        resolve_packed,
+    )
+
+    sources = np.atleast_1d(np.asarray(sources, dtype=np.int32))
+    requested = int(max_levels) if max_levels is not None else graph.num_vertices
+    packed = resolve_packed(packed_parent_fits(graph.num_vertices))
+    (state, acc), _v = bfs_multi_device(
+        graph, sources, engine=engine, max_levels=max_levels, block=block,
+        packed=packed, telemetry=True,
+    )
+    fv, changed, level = read_telemetry((acc, state.changed, state.level))
+    if packed and packed_truncated(changed, level, requested):
+        (state, acc), _v = bfs_multi_device(
+            graph, sources, engine=engine, max_levels=max_levels,
+            block=block, packed=False, telemetry=True,
+        )
+        fv, changed, level = read_telemetry((acc, state.changed, state.level))
+        packed = False
+    cap = min(PACKED_MAX_LEVELS, requested) if packed else requested
+    return level_curve(fv, cap=cap)
 
 
 def collapse_multi_source(result: MultiBfsResult):

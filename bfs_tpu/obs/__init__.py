@@ -1,0 +1,51 @@
+"""bfs_tpu.obs — unified observability: spans, device telemetry, metrics.
+
+Three pillars (ISSUE 6), replacing four disconnected lenses (the phase
+ledger, ServeMetrics + artifact counters, retrace counters, the
+resilience journal) with one layer:
+
+* **Spans** (:mod:`.spans`) — lightweight nestable wall-clock spans
+  (context manager + decorator) exported as Chrome-trace-event JSON
+  (Perfetto-loadable) and journaled through
+  :class:`~bfs_tpu.resilience.journal.RunJournal` so a resumed bench
+  stitches a complete trace across process generations.
+* **Device superstep telemetry** (:mod:`.telemetry`) — a small
+  accumulator carried as extra ``while_loop`` state by the fused BFS
+  programs (per-level frontier occupancy / changed-vertex count /
+  packed-cap proximity), pulled ONCE at loop exit — the
+  direction-switching input for ROADMAP item 2 and per-level TEPS for
+  free.  Imported lazily: it needs jax, the rest of this package is
+  stdlib-only (tools/lint.py's stub-parent fast path stays sub-100ms).
+* **One registry** (:mod:`.registry`) — a process-global
+  :class:`MetricsRegistry` absorbing ServeMetrics, artifact counters and
+  retrace counters behind one snapshot API with JSON and
+  Prometheus-text exporters.
+
+CLI: ``bfs-tpu-obs`` (= ``python -m bfs_tpu.obs``) stitches a finished
+bench journal into a Perfetto trace and prints metric snapshots;
+``tools/obs_dashboard.py`` renders trace + level curve + serve
+percentiles from a run's artifacts.
+"""
+
+from __future__ import annotations
+
+from .registry import MetricsRegistry, get_registry, prometheus_text
+from .spans import (
+    chrome_trace,
+    export_chrome_trace,
+    flush_open_spans,
+    instant,
+    journal_spans,
+    snapshot_events,
+    span,
+    span_report,
+    spans_enabled,
+    stitch_journal_trace,
+)
+
+__all__ = [
+    "MetricsRegistry", "get_registry", "prometheus_text",
+    "span", "instant", "spans_enabled", "snapshot_events", "span_report",
+    "chrome_trace", "export_chrome_trace", "flush_open_spans",
+    "journal_spans", "stitch_journal_trace",
+]

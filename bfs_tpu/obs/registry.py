@@ -1,0 +1,158 @@
+"""One process-global MetricsRegistry: every counter behind one snapshot.
+
+Before this module the repo had four disconnected metric surfaces —
+``ServeMetrics`` (per-server latency/batching aggregates),
+``bump_artifact`` (process-global cache counters), the retrace counters
+in :mod:`bfs_tpu.analysis.runtime`, and the span buffer.  Each grew its
+own ad-hoc report formatting in whichever tool read it.  The registry
+absorbs them: free-form counters live here, ``ServeMetrics`` instances
+register themselves at construction (weakly — a dropped server must not
+be pinned by its own metrics), and :meth:`MetricsRegistry.snapshot`
+composes everything into ONE JSON-ready dict that
+``tools/serve_loadgen.py``, ``tools/chaos_run.py``, the ``bfs-tpu-obs``
+CLI and any embedder print verbatim.  :func:`prometheus_text` renders
+the same snapshot as Prometheus exposition text for scrape endpoints.
+
+Stdlib-only by design (like the rest of the package minus telemetry):
+the collaborators it reads — ``utils.metrics``, ``analysis.runtime``,
+``obs.spans`` — are themselves stdlib-only, so the lint-stub fast path
+(tools/lint.py) can print a snapshot without paying a jax import.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import weakref
+
+
+class MetricsRegistry:
+    """Thread-safe process-global metrics hub.
+
+    ``counter(name)`` bumps a free-form counter owned by the registry
+    itself (e.g. ``graph_evictions``); :meth:`snapshot` additionally
+    pulls the artifact counters, retrace counters, span summary and every
+    registered ``ServeMetrics`` report, so one call answers "what has
+    this process done" across all layers.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, int] = {}  # guarded-by: _lock
+        # Live ServeMetrics instances (weak: metrics must not outlive
+        # their server just because the registry saw them once).
+        self._serve: list = []  # guarded-by: _lock — weakref.ref list
+
+    # ------------------------------------------------------------ counters --
+    def counter(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + by
+
+    def count(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def counters(self) -> dict:
+        with self._lock:
+            return dict(self._counters)
+
+    # --------------------------------------------------------------- serve --
+    def register_serve(self, metrics) -> None:
+        """Adopt a ServeMetrics instance (idempotent; weakly held)."""
+        with self._lock:
+            live = [r for r in self._serve if r() is not None]
+            if not any(r() is metrics for r in live):
+                live.append(weakref.ref(metrics))
+            self._serve = live
+
+    def _serve_reports(self) -> list[dict]:
+        with self._lock:
+            refs = list(self._serve)
+        return [m.report() for m in (r() for r in refs) if m is not None]
+
+    # ------------------------------------------------------------ snapshot --
+    def snapshot(self, retrace_baseline: dict | None = None) -> dict:
+        """The one unified view: registry counters + artifact caches +
+        retrace counters (with per-function drift when a post-warmup
+        ``retrace_baseline`` snapshot is passed — any non-zero drift names
+        a recompile leak) + span summary + every live ServeMetrics
+        report."""
+        from ..analysis.runtime import retrace_report
+        from ..utils.metrics import artifact_report
+        from .spans import span_report
+
+        retraces = retrace_report()
+        out = {
+            "counters": self.counters(),
+            "artifact_caches": artifact_report(),
+            "retraces": retraces,
+            "spans": span_report(),
+            "serve": self._serve_reports(),
+        }
+        if retrace_baseline is not None:
+            out["retrace_drift"] = {
+                name: n - retrace_baseline.get(name, 0)
+                for name, n in retraces.items()
+                if n - retrace_baseline.get(name, 0)
+            }
+        return out
+
+    def to_json(self, **kw) -> str:
+        return json.dumps(self.snapshot(**kw), indent=2, sort_keys=True)
+
+    def to_prometheus(self, **kw) -> str:
+        return prometheus_text(self.snapshot(**kw))
+
+
+_REGISTRY_LOCK = threading.Lock()
+_REGISTRY: list[MetricsRegistry] = []  # guarded-by: _REGISTRY_LOCK
+
+
+def get_registry() -> MetricsRegistry:
+    """THE process-global registry (created on first use)."""
+    with _REGISTRY_LOCK:
+        if not _REGISTRY:
+            _REGISTRY.append(MetricsRegistry())
+        return _REGISTRY[0]
+
+
+_NAME_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _prom_name(*parts: str) -> str:
+    name = "_".join(_NAME_RE.sub("_", str(p)).strip("_") for p in parts if p != "")
+    return f"bfs_tpu_{name}"
+
+
+def _flatten(prefix: tuple, obj, out: list) -> None:
+    if isinstance(obj, bool):
+        out.append((_prom_name(*prefix), int(obj)))
+    elif isinstance(obj, (int, float)):
+        out.append((_prom_name(*prefix), obj))
+    elif isinstance(obj, dict):
+        for k, v in obj.items():
+            _flatten(prefix + (str(k),), v, out)
+    elif isinstance(obj, (list, tuple)):
+        # Lists are indexed only when short and numeric (serve reports
+        # nest one dict per server); anything else is not a gauge.
+        for i, v in enumerate(obj):
+            if isinstance(v, (dict, int, float)) and not isinstance(v, bool):
+                _flatten(prefix + (str(i),), v, out)
+
+
+def prometheus_text(snapshot: dict) -> str:
+    """Prometheus exposition text (untyped gauges) for a snapshot dict:
+    numeric leaves flattened to ``bfs_tpu_<path> <value>`` lines, names
+    sanitized to the metric charset, non-numeric leaves skipped."""
+    gauges: list[tuple[str, float]] = []
+    _flatten((), snapshot, gauges)
+    lines = []
+    seen = set()
+    for name, value in gauges:
+        if name in seen:
+            continue
+        seen.add(name)
+        lines.append(f"# TYPE {name} gauge")
+        lines.append(f"{name} {value}")
+    return "\n".join(lines) + "\n"

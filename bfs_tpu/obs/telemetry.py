@@ -1,0 +1,178 @@
+"""Device-side superstep telemetry: the level curve, measured in-loop.
+
+The fused BFS programs (models/bfs.py, models/multisource.py, the sharded
+relay runner) optionally carry one extra ``while_loop`` state leaf: an
+int32[TEL_SLOTS] accumulator where slot ``l`` holds the number of
+vertices that entered the frontier at level ``l`` (every engine's
+frontier holds exactly the newly settled vertices, so the curve's sum is
+the reachable-vertex count).  The relay program additionally derives
+per-level frontier OUT-EDGES (float32 — reporting, not dispatch) in one
+pass over the final levels AT LOOP EXIT
+(:func:`edge_curve_from_levels`), which with per-level seconds from the
+superstep profile yields per-level TEPS.
+
+The hot-region contract (enforced statically by analysis rule OBS001 and
+dynamically by the transfer guard): telemetry is recorded ON DEVICE as
+part of the compiled loop body and pulled exactly ONCE at loop exit —
+:func:`read_telemetry` is the single intended ``jax.device_get``.
+Nothing in the loop ever syncs.  Telemetry costs one popcount-sum plus
+one 4-byte scatter-add per superstep, is OFF in the timed-repeat
+programs by default (a separate untimed pass collects the curve), and
+the phase ledger (bfs_tpu/profiling.py) measures its full-superstep
+overhead so every capture carries the cost next to the curve.
+
+This is the direction-switching input for ROADMAP item 2: Beamer-style
+push/pull selection keys on exactly this per-level occupancy.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+#: Accumulator slots.  Covers the packed 62-level cap with room for the
+#: unpacked fallback; deeper levels clamp into the last slot (the curve
+#: then reports ``truncated`` — sums stay exact either way).
+TEL_SLOTS = 128
+
+
+def init_level_acc(num_sources: int = 1, slots: int = TEL_SLOTS,
+                   *, wide: bool = False):
+    """int32[slots] with slot 0 = the sources (level 0 is seeded by init,
+    not produced by a superstep).
+
+    ``wide`` (the batched multi-source shape): int32[slots, 2] carrying a
+    lo16/hi16 split — a dominant level can settle up to S*V vertices in
+    one slot, past int32 (64 sources at scale 26 = 2^32), and jax int64
+    is unavailable without the x64 flag.  Per-source counts are < 2^31
+    always (int32 vertex ids); splitting them into 16-bit halves before
+    the cross-source sum keeps each half under 2^31 for any S < 2^15,
+    and :func:`level_curve` reassembles exact int64 on the host."""
+    import jax.numpy as jnp
+
+    if wide:
+        return (
+            jnp.zeros((slots, 2), jnp.int32)
+            .at[0, 0].set(jnp.int32(num_sources & 0xFFFF))
+            .at[0, 1].set(jnp.int32(num_sources >> 16))
+        )
+    return jnp.zeros((slots,), jnp.int32).at[0].set(jnp.int32(num_sources))
+
+
+def _slot(level):
+    import jax.numpy as jnp
+
+    return jnp.clip(level, 0, TEL_SLOTS - 1)
+
+
+# bfs_tpu: hot traced
+def record_frontier_words(acc, fwords, level):
+    """Accumulate popcount(fwords) into slot ``level`` (the level the
+    superstep that produced this frontier settled).  Word-packed frontiers
+    (relay/sharded)."""
+    import jax
+    import jax.numpy as jnp
+
+    n = jax.lax.population_count(fwords).sum(dtype=jnp.int32)
+    return acc.at[_slot(level)].add(n)
+
+
+# bfs_tpu: hot traced
+def record_frontier_bools(acc, frontier, level):
+    """Bool-frontier twin (push/pull BfsState; batched states sum over the
+    sources axis too — the curve is the global occupancy).  A wide acc
+    (int32[slots, 2], see :func:`init_level_acc`) gets the overflow-safe
+    lo16/hi16 split of the per-source counts."""
+    import jax.numpy as jnp
+
+    if acc.ndim == 2:
+        per_source = frontier.sum(axis=-1, dtype=jnp.int32)  # each < 2^31
+        lo = (per_source & 0xFFFF).sum(dtype=jnp.int32)
+        hi = (per_source >> 16).sum(dtype=jnp.int32)
+        return acc.at[_slot(level), 0].add(lo).at[_slot(level), 1].add(hi)
+    return acc.at[_slot(level)].add(frontier.sum(dtype=jnp.int32))
+
+
+# bfs_tpu: hot traced
+def edge_curve_from_levels(dist, outdeg, unreached):
+    """float32[TEL_SLOTS]: out-degree summed by BFS level — the per-level
+    frontier OUT-EDGE curve, computed in ONE pass over the final state at
+    loop exit (a per-superstep masked sum measured ~25% of a CPU
+    superstep; this exit-time scatter-add is free by comparison and
+    bit-identical, since each vertex enters the frontier exactly once).
+    ``dist`` int32 levels, ``unreached`` the sentinel mask."""
+    import jax.numpy as jnp
+
+    idx = jnp.clip(jnp.where(unreached, 0, dist), 0, TEL_SLOTS - 1)
+    w = jnp.where(unreached, 0, outdeg).astype(jnp.float32)
+    return jnp.zeros(TEL_SLOTS, jnp.float32).at[idx].add(w)
+
+
+def read_telemetry(tel):
+    """THE one telemetry pull: one explicit ``jax.device_get`` of the
+    whole accumulator pytree at loop exit.  Never call this inside a hot
+    region (analysis rule OBS001)."""
+    import jax
+
+    return jax.device_get(tel)
+
+
+def level_curve(
+    fvert,
+    fedges=None,
+    *,
+    cap: int | None = None,
+    reference_reached: int | None = None,
+) -> dict:
+    """JSON-ready curve from host accumulator arrays (post
+    :func:`read_telemetry`).
+
+    ``occupancy[l]`` = vertices settled at level ``l`` (trimmed after the
+    last non-zero); ``reachable`` = sum (equals the oracle's
+    reachable-vertex count — asserted against ``reference_reached`` when
+    the caller has one); ``cap_proximity`` = levels/cap, the packed-cap
+    headroom signal."""
+    fv = np.asarray(fvert)
+    if fv.ndim == 2:  # wide lo16/hi16 acc -> exact int64 on the host
+        fv = fv[:, 0].astype(np.int64) + (fv[:, 1].astype(np.int64) << 16)
+    fv = fv.astype(np.int64)
+    nz = np.flatnonzero(fv)
+    levels = int(nz[-1]) + 1 if nz.size else 0
+    occupancy = [int(x) for x in fv[:levels]]
+    out: dict = {
+        "occupancy": occupancy,
+        "levels": levels,
+        "reachable": int(fv.sum()),
+        "peak_level": int(np.argmax(fv)) if levels else 0,
+        "peak_occupancy": int(fv.max()) if levels else 0,
+        "truncated": bool(fv[TEL_SLOTS - 1] != 0) if fv.shape[0] >= TEL_SLOTS else False,
+    }
+    if fedges is not None:
+        fe = np.asarray(fedges, dtype=np.float64)
+        out["frontier_edges"] = [float(x) for x in fe[:levels]]
+    if cap is not None and cap > 0:
+        out["cap"] = int(cap)
+        out["cap_proximity"] = levels / cap
+    if reference_reached is not None:
+        out["reference_reached"] = int(reference_reached)
+        out["occupancy_sum_matches_reference"] = (
+            int(fv.sum()) == int(reference_reached)
+        )
+    return out
+
+
+def render_curve_ascii(curve: dict, width: int = 50) -> str:
+    """Terminal bar chart of a level curve (the dashboard/CLI view)."""
+    occ = curve.get("occupancy", [])
+    if not occ:
+        return "(empty level curve)"
+    peak = max(occ)
+    lines = [
+        f"level curve: {curve.get('reachable', sum(occ))} reachable over "
+        f"{curve.get('levels', len(occ))} levels"
+    ]
+    for l, n in enumerate(occ):
+        bar = "#" * max(1 if n else 0, round(width * n / peak)) if peak else ""
+        lines.append(f"  L{l:>3} {n:>12,d} {bar}")
+    if curve.get("truncated"):
+        lines.append(f"  (deeper levels clamped into slot {TEL_SLOTS - 1})")
+    return "\n".join(lines)

@@ -343,11 +343,11 @@ _SHARDED_AOT_CACHE_MAX = 8
 
 
 @functools.partial(
-    jax.jit, static_argnames=("mesh", "static", "max_levels")
+    jax.jit, static_argnames=("mesh", "static", "max_levels", "telemetry")
 )
 def _bfs_sharded_relay_fused(
     vperm_masks, net_masks, valid_words, own_words, source_new, *,
-    mesh, static, max_levels,
+    mesh, static, max_levels, telemetry: bool = False,
 ):
     """Vertex-partitioned relay BFS (v4): per-shard Beneš layouts (one
     unified SPMD program, per-device mask data), frontier exchanged as a
@@ -362,7 +362,13 @@ def _bfs_sharded_relay_fused(
     dist/parent-slot outputs are unpacked once at loop exit — the
     exchange is untouched (it ships frontier bits either way).  The loop
     caps at PACKED_MAX_LEVELS; ``changed`` is returned so the host
-    wrapper can detect a cap exit and re-run unpacked."""
+    wrapper can detect a cap exit and re-run unpacked.
+
+    With ``telemetry`` (static) the carry additionally holds the
+    per-level occupancy accumulator (obs/telemetry.py), fed the GLOBAL
+    all-gathered frontier words — identical on every shard, so the acc
+    stays replicated with no extra collective — and returned as a fifth
+    output for ONE pull at loop exit."""
     from ..ops.packed import PACKED_SENTINEL, level_word, packed_cap
     from ..ops.relay import pack_std, unpack_relay_packed
 
@@ -383,6 +389,12 @@ def _bfs_sharded_relay_fused(
         def cond(carry):
             level, changed = carry[-2], carry[-1]
             return changed & (level < cap)
+
+        if telemetry:
+            from ..obs import telemetry as T
+
+            # acc rides BEFORE (level, changed) so cond's carry[-2:] holds.
+            acc0 = T.init_level_acc()
 
         if packed:
             lo = jax.lax.axis_index(GRAPH_AXIS).astype(jnp.int32) * block
@@ -409,6 +421,20 @@ def _bfs_sharded_relay_fused(
                 )
                 return pk2, fw, level + 1, changed
 
+            if telemetry:
+
+                def body_t(carry):
+                    pk, fw, acc, level, ch = carry
+                    pk2, fw2, level2, changed = body((pk, fw, level, ch))
+                    acc = T.record_frontier_words(acc, fw2, level2)
+                    return pk2, fw2, acc, level2, changed
+
+                pk, _, acc, level, changed = jax.lax.while_loop(
+                    cond, body_t,
+                    (pk0, fwords, acc0, jnp.int32(0), jnp.bool_(True)),
+                )
+                dist, parent = unpack_relay_packed(pk, in_classes, block)
+                return dist, parent, level, changed, acc
             pk, _, level, changed = jax.lax.while_loop(
                 cond, body, (pk0, fwords, jnp.int32(0), jnp.bool_(True))
             )
@@ -432,6 +458,21 @@ def _bfs_sharded_relay_fused(
             )
             return dist, parent, fw, level, changed
 
+        if telemetry:
+
+            def body_t(carry):
+                dist, parent, fw, acc, level, ch = carry
+                dist, parent, fw2, level2, changed = body(
+                    (dist, parent, fw, level, ch)
+                )
+                acc = T.record_frontier_words(acc, fw2, level2)
+                return dist, parent, fw2, acc, level2, changed
+
+            dist, parent, _, acc, level, changed = jax.lax.while_loop(
+                cond, body_t,
+                (dist, parent, fwords, acc0, jnp.int32(0), jnp.bool_(True)),
+            )
+            return dist, parent, level, changed, acc
         dist, parent, _, level, changed = jax.lax.while_loop(
             cond, body, (dist, parent, fwords, jnp.int32(0), jnp.bool_(True))
         )
@@ -447,7 +488,11 @@ def _bfs_sharded_relay_fused(
             P(),
             P(),
         ),
-        out_specs=(P(GRAPH_AXIS), P(GRAPH_AXIS), P(), P()),
+        out_specs=(
+            (P(GRAPH_AXIS), P(GRAPH_AXIS), P(), P(), P())
+            if telemetry
+            else (P(GRAPH_AXIS), P(GRAPH_AXIS), P(), P())
+        ),
         # Fully manual over BOTH mesh axes: a partially-manual program (the
         # batch axis left in auto mode) would require the SPMD partitioner
         # to partition the Mosaic custom calls over the auto axis, which it
@@ -726,7 +771,8 @@ def bfs_sharded(
     block: int = 1024,
     vertex_block_multiple: int = 1024,
     applier: str = "auto",
-) -> BfsResult:
+    telemetry: bool = False,
+):
     """Single-source BFS sharded over the mesh's ``graph`` axis.
 
     Engines:
@@ -740,8 +786,14 @@ def bfs_sharded(
       * ``'push'`` — edge-sharded ``segment_min`` + full candidate `pmin`;
         the direct analogue of the reference's map/shuffle/reduce, kept for
         differential testing.
+
+    ``telemetry`` (relay engine only) carries the per-level occupancy
+    accumulator through the sharded loop (obs/telemetry.py) and returns
+    ``(BfsResult, level_curve)`` — one extra replicated pull at exit.
     """
     mesh = mesh if mesh is not None else make_mesh()
+    if telemetry and engine != "relay":
+        raise ValueError("telemetry is carried by the sharded relay engine only")
     if engine == "relay":
         from ..ops.packed import (
             packed_rank_fits,
@@ -767,7 +819,7 @@ def bfs_sharded(
             if use_pallas:
                 from ..models.bfs import RelayEngine
 
-                key = ("single", static, mesh, max_levels)
+                key = ("single", static, mesh, max_levels, telemetry)
                 compiled = _SHARDED_AOT_CACHE.get(key)
                 if compiled is None:
                     from ..models.bfs import compile_exe_cached
@@ -775,7 +827,7 @@ def bfs_sharded(
                     compiled = compile_exe_cached(
                         _bfs_sharded_relay_fused.lower(
                             *args, mesh=mesh, static=static,
-                            max_levels=max_levels,
+                            max_levels=max_levels, telemetry=telemetry,
                         ),
                         RelayEngine._COMPILER_OPTIONS,
                     )
@@ -784,21 +836,33 @@ def bfs_sharded(
                     _SHARDED_AOT_CACHE[key] = compiled
                 return compiled(*args)
             return _bfs_sharded_relay_fused(
-                *args, mesh=mesh, static=static, max_levels=max_levels
+                *args, mesh=mesh, static=static, max_levels=max_levels,
+                telemetry=telemetry,
             )
 
         packed = resolve_packed(packed_rank_fits(srg.in_classes))
-        dist, parent, level, changed = run_prog(packed)
+        out = run_prog(packed)
+        dist, parent, level, changed = out[:4]
         if packed and packed_truncated(
             jax.device_get(changed), jax.device_get(level), max_levels
         ):
             # Deeper than the packed level field: re-run unpacked (same
             # contract as the single-chip engine and elem mode).
-            dist, parent, level, changed = run_prog(False)
+            out = run_prog(False)
+            dist, parent, level, changed = out[:4]
+            packed = False
         dist, parent = _relay_map_back(
             srg, jax.device_get(dist), jax.device_get(parent), source
         )
-        return BfsResult(dist=dist, parent=parent, num_levels=int(level))
+        result = BfsResult(dist=dist, parent=parent, num_levels=int(level))
+        if not telemetry:
+            return result
+        from ..obs.telemetry import level_curve, read_telemetry
+        from ..ops.packed import PACKED_MAX_LEVELS
+
+        fv = read_telemetry(out[4])
+        cap = min(PACKED_MAX_LEVELS, max_levels) if packed else max_levels
+        return result, level_curve(fv, cap=cap)
     if engine == "pull":
         spg = _prepare_pull(graph, mesh, vertex_block_multiple)
         check_sources(spg.num_vertices, source)

@@ -290,6 +290,56 @@ def superstep_phase_ledger(eng, *, loops: int = 4, repeats: int = 2) -> dict:
     full_args = (pk0 if packed else d0, p0, fw_src, *flat_masks, valid)
     phases["full_superstep"] = {"seconds": mb(k_full, full_args)}
 
+    # ---- full superstep + device telemetry (the OBS overhead arm) ----------
+    # Same K-loop with the obs/telemetry level accumulator folded into the
+    # carry (one popcount-sum + one 4-byte scatter-add per superstep): the
+    # measured cost of carrying the level curve, shipped in every capture
+    # next to the curve itself so "telemetry changes timed medians by <2%"
+    # is a number, not a promise.
+    from .obs import telemetry as T
+
+    def k_full_tel(k, pk_or_d, maybe_p, fw, *ms):
+        vm = ms[:n_vp] if isinstance(vperm_m, tuple) else ms[0]
+        nm = ms[n_vp:-1] if isinstance(net_m, tuple) else ms[1]
+        vw = ms[-1]
+        acc0 = T.init_level_acc()
+        if packed:
+            st0 = R.PackedRelayState(
+                pk_or_d, fw, jnp.int32(0), jnp.bool_(True)
+            )
+
+            def body(i, c):
+                st, acc = c
+                s2 = superstep(st, vm, nm, vw)
+                acc = T.record_frontier_words(acc, s2.fwords, s2.level)
+                return (
+                    R.PackedRelayState(
+                        s2.packed, s2.fwords, st.level, st.changed
+                    ),
+                    acc,
+                )
+
+        else:
+            st0 = R.RelayState(
+                pk_or_d, maybe_p, fw, jnp.int32(0), jnp.bool_(True)
+            )
+
+            def body(i, c):
+                st, acc = c
+                s2 = superstep(st, vm, nm, vw)
+                acc = T.record_frontier_words(acc, s2.fwords, s2.level)
+                return (
+                    R.RelayState(
+                        s2.dist, s2.parent, s2.fwords, st.level, st.changed
+                    ),
+                    acc,
+                )
+
+        return jax.lax.fori_loop(0, k, body, (st0, acc0))
+
+    t_tel = mb(k_full_tel, full_args)
+    phases["full_superstep_telemetry"] = {"seconds": t_tel}
+
     accounted = sum(
         phases[p]["seconds"]
         for p in ("vperm", "broadcast", "net_apply", "rowmin", "state_update")
@@ -303,6 +353,10 @@ def superstep_phase_ledger(eng, *, loops: int = 4, repeats: int = 2) -> dict:
         "phases": phases,
         "sum_of_phases_seconds": accounted,
         "full_superstep_seconds": phases["full_superstep"]["seconds"],
+        "telemetry_overhead_ratio": (
+            phases["full_superstep_telemetry"]["seconds"]
+            / max(phases["full_superstep"]["seconds"], 1e-12)
+        ),
         "mask_bytes_total": vperm_mask_bytes + net_mask_bytes,
         "note": (
             "phase-isolated K-loop jits on the engine's real operands; "

@@ -60,6 +60,38 @@ def config_key(config: dict) -> str:
     return hashlib.blake2b(_canon(config).encode(), digest_size=8).hexdigest()
 
 
+def read_records(path: str) -> list:
+    """Lenient read-only replay of a journal FILE: every crc-valid record
+    in index order, stopping at the first torn/invalid line — no config
+    needed and nothing is locked or truncated.  The observability CLI
+    (``bfs-tpu-obs``) stitches traces from finished journals through this
+    without having to reconstruct the exact bench config that keyed them."""
+    records = []
+    expect_i = 0
+    if not os.path.exists(path):
+        return records
+    with open(path, "rb") as f:
+        for raw in f:
+            if not raw.endswith(b"\n"):
+                break
+            try:
+                rec = json.loads(raw)
+                ok = (
+                    isinstance(rec, dict)
+                    and rec.get("i") == expect_i
+                    and isinstance(rec.get("phase"), str)
+                    and _crc(rec["i"], rec["phase"], rec["payload"])
+                    == rec.get("crc")
+                )
+            except (ValueError, KeyError, TypeError):
+                break
+            if not ok:
+                break
+            records.append(rec)
+            expect_i += 1
+    return records
+
+
 class RunJournal:
     """Append-only phase journal for one run configuration.
 

@@ -272,6 +272,7 @@ class GraphRegistry:
     # bfs_tpu: holds _lock
     def _evict(self, key: tuple[str, str]) -> None:
         name, engine = key
+        nbytes = self._resident[key][0]
         self._resident.pop(key)  # drops OUR reference to the operands
         rec = self._graphs.get(name)
         layout = rec.layouts.get(engine) if rec else None
@@ -290,6 +291,16 @@ class GraphRegistry:
         self.evictions += 1
         if self.metrics is not None:
             self.metrics.bump("evictions")
+        # HBM-budget thrash was invisible (ISSUE 6 satellite): every
+        # eviction now lands a trace marker AND a registry counter, so a
+        # serve process churning its device residency shows up in both the
+        # Perfetto timeline and the metrics snapshot, not just as slow
+        # re-uploads.
+        from ..obs import get_registry, instant
+
+        instant("registry.evict", graph=name, engine=engine, bytes=nbytes)
+        get_registry().counter("graph_evictions")
+        get_registry().counter("graph_evicted_bytes", nbytes)
 
     def release(self, name: str, engine: str | None = None) -> None:
         """Explicitly evict one graph's device operands (all engines when

@@ -49,6 +49,7 @@ import numpy as np
 
 from ..models.bfs import check_sources
 from ..models.multisource import MultiBfsResult, collapse_multi_source
+from ..obs.spans import span as obs_span
 from ..resilience.retry import RetryPolicy, retry_call
 from ..utils.metrics import QueryRecord, ServeMetrics
 from .executor import (
@@ -357,7 +358,18 @@ class BfsServer:
                         keep.append(req)
                 self._pending.extendleft(reversed(keep))
             try:
-                self._execute_batch(batch)
+                # One span per executed tick batch: with the eviction
+                # markers and the metrics snapshot this is the serve
+                # loop's complete Perfetto story (coalesce -> execute ->
+                # fan out); empty ticks never reach here, so the buffer
+                # only grows with real work.
+                with obs_span(
+                    "serve.batch",
+                    graph=batch[0].graph,
+                    engine=batch[0].engine,
+                    requests=len(batch),
+                ):
+                    self._execute_batch(batch)
             except Exception as exc:  # defensive: the loop must survive
                 for req in batch:
                     if not req.future.done():

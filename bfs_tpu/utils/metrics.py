@@ -159,6 +159,13 @@ class ServeMetrics:
         self.counters: dict[str, int] = {}  # guarded-by: _lock
         self._first_ts: float | None = None  # guarded-by: _lock
         self._last_ts: float | None = None  # guarded-by: _lock
+        # One registry to find them (ISSUE 6): every ServeMetrics is
+        # weakly visible in the process-global MetricsRegistry snapshot,
+        # so loadgen/chaos/dashboards read ONE surface instead of
+        # threading per-server objects around.
+        from ..obs.registry import get_registry
+
+        get_registry().register_serve(self)
 
     def bump(self, name: str, by: int = 1) -> None:
         with self._lock:

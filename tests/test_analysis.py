@@ -405,6 +405,68 @@ def test_lck002_near_miss_no_lock_owned(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# Observability discipline (OBS001).
+# ---------------------------------------------------------------------------
+
+def test_obs001_telemetry_read_in_hot_region(tmp_path):
+    fs = lint(tmp_path, """
+        from bfs_tpu.obs.telemetry import read_telemetry
+
+        # bfs_tpu: hot
+        def tick(state, acc):
+            fv = read_telemetry(acc)
+            return state, fv
+        """)
+    assert rules_of(fs) == ["OBS001"]
+
+
+def test_obs001_metrics_reads_in_hot_span(tmp_path):
+    fs = lint(tmp_path, """
+        def bench(run, roots, registry):
+            # bfs_tpu: hot-start
+            for _ in range(3):
+                out = run(roots)
+                snap = registry.snapshot()
+            # bfs_tpu: hot-end
+            return snap
+        """)
+    assert [f.rule for f in fs] == ["OBS001"]
+    assert fs[0].line == 6
+
+
+def test_obs001_near_miss_read_at_loop_exit(tmp_path):
+    # The CONTRACT: the same read immediately AFTER the hot region (loop
+    # exit) is the intended one pull — never flagged.
+    fs = lint(tmp_path, """
+        from bfs_tpu.obs.telemetry import read_telemetry
+
+        def run(fused, src):
+            # bfs_tpu: hot-start
+            state, acc = fused(src)
+            # bfs_tpu: hot-end
+            return read_telemetry((acc, state.level))
+        """)
+    assert fs == []
+
+
+def test_obs001_span_writes_allowed_in_hot_region(tmp_path):
+    # Span/counter WRITES are host-side appends — wrapping the timed
+    # region in a span is the intended usage and must stay clean.
+    fs = lint(tmp_path, """
+        from bfs_tpu.obs.spans import span, instant
+
+        def bench(run, roots):
+            # bfs_tpu: hot-start
+            with span("bench.repeat"):
+                out = run(roots)
+            instant("marker")
+            # bfs_tpu: hot-end
+            return out
+        """)
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
 # Baseline mechanism.
 # ---------------------------------------------------------------------------
 
@@ -542,6 +604,11 @@ def test_cli_exit_nonzero_on_each_rule_fixture(tmp_path):
             "        self.d = {}  # guarded-by: _lock\n"
             "    def g(self):\n        return self.d\n"
         ),
+        "obs001.py": (
+            "from bfs_tpu.obs.telemetry import read_telemetry\n"
+            "# bfs_tpu: hot\ndef f(state, acc):\n"
+            "    return read_telemetry(acc)\n"
+        ),
     }
     assert len(fixtures) >= 8
     for name, code in fixtures.items():
@@ -557,7 +624,8 @@ def test_cli_exit_nonzero_on_each_rule_fixture(tmp_path):
 def test_cli_rules_catalog():
     proc = _run_cli(["--rules"])
     assert proc.returncode == 0
-    for rule in ("TRC001", "TRC006", "RCD001", "RCD005", "LCK001", "LCK002"):
+    for rule in ("TRC001", "TRC006", "RCD001", "RCD005", "LCK001", "LCK002",
+                 "OBS001"):
         assert rule in proc.stdout
 
 

@@ -236,18 +236,20 @@ def main(argv=None) -> int:
     log(f"kill-schedule seed: {seed}")
     rng = random.Random(seed)
     rc = chaos_bench(args, rng) if args.mode == "bench" else chaos_loadgen(args, rng)
-    # Retrace-counter report (bfs_tpu.analysis runtime sanitizer): the
-    # driver itself runs no traced programs — a non-empty table here means
-    # an in-process leak; the bench/loadgen SUBPROCESSES print their own
-    # tables in the captured logs above.  Importing tools/lint.py installs
-    # its stub bfs_tpu parent package (ONE shared bootstrap), so printing
-    # the table doesn't pay the engine-stack jax import at exit.
+    # Unified metrics snapshot (bfs_tpu.obs.MetricsRegistry — replaces the
+    # bespoke retrace table): the driver itself runs no traced programs, so
+    # non-empty retraces here mean an in-process leak; the bench/loadgen
+    # SUBPROCESSES print their own snapshots in the captured logs above.
+    # Importing tools/lint.py installs its stub bfs_tpu parent package
+    # (ONE shared bootstrap) — obs.registry and its collaborators are
+    # stdlib-only, so the snapshot costs no engine-stack jax import.
     sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
     import lint  # noqa: F401  (side effect: stub parent package)
 
-    from bfs_tpu.analysis.runtime import format_retrace_report
+    from bfs_tpu.obs import get_registry
 
-    log(format_retrace_report())
+    log("driver metrics snapshot:")
+    log(get_registry().to_json())
     return rc
 
 

@@ -1,0 +1,162 @@
+"""Terminal dashboard over a run's observability artifacts.
+
+One screen for one run: the span timeline (per-phase wall time across
+every process generation, stitched from the journal), the device-side
+level curve (ASCII bars + packed-cap proximity + per-level TEPS when the
+superstep profile timed the levels), and — when the journal's headline
+or a ``--serve`` report file carries them — the serve percentiles.
+
+    python tools/obs_dashboard.py <journal.jsonl>
+    python tools/obs_dashboard.py <journal.jsonl> --serve loadgen_out.json
+
+Reads journals directly through the lint-stub bootstrap (no jax import,
+sub-100ms); ``bfs-tpu-obs trace`` writes the Perfetto JSON twin of the
+timeline section.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(ROOT, "tools"))
+import lint  # noqa: F401  (side effect: stub bfs_tpu parent package)
+
+from bfs_tpu.obs.__main__ import _find_curve  # noqa: E402
+from bfs_tpu.obs.telemetry import render_curve_ascii  # noqa: E402
+from bfs_tpu.resilience.journal import read_records  # noqa: E402
+
+BAR = 40
+
+
+def _rule(title: str) -> str:
+    return f"\n=== {title} " + "=" * max(4, 66 - len(title))
+
+
+def span_timeline(records) -> str:
+    """Per-name span aggregate across all journaled generations, widest
+    first — the text twin of the Perfetto view."""
+    events = []
+    for rec in records:
+        if rec["phase"].startswith("spans:"):
+            events.extend(rec["payload"].get("events", ()))
+    if not events:
+        return "(no journaled spans — run with BFS_TPU_SPANS=1, the default)"
+    gens = sorted({e.get("pid") for e in events})
+    agg: dict[str, dict] = {}
+    for e in events:
+        if e.get("ph") != "X":
+            continue
+        a = agg.setdefault(e["name"], {"count": 0, "us": 0, "flushed": 0})
+        a["count"] += 1
+        a["us"] += e.get("dur", 0)
+        if (e.get("args") or {}).get("flushed"):
+            a["flushed"] += 1
+    total = max(sum(a["us"] for a in agg.values()), 1)
+    lines = [f"{len(events)} events over {len(gens)} process generation(s)"]
+    for name, a in sorted(agg.items(), key=lambda kv: -kv[1]["us"]):
+        bar = "#" * max(1, round(BAR * a["us"] / total))
+        flush = f"  [{a['flushed']} flushed by signal]" if a["flushed"] else ""
+        lines.append(
+            f"  {name:<26} {a['us'] / 1e6:>9.3f}s x{a['count']:<3} {bar}{flush}"
+        )
+    markers = [e for e in events if e.get("ph") == "i"]
+    if markers:
+        lines.append(f"  {len(markers)} instant marker(s):")
+        for e in markers[:10]:
+            lines.append(f"    {e['name']} {e.get('args')}")
+    return "\n".join(lines)
+
+
+def curve_section(records) -> str:
+    curve = _find_curve(records)
+    if curve is None:
+        return "(no level curve journaled — BENCH_LEVEL_CURVE=1 is the default)"
+    out = [render_curve_ascii(curve)]
+    if "cap_proximity" in curve:
+        out.append(
+            f"packed-cap proximity: {curve['levels']}/{curve.get('cap')} "
+            f"({curve['cap_proximity']:.2f})"
+        )
+    if curve.get("per_level_teps"):
+        out.append("per-level TEPS (frontier out-edges / profiled seconds):")
+        for l, teps in sorted(
+            curve["per_level_teps"].items(), key=lambda kv: int(kv[0])
+        ):
+            out.append(f"  L{int(l):>3} {teps / 1e6:>12.1f} M TEPS")
+    if "occupancy_sum_matches_reference" in curve:
+        out.append(
+            "occupancy sum matches oracle component: "
+            f"{curve['occupancy_sum_matches_reference']}"
+        )
+    return "\n".join(out)
+
+
+def serve_section(records, serve_path: str) -> str:
+    report = None
+    if serve_path:
+        with open(serve_path) as f:
+            doc = json.load(f)
+        report = doc.get("server_report", doc)
+    else:
+        for rec in records:
+            if rec["phase"] == "headline":
+                d = (rec["payload"].get("headline") or {}).get("details") or {}
+                report = d.get("serve") or report
+    if not isinstance(report, dict):
+        return "(no serve report; pass --serve <loadgen output json>)"
+    keys = (
+        "queries", "served", "timeouts", "errors", "latency_p50_ms",
+        "latency_p99_ms", "queue_wait_p99_ms", "batch_size_mean",
+        "queries_per_sec", "compile_hit_rate", "result_cache_hit_rate",
+    )
+    lines = []
+    for k in keys:
+        if k in report:
+            v = report[k]
+            lines.append(
+                f"  {k:<24} {v:.3f}" if isinstance(v, float) else f"  {k:<24} {v}"
+            )
+    ev = (report.get("counters") or {}).get("evictions")
+    if ev is not None:
+        lines.append(f"  {'evictions':<24} {ev}")
+    return "\n".join(lines) if lines else "(serve report had no known fields)"
+
+
+def headline_section(records) -> str:
+    for rec in records:
+        if rec["phase"] == "headline":
+            doc = rec["payload"].get("headline") or {}
+            return (
+                f"{doc.get('metric')}: {doc.get('value', 0):.3e} "
+                f"{doc.get('unit')} — check: "
+                f"{(doc.get('details') or {}).get('check')!r}"
+            )
+    return "(run not finished — no headline record yet)"
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("journal", help="a bench RunJournal .jsonl file")
+    ap.add_argument("--serve", default="", help="loadgen output JSON")
+    args = ap.parse_args(argv)
+    records = read_records(args.journal)
+    if not records:
+        print(f"no readable records in {args.journal}", file=sys.stderr)
+        return 1
+    print(f"run: {os.path.basename(args.journal)} ({len(records)} records)")
+    print(headline_section(records))
+    print(_rule("span timeline"))
+    print(span_timeline(records))
+    print(_rule("level curve"))
+    print(curve_section(records))
+    print(_rule("serve percentiles"))
+    print(serve_section(records, args.serve))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -180,7 +180,7 @@ def main(argv=None) -> int:
             flush=True,
         )
         pre = dict(server.metrics.report()["counters"])
-        from bfs_tpu.analysis.runtime import format_retrace_report, retrace_report
+        from bfs_tpu.analysis.runtime import retrace_report
 
         retrace_warm = retrace_report()  # post-warmup snapshot: steady
         # state must not move any of these counters
@@ -257,10 +257,17 @@ def main(argv=None) -> int:
         "server_report": report,
     }
     print(json.dumps(out, indent=2, sort_keys=True))
-    # Name the function that retraced: a sub-100% hit rate plus a non-zero
-    # drift line turns "something recompiled" into "THIS program recompiled"
-    # (bfs_tpu.analysis runtime sanitizer).
-    print(format_retrace_report(baseline=retrace_warm), file=sys.stderr)
+    # ONE snapshot surface (bfs_tpu.obs.MetricsRegistry) instead of the
+    # old bespoke retrace table: serve report, artifact caches, retrace
+    # counters WITH post-warmup drift (a sub-100% hit rate plus a non-zero
+    # retrace_drift entry names exactly which program recompiled), span
+    # summary, eviction counters.
+    from bfs_tpu.obs import get_registry
+
+    print(
+        get_registry().to_json(retrace_baseline=retrace_warm),
+        file=sys.stderr,
+    )
     for msg in wrong[:10]:
         print(f"WRONG: {msg}", file=sys.stderr)
     if wrong:
