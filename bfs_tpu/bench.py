@@ -1110,6 +1110,17 @@ def main():
         # BENCH_APPLIER must map to a different journal, never to a resume
         # that mixes xla- and pallas-timed repeats into one median.
         "applier": os.environ.get("BENCH_APPLIER", "auto"),
+        # Direction knobs likewise (ISSUE 7): two different push/pull
+        # schedules (or thresholds, or forced per-phase kernels) must
+        # never blend into one median — and conversely a resumed run with
+        # the same knobs replays the SAME schedule bit-identically (the
+        # schedule is a pure on-device function of graph + thresholds).
+        "direction": os.environ.get("BFS_TPU_DIRECTION", "auto") or "auto",
+        "direction_alpha": os.environ.get("BFS_TPU_DIRECTION_ALPHA", ""),
+        "direction_beta": os.environ.get("BFS_TPU_DIRECTION_BETA", ""),
+        "rowmin_kernel": os.environ.get("BFS_TPU_ROWMIN", "auto") or "auto",
+        "state_update_kernel": os.environ.get("BFS_TPU_STATE_UPDATE", "auto")
+        or "auto",
     })
     _install_signal_handlers(jr)
 
@@ -1309,7 +1320,38 @@ def main():
                 jr.restart("applier drift")
                 for p, payload in keep.items():
                     jr.put(p, payload)  # still true for this run
-        _boundary(jr, "engine_init", {"applier": eng.applier})
+        # The per-phase kernel verdicts travel with the applier record: a
+        # resumed run whose phase selection resolved differently (cached
+        # TPU probe vs a fresh one) must rotate for the same reason an
+        # applier drift does — two phase mixes never blend into one
+        # median.
+        if jr is not None:
+            erec = jr.get("engine_init")
+            drifted = erec is not None and erec.get("phase_selection") not in (
+                None,
+                {k: v for k, v in eng.phase_selection.items() if k != "basis"},
+            )
+            if drifted:
+                _stamp(
+                    "journal: phase-kernel selection drift; rotating "
+                    "journal aside (fresh run)"
+                )
+                keep = {
+                    p: jr.get(p) for p in ("scale", "graph", "layout")
+                    if jr.get(p) is not None
+                }
+                jr.restart("phase-selection drift")
+                for p, payload in keep.items():
+                    jr.put(p, payload)
+        _boundary(jr, "engine_init", {
+            "applier": eng.applier,
+            "phase_selection": {
+                k: v for k, v in eng.phase_selection.items() if k != "basis"
+            },
+        })
+        layout_detail["phase_kernel_selection"] = eng.phase_selection
+        if eng.phase_probe is not None:
+            layout_detail["phase_kernel_probe"] = eng.phase_probe
         if (
             isinstance(eng.applier_probe, dict)
             and "selected" in eng.applier_probe
@@ -1658,7 +1700,16 @@ def main():
 
             _stamp("superstep phase ledger (phase-isolated jits)...")
             with obs_span("bench.phase_ledger"):
-                layout_detail["superstep_phases"] = superstep_phase_ledger(eng)
+                # Small graphs need more K-loop iterations for the
+                # difference timing to clear the timer floor; the knobs
+                # are part of methodology, not config (not in the
+                # journal key — a resumed run restores the measured
+                # ledger rather than re-running it).
+                layout_detail["superstep_phases"] = superstep_phase_ledger(
+                    eng,
+                    loops=int(os.environ.get("BENCH_LEDGER_LOOPS", "4")),
+                    repeats=int(os.environ.get("BENCH_LEDGER_REPEATS", "2")),
+                )
             _stamp("superstep phase ledger done")
             _boundary(jr, "phase_ledger", {
                 "superstep_phases": layout_detail["superstep_phases"],
@@ -1675,7 +1726,11 @@ def main():
         curve_rec = jr.get("level_curve") if jr is not None else None
         if curve_rec is not None:
             layout_detail["level_curve"] = curve_rec["level_curve"]
-            _stamp("journal: level curve restored")
+            if isinstance(curve_rec["level_curve"], dict):
+                sched = curve_rec["level_curve"].get("direction_schedule")
+                if sched is not None:
+                    layout_detail["direction_schedule"] = sched
+            _stamp("journal: level curve restored (direction schedule rides it)")
         elif _behind(0.80):
             _stamp("behind budget: skipping level curve")
             layout_detail["level_curve"] = "skipped (time budget)"
@@ -1717,6 +1772,20 @@ def main():
                     f"{curve['reference_reached']}"
                 )
             layout_detail["level_curve"] = curve
+            sched = curve.get("direction_schedule")
+            if sched is not None:
+                # details.direction_schedule next to the curve (ISSUE 7):
+                # the per-superstep push/pull record from the SAME
+                # telemetry pull, journaled with the curve so a resumed
+                # bench replays it bit-identically.
+                layout_detail["direction_schedule"] = sched
+                _stamp(
+                    "direction schedule: "
+                    + "".join(
+                        "P" if s == "push" else "L" for s in sched["schedule"]
+                    )
+                    + f" ({sched['switches']} switches, mode={sched['mode']})"
+                )
             _stamp(
                 f"level curve done: {curve['levels']} levels, peak "
                 f"{curve['peak_occupancy']} at L{curve['peak_level']}, "

@@ -261,6 +261,19 @@ SPARSE_BV = 32 * 1024
 SPARSE_BE = 64 * 1024
 
 
+def sparse_budgets(vr: int, num_adj_entries: int) -> tuple[int, int]:
+    """Effective (vertex, edge) budgets for the sparse path's STATIC
+    shapes, clamped to the graph itself: the module budgets are overflow
+    insurance sized for bench-scale graphs, and padding a 6K-edge
+    graph's every push superstep to the 64K-lane worst case made the
+    sparse path ~10x slower than it needed to be at small scales (the
+    gather/sort/scatter all run over the full static budget regardless
+    of the live frontier).  A frontier can never exceed the whole vertex
+    space or the whole adjacency, so the clamp is exact, and at bench
+    scale (vr, E >> budgets) nothing changes."""
+    return min(SPARSE_BV, int(vr)), min(SPARSE_BE, int(num_adj_entries))
+
+
 def _relay_static(rg):
     """Hashable static layout descriptor for program caching."""
     return (
@@ -269,21 +282,32 @@ def _relay_static(rg):
     )
 
 
-def _superstep_fn(static, use_pallas: bool, packed: bool = False):
+def _superstep_fn(static, use_pallas: bool, packed: bool = False,
+                  phase_sel: tuple | None = None):
     """Dense superstep closure.  ``vperm_m``/``net_m`` are either the flat
     mask array (XLA per-stage path) or the tuple of per-pass arrays from
     :func:`~bfs_tpu.ops.relay_pallas.prepare_pass_masks` (fused TPU path) —
     chosen per network by :func:`_net_uses_pallas`.  With ``packed`` the
     carry is the fused-word PackedRelayState: the row-min emits RANKS and
     the state update is one lexicographic min (ops/relay.py
-    apply_relay_candidates_packed) — the routing pipeline is identical."""
+    apply_relay_candidates_packed) — the routing pipeline is identical.
+
+    ``phase_sel`` is the per-phase kernel selection ``(rowmin,
+    state_update)`` with values ``'xla'``/``'pallas'`` (ISSUE 7 tentpole
+    b): the packed row-min and packed state-update each run their fused
+    Pallas kernel when selected BY MEASUREMENT (RelayEngine
+    phase_selection; profiling.probe_phase_kernels is the probe) —
+    winners are picked per phase, not globally, and both flavors are
+    bit-exact so the selection can never change a result."""
     (vr, vperm_size, vperm_table, out_classes, out_space, net_table,
      net_size, in_classes) = static
     from ..ops import relay as R
 
     vp_pallas = use_pallas and _net_uses_pallas(vperm_size)
     net_pallas = use_pallas and _net_uses_pallas(net_size)
-    if vp_pallas or net_pallas:
+    rowmin_pallas = bool(packed and phase_sel and phase_sel[0] == "pallas")
+    update_pallas = bool(packed and phase_sel and phase_sel[1] == "pallas")
+    if vp_pallas or net_pallas or rowmin_pallas or update_pallas:
         from ..ops import relay_pallas as RP
 
         vp_static = RP.pass_static(vperm_table, vperm_size) if vp_pallas else None
@@ -303,7 +327,14 @@ def _superstep_fn(static, use_pallas: bool, packed: bool = False):
         else:
             l1 = R.apply_benes_std(l2, net_m, net_table, net_size)
         if packed:
-            cand = R.rowmin_ranks(l1, valid_words, in_classes, vr)
+            if rowmin_pallas:
+                cand = RP.rowmin_ranks_pallas(
+                    l1, valid_words, in_classes, vr
+                )
+            else:
+                cand = R.rowmin_ranks(l1, valid_words, in_classes, vr)
+            if update_pallas:
+                return RP.apply_relay_candidates_packed_pallas(st, cand)
             return R.apply_relay_candidates_packed(st, cand)
         cand = R.rowmin_candidates(l1, valid_words, in_classes, vr)
         return R.apply_relay_candidates(st, cand)
@@ -362,7 +393,7 @@ def _sparse_superstep(st, adj_indptr, adj_dst, adj_slot, *, vr: int,
     words into the packed carry."""
     from ..ops.relay import PackedRelayState, RelayState
 
-    bv, be = SPARSE_BV, SPARSE_BE
+    bv, be = sparse_budgets(vr, adj_dst.shape[0])
     flist = _extract_frontier_list(st.fwords, vr, bv)
     deg = adj_indptr[flist + 1] - adj_indptr[flist]  # 0 at the vr fill slot
     cum = jnp.cumsum(deg)
@@ -418,30 +449,48 @@ def _frontier_stats(st, outdeg, vr: int):
     return fsize, fedges
 
 
-def _take_sparse(st, outdeg, vr: int):
+def _take_sparse(st, outdeg, vr: int, num_adj_entries: int):
     """THE sparse-path dispatch predicate (single definition — the fused
     loop's ``small()`` and the stepped ``step_dispatch`` both call this):
-    frontier has <= SPARSE_BV vertices AND <= SPARSE_BE out-edges.
-    Overflow-safe without int64: per-vertex degrees are capped at
-    SPARSE_BE+1 before the uint32 sum, so any frontier small enough to
-    pass the vertex bound sums to at most SPARSE_BV*(SPARSE_BE+1) < 2^32
-    — a >2^31-edge frontier on a scale-27+ graph cannot wrap into a
-    spuriously-small ``fedges`` and silently overrun the sparse path's
-    static edge budget."""
+    frontier fits the CLAMPED budgets (:func:`sparse_budgets` — the same
+    derivation the sparse superstep's static shapes use, so dispatch and
+    capacity can never disagree).  Overflow-safe without int64:
+    per-vertex degrees are capped at be+1 before the uint32 sum, so any
+    frontier small enough to pass the vertex bound sums to at most
+    bv*(be+1) < 2^32 — a >2^31-edge frontier on a scale-27+ graph cannot
+    wrap into a spuriously-small ``fedges`` and silently overrun the
+    sparse path's static edge budget."""
+    from ..ops import relay as R
+
+    bv, be = sparse_budgets(vr, num_adj_entries)
+    fsize = jax.lax.population_count(st.fwords).sum(dtype=jnp.int32)
+    bools = R.unpack_std(st.fwords, vr)
+    capped = jnp.minimum(outdeg, be + 1).astype(jnp.uint32)
+    fedges = jnp.where(bools != 0, capped, jnp.uint32(0)).sum(
+        dtype=jnp.uint32
+    )
+    return (fsize <= bv) & (fedges <= jnp.uint32(be))
+
+
+# bfs_tpu: hot traced
+def _frontier_masses_words(st, outdeg, vr: int):
+    """(occupancy int32, out-edge mass float32) of a word-packed frontier
+    — the Beamer predicate's inputs (models/direction.py take_pull), one
+    popcount + one masked sum per superstep.  Float32 mass: counts are
+    integer-exact below 2^24 and far from any threshold above it."""
     from ..ops import relay as R
 
     fsize = jax.lax.population_count(st.fwords).sum(dtype=jnp.int32)
     bools = R.unpack_std(st.fwords, vr)
-    capped = jnp.minimum(outdeg, SPARSE_BE + 1).astype(jnp.uint32)
-    fedges = jnp.where(bools != 0, capped, jnp.uint32(0)).sum(
-        dtype=jnp.uint32
-    )
-    return (fsize <= SPARSE_BV) & (fedges <= jnp.uint32(SPARSE_BE))
+    fe = jnp.where(bools != 0, outdeg, 0).astype(jnp.float32).sum()
+    return fsize, fe
 
 
 @functools.lru_cache(maxsize=16)
 def _relay_fused_program(static, sparse: bool, use_pallas: bool,
-                         packed: bool = False, telemetry: bool = False):
+                         packed: bool = False, telemetry: bool = False,
+                         direction: tuple | None = None,
+                         phase_sel: tuple | None = None):
     """Jitted relay BFS loop (v4), cached per static layout shape.
 
     With ``sparse``, small frontiers (under the SPARSE_BV/BE budgets) take
@@ -465,17 +514,53 @@ def _relay_fused_program(static, sparse: bool, use_pallas: bool,
 
     With ``telemetry`` (static), the carry additionally holds the
     per-level accumulators (obs/telemetry.py): frontier occupancy
-    (int32[TEL_SLOTS]) and frontier out-edges (float32 — ``outdeg`` is
-    already a loop operand), recorded after every dense AND sparse
-    superstep and returned alongside the finished state for ONE pull at
-    loop exit — the Beamer-style direction-switching input (ROADMAP
-    item 2) without a per-superstep host sync."""
+    (int32[TEL_SLOTS]), frontier out-edges (float32 — ``outdeg`` is
+    already a loop operand), and the DIRECTION schedule (DIR_PUSH /
+    DIR_PULL per settled level), recorded after every superstep and
+    returned alongside the finished state for ONE pull at loop exit.
+
+    ``direction`` (ISSUE 7 tentpole a) selects the superstep body per
+    level:
+
+      * ``None`` — legacy: the nested-while hybrid when ``sparse``
+        (budget-predicate dispatch), dense-only otherwise.
+      * ``('pull', a, b)`` — dense relay every superstep.
+      * ``('push', a, b)`` — the legacy hybrid structure (sparse gather
+        whenever the static budgets allow — the frontier/element
+        preference).
+      * ``('auto', alpha, beta)`` — Beamer-style per-superstep
+        ``lax.cond``: push (sparse gather) on sparse frontiers, pull
+        (dense relay) once the frontier's out-edge mass crosses the
+        unexplored mass (``m_f*alpha > m_u``) or its occupancy crosses
+        ``n*beta`` (models/direction.py take_pull — the single predicate
+        definition).  The unexplored mass rides the carry (one masked
+        out-degree sum per superstep — the same sum the predicate
+        needs), so the decision is entirely on-device: no host sync, no
+        retrace, and the schedule is a pure function of the graph +
+        thresholds (a resumed bench replays it bit-identically).
+        Push additionally requires the sparse path's static budgets
+        (SPARSE_BV/BE) — the gather superstep's shapes are compiled.
+    """
     (vr, vperm_size, vperm_table, out_classes, out_space, net_table,
      net_size, in_classes) = static
     from ..ops import relay as R
     from ..ops.packed import packed_cap
 
-    superstep = _superstep_fn(static, use_pallas, packed)
+    superstep = _superstep_fn(static, use_pallas, packed, phase_sel)
+    mode = direction[0] if direction is not None else None
+    # Static Python floats, hoisted OUT of the jitted program body (the
+    # float() casts below run at trace-build time on config values, never
+    # on device values).
+    dir_alpha = float(direction[1]) if direction is not None else 0.0
+    dir_beta = float(direction[2]) if direction is not None else 0.0
+    if mode == "pull" or (mode in ("auto", "push") and not sparse):
+        # Dense-only body regardless of the hybrid operands.  A 'push'
+        # request without the sparse operands is rejected at the ENGINE
+        # boundary; this normalization keeps the schedule honest (all
+        # supersteps recorded as the pull body they actually run) for
+        # any direct program caller.
+        sparse = False
+        mode = "pull"
 
     @functools.partial(jax.jit, static_argnames=("max_levels",))
     @traced("bfs.relay_fused")
@@ -509,37 +594,113 @@ def _relay_fused_program(static, sparse: bool, use_pallas: bool,
             from ..obs import telemetry as T
             from ..ops.relax import INT32_MAX
 
-            # In-loop carry: ONLY the popcount occupancy accumulator
-            # (measured free next to a superstep).  The out-edge curve is
-            # derived in one pass at loop exit from the final levels —
-            # a per-superstep masked outdeg sum cost ~25% of a CPU
-            # superstep, violating the <2% telemetry budget.
+            # In-loop carry: the popcount occupancy accumulator plus the
+            # int32[TEL_SLOTS] direction schedule (one .set per
+            # superstep).  The out-edge curve is derived in one pass at
+            # loop exit from the final levels — a per-superstep masked
+            # outdeg sum cost ~25% of a CPU superstep, violating the <2%
+            # telemetry budget (the AUTO body pays that sum as its
+            # dispatch predicate, which the schedule then records).
             acc0 = T.init_level_acc()
+            dir0 = T.init_dir_acc()
 
             def rec(fv, st):
                 return T.record_frontier_words(fv, st.fwords, st.level)
 
-            def finish_tel(out, fv):
+            def finish_tel(out, fv, dirs):
                 st = finish(out)
                 fe = T.edge_curve_from_levels(
                     st.dist, outdeg, st.dist == INT32_MAX
                 )
-                return st, (fv, fe)
+                return st, (fv, fe, dirs)
 
         if not sparse:
+            # Dense-only: every superstep is a pull (relay) superstep.
             if telemetry:
-                out, fv = _loop_with_acc(live, dense, state, acc0, rec)
-                return finish_tel(out, fv)
+
+                def dense_t(c):
+                    st, fv, dirs = c
+                    st2 = dense(st)
+                    return (
+                        st2,
+                        rec(fv, st2),
+                        T.record_direction(dirs, st2.level, T.DIR_PULL),
+                    )
+
+                out, fv, dirs = jax.lax.while_loop(
+                    lambda cc: live(cc[0]), dense_t, (state, acc0, dir0)
+                )
+                return finish_tel(out, fv, dirs)
             return finish(jax.lax.while_loop(live, dense, state))
 
         def small(st):
-            return _take_sparse(st, outdeg, vr)
+            return _take_sparse(st, outdeg, vr, adj_dst.shape[0])
 
         def sparse_step(st):
             return _sparse_superstep(
                 st, adj_indptr, adj_dst, adj_slot, vr=vr, packed=packed
             )
 
+        if mode == "auto":
+            # Beamer-style per-superstep dispatch: ONE lax.cond on the
+            # on-device masses.  The unexplored-mass carry ``mu`` holds
+            # the out-edge mass of every vertex not settled before the
+            # current frontier (mu - fe = the true unexplored mass m_u),
+            # so the predicate costs exactly one masked out-degree sum
+            # per superstep and nothing ever syncs to the host.
+            from .direction import take_pull
+
+            alpha, beta = dir_alpha, dir_beta
+            mu0 = outdeg.astype(jnp.float32).sum()
+
+            def decide(st, mu, prev_pull):
+                fsize, fe = _frontier_masses_words(st, outdeg, vr)
+                # Clamped: float32 rounding must not let the tail's
+                # unexplored mass dip negative (it would satisfy any
+                # pull threshold).
+                m_u = jnp.maximum(mu - fe, 0.0)
+                bv, be = sparse_budgets(vr, adj_dst.shape[0])
+                budget_ok = (fsize <= bv) & (fe <= jnp.float32(be))
+                use_pull = (
+                    take_pull(prev_pull, fsize, fe, m_u, vr, alpha, beta)
+                    | ~budget_ok
+                )
+                return use_pull, m_u
+
+            if telemetry:
+
+                def body_ta(c):
+                    st, mu, prev, fv, dirs = c
+                    use_pull, m_u = decide(st, mu, prev)
+                    st2 = jax.lax.cond(use_pull, dense, sparse_step, st)
+                    code = jnp.where(
+                        use_pull, jnp.int32(T.DIR_PULL), jnp.int32(T.DIR_PUSH)
+                    )
+                    return (
+                        st2, m_u, use_pull, rec(fv, st2),
+                        T.record_direction(dirs, st2.level, code),
+                    )
+
+                out, _, _, fv, dirs = jax.lax.while_loop(
+                    lambda cc: live(cc[0]), body_ta,
+                    (state, mu0, jnp.bool_(False), acc0, dir0),
+                )
+                return finish_tel(out, fv, dirs)
+
+            def body_a(c):
+                st, mu, prev = c
+                use_pull, m_u = decide(st, mu, prev)
+                st2 = jax.lax.cond(use_pull, dense, sparse_step, st)
+                return st2, m_u, use_pull
+
+            out, _, _ = jax.lax.while_loop(
+                lambda cc: live(cc[0]), body_a,
+                (state, mu0, jnp.bool_(False)),
+            )
+            return finish(out)
+
+        # mode in (None, 'push'): the legacy nested-while hybrid — sparse
+        # (push) whenever the static budgets allow, dense otherwise.
         def sparse_phase(st):
             return jax.lax.while_loop(
                 lambda s: live(s) & small(s), sparse_step, st
@@ -549,13 +710,17 @@ def _relay_fused_program(static, sparse: bool, use_pallas: bool,
             return sparse_phase(dense(st))
 
         if telemetry:
-            # Same nested-while structure, carry extended with the acc:
-            # dense and sparse supersteps both record, so the curve covers
-            # every level regardless of which path settled it.
+            # Same nested-while structure, carry extended with the accs:
+            # dense and sparse supersteps both record, so the curve and
+            # the schedule cover every level regardless of which path
+            # settled it.
             def sparse_step_t(c):
-                st, fv = c
+                st, fv, dirs = c
                 st2 = sparse_step(st)
-                return st2, rec(fv, st2)
+                return (
+                    st2, rec(fv, st2),
+                    T.record_direction(dirs, st2.level, T.DIR_PUSH),
+                )
 
             def sparse_phase_t(c):
                 return jax.lax.while_loop(
@@ -563,17 +728,21 @@ def _relay_fused_program(static, sparse: bool, use_pallas: bool,
                 )
 
             def dense_t(c):
-                st, fv = c
+                st, fv, dirs = c
                 st2 = dense(st)
-                return st2, rec(fv, st2)
+                return (
+                    st2, rec(fv, st2),
+                    T.record_direction(dirs, st2.level, T.DIR_PULL),
+                )
 
             def body_t(c):
                 return sparse_phase_t(dense_t(c))
 
-            out, fv = jax.lax.while_loop(
-                lambda cc: live(cc[0]), body_t, sparse_phase_t((state, acc0))
+            out, fv, dirs = jax.lax.while_loop(
+                lambda cc: live(cc[0]), body_t,
+                sparse_phase_t((state, acc0, dir0)),
             )
-            return finish_tel(out, fv)
+            return finish_tel(out, fv, dirs)
 
         return finish(jax.lax.while_loop(live, body, sparse_phase(state)))
 
@@ -625,7 +794,8 @@ def _relay_elem_program(static, pt: int, groups: int, use_pallas: bool):
 
 @functools.lru_cache(maxsize=8)
 def _relay_multi_fused_program(static, use_pallas: bool,
-                               packed: bool = False):
+                               packed: bool = False,
+                               phase_sel: tuple | None = None):
     """Batched (multi-source) relay loop: ``vmap`` lifts the dense superstep
     over a leading sources axis while all trees share one lock-step
     ``while_loop`` (BASELINE.json config 5 semantics).  ``packed`` as in
@@ -636,7 +806,7 @@ def _relay_multi_fused_program(static, use_pallas: bool,
     from ..ops import relay as R
     from ..ops.packed import packed_cap
 
-    superstep = _superstep_fn(static, use_pallas, packed)
+    superstep = _superstep_fn(static, use_pallas, packed, phase_sel)
 
     @functools.partial(jax.jit, static_argnames=("max_levels",))
     @traced("bfs.relay_multi_fused")
@@ -1124,7 +1294,7 @@ class RelayEngine:
     """
 
     def __init__(self, graph, *, sparse_hybrid: bool = True,
-                 applier: str = "auto"):
+                 applier: str = "auto", direction: str | None = None):
         from ..graph.relay import RelayGraph, build_relay_graph, valid_slot_words
 
         rg = graph if isinstance(graph, RelayGraph) else build_relay_graph(graph)
@@ -1133,6 +1303,23 @@ class RelayEngine:
         if applier not in ("auto", "pallas", "xla"):
             raise ValueError(
                 f"unknown applier {applier!r}; use 'auto', 'pallas' or 'xla'"
+            )
+        # Direction-optimizing superstep policy (ISSUE 7 tentpole a):
+        # push|pull|auto with Beamer alpha/beta thresholds, env-resolved
+        # (BFS_TPU_DIRECTION / _ALPHA / _BETA) unless forced by argument.
+        # Frozen per engine — every program and executable key carries it,
+        # so auto-switching (an in-program lax.cond) never retraces and a
+        # knob flip can never reuse a stale compiled program.
+        from .direction import resolve_direction
+
+        self.direction = resolve_direction(direction)
+        if self.direction.mode == "push" and not sparse_hybrid:
+            # Same contract as the sharded engine: without the sparse
+            # adjacency there is no push body — running dense while the
+            # schedule claims 'push' would ship a lying capture.
+            raise ValueError(
+                "direction='push' needs sparse_hybrid=True (the push body "
+                "is the sparse gather superstep); use 'pull' or 'auto'"
             )
         # Packed fused-word state (ops/packed.py): on by default whenever
         # every parent rank fits the 26-bit field; BFS_TPU_PACKED=0/1
@@ -1240,8 +1427,78 @@ class RelayEngine:
             )
         self._static = _relay_static(rg)
         self._compiled = {}
+        _istamp("resolving per-phase kernel selection...")
+        self.phase_probe = None
+        self.phase_selection = self._resolve_phase_selection()
         _init_span.__exit__(None, None, None)
         _istamp("init done")
+
+    def _resolve_phase_selection(self) -> dict:
+        """Per-phase kernel choice for the packed row-min and packed
+        state-update (ISSUE 7 tentpole b): ``BFS_TPU_ROWMIN`` /
+        ``BFS_TPU_STATE_UPDATE`` force ``pallas``/``xla``; ``auto`` (the
+        default) MEASURES both arms on TPU backends
+        (profiling.probe_phase_kernels, K-loop difference timing on the
+        engine's real shapes) and picks per phase — never a static
+        default.  Off-TPU the fused kernels only exist in interpret mode
+        (measured for the ledger's verdict, never competitive), so auto
+        resolves to the XLA arms with the basis recorded."""
+        import os
+
+        sel, basis = {}, {}
+        forced = {}
+        for phase, env in (
+            ("rowmin", "BFS_TPU_ROWMIN"),
+            ("state_update", "BFS_TPU_STATE_UPDATE"),
+        ):
+            v = os.environ.get(env, "auto") or "auto"
+            if v not in ("auto", "pallas", "xla"):
+                raise ValueError(
+                    f"unknown {env} {v!r}; use 'auto', 'pallas' or 'xla'"
+                )
+            forced[phase] = v
+        need_auto = [p for p, v in forced.items() if v == "auto"]
+        if need_auto and self.packed and jax.default_backend() == "tpu":
+            from ..profiling import probe_phase_kernels
+
+            try:
+                probe = probe_phase_kernels(self)
+            except Exception as exc:  # pragma: no cover - TPU-only path
+                logger.warning("phase-kernel probe failed: %r", exc)
+                probe = None
+            self.phase_probe = probe
+            for p in forced:
+                if forced[p] != "auto":
+                    sel[p], basis[p] = forced[p], "forced (env)"
+                elif probe is not None and p in probe:
+                    sel[p] = probe[p]["selected"]
+                    basis[p] = probe[p]["selection_basis"]
+                else:
+                    sel[p], basis[p] = "xla", "fallback (probe failed)"
+        else:
+            for p in forced:
+                if forced[p] != "auto":
+                    sel[p], basis[p] = forced[p], "forced (env)"
+                elif not self.packed:
+                    sel[p], basis[p] = "xla", "unpacked carry (no fused arm)"
+                else:
+                    sel[p], basis[p] = (
+                        "xla",
+                        "non-tpu backend (pallas arm is interpret-only; "
+                        "the phase ledger still measures it)",
+                    )
+        return {
+            "rowmin": sel["rowmin"],
+            "state_update": sel["state_update"],
+            "basis": basis,
+        }
+
+    def _phase_sel(self) -> tuple:
+        """Hashable per-phase selection for program/executable keys."""
+        return (
+            self.phase_selection["rowmin"],
+            self.phase_selection["state_update"],
+        )
 
     def _resolve_applier(self, applier: str) -> str:
         """Forced env/arg choice, or the measured probe on TPU 'auto'."""
@@ -1320,14 +1577,17 @@ class RelayEngine:
             packed = self.packed
         fused = _relay_fused_program(
             self._static, self.sparse_hybrid, self._use_pallas(), packed,
-            telemetry,
+            telemetry, self.direction.key(), self._phase_sel(),
         )
         args = (
             source_new, *self._tensors, *self._sparse_tensors_for(packed)
         )
         if not self._use_pallas():
             return fused(*args, max_levels=max_levels)
-        key = ("fused", max_levels, packed, telemetry)
+        key = (
+            "fused", max_levels, packed, telemetry, self.direction.key(),
+            self._phase_sel(),
+        )
         compiled = self._compiled.get(key)
         if compiled is None:
             compiled = self._compile_maybe_cached(
@@ -1372,8 +1632,9 @@ class RelayEngine:
         compiled = self._compiled.get(key)
         if compiled is None:
             vr = self.relay_graph.vr
+            n_adj = int(self._sparse_tensors[1].shape[0])
             compiled = jax.jit(
-                lambda st, od: _take_sparse(st, od, vr)
+                lambda st, od: _take_sparse(st, od, vr, n_adj)
             )
             self._compiled[key] = compiled
         return bool(
@@ -1403,7 +1664,10 @@ class RelayEngine:
 
                 args = (state, *self._sparse_tensors_for(packed)[:3])
             else:
-                fn = _superstep_fn(self._static, self._use_pallas(), packed)
+                fn = _superstep_fn(
+                    self._static, self._use_pallas(), packed,
+                    self._phase_sel(),
+                )
                 args = (state, *self._tensors)
             opts = (
                 self._COMPILER_OPTIONS
@@ -1644,34 +1908,48 @@ class RelayEngine:
         (the whole point: the curve is the direction-switching input for
         ROADMAP item 2 and must be readable without breaking the
         hot-region transfer rules)."""
-        from ..obs.telemetry import level_curve, read_telemetry
+        from ..obs.telemetry import (
+            direction_schedule,
+            level_curve,
+            read_telemetry,
+        )
         from ..ops.packed import PACKED_MAX_LEVELS, packed_truncated
 
         rg = self.relay_graph
         check_sources(rg.num_vertices, source)
         max_levels = int(max_levels) if max_levels is not None else rg.vr
         src = jax.device_put(np.int32(rg.old2new[source]))
-        state, (fv_d, fe_d) = self._fused(src, max_levels, telemetry=True)
-        fv, fe, changed, level = read_telemetry(
-            (fv_d, fe_d, state.changed, state.level)
+        state, (fv_d, fe_d, dir_d) = self._fused(
+            src, max_levels, telemetry=True
+        )
+        fv, fe, dirs, changed, level = read_telemetry(
+            (fv_d, fe_d, dir_d, state.changed, state.level)
         )
         packed_run = self.packed
         if packed_run and packed_truncated(changed, level, max_levels):
             # Deeper than the packed level field: the curve would be
             # truncated at the cap — re-run unpacked, same contract as run().
-            state, (fv_d, fe_d) = self._fused(
+            state, (fv_d, fe_d, dir_d) = self._fused(
                 src, max_levels, packed=False, telemetry=True
             )
-            fv, fe, changed, level = read_telemetry(
-                (fv_d, fe_d, state.changed, state.level)
+            fv, fe, dirs, changed, level = read_telemetry(
+                (fv_d, fe_d, dir_d, state.changed, state.level)
             )
             packed_run = False
         # The loop's REAL cap: the packed level field AND the caller's
         # max_levels both bound it — reporting the raw 62 would hide a
         # caller-limit truncation behind a healthy-looking proximity.
         cap = min(PACKED_MAX_LEVELS, max_levels) if packed_run else max_levels
-        return level_curve(fv, fe, cap=cap,
-                           reference_reached=reference_reached)
+        curve = level_curve(fv, fe, cap=cap,
+                            reference_reached=reference_reached)
+        # The per-superstep push/pull schedule rides the same telemetry
+        # pull — shipped by bench as details.direction_schedule next to
+        # the curve (ISSUE 7 tentpole a).
+        curve["direction_schedule"] = direction_schedule(
+            dirs, mode=self.direction.mode, alpha=self.direction.alpha,
+            beta=self.direction.beta,
+        )
+        return curve
 
     def run_many_device(self, sources, *, max_levels: int | None = None):
         """Graph500-style batched timing path: dispatch one fused BFS per
@@ -1715,13 +1993,16 @@ class RelayEngine:
         if packed is None:
             packed = self.packed
         fused = _relay_multi_fused_program(
-            self._static, self._use_pallas(), packed
+            self._static, self._use_pallas(), packed, self._phase_sel()
         )
         sources_new = jax.device_put(rg.old2new[sources])  # explicit: guard-clean in timed repeats
         args = (sources_new, *self._tensors)
         if not self._use_pallas():
             return fused(*args, max_levels=max_levels)
-        key = ("multi", sources_new.shape[0], max_levels, packed)
+        key = (
+            "multi", sources_new.shape[0], max_levels, packed,
+            self._phase_sel(),
+        )
         compiled = self._compiled.get(key)
         if compiled is None:
             compiled = self._compile_maybe_cached(

@@ -1,0 +1,397 @@
+"""Direction-optimizing supersteps: Beamer-style push/pull selection.
+
+The reference (and every engine through PR 6) runs each superstep the same
+way regardless of frontier size.  Direction-optimizing BFS (Beamer et al.;
+BLEST arxiv 2512.21967 and Graph Traversal on Tensor Cores arxiv 2606.05081
+are the tensor-core instantiations) switches bodies per superstep:
+
+  * **push** (element/frontier): walk the frontier's out-edges — cheap on
+    SPARSE frontiers (the first and last levels of a low-diameter graph),
+    cost ~ frontier out-edge mass.
+  * **pull** (dense relay): evaluate every vertex's in-edges against the
+    frontier — cheap on the DENSE middle levels, cost ~ fixed per
+    superstep but touched-once per vertex.
+
+The classic thresholds, both tunable:
+
+    go pull when  m_f * alpha > m_u      (frontier out-edges vs unexplored)
+    stay pull while  n_f * beta > n      (frontier occupancy vs vertices)
+
+evaluated here STATELESSLY per superstep (``pull iff either holds``) so
+the decision is a pure function of on-device frontier state — no Python
+in the loop, no host sync: the predicate compiles into the fused
+``while_loop`` body and an ``lax.cond`` selects the superstep body.  The
+unexplored-edge mass ``m_u`` rides the loop carry (decremented by each
+new frontier's mass — the masked out-degree sum the predicate needs
+anyway), so no extra O(V) pass exists beyond the one sum.
+
+Knobs (resolved once per engine/program, never per superstep):
+
+    BFS_TPU_DIRECTION        push | pull | auto   (default auto)
+    BFS_TPU_DIRECTION_ALPHA  float > 0            (default 14.0)
+    BFS_TPU_DIRECTION_BETA   float > 0            (default 24.0)
+
+The chosen direction per level is recorded in the telemetry accumulator
+(obs/telemetry.py DIR_PUSH/DIR_PULL) and ships as
+``details.direction_schedule`` next to the level curve.
+
+This module also hosts the combined-layout programs for the push/pull
+engines: :func:`bfs_direction` / :func:`bfs_multi_direction` carry BOTH
+operand sets (the dst-sorted edge list for push, the ELL for pull) in one
+fused program and cond between :func:`~bfs_tpu.ops.relax.relax_superstep`
+and :func:`~bfs_tpu.ops.pull.relax_pull_superstep` per superstep —
+bit-exact against either pure engine for ANY schedule, since both bodies
+compute the same canonical min-parent candidates.  The relay engine's
+switching (sparse gather vs dense relay) lives in models/bfs.py.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..analysis.runtime import traced
+from ..obs import telemetry as T
+from ..ops.relax import INT32_MAX
+
+DEFAULT_ALPHA = 14.0
+DEFAULT_BETA = 24.0
+
+DIRECTION_MODES = ("push", "pull", "auto")
+
+
+@dataclass(frozen=True)
+class DirectionConfig:
+    """Resolved direction policy — hashable, so it can sit in program and
+    executable cache keys (the flag must thread through
+    ``ExecutableCache`` keys so a knob flip can never reuse a stale
+    compiled program, and auto-switching itself never retraces: the cond
+    is IN the program)."""
+
+    mode: str = "auto"
+    alpha: float = DEFAULT_ALPHA
+    beta: float = DEFAULT_BETA
+
+    def key(self) -> tuple:
+        return (self.mode, float(self.alpha), float(self.beta))
+
+
+def resolve_direction(mode: str | None = None) -> DirectionConfig:
+    """Env-resolved config; an explicit ``mode`` argument wins over
+    ``BFS_TPU_DIRECTION``.  Raises on unknown modes/non-positive
+    thresholds (silently clamping a typo'd knob would quietly change
+    what a capture measured)."""
+    if mode is None:
+        mode = os.environ.get("BFS_TPU_DIRECTION", "auto") or "auto"
+    if mode not in DIRECTION_MODES:
+        raise ValueError(
+            f"unknown direction {mode!r}; use 'push', 'pull' or 'auto'"
+        )
+    alpha = float(os.environ.get("BFS_TPU_DIRECTION_ALPHA", DEFAULT_ALPHA))
+    beta = float(os.environ.get("BFS_TPU_DIRECTION_BETA", DEFAULT_BETA))
+    if alpha <= 0 or beta <= 0:
+        raise ValueError(
+            f"direction thresholds must be positive (alpha={alpha}, "
+            f"beta={beta})"
+        )
+    return DirectionConfig(mode=mode, alpha=alpha, beta=beta)
+
+
+# bfs_tpu: hot traced
+def take_pull(prev_pull, fsize, fedges, unexplored, num_vertices, alpha,
+              beta):
+    """THE on-device Beamer predicate (single definition — every fused
+    program's cond compiles this), with the classic hysteresis pair:
+
+      * in push mode, switch to pull when the frontier's out-edge mass
+        crosses the unexplored mass: ``m_f * alpha > m_u``;
+      * in pull mode, switch back to push when the frontier occupancy
+        drops under the vertex threshold: stay while ``n_f * beta > n``.
+
+    ``prev_pull`` is the previous superstep's decision (a loop-carried
+    bool — deterministic, so a resumed run replays the schedule
+    bit-identically).  All masses are float32 (counts are integer-valued
+    and exact below 2^24; above it the comparison is far from the
+    boundary, so rounding cannot flip it)."""
+    fe = fedges.astype(jnp.float32)
+    fs = fsize.astype(jnp.float32)
+    go_pull = fe * jnp.float32(alpha) > unexplored.astype(jnp.float32)
+    stay_pull = fs * jnp.float32(beta) > jnp.float32(np.float32(num_vertices))
+    return jnp.where(prev_pull, stay_pull, go_pull)
+
+
+# bfs_tpu: hot traced
+def frontier_masses(frontier_bool, outdeg):
+    """(occupancy, out-edge mass float32) of a bool frontier — summed over
+    every axis (batched states give the GLOBAL masses: the lock-step
+    multi-source programs make one decision for the whole batch)."""
+    fsize = frontier_bool.sum(dtype=jnp.int32)
+    fedges = jnp.where(frontier_bool, outdeg, 0).astype(jnp.float32).sum()
+    return fsize, fedges
+
+
+def _host_outdeg(num_vertices: int, src: np.ndarray) -> np.ndarray:
+    """Out-degree per vertex id from the (possibly padded) edge-source
+    array: int32[V+1] with an inert sentinel slot, matching the engines'
+    ``[V+1]`` state convention."""
+    deg = np.bincount(
+        np.asarray(src)[np.asarray(src) < num_vertices],
+        minlength=num_vertices,
+    )
+    return np.concatenate([deg, [0]]).astype(np.int32)
+
+
+# --------------------------------------------------------------------------
+# Combined push/pull fused programs (single- and multi-source).
+
+
+def _dir_code(mode: str, use_pull):
+    if mode == "push":
+        return jnp.int32(T.DIR_PUSH)
+    if mode == "pull":
+        return jnp.int32(T.DIR_PULL)
+    return jnp.where(use_pull, jnp.int32(T.DIR_PULL), jnp.int32(T.DIR_PUSH))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_vertices", "max_levels", "packed", "mode"),
+)
+@traced("direction._bfs_direction_fused")
+def _bfs_direction_fused(
+    src, dst, ell0, folds, outdeg, sources, alpha, beta,
+    num_vertices: int, max_levels: int, packed: bool = False,
+    mode: str = "auto",
+):
+    """One fused loop over BOTH layouts: per superstep an ``lax.cond`` on
+    the Beamer predicate selects the push body (edge-list segment-min)
+    or the pull body (ELL gather row-min).  ``sources`` is int32[] for a
+    single tree or int32[S] for the lock-step batch (one GLOBAL decision
+    per superstep — the trees share the loop).  Returns
+    ``(state, occupancy_acc, direction_acc)``; the accumulators are
+    pulled once at loop exit (obs/telemetry.py contract).  ``alpha`` /
+    ``beta`` are TRACED operands, so threshold sweeps never recompile.
+
+    With ``packed`` the carry is the fused ``level:6|parent:26`` word
+    state capped at PACKED_MAX_LEVELS; callers detect a cap exit via
+    ``packed_truncated`` and re-run unpacked — switching and fallback
+    compose (the schedule is a pure function of frontier masses, which
+    both carries produce identically)."""
+    from ..ops.packed import packed_cap
+    from ..ops.pull import relax_pull_superstep, relax_pull_superstep_packed
+    from ..ops.relax import (
+        init_batched_state,
+        init_packed_batched_state,
+        init_packed_state,
+        init_state,
+        relax_superstep,
+        relax_superstep_batched,
+        relax_superstep_batched_packed,
+        relax_superstep_packed,
+        unpack_bfs_state,
+    )
+
+    batched = sources.ndim == 1
+    nsrc = sources.shape[0] if batched else 1
+    total_edges = outdeg.astype(jnp.float32).sum() * jnp.float32(nsrc)
+
+    if packed:
+        cap = packed_cap(max_levels)
+        state = (
+            init_packed_batched_state(num_vertices, sources)
+            if batched
+            else init_packed_state(num_vertices, sources)
+        )
+
+        def push_body(s):
+            return (
+                relax_superstep_batched_packed(s, src, dst)
+                if batched
+                else relax_superstep_packed(s, src, dst)
+            )
+
+        def pull_body(s):
+            return relax_pull_superstep_packed(s, ell0, folds)
+
+    else:
+        cap = max_levels
+        state = (
+            init_batched_state(num_vertices, sources)
+            if batched
+            else init_state(num_vertices, sources)
+        )
+
+        def push_body(s):
+            return (
+                relax_superstep_batched(s, src, dst)
+                if batched
+                else relax_superstep(s, src, dst)
+            )
+
+        def pull_body(s):
+            return relax_pull_superstep(s, ell0, folds)
+
+    occ0 = T.init_level_acc(nsrc, wide=batched)
+    dir0 = T.init_dir_acc()
+    src_edges = (
+        outdeg[sources].astype(jnp.float32).sum()
+        if batched
+        else outdeg[sources].astype(jnp.float32)
+    )
+    def cond(c):
+        s = c[0]
+        return s.changed & (s.level < cap)
+
+    if mode == "auto":
+        carry0 = (
+            state, total_edges - src_edges, src_edges, jnp.bool_(False),
+            occ0, dir0,
+        )
+
+        def body(c):
+            s, mu, fe, prev_pull, occ, dirs = c
+            fsize, _ = frontier_masses(s.frontier, outdeg)
+            use_pull = take_pull(
+                prev_pull, fsize, fe, mu, num_vertices * nsrc, alpha, beta
+            )
+            s2 = jax.lax.cond(use_pull, pull_body, push_body, s)
+            _, fe2 = frontier_masses(s2.frontier, outdeg)
+            occ = T.record_frontier_bools(occ, s2.frontier, s2.level)
+            dirs = T.record_direction(
+                dirs, s2.level, _dir_code(mode, use_pull)
+            )
+            # Clamp: float32 rounding must not let the unexplored mass
+            # dip below zero at the tail (a negative m_u would satisfy
+            # ANY pull threshold and perturb the schedule's last
+            # entries).
+            return s2, jnp.maximum(mu - fe2, 0.0), fe2, use_pull, occ, dirs
+
+        out, _, _, _, occ, dirs = jax.lax.while_loop(cond, body, carry0)
+    else:
+        # Forced modes: no predicate, so no per-superstep mass sums and
+        # no mu/fe/prev carry — the body is the chosen superstep plus
+        # the two accumulator writes.
+        forced_body = push_body if mode == "push" else pull_body
+        code = _dir_code(mode, None)
+
+        def body(c):
+            s, occ, dirs = c
+            s2 = forced_body(s)
+            occ = T.record_frontier_bools(occ, s2.frontier, s2.level)
+            dirs = T.record_direction(dirs, s2.level, code)
+            return s2, occ, dirs
+
+        out, occ, dirs = jax.lax.while_loop(
+            cond, body, (state, occ0, dir0)
+        )
+    if packed:
+        out = unpack_bfs_state(out)
+    return out, occ, dirs
+
+
+def _direction_operands(graph, *, block: int = 1024):
+    """Both device layouts + the out-degree table for the combined
+    program, built once per call site (tests/serving memoize upstream)."""
+    from ..graph.csr import DeviceGraph, build_device_graph
+    from ..graph.ell import PullGraph, build_pull_graph, device_ell
+
+    if isinstance(graph, (DeviceGraph, PullGraph)):
+        raise ValueError(
+            "bfs_direction needs the raw Graph: it builds BOTH the edge "
+            "list (push) and ELL (pull) layouts"
+        )
+    dg = build_device_graph(graph, block=block)
+    pg = build_pull_graph(graph)
+    ell0, folds = device_ell(pg)
+    outdeg = jnp.asarray(_host_outdeg(dg.num_vertices, dg.src))
+    return dg, ell0, folds, outdeg
+
+
+def _run_direction(graph, sources, *, max_levels, config, block):
+    from ..ops.packed import (
+        packed_parent_fits,
+        packed_truncated,
+        resolve_packed,
+    )
+    from .bfs import check_sources
+
+    cfg = config if config is not None else resolve_direction()
+    dg, ell0, folds, outdeg = _direction_operands(graph, block=block)
+    check_sources(dg.num_vertices, sources)
+    limit = int(max_levels) if max_levels is not None else dg.num_vertices
+    src_t, dst_t = jnp.asarray(dg.src), jnp.asarray(dg.dst)
+    alpha = jnp.float32(cfg.alpha)
+    beta = jnp.float32(cfg.beta)
+
+    def run(packed):
+        return _bfs_direction_fused(
+            src_t, dst_t, ell0, folds, outdeg, jnp.asarray(sources),
+            alpha, beta, dg.num_vertices, limit, packed, cfg.mode,
+        )
+
+    packed = resolve_packed(packed_parent_fits(dg.num_vertices))
+    state, occ, dirs = jax.device_get(run(packed))
+    if packed and packed_truncated(state.changed, state.level, limit):
+        # Deeper than the packed level field: re-run unpacked — the
+        # schedule re-records identically (it is a pure function of the
+        # frontier masses both carries share).
+        state, occ, dirs = jax.device_get(run(False))
+    schedule = T.direction_schedule(
+        dirs, mode=cfg.mode, alpha=cfg.alpha, beta=cfg.beta
+    )
+    return state, occ, schedule, dg.num_vertices
+
+
+def bfs_direction(
+    graph,
+    source: int = 0,
+    *,
+    max_levels: int | None = None,
+    config: DirectionConfig | None = None,
+    block: int = 1024,
+):
+    """Single-source direction-optimizing BFS over the push/pull engine
+    pair: returns ``(BfsResult, direction_schedule dict)``.  Bit-exact
+    against ``bfs(engine='push'/'pull')`` for any schedule."""
+    from .bfs import BfsResult
+
+    state, _occ, schedule, v = _run_direction(
+        graph, np.int32(source), max_levels=max_levels, config=config,
+        block=block,
+    )
+    result = BfsResult(
+        dist=np.asarray(state.dist[:v]),
+        parent=np.asarray(state.parent[:v]),
+        num_levels=int(state.level),
+    )
+    return result, schedule
+
+
+def bfs_multi_direction(
+    graph,
+    sources,
+    *,
+    max_levels: int | None = None,
+    config: DirectionConfig | None = None,
+    block: int = 1024,
+):
+    """Batched multi-source direction-optimizing BFS (lock-step trees,
+    one global per-superstep decision): ``(MultiBfsResult, schedule)``."""
+    from .multisource import MultiBfsResult
+
+    sources = np.atleast_1d(np.asarray(sources, dtype=np.int32))
+    state, _occ, schedule, v = _run_direction(
+        graph, sources, max_levels=max_levels, config=config, block=block,
+    )
+    result = MultiBfsResult(
+        sources=sources,
+        dist=np.asarray(state.dist[:, :v]),
+        parent=np.asarray(state.parent[:, :v]),
+        num_levels=int(state.level),
+    )
+    return result, schedule

@@ -299,6 +299,21 @@ def bfs_multi_level_curve(
     return level_curve(fv, cap=cap)
 
 
+def bfs_multi_direction(graph, sources, *, max_levels=None, config=None,
+                        block: int = 1024):
+    """Direction-optimizing batched multi-source BFS (ISSUE 7): the
+    lock-step trees share one fused loop carrying BOTH layouts (edge
+    list + ELL) and an ``lax.cond`` selects push or pull per superstep
+    from the GLOBAL frontier masses (models/direction.py — the Beamer
+    predicate and knobs).  Returns ``(MultiBfsResult, schedule)``,
+    bit-exact with :func:`bfs_multi` under any schedule."""
+    from .direction import bfs_multi_direction as _impl
+
+    return _impl(
+        graph, sources, max_levels=max_levels, config=config, block=block
+    )
+
+
 def collapse_multi_source(result: MultiBfsResult):
     """Reduce per-source trees to the oracle's multi-source answer:
     ``dist[v] = min_s dist_s[v]``, parent from the argmin source's tree with

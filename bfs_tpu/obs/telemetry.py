@@ -107,6 +107,64 @@ def edge_curve_from_levels(dist, outdeg, unreached):
     return jnp.zeros(TEL_SLOTS, jnp.float32).at[idx].add(w)
 
 
+# -------------------------------------------------------- direction codes --
+# The per-superstep direction schedule rides the SAME accumulator shape as
+# the level curve: int32[TEL_SLOTS] where slot ``l`` records which body the
+# superstep that settled level ``l`` ran — the Beamer-style switching
+# evidence (ROADMAP item 2) pulled in the ONE loop-exit device_get next to
+# the occupancy curve.  0 = level not executed.
+
+DIR_PUSH = 1  # element/frontier body (sparse gather superstep)
+DIR_PULL = 2  # dense relay body (full-network superstep)
+
+DIR_NAMES = {DIR_PUSH: "push", DIR_PULL: "pull"}
+
+
+def init_dir_acc(slots: int = TEL_SLOTS):
+    """int32[slots] direction accumulator (slot 0 stays 0: level 0 is
+    seeded by init, no superstep ran it)."""
+    import jax.numpy as jnp
+
+    return jnp.zeros((slots,), jnp.int32)
+
+
+# bfs_tpu: hot traced
+def record_direction(dacc, level, code):
+    """Record the direction ``code`` (DIR_PUSH/DIR_PULL, traced or static)
+    of the superstep that settled ``level``.  Each level is settled by
+    exactly one superstep, so a plain ``set`` suffices."""
+    import jax.numpy as jnp
+
+    return dacc.at[_slot(level)].set(jnp.asarray(code, jnp.int32))
+
+
+def direction_schedule(dirs, *, mode: str, alpha: float, beta: float) -> dict:
+    """JSON-ready schedule from the host direction accumulator (post
+    :func:`read_telemetry`): per-level push/pull labels, switch count, and
+    the threshold config that produced them — shipped by bench as
+    ``details.direction_schedule`` next to the level curve."""
+    dv = np.asarray(dirs, dtype=np.int64)
+    nz = np.flatnonzero(dv)
+    levels = int(nz[-1]) + 1 if nz.size else 0
+    labels = [DIR_NAMES.get(int(c), "none") for c in dv[1:levels]]
+    switches = sum(
+        1 for a, b in zip(labels, labels[1:])
+        if a != b and "none" not in (a, b)
+    )
+    return {
+        "mode": mode,
+        "alpha": float(alpha),
+        "beta": float(beta),
+        "schedule": labels,  # index i = the superstep that settled level i+1
+        "switches": switches,
+        "push_supersteps": labels.count("push"),
+        "pull_supersteps": labels.count("pull"),
+        "truncated": bool(dv[TEL_SLOTS - 1] != 0)
+        if dv.shape[0] >= TEL_SLOTS
+        else False,
+    }
+
+
 def read_telemetry(tel):
     """THE one telemetry pull: one explicit ``jax.device_get`` of the
     whole accumulator pytree at loop exit.  Never call this inside a hot

@@ -954,6 +954,251 @@ def elem_superstep_tpu_factory(static, plane_offsets, pt: int):
     return superstep
 
 
+# ---------------------------------------------------------------------------
+# Per-phase kernels beyond the Beneš appliers (ISSUE 7 tentpole b): the two
+# next-largest ledger phases after net-apply — the masked row-min tournament
+# and the packed lexicographic-min state update — as fused Pallas kernels,
+# each bit-exact against its XLA twin (ops/relay.rowmin_ranks /
+# apply_relay_candidates_packed) and selected PER PHASE by measurement
+# (profiling.probe_phase_kernels feeds the engine's phase_selection), never
+# by default.  Off-TPU they run in interpret mode — measured for the ledger
+# verdict and exercised for parity in tests, but interpret overheads mean
+# XLA wins the selection there.
+
+
+def pallas_interpret() -> bool:
+    """Interpret-mode flag for the per-phase kernels: real Mosaic on TPU
+    backends, the Pallas interpreter everywhere else (parity tests + the
+    ledger's measured-arm probes on CPU)."""
+    try:
+        return jax.default_backend() != "tpu"
+    except Exception:  # pragma: no cover - backend init failure
+        return True
+
+
+def _pow2(n: int) -> bool:
+    return n >= 1 and (n & (n - 1)) == 0
+
+
+#: VMEM word budget for one row-min tile ([width, chunk] uint32 x2 operands).
+ROWMIN_TILE_WORDS = 1 << 19
+
+
+def _rowmin_chunk(width: int, cw: int) -> int:
+    """Lane-chunk for one class's [width, cw] tournament tile: the whole
+    row span when it fits the VMEM budget, else the largest divisor of
+    ``cw`` under it (preferring 128-lane multiples — the TPU-friendly
+    shape); 0 when nothing fits (class falls back to XLA)."""
+    p2 = 1 << max((width - 1).bit_length(), 0)
+    limit = ROWMIN_TILE_WORDS // max(p2, 1)
+    if limit < 1:
+        return 0
+    if cw <= limit:
+        return cw
+    aligned = [d for d in range(1, limit + 1) if cw % d == 0 and d % LANES == 0]
+    anyd = [d for d in range(1, limit + 1) if cw % d == 0]
+    return aligned[-1] if aligned else (anyd[-1] if anyd else 0)
+
+
+def rowmin_class_ok(cs) -> bool:
+    """Is one class eligible for the fused tournament kernel?  Rank-major
+    with at least two rows (the kernel zero-pads rows to the next power
+    of two, mirroring the XLA tournament) and a chunk under the VMEM
+    budget must exist."""
+    return (
+        not cs.vertex_major
+        and cs.width >= 2
+        and _rowmin_chunk(cs.width, cs.count // 32) > 0
+    )
+
+
+def _class_tournament_call(x2d, v2d, width: int, cw: int, interpret: bool):
+    """Masked min-row-index tournament over one class's [width, cw] word
+    view: returns uint32[1 + log2(width), cw] — row 0 the found words,
+    rows 1.. the rank bit-plane words low..high, bit-exact with
+    ops/relay._word_tournament on ``x & v``.  The grid streams lane
+    chunks; each instance holds one [width, chunk] tile x2 in VMEM and
+    runs the log2(width) merge rounds register-resident — the XLA path
+    round-trips every round through HBM."""
+    from jax.experimental import pallas as pl
+
+    nb = max(width - 1, 1).bit_length() if width > 1 else 0
+    chunk = _rowmin_chunk(width, cw)
+
+    # bfs_tpu: hot
+    def kernel(x_ref, v_ref, o_ref):
+        f = x_ref[...] & v_ref[...]
+        rows = f.shape[0]
+        p2 = 1 << max((rows - 1).bit_length(), 0)
+        if p2 != rows:
+            # Zero-pad rows to the power of two the log reduce halves —
+            # exactly the XLA tournament's padding (zero words never win).
+            f = jnp.concatenate(
+                [f, jnp.zeros((p2 - rows, f.shape[-1]), jnp.uint32)], axis=0
+            )
+            rows = p2
+        planes: list = []
+        while rows > 1:
+            fr = f.reshape(rows // 2, 2, f.shape[-1])
+            fa, fb = fr[:, 0, :], fr[:, 1, :]
+            new_planes = []
+            for pl_w in planes:
+                pr = pl_w.reshape(rows // 2, 2, pl_w.shape[-1])
+                new_planes.append(pr[:, 0, :] | (pr[:, 1, :] & ~fa))
+            new_planes.append(fb & ~fa)
+            planes = new_planes
+            f = fa | fb
+            rows //= 2
+        o_ref[...] = jnp.concatenate([f] + planes, axis=0)
+
+    in_spec = pl.BlockSpec((width, chunk), lambda j: (0, j))
+    return pl.pallas_call(
+        kernel,
+        grid=(cw // chunk,),
+        in_specs=[in_spec, in_spec],
+        out_specs=pl.BlockSpec((nb + 1, chunk), lambda j: (0, j)),
+        out_shape=jax.ShapeDtypeStruct((nb + 1, cw), jnp.uint32),
+        interpret=interpret,
+    )(x2d, v2d)
+
+
+# bfs_tpu: hot traced
+def rowmin_ranks_pallas(
+    l1words: jax.Array, valid_words: jax.Array, in_classes, vr: int,
+    interpret: bool | None = None,
+) -> jax.Array:
+    """Pallas flavor of :func:`bfs_tpu.ops.relay.rowmin_ranks`: min active
+    RANK per relabeled vertex (uint32, PACKED_SENTINEL where none), with
+    eligible rank-major classes' tournaments fused into one VMEM-resident
+    kernel per class and the masking (``l1 & valid``) applied in-kernel —
+    the two net-sized operands stream through VMEM exactly once.
+    Ineligible classes (vertex-major, non-pow2 width, unaligned chunk)
+    take the XLA tournament, so the output is bit-exact with the XLA path
+    for every layout."""
+    from . import relay as R
+    from .packed import PACKED_SENTINEL
+
+    if interpret is None:
+        interpret = pallas_interpret()
+    cands = []
+    covered = 0
+    for cs in sorted(in_classes, key=lambda c: c.va):
+        assert cs.va == covered, "in_classes must tile the vertex space"
+        if rowmin_class_ok(cs):
+            a, b = cs.sa // 32, cs.sb // 32
+            cw = cs.count // 32
+            x2d = jax.lax.slice_in_dim(l1words, a, b).reshape(cs.width, cw)
+            v2d = jax.lax.slice_in_dim(valid_words, a, b).reshape(
+                cs.width, cw
+            )
+            out = _class_tournament_call(
+                x2d, v2d, cs.width, cw, interpret
+            )
+            found = R.unpack_std(out[0], cs.count) != 0
+            rank = jnp.zeros(cs.count, jnp.int32)
+            for j in range(out.shape[0] - 1):
+                rank = rank | (
+                    R.unpack_std(out[j + 1], cs.count).astype(jnp.int32)
+                    << j
+                )
+        else:
+            found, rank = R._class_found_rank(
+                R._masked_class_words(l1words, valid_words, cs), cs
+            )
+        cands.append(
+            jnp.where(found, rank.astype(jnp.uint32), PACKED_SENTINEL)
+        )
+        covered = cs.vb
+    if covered < vr:
+        cands.append(jnp.full(vr - covered, PACKED_SENTINEL, jnp.uint32))
+    return jnp.concatenate(cands)
+
+
+#: State-update view: packed words as [vr/128, 128]; a tile of ``tr`` rows
+#: (tr % 32 == 0) emits its frontier words as one [tr/32, 128] block, so
+#: vr must pad to a multiple of 32*128 = 4096 elements.
+_UPDATE_ALIGN = 32 * LANES
+
+
+def _update_tile_rows(rows: int) -> int:
+    for tr in (2048, 1024, 512, 256, 128, 64, 32):
+        if rows % tr == 0:
+            return tr
+    return 0
+
+
+def _apply_packed_kernel_factory(tr: int, interpret: bool):
+    # bfs_tpu: hot
+    def kernel(x_ref, c_ref, o_ref, f_ref):
+        pk = x_ref[...]
+        pk2 = jnp.minimum(pk, c_ref[...])  # THE lexicographic min
+        newly = (pk2 != pk).astype(jnp.uint32)
+        lane = jax.lax.broadcasted_iota(jnp.uint32, pk.shape, 1)
+        lmod = lane & 31
+        # Standard packing in-register: t0 = bit << (lane%32), then a
+        # 5-step guarded prefix-OR within each 32-lane group leaves the
+        # group's packed word at its lane-0 slot; the stride-32 gather +
+        # minor reshape lays the tr*4 words out as the [tr/32, 128]
+        # fwords block (flat word g = row*4 + lane/32 = the standard
+        # ``e >> 5`` word order).
+        t = newly << lmod
+        for k in (1, 2, 4, 8, 16):
+            rolled = _kroll(t, -k, 1, interpret)
+            t = t | jnp.where(lmod + k < 32, rolled, jnp.uint32(0))
+        f_ref[...] = t[:, ::32].reshape(tr // 32, LANES)
+        o_ref[...] = pk2
+
+    return kernel
+
+
+# bfs_tpu: hot traced
+def apply_relay_candidates_packed_pallas(
+    state, rank_or_sent: jax.Array, interpret: bool | None = None,
+):
+    """Pallas flavor of
+    :func:`bfs_tpu.ops.relay.apply_relay_candidates_packed`: the packed
+    lexicographic-min state update with the frontier-word repack fused
+    into the same kernel — the packed carry and candidate words stream
+    through VMEM once and the newly-bits never materialize as a V-sized
+    bool array in HBM (the XLA path's ``pack_std`` reads them back).
+    Bit-exact with the XLA twin; the carry tail (level, changed) follows
+    the same contract."""
+    from jax.experimental import pallas as pl
+
+    from .packed import PACKED_SENTINEL, level_word
+    from .relay import PackedRelayState
+
+    if interpret is None:
+        interpret = pallas_interpret()
+    cand = rank_or_sent | level_word(state.level + 1)
+    vr = state.packed.shape[0]
+    vrp = ((vr + _UPDATE_ALIGN - 1) // _UPDATE_ALIGN) * _UPDATE_ALIGN
+    pk = state.packed
+    if vrp != vr:
+        pad = jnp.full(vrp - vr, PACKED_SENTINEL, jnp.uint32)
+        pk = jnp.concatenate([pk, pad])
+        cand = jnp.concatenate([cand, pad])
+    rows = vrp // LANES
+    tr = _update_tile_rows(rows)
+    x_spec = pl.BlockSpec((tr, LANES), lambda i: (i, 0))
+    pk2, fw = pl.pallas_call(
+        _apply_packed_kernel_factory(tr, interpret),
+        grid=(rows // tr,),
+        in_specs=[x_spec, x_spec],
+        out_specs=(x_spec, pl.BlockSpec((tr // 32, LANES), lambda i: (i, 0))),
+        out_shape=(
+            jax.ShapeDtypeStruct((rows, LANES), jnp.uint32),
+            jax.ShapeDtypeStruct((rows // 32, LANES), jnp.uint32),
+        ),
+        interpret=interpret,
+    )(pk.reshape(rows, LANES), cand.reshape(rows, LANES))
+    packed2 = pk2.reshape(-1)[:vr]
+    fwords = fw.reshape(-1)[: vr // 32]
+    return PackedRelayState(
+        packed2, fwords, state.level + 1, (fwords != jnp.uint32(0)).any()
+    )
+
+
 def apply_benes_fused(
     words: jax.Array,
     pass_arrays,  # device arrays in prepare_pass_masks order

@@ -32,10 +32,7 @@ import numpy as np
 
 from jax.sharding import Mesh, PartitionSpec as P
 
-try:  # JAX >= 0.6 exposes shard_map at top level
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
+from .compat import pcast_varying, shard_map as _shard_map
 
 from ..graph.csr import DeviceGraph, Graph, build_device_graph
 from ..graph.ell import ShardedPullGraph, build_sharded_pull_graph
@@ -152,7 +149,7 @@ def _packed_source_frontier(source, block: int, n: int):
         .at[source >> 5]
         .set(jnp.uint32(1) << (source & 31).astype(jnp.uint32))
     )
-    return jax.lax.pcast(fwords, (GRAPH_AXIS,), to="varying")
+    return pcast_varying(fwords, (GRAPH_AXIS,))
 
 
 def _apply_block_candidates(carry, cand, nw: int):
@@ -365,10 +362,15 @@ def _bfs_sharded_relay_fused(
     wrapper can detect a cap exit and re-run unpacked.
 
     With ``telemetry`` (static) the carry additionally holds the
-    per-level occupancy accumulator (obs/telemetry.py), fed the GLOBAL
-    all-gathered frontier words — identical on every shard, so the acc
-    stays replicated with no extra collective — and returned as a fifth
-    output for ONE pull at loop exit."""
+    per-level occupancy accumulator AND the direction-schedule
+    accumulator (obs/telemetry.py), fed the GLOBAL all-gathered frontier
+    words — identical on every shard, so the accs stay replicated with no
+    extra collective — and returned as fifth/sixth outputs for ONE pull
+    at loop exit.  Every sharded superstep records DIR_PULL: the sharded
+    layout ships no per-shard adjacency yet, so the dense relay pipeline
+    is the only correct body on the mesh (the push flavor needs the
+    dst-owned adjacency slice — ROADMAP item 1's exchange work);
+    ``bfs_sharded`` rejects ``direction='push'`` for the same reason."""
     from ..ops.packed import PACKED_SENTINEL, level_word, packed_cap
     from ..ops.relay import pack_std, unpack_relay_packed
 
@@ -393,8 +395,9 @@ def _bfs_sharded_relay_fused(
         if telemetry:
             from ..obs import telemetry as T
 
-            # acc rides BEFORE (level, changed) so cond's carry[-2:] holds.
+            # accs ride BEFORE (level, changed) so cond's carry[-2:] holds.
             acc0 = T.init_level_acc()
+            dir0 = T.init_dir_acc()
 
         if packed:
             lo = jax.lax.axis_index(GRAPH_AXIS).astype(jnp.int32) * block
@@ -424,17 +427,18 @@ def _bfs_sharded_relay_fused(
             if telemetry:
 
                 def body_t(carry):
-                    pk, fw, acc, level, ch = carry
+                    pk, fw, acc, dirs, level, ch = carry
                     pk2, fw2, level2, changed = body((pk, fw, level, ch))
                     acc = T.record_frontier_words(acc, fw2, level2)
-                    return pk2, fw2, acc, level2, changed
+                    dirs = T.record_direction(dirs, level2, T.DIR_PULL)
+                    return pk2, fw2, acc, dirs, level2, changed
 
-                pk, _, acc, level, changed = jax.lax.while_loop(
+                pk, _, acc, dirs, level, changed = jax.lax.while_loop(
                     cond, body_t,
-                    (pk0, fwords, acc0, jnp.int32(0), jnp.bool_(True)),
+                    (pk0, fwords, acc0, dir0, jnp.int32(0), jnp.bool_(True)),
                 )
                 dist, parent = unpack_relay_packed(pk, in_classes, block)
-                return dist, parent, level, changed, acc
+                return dist, parent, level, changed, acc, dirs
             pk, _, level, changed = jax.lax.while_loop(
                 cond, body, (pk0, fwords, jnp.int32(0), jnp.bool_(True))
             )
@@ -461,18 +465,20 @@ def _bfs_sharded_relay_fused(
         if telemetry:
 
             def body_t(carry):
-                dist, parent, fw, acc, level, ch = carry
+                dist, parent, fw, acc, dirs, level, ch = carry
                 dist, parent, fw2, level2, changed = body(
                     (dist, parent, fw, level, ch)
                 )
                 acc = T.record_frontier_words(acc, fw2, level2)
-                return dist, parent, fw2, acc, level2, changed
+                dirs = T.record_direction(dirs, level2, T.DIR_PULL)
+                return dist, parent, fw2, acc, dirs, level2, changed
 
-            dist, parent, _, acc, level, changed = jax.lax.while_loop(
+            dist, parent, _, acc, dirs, level, changed = jax.lax.while_loop(
                 cond, body_t,
-                (dist, parent, fwords, acc0, jnp.int32(0), jnp.bool_(True)),
+                (dist, parent, fwords, acc0, dir0, jnp.int32(0),
+                 jnp.bool_(True)),
             )
-            return dist, parent, level, changed, acc
+            return dist, parent, level, changed, acc, dirs
         dist, parent, _, level, changed = jax.lax.while_loop(
             cond, body, (dist, parent, fwords, jnp.int32(0), jnp.bool_(True))
         )
@@ -489,7 +495,7 @@ def _bfs_sharded_relay_fused(
             P(),
         ),
         out_specs=(
-            (P(GRAPH_AXIS), P(GRAPH_AXIS), P(), P(), P())
+            (P(GRAPH_AXIS), P(GRAPH_AXIS), P(), P(), P(), P())
             if telemetry
             else (P(GRAPH_AXIS), P(GRAPH_AXIS), P(), P())
         ),
@@ -541,7 +547,7 @@ def _bfs_sharded_relay_multi_fused(
             .at[jnp.arange(s_l), sources_blk >> 5]
             .set(jnp.uint32(1) << (sources_blk & 31).astype(jnp.uint32))
         )
-        fwords = jax.lax.pcast(fwords, (GRAPH_AXIS,), to="varying")
+        fwords = pcast_varying(fwords, (GRAPH_AXIS,))
 
         def cond(carry):
             level, changed = carry[-2], carry[-1]
@@ -772,6 +778,7 @@ def bfs_sharded(
     vertex_block_multiple: int = 1024,
     applier: str = "auto",
     telemetry: bool = False,
+    direction: str | None = None,
 ):
     """Single-source BFS sharded over the mesh's ``graph`` axis.
 
@@ -788,12 +795,31 @@ def bfs_sharded(
         differential testing.
 
     ``telemetry`` (relay engine only) carries the per-level occupancy
-    accumulator through the sharded loop (obs/telemetry.py) and returns
-    ``(BfsResult, level_curve)`` — one extra replicated pull at exit.
+    and direction-schedule accumulators through the sharded loop
+    (obs/telemetry.py) and returns ``(BfsResult, level_curve)`` — one
+    extra replicated pull at exit, the curve carrying
+    ``direction_schedule``.
+
+    ``direction`` resolves like the single-chip engine's knob
+    (BFS_TPU_DIRECTION; models/direction.py).  The sharded relay layout
+    ships no per-shard adjacency yet, so the dense relay (pull) body is
+    the only correct body on the mesh: ``'pull'``/``'auto'`` both run it
+    (auto records an all-pull schedule); ``'push'`` raises — the sparse
+    gather flavor needs the dst-owned adjacency slice that ROADMAP item
+    1's compressed-exchange work adds.
     """
+    from ..models.direction import resolve_direction
+
     mesh = mesh if mesh is not None else make_mesh()
     if telemetry and engine != "relay":
         raise ValueError("telemetry is carried by the sharded relay engine only")
+    dir_cfg = resolve_direction(direction)
+    if engine == "relay" and dir_cfg.mode == "push":
+        raise ValueError(
+            "direction='push' is unavailable on the sharded relay engine: "
+            "the sharded layout ships no per-shard adjacency (use 'pull' "
+            "or 'auto')"
+        )
     if engine == "relay":
         from ..ops.packed import (
             packed_rank_fits,
@@ -857,12 +883,20 @@ def bfs_sharded(
         result = BfsResult(dist=dist, parent=parent, num_levels=int(level))
         if not telemetry:
             return result
-        from ..obs.telemetry import level_curve, read_telemetry
+        from ..obs.telemetry import (
+            direction_schedule,
+            level_curve,
+            read_telemetry,
+        )
         from ..ops.packed import PACKED_MAX_LEVELS
 
-        fv = read_telemetry(out[4])
+        fv, dirs = read_telemetry((out[4], out[5]))
         cap = min(PACKED_MAX_LEVELS, max_levels) if packed else max_levels
-        return result, level_curve(fv, cap=cap)
+        curve = level_curve(fv, cap=cap)
+        curve["direction_schedule"] = direction_schedule(
+            dirs, mode=dir_cfg.mode, alpha=dir_cfg.alpha, beta=dir_cfg.beta
+        )
+        return result, curve
     if engine == "pull":
         spg = _prepare_pull(graph, mesh, vertex_block_multiple)
         check_sources(spg.num_vertices, source)
@@ -965,7 +999,7 @@ def _bfs_sharded_pull_multi_fused(ell0, folds, sources, *, mesh, block, max_leve
         )
         # See the single-source variant: the all_gather in the body makes
         # the frontier carry graph-axis-varying.
-        fwords = jax.lax.pcast(fwords, (GRAPH_AXIS,), to="varying")
+        fwords = pcast_varying(fwords, (GRAPH_AXIS,))
         gids = jnp.arange(vtot, dtype=jnp.int32)
         inf1 = jnp.full((s_l, 1), INT32_MAX, dtype=jnp.int32)
 

@@ -39,7 +39,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["superstep_phase_ledger", "state_update_bytes"]
+__all__ = [
+    "superstep_phase_ledger",
+    "state_update_bytes",
+    "probe_phase_kernels",
+]
 
 
 def state_update_bytes(vr: int, packed: bool) -> dict:
@@ -139,9 +143,15 @@ def superstep_phase_ledger(eng, *, loops: int = 4, repeats: int = 2) -> dict:
 
     # ---- broadcast --------------------------------------------------------
     def k_bcast(k, y):
+        # Feed only the overlapping prefix back: tiny layouts can have
+        # net_size < vperm_size, where a full-width slice would overrun.
+        w = min(y.shape[0], net_size // 32)
+
         def body(i, c):
             l2 = R.broadcast_l2(y ^ c, out_classes, net_size, out_space)
-            return c ^ (jax.lax.slice_in_dim(l2, 0, y.shape[0]) & jnp.uint32(1))
+            bit = jax.lax.slice_in_dim(l2, 0, w) & jnp.uint32(1)
+            pad = jnp.zeros(y.shape[0] - w, jnp.uint32)
+            return c ^ jnp.concatenate([bit, pad])
 
         return jax.lax.fori_loop(0, k, body, jnp.zeros_like(y))
 
@@ -171,26 +181,77 @@ def superstep_phase_ledger(eng, *, loops: int = 4, repeats: int = 2) -> dict:
     }
 
     # ---- masked row-min ----------------------------------------------------
+    # Packed layouts measure BOTH implementations (ISSUE 7 tentpole b):
+    # the XLA word tournament and the fused Pallas kernel — compiled on
+    # TPU backends, interpret-mode elsewhere (a real if slow measurement,
+    # so the verdict is always a comparison).  ``seconds`` reports the
+    # arm the ENGINE actually selected (phase_selection), keeping the
+    # before/after ledger comparable with what timed repeats ran.
     packed = bool(getattr(eng, "packed", False))
+    sel = getattr(eng, "phase_selection", None) or {
+        "rowmin": "xla", "state_update": "xla", "basis": {},
+    }
+    from .ops.relay_pallas import pallas_interpret
 
-    def k_rowmin(k, l1, vw):
-        def body(i, c):
-            lx = l1 ^ jax.lax.slice_in_dim(c, 0, l1.shape[0])
-            if packed:
-                cand = R.rowmin_ranks(lx, vw, in_classes, vr)
-                bit = cand & jnp.uint32(1)
-            else:
-                cand = R.rowmin_candidates(lx, vw, in_classes, vr)
-                bit = cand.astype(jnp.uint32) & jnp.uint32(1)
-            w = max(l1.shape[0], vr)
-            pad = jnp.zeros(w - vr, jnp.uint32)
-            return c ^ jnp.concatenate([bit, pad])
+    interp = pallas_interpret()
 
-        size = max(net_size // 32, vr)
-        return jax.lax.fori_loop(0, k, body, jnp.zeros(size, jnp.uint32))
+    def k_rowmin_arm(use_pallas_arm):
+        def k_rowmin(k, l1, vw):
+            def body(i, c):
+                lx = l1 ^ jax.lax.slice_in_dim(c, 0, l1.shape[0])
+                if packed:
+                    if use_pallas_arm:
+                        from .ops import relay_pallas as RP
 
+                        cand = RP.rowmin_ranks_pallas(
+                            lx, vw, in_classes, vr, interpret=interp
+                        )
+                    else:
+                        cand = R.rowmin_ranks(lx, vw, in_classes, vr)
+                    bit = cand & jnp.uint32(1)
+                else:
+                    cand = R.rowmin_candidates(lx, vw, in_classes, vr)
+                    bit = cand.astype(jnp.uint32) & jnp.uint32(1)
+                w = max(l1.shape[0], vr)
+                pad = jnp.zeros(w - vr, jnp.uint32)
+                return c ^ jnp.concatenate([bit, pad])
+
+            size = max(net_size // 32, vr)
+            return jax.lax.fori_loop(0, k, body, jnp.zeros(size, jnp.uint32))
+
+        return k_rowmin
+
+    def _effective(arms: dict, wanted: str, basis: str):
+        """(selected, basis, seconds) — if the engine's wanted arm has no
+        measurement here (the pallas arm errored), the ledger must SAY
+        the fallback happened, never attribute the other arm's seconds
+        to the wanted one."""
+        if wanted in arms:
+            return wanted, basis, arms[wanted]
+        return (
+            "xla",
+            f"fallback: {wanted} arm unmeasured "
+            f"({arms.get('pallas_error', 'missing')})",
+            arms["xla"],
+        )
+
+    rowmin_arms = {"xla": mb(k_rowmin_arm(False), (x_net, valid))}
+    if packed:
+        try:
+            rowmin_arms["pallas"] = mb(k_rowmin_arm(True), (x_net, valid))
+        except Exception as exc:
+            rowmin_arms["pallas_error"] = repr(exc)
+    rm_sel, rm_basis, rm_seconds = _effective(
+        rowmin_arms,
+        sel["rowmin"] if packed else "xla",
+        sel.get("basis", {}).get("rowmin", "unpacked carry (no fused arm)"),
+    )
     phases["rowmin"] = {
-        "seconds": mb(k_rowmin, (x_net, valid)),
+        "seconds": rm_seconds,
+        "selected": rm_sel,
+        "selection_basis": rm_basis,
+        "arms": rowmin_arms,
+        "interpret_arm": interp,
         "flavor": "ranks (packed)" if packed else "slots (unpacked)",
         "word_bytes_read": 2 * (net_size // 8),
         "candidate_bytes_written": 4 * vr,
@@ -233,10 +294,44 @@ def superstep_phase_ledger(eng, *, loops: int = 4, repeats: int = 2) -> dict:
     cand_s = jnp.full(vr, np.int32(2**31 - 1), jnp.int32).at[:64].set(
         jnp.arange(64, dtype=jnp.int32)
     )
+    def k_apply_packed_pallas(k, pk, fw, cand):
+        from .ops import relay_pallas as RP
+
+        st0 = R.PackedRelayState(pk, fw, jnp.int32(0), jnp.bool_(True))
+
+        def body(i, st):
+            s2 = RP.apply_relay_candidates_packed_pallas(
+                st, cand ^ (st.packed & jnp.uint32(1)), interpret=interp
+            )
+            return R.PackedRelayState(
+                s2.packed, s2.fwords, jnp.int32(0), s2.changed
+            )
+
+        return jax.lax.fori_loop(0, k, body, st0).packed
+
     t_packed = mb(k_apply_packed, (pk0, fw0, cand_r))
     t_unpacked = mb(k_apply_unpacked, (d0, p0, fw0, cand_s))
+    update_arms = {"xla": t_packed}
+    if packed:
+        try:
+            update_arms["pallas"] = mb(
+                k_apply_packed_pallas, (pk0, fw0, cand_r)
+            )
+        except Exception as exc:
+            update_arms["pallas_error"] = repr(exc)
+    up_sel, up_basis, up_seconds = _effective(
+        update_arms,
+        sel["state_update"] if packed else "xla",
+        sel.get("basis", {}).get(
+            "state_update", "unpacked carry (no fused arm)"
+        ),
+    )
     phases["state_update"] = {
-        "seconds": t_packed if packed else t_unpacked,
+        "seconds": up_seconds if packed else t_unpacked,
+        "selected": up_sel,
+        "selection_basis": up_basis,
+        "arms": update_arms,
+        "interpret_arm": interp,
         "packed": {
             "seconds": t_packed, "bytes": state_update_bytes(vr, True),
         },
@@ -252,7 +347,10 @@ def superstep_phase_ledger(eng, *, loops: int = 4, repeats: int = 2) -> dict:
     # ---- full dense superstep (cross-check) --------------------------------
     from .models.bfs import _superstep_fn
 
-    superstep = _superstep_fn(static, eng._use_pallas(), packed)
+    superstep = _superstep_fn(
+        static, eng._use_pallas(), packed,
+        eng._phase_sel() if hasattr(eng, "_phase_sel") else None,
+    )
     flat_masks = []
     for m in (vperm_m, net_m):
         flat_masks.extend(m if isinstance(m, tuple) else (m,))
@@ -364,6 +462,105 @@ def superstep_phase_ledger(eng, *, loops: int = 4, repeats: int = 2) -> dict:
             "reports BOTH layouts — dist/parent bytes halved packed"
         ),
     }
+
+
+def probe_phase_kernels(eng, *, loops: int = 4, repeats: int = 2) -> dict:
+    """Measure the pallas-vs-XLA arms of the packed row-min and packed
+    state-update on a RelayEngine's real shapes and pick per phase — the
+    engine-init selector (RelayEngine._resolve_phase_selection) on TPU
+    backends, where the fused kernels compile for real.  K-loop / 2K-loop
+    difference timing, same methodology as the applier probe and the
+    ledger; ``selection_basis`` is always ``"measured"`` — a failed
+    pallas arm records its error and selects xla, still a comparison
+    with the failure on record, never a silent default.
+
+    Runs anywhere (interpret-mode kernels off-TPU — the ledger uses the
+    same arms to ship the verdict in every capture), but only the TPU
+    engine init consults it for production selection: interpret arms
+    measure real work at interpreter speed and must not steer the timed
+    repeats."""
+    from .ops import relay as R
+    from .ops import relay_pallas as RP
+    from .ops.packed import PACKED_SENTINEL
+
+    rg = eng.relay_graph
+    (vr, _vs, _vt, _oc, _os, _nt, net_size, in_classes) = eng._static
+    valid = eng._tensors[2]
+    opts = eng._COMPILER_OPTIONS
+    interp = RP.pallas_interpret()
+    x_net = jnp.zeros(net_size // 32, jnp.uint32)
+    fw0 = jnp.zeros(vr // 32, jnp.uint32)
+    pk0 = jnp.full(vr, PACKED_SENTINEL, jnp.uint32)
+    cand_r = jnp.full(vr, PACKED_SENTINEL, jnp.uint32).at[:64].set(
+        jnp.arange(64, dtype=jnp.uint32)
+    )
+
+    def mb(fn, args):
+        return _measure(fn, args, loops, repeats, opts)
+
+    def k_rowmin(use_pallas_arm):
+        def fn(k, l1, vw):
+            def body(i, c):
+                lx = l1 ^ jax.lax.slice_in_dim(c, 0, l1.shape[0])
+                if use_pallas_arm:
+                    cand = RP.rowmin_ranks_pallas(
+                        lx, vw, in_classes, vr, interpret=interp
+                    )
+                else:
+                    cand = R.rowmin_ranks(lx, vw, in_classes, vr)
+                bit = cand & jnp.uint32(1)
+                w = max(l1.shape[0], vr)
+                return c ^ jnp.concatenate(
+                    [bit, jnp.zeros(w - vr, jnp.uint32)]
+                )
+
+            size = max(net_size // 32, vr)
+            return jax.lax.fori_loop(
+                0, k, body, jnp.zeros(size, jnp.uint32)
+            )
+
+        return fn
+
+    def k_update(use_pallas_arm):
+        def fn(k, pk, fw, cand):
+            st0 = R.PackedRelayState(pk, fw, jnp.int32(0), jnp.bool_(True))
+
+            def body(i, st):
+                c = cand ^ (st.packed & jnp.uint32(1))
+                if use_pallas_arm:
+                    s2 = RP.apply_relay_candidates_packed_pallas(
+                        st, c, interpret=interp
+                    )
+                else:
+                    s2 = R.apply_relay_candidates_packed(st, c)
+                return R.PackedRelayState(
+                    s2.packed, s2.fwords, jnp.int32(0), s2.changed
+                )
+
+            return jax.lax.fori_loop(0, k, body, st0).packed
+
+        return fn
+
+    out = {"interpret": interp, "device": str(jax.devices()[0])}
+    for phase, maker, args in (
+        ("rowmin", k_rowmin, (x_net, valid)),
+        ("state_update", k_update, (pk0, fw0, cand_r)),
+    ):
+        t_xla = mb(maker(False), args)
+        rec = {"xla_seconds": t_xla}
+        try:
+            t_pal = mb(maker(True), args)
+            rec["pallas_seconds"] = t_pal
+            rec["selected"] = "pallas" if t_pal <= t_xla else "xla"
+            rec["selection_basis"] = (
+                "measured (interpret arm)" if interp else "measured"
+            )
+        except Exception as exc:
+            rec["pallas_error"] = repr(exc)
+            rec["selected"] = "xla"
+            rec["selection_basis"] = "measured (pallas arm failed)"
+        out[phase] = rec
+    return out
 
 
 def main() -> None:

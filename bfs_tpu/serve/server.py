@@ -160,6 +160,13 @@ class BfsServer:
             retry_policy if retry_policy is not None else DEFAULT_RETRY_POLICY
         )
         self.exe_cache = ExecutableCache(exe_cache_size, metrics=self.metrics)
+        # Direction policy resolved ONCE: a malformed BFS_TPU_DIRECTION /
+        # alpha / beta knob fails server construction loudly instead of
+        # raising inside every tick (which would silently degrade every
+        # query to the host oracle).
+        from ..models.direction import resolve_direction
+
+        self._direction_key = resolve_direction().key()  # immutable after init
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)  # holding _cond == holding _lock
         self._result_cache: OrderedDict[tuple, tuple] = OrderedDict()  # guarded-by: _lock
@@ -412,8 +419,21 @@ class BfsServer:
 
                 def _device_tick():
                     nonlocal compile_hit
+                    # The direction policy (resolved ONCE at server init —
+                    # a malformed knob fails construction, never a tick)
+                    # is part of the executable key (ISSUE 7): today the
+                    # relay batch runner reads the same env at build, so
+                    # the key keeps a stale-program reuse impossible when
+                    # the knob changes across server restarts; when the
+                    # batch programs grow in-program switching the key is
+                    # already right.  Auto-switching itself is an
+                    # IN-program lax.cond — steady-state ticks never
+                    # retrace however often the schedule flips direction.
                     runner, compile_hit = self.exe_cache.get(
-                        (first.graph, first.engine, padded),
+                        (
+                            first.graph, first.engine, padded,
+                            self._direction_key,
+                        ),
                         lambda: build_batch_runner(
                             self.registry, first.graph, first.engine, padded
                         ),

@@ -547,6 +547,17 @@ def test_repo_has_expected_hot_coverage():
             "apply_relay_candidates_packed",
             "relay_superstep_words_packed",
         ),
+        # the per-phase Pallas kernels (ISSUE 7) run inside the fused
+        # hot loop when selected — they must keep static hot coverage
+        "bfs_tpu/ops/relay_pallas.py": (
+            "rowmin_ranks_pallas",
+            "apply_relay_candidates_packed_pallas",
+        ),
+        # the direction predicate and its mass inputs compile into every
+        # auto-mode while_loop body (ISSUE 7 tentpole a)
+        "bfs_tpu/models/direction.py": ("take_pull", "frontier_masses"),
+        "bfs_tpu/models/bfs.py": ("_frontier_masses_words",),
+        "bfs_tpu/obs/telemetry.py": ("record_direction",),
         "bfs_tpu/serve/executor.py": ("_state_to_result",),
     }
     for rel, fn_names in expectations.items():

@@ -47,8 +47,8 @@ DETERMINISTIC_DETAILS = (
 )
 
 
-def run_bench(cache_dir, journal_dir, fault=None, timeout=240):
-    env = {**os.environ, **BENCH_ENV}
+def run_bench(cache_dir, journal_dir, fault=None, timeout=240, extra_env=None):
+    env = {**os.environ, **BENCH_ENV, **(extra_env or {})}
     env["BFS_TPU_CACHE_DIR"] = str(cache_dir)
     env["BFS_TPU_JOURNAL_DIR"] = str(journal_dir)
     env.pop("BFS_TPU_FAULT", None)
@@ -159,6 +159,58 @@ def test_kill_sweep_every_phase_boundary(cache_dir, golden, tmp_path, phase):
     # Idempotent completion: one more invocation replays, bit-identical.
     proc3, lines3 = run_bench(cache_dir, tmp_path)
     assert lines3[-1] == final
+
+
+def test_direction_forced_resume_replays_schedule(
+    cache_dir, tmp_path, tmp_path_factory
+):
+    """ISSUE 7 satellite: a direction-forced relay run killed AFTER the
+    level-curve boundary resumes with the journaled schedule restored —
+    and the schedule matches an independent golden run bit-identically
+    (it is a pure on-device function of graph + thresholds, and the
+    direction knobs are part of the journal config key)."""
+    from bfs_tpu.graph import benes
+
+    if not benes.native_available():
+        pytest.skip("native benes router unavailable")
+    env = {
+        "BENCH_ENGINE": "relay",
+        "BENCH_SPARSE": "1",
+        "BFS_TPU_DIRECTION": "auto",
+        "BENCH_ROOTS": "2",
+        "BENCH_CHECK_ROOTS": "2",
+    }
+    gp, glines = run_bench(
+        cache_dir, tmp_path_factory.mktemp("dir_golden_j"), extra_env=env
+    )
+    assert gp.returncode == 0, gp.stderr[-2000:]
+    gsched = glines[-1]["details"].get("direction_schedule")
+    assert gsched is not None, "relay headline shipped no direction_schedule"
+    assert set(gsched["schedule"]) <= {"push", "pull"}
+    assert gsched["mode"] == "auto"
+
+    # Kill at the verification boundary — the curve + schedule are
+    # already journaled; the resume must RESTORE them, not re-run.
+    p1, _ = run_bench(cache_dir, tmp_path, fault="kill:verify", extra_env=env)
+    assert p1.returncode == -signal.SIGKILL
+    p2, lines2 = run_bench(cache_dir, tmp_path, extra_env=env)
+    assert p2.returncode == 0, p2.stderr[-2000:]
+    assert "journal: level curve restored" in p2.stderr
+    final = lines2[-1]
+    assert final["details"]["direction_schedule"]["schedule"] == (
+        gsched["schedule"]
+    )
+
+    # A different threshold knob maps to a DIFFERENT journal (config
+    # key): the run starts fresh instead of resuming the auto journal.
+    p3, lines3 = run_bench(
+        cache_dir, tmp_path_factory.mktemp("dir_pull_j"),
+        extra_env={**env, "BFS_TPU_DIRECTION": "pull"},
+    )
+    assert p3.returncode == 0, p3.stderr[-2000:]
+    sched3 = lines3[-1]["details"]["direction_schedule"]
+    assert sched3["mode"] == "pull"
+    assert set(sched3["schedule"]) == {"pull"}
 
 
 @pytest.mark.slow

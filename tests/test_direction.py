@@ -1,0 +1,362 @@
+"""Direction-optimizing supersteps (ISSUE 7): oracle parity under every
+schedule, real auto switching, packed-cap fallback under switching, the
+per-phase Pallas kernels' bit-exactness, and the knob surface.
+
+Fixture shapes: a STAR (shallow — 2 levels, hub explosion), a PATH deeper
+than the packed 62-level cap (the fallback-under-switching case), and a
+G(n,m) whose ramp-up/dense-middle/sparse-tail profile makes the Beamer
+predicate actually switch push -> pull -> push."""
+
+import os
+
+import numpy as np
+import pytest
+
+from bfs_tpu.graph import benes
+from bfs_tpu.graph.csr import Graph
+from bfs_tpu.graph.generators import gnm_graph, path_graph, rmat_graph
+from bfs_tpu.models.direction import (
+    DirectionConfig,
+    bfs_direction,
+    bfs_multi_direction,
+    resolve_direction,
+)
+from bfs_tpu.oracle.bfs import canonical_bfs, check, queue_bfs
+
+needs_native = pytest.mark.skipif(
+    not benes.native_available(), reason="native benes router unavailable"
+)
+
+
+def star_graph(n: int = 256) -> Graph:
+    """Hub 0 -> every leaf, plus the reverse edges: 2 levels from any
+    leaf, 1 from the hub — the shallow extreme."""
+    hub = np.zeros(n - 1, np.int32)
+    leaves = np.arange(1, n, dtype=np.int32)
+    src = np.concatenate([hub, leaves])
+    dst = np.concatenate([leaves, hub])
+    return Graph(n, src, dst)
+
+
+def switchy_fixture():
+    """(graph, source) whose frontier curve ramps through both Beamer
+    thresholds: sparse start (push), dense middle (pull), sparse tail."""
+    g = gnm_graph(1 << 10, 3 << 10, seed=5)
+    deg = np.bincount(np.asarray(g.src), minlength=g.num_vertices)
+    return g, int(np.argmax(deg))
+
+
+def assert_oracle(g, res, s):
+    d, _ = queue_bfs(g, s)
+    _, p = canonical_bfs(g, s)
+    np.testing.assert_array_equal(res.dist, d)
+    np.testing.assert_array_equal(res.parent, p)
+    assert check(g, res.dist, res.parent, s) == []
+
+
+# ---------------------------------------------------------------------------
+# Config / knob surface.
+# ---------------------------------------------------------------------------
+
+def test_resolve_direction_env_knobs(monkeypatch):
+    monkeypatch.setenv("BFS_TPU_DIRECTION", "pull")
+    monkeypatch.setenv("BFS_TPU_DIRECTION_ALPHA", "7.5")
+    monkeypatch.setenv("BFS_TPU_DIRECTION_BETA", "48")
+    cfg = resolve_direction()
+    assert (cfg.mode, cfg.alpha, cfg.beta) == ("pull", 7.5, 48.0)
+    # explicit argument wins over the env
+    assert resolve_direction("push").mode == "push"
+
+
+def test_resolve_direction_rejects_bad_knobs(monkeypatch):
+    monkeypatch.setenv("BFS_TPU_DIRECTION", "sideways")
+    with pytest.raises(ValueError):
+        resolve_direction()
+    monkeypatch.setenv("BFS_TPU_DIRECTION", "auto")
+    monkeypatch.setenv("BFS_TPU_DIRECTION_ALPHA", "-1")
+    with pytest.raises(ValueError):
+        resolve_direction()
+
+
+# ---------------------------------------------------------------------------
+# Combined push/pull engine pair (models/direction.py fused program).
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mode", ["auto", "push", "pull"])
+def test_direction_oracle_parity_all_modes(mode):
+    g, s = switchy_fixture()
+    res, sched = bfs_direction(g, s, config=DirectionConfig(mode=mode))
+    assert_oracle(g, res, s)
+    assert sched["mode"] == mode
+    if mode == "push":
+        assert set(sched["schedule"]) == {"push"}
+    if mode == "pull":
+        assert set(sched["schedule"]) == {"pull"}
+
+
+def test_direction_auto_actually_switches():
+    """The acceptance shape: the auto schedule must contain BOTH
+    directions and at least one switch, with parents still canonical."""
+    g, s = switchy_fixture()
+    res, sched = bfs_direction(g, s)
+    assert_oracle(g, res, s)
+    assert "push" in sched["schedule"] and "pull" in sched["schedule"]
+    assert sched["switches"] >= 1
+    # classic Beamer hysteresis: the dense middle is pull, both tails push
+    assert sched["schedule"][0] == "push"
+
+
+def test_direction_star_shallow():
+    g = star_graph()
+    res, sched = bfs_direction(g, 5)
+    assert_oracle(g, res, 5)
+    # leaf source: hub at L1 (tiny frontier, push), every other leaf at
+    # L2 (the hub's mass crossed the threshold -> pull), final empty step
+    assert len(sched["schedule"]) == res.num_levels
+    assert sched["schedule"][0] == "push"
+
+
+def test_direction_deep_path_packed_fallback():
+    """Deeper than the packed 62-level cap: the fused-word carry detects
+    the cap exit and re-runs unpacked UNDER the same switching — the
+    schedule covers all levels and parity holds."""
+    g = path_graph(80)
+    res, sched = bfs_direction(g, 0)
+    assert_oracle(g, res, 0)
+    assert res.num_levels == 80
+    assert len(sched["schedule"]) == 80
+
+
+def test_direction_multi_source_parity():
+    from bfs_tpu.models.multisource import bfs_multi
+
+    g, s = switchy_fixture()
+    sources = [s, 3, 11]
+    res, sched = bfs_multi_direction(g, sources)
+    ref = bfs_multi(g, sources)
+    np.testing.assert_array_equal(res.dist, ref.dist)
+    np.testing.assert_array_equal(res.parent, ref.parent)
+    assert len(sched["schedule"]) >= 1
+
+
+def test_direction_thresholds_move_the_switch():
+    """alpha/beta are live knobs (pull when ``m_f * alpha > m_u``): a
+    vanishing alpha never satisfies the pull condition — all push; an
+    enormous alpha satisfies it immediately — pull from level 1."""
+    g, s = switchy_fixture()
+    _, push_heavy = bfs_direction(
+        g, s, config=DirectionConfig(mode="auto", alpha=1e-9, beta=1e9)
+    )
+    # Every non-terminal superstep pushes; the terminal one may pull —
+    # with the whole component explored m_u == 0, so ANY positive
+    # frontier mass satisfies the pull condition (classic Beamer does
+    # the same at the boundary).
+    assert set(push_heavy["schedule"][:-1]) == {"push"}
+    _, pull_heavy = bfs_direction(
+        g, s, config=DirectionConfig(mode="auto", alpha=1e9, beta=1e9)
+    )
+    assert "pull" in pull_heavy["schedule"]
+    assert pull_heavy["schedule"][0] == "pull"
+
+
+# ---------------------------------------------------------------------------
+# Relay engine switching (models/bfs.py fused program).
+# ---------------------------------------------------------------------------
+
+@needs_native
+@pytest.mark.parametrize("mode", ["auto", "push", "pull"])
+def test_relay_direction_oracle_parity(mode):
+    from bfs_tpu.models.bfs import RelayEngine
+
+    g, s = switchy_fixture()
+    eng = RelayEngine(g, sparse_hybrid=True, direction=mode)
+    res = eng.run(s)
+    assert_oracle(g, res, s)
+    curve = eng.run_level_curve(s)
+    sched = curve["direction_schedule"]
+    assert sched["mode"] == mode
+    if mode == "auto":
+        assert "push" in sched["schedule"] and "pull" in sched["schedule"]
+        assert sched["switches"] >= 1
+    elif mode == "pull":
+        assert set(sched["schedule"]) == {"pull"}
+    else:
+        assert set(sched["schedule"]) == {"push"}
+
+
+@needs_native
+def test_relay_direction_auto_without_hybrid_is_pull():
+    """No sparse adjacency shipped -> auto degenerates to dense-only and
+    the schedule says so (never a silently-wrong sparse body)."""
+    from bfs_tpu.models.bfs import RelayEngine
+
+    g, s = switchy_fixture()
+    eng = RelayEngine(g, sparse_hybrid=False, direction="auto")
+    res = eng.run(s)
+    assert_oracle(g, res, s)
+    sched = eng.run_level_curve(s)["direction_schedule"]
+    assert set(sched["schedule"]) == {"pull"}
+
+
+@needs_native
+def test_relay_direction_deep_path_packed_fallback():
+    from bfs_tpu.models.bfs import RelayEngine
+
+    g = path_graph(80)
+    eng = RelayEngine(g, sparse_hybrid=True, direction="auto")
+    res = eng.run(0)
+    assert_oracle(g, res, 0)
+    assert res.num_levels == 80
+    curve = eng.run_level_curve(0)
+    assert len(curve["direction_schedule"]["schedule"]) == 80
+
+
+@needs_native
+def test_relay_schedule_deterministic_across_engines():
+    """The journal-replay invariant's core: the schedule is a pure
+    function of graph + thresholds — two engines on the same graph
+    produce identical schedules."""
+    from bfs_tpu.models.bfs import RelayEngine
+
+    g, s = switchy_fixture()
+    s1 = RelayEngine(g, sparse_hybrid=True, direction="auto")
+    s2 = RelayEngine(g, sparse_hybrid=True, direction="auto")
+    a = s1.run_level_curve(s)["direction_schedule"]["schedule"]
+    b = s2.run_level_curve(s)["direction_schedule"]["schedule"]
+    assert a == b
+
+
+# ---------------------------------------------------------------------------
+# Per-phase Pallas kernels (ops/relay_pallas.py, interpret mode on CPU).
+# ---------------------------------------------------------------------------
+
+@needs_native
+def test_pallas_rowmin_and_update_bit_exact():
+    """The fused tournament and packed-min kernels vs their XLA twins,
+    superstep by superstep on a real relay layout."""
+    import jax.numpy as jnp
+
+    from bfs_tpu.graph.relay import valid_slot_words
+    from bfs_tpu.models.bfs import RelayEngine
+    from bfs_tpu.ops import relay as R
+    from bfs_tpu.ops import relay_pallas as RP
+
+    g = rmat_graph(9, 8, seed=11)
+    eng = RelayEngine(g, sparse_hybrid=False)
+    rg = eng.relay_graph
+    vr = rg.vr
+    st = eng.init_packed_state(3)
+    valid = jnp.asarray(valid_slot_words(rg.src_l1, rg.net_size))
+    vm, nm = jnp.asarray(rg.vperm_masks), jnp.asarray(rg.net_masks)
+    assert any(RP.rowmin_class_ok(cs) for cs in rg.in_classes), (
+        "no class on the fused tournament — the kernel is not exercised"
+    )
+    for _ in range(3):
+        fw = jnp.concatenate(
+            [st.fwords, jnp.zeros((rg.vperm_size - vr) // 32, jnp.uint32)]
+        )
+        y = R.apply_benes_std(fw, vm, rg.vperm_table, rg.vperm_size)
+        l2 = R.broadcast_l2(y, rg.out_classes, rg.net_size, rg.out_space)
+        l1 = R.apply_benes_std(l2, nm, rg.net_table, rg.net_size)
+        ref = R.rowmin_ranks(l1, valid, rg.in_classes, vr)
+        got = RP.rowmin_ranks_pallas(
+            l1, valid, rg.in_classes, vr, interpret=True
+        )
+        np.testing.assert_array_equal(np.asarray(ref), np.asarray(got))
+        s_ref = R.apply_relay_candidates_packed(st, ref)
+        s_got = RP.apply_relay_candidates_packed_pallas(
+            st, got, interpret=True
+        )
+        np.testing.assert_array_equal(
+            np.asarray(s_ref.packed), np.asarray(s_got.packed)
+        )
+        np.testing.assert_array_equal(
+            np.asarray(s_ref.fwords), np.asarray(s_got.fwords)
+        )
+        assert bool(s_ref.changed) == bool(s_got.changed)
+        st = s_ref
+
+
+@needs_native
+def test_forced_pallas_phases_end_to_end(monkeypatch):
+    """BFS_TPU_ROWMIN/BFS_TPU_STATE_UPDATE=pallas force the fused kernels
+    into the production superstep (interpret mode here) — full searches
+    stay oracle-exact, and the selection records the forced basis."""
+    from bfs_tpu.models.bfs import RelayEngine
+
+    monkeypatch.setenv("BFS_TPU_ROWMIN", "pallas")
+    monkeypatch.setenv("BFS_TPU_STATE_UPDATE", "pallas")
+    g, s = switchy_fixture()
+    eng = RelayEngine(g, sparse_hybrid=True, direction="auto")
+    assert eng.phase_selection["rowmin"] == "pallas"
+    assert eng.phase_selection["basis"]["rowmin"] == "forced (env)"
+    res = eng.run(s)
+    assert_oracle(g, res, s)
+
+
+@needs_native
+def test_phase_selection_defaults_to_measured_xla_off_tpu():
+    from bfs_tpu.models.bfs import RelayEngine
+
+    g, _ = switchy_fixture()
+    eng = RelayEngine(g, sparse_hybrid=False)
+    assert eng.phase_selection["rowmin"] == "xla"
+    assert "interpret" in eng.phase_selection["basis"]["rowmin"] or (
+        "non-tpu" in eng.phase_selection["basis"]["rowmin"]
+    )
+
+
+@needs_native
+def test_phase_probe_measures_both_arms():
+    """probe_phase_kernels returns a real two-arm comparison for both
+    phases — selection_basis is always a measurement."""
+    from bfs_tpu.models.bfs import RelayEngine
+    from bfs_tpu.profiling import probe_phase_kernels
+
+    g = rmat_graph(9, 8, seed=11)
+    eng = RelayEngine(g, sparse_hybrid=False)
+    probe = probe_phase_kernels(eng, loops=2, repeats=2)
+    for phase in ("rowmin", "state_update"):
+        rec = probe[phase]
+        assert "xla_seconds" in rec
+        assert "pallas_seconds" in rec or "pallas_error" in rec
+        assert rec["selected"] in ("xla", "pallas")
+        assert rec["selection_basis"].startswith("measured")
+
+
+def test_pallas_kernels_carry_hot_pragmas():
+    """Pin: the new kernels (and the direction predicate) are declared
+    hot — deleting a pragma fails here, not silently in review."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    from bfs_tpu.analysis.core import SourceFile, hot_regions
+
+    for rel, names in (
+        ("bfs_tpu/ops/relay_pallas.py",
+         ("rowmin_ranks_pallas", "apply_relay_candidates_packed_pallas")),
+        ("bfs_tpu/models/direction.py", ("take_pull", "frontier_masses")),
+        ("bfs_tpu/obs/telemetry.py", ("record_direction",)),
+    ):
+        src = SourceFile(os.path.join(repo, rel), repo)
+        declared = {r.name for r in hot_regions(src)}
+        for n in names:
+            assert n in declared, (rel, n, sorted(declared))
+
+
+# ---------------------------------------------------------------------------
+# Sharded surface.
+# ---------------------------------------------------------------------------
+
+@needs_native
+def test_sharded_direction_push_rejected_and_schedule_ships():
+    from bfs_tpu.parallel.sharded import bfs_sharded, make_mesh
+
+    g = rmat_graph(9, 8, seed=11)
+    mesh = make_mesh(graph=2)
+    with pytest.raises(ValueError, match="per-shard adjacency"):
+        bfs_sharded(g, 0, mesh=mesh, engine="relay", direction="push")
+    res, curve = bfs_sharded(
+        g, 0, mesh=mesh, engine="relay", telemetry=True, direction="auto"
+    )
+    assert_oracle(g, res, 0)
+    sched = curve["direction_schedule"]
+    assert set(sched["schedule"]) == {"pull"}  # dense body only, recorded

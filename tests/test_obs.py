@@ -200,7 +200,9 @@ def test_relay_level_curve_one_device_get(monkeypatch, small_graph):
     monkeypatch.undo()
 
     assert len(calls) == 1, f"expected ONE pull at loop exit, saw {len(calls)}"
-    assert calls[0] <= 2 * TEL_SLOTS + 2  # fv + fe + (changed, level)
+    # fv + fe + direction schedule + (changed, level): the push/pull
+    # schedule (ISSUE 7) rides the SAME single loop-exit pull.
+    assert calls[0] <= 3 * TEL_SLOTS + 2
     assert curve["reachable"] == reached
     assert curve["occupancy"] == hist
 

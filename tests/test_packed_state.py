@@ -324,15 +324,12 @@ def test_sharded_relay_packed_parity(num_shards):
 
 
 def _mesh_relay_available() -> bool:
-    """The shard_map relay program needs the post-0.4.x mesh API
-    (jax.shard_map with axis_names); older jax runs the layout math but
-    not the SPMD program."""
-    try:
-        from jax import shard_map  # noqa: F401
+    """The shard_map relay program runs through the version-spanning shim
+    (bfs_tpu/parallel/compat.py) on every supported jax — the old
+    jax.shard_map axis_names gate is retired with it."""
+    from bfs_tpu.parallel.compat import shard_map_available
 
-        return True
-    except ImportError:
-        return False
+    return shard_map_available()
 
 
 @needs_native

@@ -9,6 +9,14 @@ cache hit, retry loop, fan-out — is the code under test."""
 import numpy as np
 import pytest
 
+def _tick_key(graph, engine, padded):
+    """The server's executable key now carries the direction policy
+    (ISSUE 7) — injected runners must use the same key shape."""
+    from bfs_tpu.models.direction import resolve_direction
+
+    return (graph, engine, padded, resolve_direction().key())
+
+
 from bfs_tpu.graph.generators import gnm_graph
 from bfs_tpu.oracle.bfs import queue_bfs
 from bfs_tpu.resilience.retry import RetryPolicy, TransientError
@@ -54,7 +62,7 @@ def test_transient_failure_is_retried_not_degraded(graph):
     with make_server(graph) as srv:
         flaky = FlakyRunner(graph, fail_n=2)
         # Bucket for one single-source query is 1.
-        srv.exe_cache.put(("g", "pull", 1), flaky)
+        srv.exe_cache.put(_tick_key("g", "pull", 1), flaky)
         reply = srv.query("g", 5).result(TIMEOUT)
 
         # Served by the (recovered) device path, not the oracle fallback.
@@ -75,7 +83,7 @@ def test_permanent_failure_degrades_exactly_once(graph):
         broken = FlakyRunner(
             graph, fail_n=10**9, exc=ValueError("lowering failed")
         )
-        srv.exe_cache.put(("g", "pull", 1), broken)
+        srv.exe_cache.put(_tick_key("g", "pull", 1), broken)
         reply = srv.query("g", 9).result(TIMEOUT)
 
         # One attempt — permanent errors never burn retries — then the
@@ -93,7 +101,7 @@ def test_permanent_failure_degrades_exactly_once(graph):
 def test_transient_exhaustion_degrades_once_with_counts(graph):
     with make_server(graph) as srv:
         down = FlakyRunner(graph, fail_n=10**9)  # never recovers
-        srv.exe_cache.put(("g", "pull", 1), down)
+        srv.exe_cache.put(_tick_key("g", "pull", 1), down)
         reply = srv.query("g", 3).result(TIMEOUT)
 
         # max_attempts=3 device tries, then ONE oracle degradation.
@@ -113,7 +121,7 @@ def test_retry_disabled_policy_matches_old_behavior(graph):
         graph, retry_policy=RetryPolicy(max_attempts=1, base_delay_s=0.0)
     ) as srv:
         flaky = FlakyRunner(graph, fail_n=1)  # would recover on 2nd try
-        srv.exe_cache.put(("g", "pull", 1), flaky)
+        srv.exe_cache.put(_tick_key("g", "pull", 1), flaky)
         reply = srv.query("g", 2).result(TIMEOUT)
         # max_attempts=1 restores degrade-on-first-failure.
         assert flaky.calls == 1

@@ -101,6 +101,67 @@ def diff_headline(final: dict, golden: dict) -> list[str]:
     return bad
 
 
+def diff_schedule(final: dict, golden: dict) -> list[str]:
+    """The direction schedule is a pure on-device function of graph +
+    thresholds (models/direction.py), so it is deterministic ACROSS
+    processes: a resumed run's schedule must equal the golden run's
+    exactly, kill or no kill."""
+    sg = golden["details"].get("direction_schedule")
+    sf = final["details"].get("direction_schedule")
+    if not isinstance(sg, dict):
+        return []
+    if not isinstance(sf, dict) or sf.get("schedule") != sg.get("schedule"):
+        return [
+            "details.direction_schedule: resumed "
+            f"{(sf or {}).get('schedule')!r} != golden "
+            f"{sg.get('schedule')!r} (the schedule must be a pure "
+            "function of graph + thresholds)"
+        ]
+    return []
+
+
+def diff_ledgers(final: dict, replayed: dict) -> list[str]:
+    """Resumed-vs-replayed ledger + schedule invariant via
+    tools/ledger_compare.py --exact (ISSUE 7 satellite): ``replayed`` is
+    one more invocation over the SAME completed journal (a pure replay),
+    so its superstep_phases seconds and direction_schedule must be
+    BIT-IDENTICAL to the resumed run's — a mismatch means the replay
+    path re-measured something it should have restored.  (Phase seconds
+    are NOT deterministic across independent measurements, so the golden
+    run is the wrong reference for exactness — diff_schedule covers the
+    deterministic part against it.)  Skipped when either run shipped no
+    ledger (budget-gated phases record a 'skipped' string)."""
+    fl = final["details"].get("superstep_phases")
+    gl = replayed["details"].get("superstep_phases")
+    if not (isinstance(fl, dict) and isinstance(gl, dict)):
+        return []
+    with tempfile.NamedTemporaryFile(
+        "w", suffix=".json", delete=False
+    ) as fg, tempfile.NamedTemporaryFile(
+        "w", suffix=".json", delete=False
+    ) as ff:
+        json.dump(replayed, fg)
+        json.dump(final, ff)
+    try:
+        proc = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(REPO_ROOT, "tools", "ledger_compare.py"),
+                fg.name, ff.name, "--exact",
+            ],
+            capture_output=True, text=True,
+        )
+        if proc.returncode != 0:
+            return [
+                "superstep_phases/direction_schedule: resumed ledger "
+                f"diverged from golden:\n{proc.stderr.strip()}"
+            ]
+        return []
+    finally:
+        os.unlink(fg.name)
+        os.unlink(ff.name)
+
+
 def chaos_bench(args, rng: random.Random) -> int:
     with tempfile.TemporaryDirectory(prefix="chaos_golden_") as golden_dir:
         log("golden run (uninterrupted)...")
@@ -151,7 +212,15 @@ def chaos_bench(args, rng: random.Random) -> int:
                 failures += 1
                 continue
             final = lines[-1]
-            bad = diff_headline(final, golden)
+            bad = diff_headline(final, golden) + diff_schedule(final, golden)
+            # One more invocation over the completed journal is a pure
+            # replay: its ledger + schedule must be bit-identical to the
+            # resumed run's (ledger_compare --exact).
+            rproc, rlines = run_bench(args, journal_dir)
+            if rproc.returncode != 0 or not rlines:
+                bad.append("pure replay run failed or emitted no headline")
+            else:
+                bad += diff_ledgers(final, rlines[-1])
             if provisional is not None and final["value"] != provisional["value"]:
                 bad.append(
                     f"value: resumed {final['value']!r} != provisional "

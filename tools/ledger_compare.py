@@ -1,0 +1,168 @@
+#!/usr/bin/env python3
+"""Diff two superstep phase ledgers: the before/after evidence tool.
+
+Feeds on either a bench headline JSON (the line bench.py prints — the
+ledger lives at ``details.superstep_phases``) or a raw ledger JSON (the
+``python -m bfs_tpu.profiling`` output).  Prints a phase-by-phase delta
+table (markdown, ready for BENCHMARKS.md) and exits non-zero when any
+phase REGRESSED by more than ``--threshold`` (default 25% — the
+in-container CPU run noise band) — the CI tripwire ROADMAP item 2's
+acceptance asks for.
+
+``--exact`` compares for bit-identical phase seconds AND an identical
+``direction_schedule`` instead — the resumed-vs-golden invariant
+(tools/chaos_run.py bench mode): both ledgers replay from the same
+journal, so any difference means the resume path recomputed something it
+should have restored.
+
+No jax import: runs anywhere the repo does (the lint-stub discipline of
+tools/obs_dashboard.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+#: Phases in ledger order (unknown extras are appended as found).
+PHASE_ORDER = ["vperm", "broadcast", "net_apply", "rowmin", "state_update",
+               "full_superstep", "full_superstep_telemetry"]
+
+
+def load_doc(path: str) -> dict:
+    """Headline line(s) or raw ledger file -> the containing doc.  Bench
+    output may hold several JSON lines (provisional + final): the LAST
+    parseable line wins, matching how captures are read everywhere else."""
+    with open(path) as f:
+        text = f.read()
+    try:
+        # Whole-file document (the indent-2 profiling CLI output).
+        return json.loads(text)
+    except ValueError:
+        pass
+    doc = None
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            doc = json.loads(line)
+        except ValueError:
+            continue
+    if doc is None:
+        raise SystemExit(f"{path}: no parseable JSON line")
+    return doc
+
+
+def extract(doc: dict, path: str):
+    """(phases {name: seconds}, full ledger dict, direction_schedule|None)."""
+    ledger = doc
+    details = doc.get("details")
+    if isinstance(details, dict):
+        ledger = details.get("superstep_phases")
+    if not isinstance(ledger, dict) or "phases" not in ledger:
+        raise SystemExit(
+            f"{path}: no superstep phase ledger found (need a bench "
+            "headline with details.superstep_phases or a raw ledger JSON)"
+        )
+    phases = {
+        name: float(rec["seconds"])
+        for name, rec in ledger["phases"].items()
+        if isinstance(rec, dict) and "seconds" in rec
+    }
+    sched = None
+    if isinstance(details, dict):
+        ds = details.get("direction_schedule")
+        if isinstance(ds, dict):
+            sched = ds.get("schedule")
+    return phases, ledger, sched
+
+
+def fmt_s(s: float) -> str:
+    if s >= 1e-3:
+        return f"{s * 1e3:.3f} ms"
+    return f"{s * 1e6:.1f} µs"
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("before")
+    ap.add_argument("after")
+    ap.add_argument(
+        "--threshold", type=float, default=0.25,
+        help="max tolerated per-phase regression (fraction; default 0.25)",
+    )
+    ap.add_argument(
+        "--exact", action="store_true",
+        help="require bit-identical phase seconds + direction schedule "
+        "(the resumed-vs-golden invariant)",
+    )
+    args = ap.parse_args()
+
+    pb, lb, sb = extract(load_doc(args.before), args.before)
+    pa, la, sa = extract(load_doc(args.after), args.after)
+
+    names = [p for p in PHASE_ORDER if p in pb or p in pa]
+    names += [p for p in sorted(set(pb) | set(pa)) if p not in names]
+
+    rows = []
+    regressed, mismatched = [], []
+    for name in names:
+        b, a = pb.get(name), pa.get(name)
+        if b is None or a is None:
+            rows.append((name, b, a, None))
+            if args.exact:
+                mismatched.append(name)
+            continue
+        delta = (a - b) / b if b > 0 else 0.0
+        rows.append((name, b, a, delta))
+        if args.exact and a != b:
+            mismatched.append(name)
+        elif not args.exact and delta > args.threshold:
+            regressed.append((name, delta))
+
+    print("| phase | before | after | delta |")
+    print("|---|---|---|---|")
+    for name, b, a, delta in rows:
+        bs = fmt_s(b) if b is not None else "—"
+        as_ = fmt_s(a) if a is not None else "—"
+        ds = f"{delta * 100:+.1f}%" if delta is not None else "—"
+        print(f"| {name} | {bs} | {as_} | {ds} |")
+
+    for side, led in (("before", lb), ("after", la)):
+        sel = {
+            p: led["phases"][p].get("selected")
+            for p in ("rowmin", "state_update")
+            if p in led.get("phases", {})
+            and isinstance(led["phases"][p], dict)
+            and led["phases"][p].get("selected")
+        }
+        if sel:
+            print(f"\n{side}: selected arms {sel}", file=sys.stderr)
+
+    if args.exact:
+        if sb != sa:
+            mismatched.append("direction_schedule")
+        if mismatched:
+            print(
+                f"\nEXACT MISMATCH: {mismatched} (resumed ledger must "
+                "replay the golden one bit-identically)",
+                file=sys.stderr,
+            )
+            return 2
+        print("\nexact match (phases + direction schedule)", file=sys.stderr)
+        return 0
+    if regressed:
+        print(
+            "\nREGRESSION over threshold "
+            f"{args.threshold * 100:.0f}%: "
+            + ", ".join(f"{n} {d * 100:+.1f}%" for n, d in regressed),
+            file=sys.stderr,
+        )
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
