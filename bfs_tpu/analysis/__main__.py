@@ -6,12 +6,26 @@ default because their fixtures deliberately trip rules.  Exit codes:
 
 * 0 — no unsuppressed error-severity findings (baseline-accepted ones
   and warnings don't fail the run);
-* 1 — at least one new error;
+* 1 — at least one new error, or (on a default-target run) a STALE
+  baseline entry — an accepted finding that no longer exists must be
+  pruned, not silently carried;
 * 2 — usage/configuration problem.
 
-``--write-baseline`` rewrites the baseline file from the current
-findings (errors only, warnings never need baselining) with TODO
-justifications to fill in; ``--no-baseline`` shows everything.
+Passes:
+
+* default — the stdlib AST pass (transfer/trace/recompile/lock rules).
+* ``--ir`` (or the ``ir`` subcommand) — the IR-grade pass: lowers the
+  declared hot fused programs to jaxprs and checks donation, loop-body
+  host round-trips, dtype drift, HBM budgets and collective correctness
+  (:mod:`bfs_tpu.analysis.ir`).  Imports jax; results are cached
+  content-addressed so repeat runs are instant (``--no-cache`` forces).
+
+``--changed`` lints only files named by ``git diff --name-only HEAD``
+(the pre-commit spelling).  ``--write-baseline`` rewrites the baseline
+file from the current AST findings (errors only, warnings never need
+baselining) with TODO justifications to fill in; with ``--ir`` it
+PRINTS the baseline lines instead (the IR section is curated by hand,
+never clobbered).  ``--no-baseline`` shows everything.
 """
 
 from __future__ import annotations
@@ -19,6 +33,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 from . import (
@@ -40,7 +55,40 @@ def _repo_root() -> str:
     return cand
 
 
+def _changed_files(root: str) -> list[str]:
+    """Repo files touched vs HEAD (staged + unstaged), absolute paths —
+    restricted to the default lint surface (bfs_tpu/, tools/, bench.py):
+    tests/ fixtures deliberately trip rules and are never linted, so a
+    changed test file must not fail the pre-commit fast path."""
+    try:
+        out = subprocess.run(
+            ["git", "diff", "--name-only", "HEAD"],
+            capture_output=True, text=True, cwd=root, timeout=30,
+        )
+    except (OSError, subprocess.TimeoutExpired):
+        return []
+    if out.returncode != 0:
+        return []
+    picked = []
+    for line in out.stdout.splitlines():
+        rel = line.strip()
+        if not rel.endswith(".py"):
+            continue
+        if not (rel.startswith("bfs_tpu/") or rel.startswith("tools/")
+                or rel == "bench.py"):
+            continue
+        p = os.path.join(root, rel)
+        if os.path.exists(p):
+            picked.append(p)
+    return picked
+
+
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    argv = list(argv)
+    if argv and argv[0] == "ir":  # subcommand spelling of --ir
+        argv = ["--ir"] + argv[1:]
     ap = argparse.ArgumentParser(
         prog="python -m bfs_tpu.analysis",
         description=__doc__.splitlines()[0],
@@ -61,6 +109,14 @@ def main(argv=None) -> int:
                     help="warnings also fail the run")
     ap.add_argument("--rules", action="store_true",
                     help="print the rule catalog and exit")
+    ap.add_argument("--ir", action="store_true",
+                    help="run the IR-grade pass instead (lowers the hot "
+                         "fused programs to jaxprs; imports jax)")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="IR pass: ignore the content-addressed result cache")
+    ap.add_argument("--changed", action="store_true",
+                    help="AST pass: lint only files in `git diff "
+                         "--name-only HEAD`")
     args = ap.parse_args(argv)
 
     if args.rules:
@@ -69,23 +125,54 @@ def main(argv=None) -> int:
         return 0
 
     root = os.path.abspath(args.root) if args.root else _repo_root()
-    if args.paths:
-        paths = [os.path.abspath(p) for p in args.paths]
-    else:
-        paths = [
-            p for p in (
-                os.path.join(root, "bfs_tpu"),
-                os.path.join(root, "tools"),
-                os.path.join(root, "bench.py"),
-            ) if os.path.exists(p)
-        ]
-    if not paths:
-        print("analysis: nothing to lint", file=sys.stderr)
-        return 2
-
-    findings = analyze_paths(paths, root)
-
     baseline_path = args.baseline or default_baseline_path()
+
+    if args.ir:
+        if args.paths or args.changed:
+            print(
+                "analysis: --ir always analyzes the whole hot-program "
+                "registry — it cannot be scoped by paths or --changed",
+                file=sys.stderr,
+            )
+            return 2
+        from . import ir
+
+        findings, meta = ir.analyze_ir(
+            use_cache=not args.no_cache, root=root
+        )
+        # Stale enforcement below only looks at IR-family entries: an IR
+        # run says nothing about whether AST findings still exist.  And a
+        # run that SKIPPED programs (e.g. the mesh specs below 2 devices)
+        # proves nothing about their entries either — fingerprints don't
+        # name programs, so any skip exempts the whole family.
+        default_surface = not meta["skipped"]
+        rule_family = lambda r: r.startswith("IR")  # noqa: E731
+    else:
+        if args.changed:
+            paths = _changed_files(root)
+            if not paths:
+                print("analysis: no changed python files", file=sys.stderr)
+                return 0
+            default_surface = False
+        elif args.paths:
+            paths = [os.path.abspath(p) for p in args.paths]
+            default_surface = False
+        else:
+            paths = [
+                p for p in (
+                    os.path.join(root, "bfs_tpu"),
+                    os.path.join(root, "tools"),
+                    os.path.join(root, "bench.py"),
+                ) if os.path.exists(p)
+            ]
+            default_surface = True
+        if not paths:
+            print("analysis: nothing to lint", file=sys.stderr)
+            return 2
+        findings = analyze_paths(paths, root)
+        meta = None
+        rule_family = lambda r: not r.startswith("IR")  # noqa: E731
+
     baseline = (
         Baseline(path=baseline_path)
         if args.no_baseline
@@ -94,11 +181,38 @@ def main(argv=None) -> int:
 
     if args.write_baseline:
         errors = [f for f in findings if f.severity == "error"]
+        if args.ir:
+            # Never clobber the committed file from the IR pass: its
+            # entries span BOTH passes.  Print the lines to curate in.
+            print(Baseline.render(errors), end="")
+            print(
+                f"analysis: {len(errors)} IR finding(s) rendered above — "
+                "paste the justified ones into the baseline's IR section",
+                file=sys.stderr,
+            )
+            return 0
+        # Regenerating the AST section must not drop the hand-curated IR
+        # entries living in the same file: carry them over verbatim.
+        kept_ir = [
+            f"{rule}  {fp}  {just}".rstrip()
+            for fp, (rule, just) in baseline.entries.items()
+            if rule.startswith("IR")
+        ]
         with open(baseline_path, "w", encoding="utf-8") as f:
             f.write(Baseline.render(errors))
+            if kept_ir:
+                f.write(
+                    "\n# -- IR-pass entries (curated by hand; carried "
+                    "over by --write-baseline) --\n"
+                )
+                f.write("\n".join(kept_ir) + "\n")
         print(
             f"analysis: wrote {len(errors)} accepted finding(s) to "
-            f"{baseline_path} — fill in the justifications"
+            f"{baseline_path}"
+            + (f" (+{len(kept_ir)} IR entr"
+               f"{'y' if len(kept_ir) == 1 else 'ies'} carried over)"
+               if kept_ir else "")
+            + " — fill in the justifications"
         )
         return 0
 
@@ -106,6 +220,13 @@ def main(argv=None) -> int:
     new_errors = [f for f in fresh if f.severity == "error"]
     warnings = [f for f in fresh if f.severity == "warning"]
     accepted = len(findings) - len(fresh)
+    # Stale entries: only enforced when the run covered the full default
+    # surface of its pass — a single-file lint matching nothing proves
+    # nothing — and only for the pass's own rule family.
+    stale = [
+        fp for fp in baseline.stale()
+        if rule_family(baseline.entries[fp][0])
+    ] if default_surface else []
 
     if args.as_json:
         print(json.dumps(
@@ -120,27 +241,35 @@ def main(argv=None) -> int:
                     for f in fresh
                 ],
                 "accepted_by_baseline": accepted,
-                "stale_baseline_entries": baseline.stale(),
+                "stale_baseline_entries": stale,
+                **({"ir": meta} if meta is not None else {}),
             },
             indent=2,
         ))
     else:
         for f in fresh:
             print(f.render())
-        stale = baseline.stale()
         summary = (
             f"analysis: {len(new_errors)} error(s), {len(warnings)} "
             f"warning(s), {accepted} baseline-accepted"
         )
+        if meta is not None:
+            summary += (
+                f" [ir: {len(meta['programs'])} program(s), cache "
+                f"{meta['cache']}"
+                + (f", skipped {sorted(meta['skipped'])}"
+                   if meta["skipped"] else "")
+                + "]"
+            )
         if stale:
             summary += (
                 f", {len(stale)} STALE baseline entr"
                 f"{'y' if len(stale) == 1 else 'ies'} (fixed or edited — "
-                "prune them)"
+                "prune them; stale entries FAIL the self-lint)"
             )
         print(summary, file=sys.stderr)
 
-    if new_errors or (args.strict and warnings):
+    if new_errors or stale or (args.strict and warnings):
         return 1
     return 0
 

@@ -108,6 +108,36 @@ RULES: dict[str, tuple[str, str]] = {
     "LCK002": ("warning",
                "shared mutable field in a lock-owning class has no "
                "guarded-by annotation"),
+    # -- IR-grade rules (bfs_tpu.analysis.ir — lowers the hot fused
+    # programs to jaxprs; unlike the AST rules these need jax) ------------
+    "IR000": ("error",
+              "hot program failed to build/lower for IR analysis — a "
+              "policed program that cannot be checked is unpoliced"),
+    "IR001": ("error",
+              "V-sized carry not donated to its consumer program: both "
+              "the dead input and the output stay live, doubling the "
+              "carry's HBM bytes for the call"),
+    "IR002": ("error",
+              "host round-trip (callback/device_put-shaped eqn) inside a "
+              "fused loop body — the whole superstep loop must stay one "
+              "device-resident program"),
+    "IR003": ("error",
+              "dtype drift in a fused loop body: packed uint32 state "
+              "words widened to f32/f64/i64, or int32 telemetry "
+              "accumulators widened to 64-bit"),
+    "IR004": ("error",
+              "static HBM footprint estimate (operands + carries + "
+              "temps from eqn shapes) exceeds the program's declared "
+              "byte budget"),
+    "IR005": ("error",
+              "collective/mesh-axis mismatch: axis used but undeclared, "
+              "a required exchange axis has no collective, or a "
+              "shard_map result's sharding disagrees with the declared "
+              "out_specs"),
+    "IR006": ("error",
+              "exchange payload regressed: a collective moves a V-scale "
+              "payload whose dtype/width is outside the program's "
+              "declared exchange format"),
 }
 
 

@@ -1641,6 +1641,30 @@ class RelayEngine:
             jax.device_get(compiled(state, self._sparse_tensors[3]))
         )
 
+    def _step_fn(self, kind: str, packed: bool):
+        """The jit program one superstep body compiles to, with the state
+        carry DONATED (argnum 0): a stepped superstep consumes its input
+        state — it is dead the moment the step returns — so donation lets
+        XLA write the output into the input's buffers instead of holding
+        both, halving the step's peak state HBM (IR lint rule IR001; at
+        s24 the packed relay carry is ~69 MB, the unpacked push state
+        ~151 MB — un-donated, each step doubles that).  Callers must not
+        reuse a state they have stepped; every stepped path reassigns
+        (``state = step(state)``)."""
+        if kind == "sparse":
+            vr = self.relay_graph.vr
+
+            def fn(st, indptr, adst, aslot):
+                return _sparse_superstep(
+                    st, indptr, adst, aslot, vr=vr, packed=packed
+                )
+        else:
+            fn = _superstep_fn(
+                self._static, self._use_pallas(), packed,
+                self._phase_sel(),
+            )
+        return jax.jit(fn, donate_argnums=0)
+
     def _step_body(self, kind: str, state):
         """AOT-compiled dense or sparse superstep body (cached per engine;
         scoped-vmem options on TPU backends only — the CPU XLA rejects the
@@ -1655,26 +1679,17 @@ class RelayEngine:
         compiled = self._compiled.get(key)
         if compiled is None:
             if kind == "sparse":
-                vr = self.relay_graph.vr
-
-                def fn(st, indptr, adst, aslot):
-                    return _sparse_superstep(
-                        st, indptr, adst, aslot, vr=vr, packed=packed
-                    )
-
                 args = (state, *self._sparse_tensors_for(packed)[:3])
             else:
-                fn = _superstep_fn(
-                    self._static, self._use_pallas(), packed,
-                    self._phase_sel(),
-                )
                 args = (state, *self._tensors)
             opts = (
                 self._COMPILER_OPTIONS
                 if jax.default_backend() == "tpu"
                 else None
             )
-            compiled = compile_exe_cached(jax.jit(fn).lower(*args), opts)
+            compiled = compile_exe_cached(
+                self._step_fn(kind, packed).lower(*args), opts
+            )
             self._compiled[key] = compiled
         return compiled
 
@@ -2363,14 +2378,18 @@ class SuperstepRunner:
             self.num_vertices = self.device_graph.num_vertices
             src = jnp.asarray(self.device_graph.src)
             dst = jnp.asarray(self.device_graph.dst)
-            self._step = jax.jit(traced("bfs.push_step")(lambda s: relax_superstep(s, src, dst)))
+            # donate_argnums=0: the stepped state is consumed — run()'s
+            # loop and every external caller reassign (state = step(state))
+            # — so the output reuses the input's buffers instead of
+            # doubling the V-sized state HBM per step (IR lint IR001).
+            self._step = jax.jit(traced("bfs.push_step")(lambda s: relax_superstep(s, src, dst)), donate_argnums=0)
         elif engine == "pull":
             pg = graph if isinstance(graph, PullGraph) else build_pull_graph(graph)
             self.num_vertices = pg.num_vertices
             from ..graph.ell import device_ell
 
             ell0, folds = device_ell(pg)
-            self._step = jax.jit(traced("bfs.pull_step")(lambda s: relax_pull_superstep(s, ell0, folds)))
+            self._step = jax.jit(traced("bfs.pull_step")(lambda s: relax_pull_superstep(s, ell0, folds)), donate_argnums=0)
         elif engine == "relay":
             eng = RelayEngine(graph)
             self._relay = eng

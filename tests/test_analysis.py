@@ -517,11 +517,23 @@ def test_repo_self_lint_clean_modulo_baseline():
     ]
     findings = analyze_paths([p for p in paths if os.path.exists(p)], REPO)
     baseline = Baseline.load(default_baseline_path())
-    fresh_errors = [
-        f for f in findings
-        if f.severity == "error" and not baseline.accepts(f)
-    ]
+    # accepts() over EVERY finding (warnings too — a baselined RCD004 is
+    # a warning) so stale() below reflects what the CLI would see.
+    fresh = [f for f in findings if not baseline.accepts(f)]
+    fresh_errors = [f for f in fresh if f.severity == "error"]
     assert fresh_errors == [], "\n".join(f.render() for f in fresh_errors)
+    # Stale AST entries fail the self-lint too (ISSUE 8 satellite): an
+    # accepted finding that no longer exists must be pruned, or the
+    # baseline rots into a list of things nobody can re-triage.  IR
+    # entries are not exercised by this pass and don't count here.
+    stale_ast = [
+        fp for fp in baseline.stale()
+        if not baseline.entries[fp][0].startswith("IR")
+    ]
+    assert stale_ast == [], (
+        "stale baseline entries (fixed or edited — prune them): "
+        + ", ".join(stale_ast)
+    )
 
 
 def test_repo_has_expected_hot_coverage():
@@ -548,14 +560,24 @@ def test_repo_has_expected_hot_coverage():
             "relay_superstep_words_packed",
         ),
         # the per-phase Pallas kernels (ISSUE 7) run inside the fused
-        # hot loop when selected — they must keep static hot coverage
+        # hot loop when selected — they must keep static hot coverage,
+        # INCLUDING the inner pallas kernel bodies PR 7 added (the
+        # tournament and packed-update kernels are both named `kernel`;
+        # the pin lagged them — ISSUE 8 satellite)
         "bfs_tpu/ops/relay_pallas.py": (
             "rowmin_ranks_pallas",
             "apply_relay_candidates_packed_pallas",
+            "kernel",
         ),
         # the direction predicate and its mass inputs compile into every
-        # auto-mode while_loop body (ISSUE 7 tentpole a)
-        "bfs_tpu/models/direction.py": ("take_pull", "frontier_masses"),
+        # auto-mode while_loop body (ISSUE 7 tentpole a), and the
+        # combined-layout fused program itself is jit-hot (ISSUE 8
+        # satellite: the pin lagged PR 7's program)
+        "bfs_tpu/models/direction.py": (
+            "take_pull",
+            "frontier_masses",
+            "_bfs_direction_fused",
+        ),
         "bfs_tpu/models/bfs.py": ("_frontier_masses_words",),
         "bfs_tpu/obs/telemetry.py": ("record_direction",),
         "bfs_tpu/serve/executor.py": ("_state_to_result",),
@@ -636,8 +658,75 @@ def test_cli_rules_catalog():
     proc = _run_cli(["--rules"])
     assert proc.returncode == 0
     for rule in ("TRC001", "TRC006", "RCD001", "RCD005", "LCK001", "LCK002",
-                 "OBS001"):
+                 "OBS001", "IR001", "IR004", "IR006"):
         assert rule in proc.stdout
+
+
+def test_cli_stale_baseline_fails_default_run(tmp_path):
+    """A baseline entry whose fingerprint matches nothing is an ERROR on
+    a default-surface run (ISSUE 8 satellite: stale entries used to be
+    only reported) — but an explicit-path run proves nothing about the
+    rest of the tree and must not trip on it."""
+    bl = tmp_path / "baseline.txt"
+    shipped = open(
+        os.path.join(REPO, "bfs_tpu", "analysis", "baseline.txt"),
+        encoding="utf-8",
+    ).read()
+    bl.write_text(shipped + "TRC001  deadbeef0000  a dead entry\n")
+    proc = _run_cli(["--baseline", str(bl)])
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "STALE" in proc.stderr
+    # Same baseline, single-file target: stale not enforced.
+    proc = _run_cli([
+        os.path.join(REPO, "tools", "ledger_compare.py"),
+        "--baseline", str(bl),
+    ])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_write_baseline_carries_ir_entries_over(tmp_path):
+    """The AST --write-baseline regenerates its own section but must not
+    drop the hand-curated IR entries sharing the file."""
+    bl = tmp_path / "baseline.txt"
+    shipped = open(
+        os.path.join(REPO, "bfs_tpu", "analysis", "baseline.txt"),
+        encoding="utf-8",
+    ).read()
+    bl.write_text(shipped + "IR001  cafecafe0000  fixture: justified\n")
+    proc = _run_cli(["--write-baseline", "--baseline", str(bl)])
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    rewritten = bl.read_text()
+    assert "IR001  cafecafe0000  fixture: justified" in rewritten
+    assert "carried over" in proc.stdout
+
+
+def test_cli_changed_lints_only_diffed_files(tmp_path):
+    """--changed on a clean tree (or outside git) lints nothing and
+    exits 0 — the pre-commit fast path."""
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as empty:
+        os.makedirs(os.path.join(empty, "bfs_tpu"), exist_ok=True)
+        proc = _run_cli(["--changed", "--root", empty])
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "no changed python files" in proc.stderr
+
+
+def test_changed_files_scope_excludes_tests():
+    """_changed_files keeps only the default lint surface: a changed
+    tests/ file (whose fixtures deliberately trip rules) must never fail
+    the --changed fast path."""
+    from unittest import mock
+
+    from bfs_tpu.analysis.__main__ import _changed_files
+
+    diff = "tests/test_analysis_ir.py\nbfs_tpu/models/bfs.py\n" \
+           "tools/lint.py\nbench.py\nREADME.md\n"
+    done = mock.Mock(returncode=0, stdout=diff)
+    with mock.patch("subprocess.run", return_value=done), \
+         mock.patch("os.path.exists", return_value=True):
+        rels = [os.path.relpath(p, REPO) for p in _changed_files(REPO)]
+    assert rels == ["bfs_tpu/models/bfs.py", "tools/lint.py", "bench.py"]
 
 
 # ---------------------------------------------------------------------------

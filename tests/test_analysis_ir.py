@@ -1,0 +1,509 @@
+"""Tests for bfs_tpu.analysis.ir — the IR-grade pass: every rule must
+trip on a fixture program and stay quiet on its near-miss, the repo's own
+hot-program registry must lint clean modulo the baseline, the
+content-addressed result cache must hit on an unchanged tree, and the
+CLI must exit non-zero on each rule fixture.
+
+The repo-wide registry runs carry the ``lint_ir`` marker so a quick
+``-m 'not lint_ir'`` selection can skip the (cached, but cold-traced)
+jax work; plain tier-1 runs them.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from bfs_tpu.analysis import Baseline, default_baseline_path
+from bfs_tpu.analysis.ir import (
+    Program,
+    analyze_ir,
+    analyze_program,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+V = 64
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+def _mesh(shape=(2,), names=("graph",)):
+    n = int(np.prod(shape))
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} devices")
+    return Mesh(np.array(jax.devices()[:n]).reshape(shape), names)
+
+
+# ---------------------------------------------------------------------------
+# IR001 — donation.
+# ---------------------------------------------------------------------------
+
+def _step_like(donate: bool):
+    fn = jax.jit(lambda s: s + 1, donate_argnums=0) if donate else jax.jit(
+        lambda s: s + 1
+    )
+    return Program(
+        name="fx.step", path="fx.py", fn=fn,
+        args=(jnp.zeros(V + 1, jnp.int32),), v_elements=V,
+        donate={0: "state"},
+    )
+
+
+def test_ir001_undonated_carry_trips():
+    fs = analyze_program(_step_like(donate=False))
+    assert rules_of(fs) == ["IR001"]
+    # The finding reports the doubled bytes: (V+1) int32 = 260.
+    assert "260" in fs[0].message
+
+
+def test_ir001_near_miss_donated():
+    assert analyze_program(_step_like(donate=True)) == []
+
+
+def test_ir001_scalar_leaves_never_flagged():
+    # A pytree carry whose small leaves (level/changed scalars) are not
+    # donatable must not trip as long as the V-sized leaves are donated.
+    fn = jax.jit(lambda s: (s[0] * 2, s[1] + 1), donate_argnums=0)
+    prog = Program(
+        name="fx.tree", path="fx.py", fn=fn,
+        args=((jnp.zeros(V, jnp.uint32), jnp.int32(0)),),
+        v_elements=V, donate={0: "state"},
+    )
+    assert analyze_program(prog) == []
+
+
+# ---------------------------------------------------------------------------
+# IR002 — host round-trips inside loop bodies.
+# ---------------------------------------------------------------------------
+
+def test_ir002_callback_in_loop_trips():
+    @jax.jit
+    def loopy(x):
+        def body(c):
+            jax.debug.print("level {}", c[1])
+            return c[0] * 2, c[1] + 1
+
+        return jax.lax.while_loop(lambda c: c[1] < 3, body, (x, 0))
+
+    prog = Program(name="fx.cb", path="fx.py", fn=loopy,
+                   args=(jnp.zeros(V, jnp.uint32),), v_elements=V)
+    assert rules_of(analyze_program(prog)) == ["IR002"]
+
+
+def test_ir002_near_miss_callback_outside_loop():
+    @jax.jit
+    def tail_print(x):
+        out = jax.lax.while_loop(
+            lambda c: c[1] < 3, lambda c: (c[0] * 2, c[1] + 1), (x, 0)
+        )
+        jax.debug.print("done {}", out[1])
+        return out
+
+    prog = Program(name="fx.cb_ok", path="fx.py", fn=tail_print,
+                   args=(jnp.zeros(V, jnp.uint32),), v_elements=V)
+    assert analyze_program(prog) == []
+
+
+# ---------------------------------------------------------------------------
+# IR003 — dtype drift.
+# ---------------------------------------------------------------------------
+
+def test_ir003_packed_word_widening_trips():
+    @jax.jit
+    def drift(x):
+        def body(c):
+            w, i = c
+            bad = w.astype(jnp.float32).sum()  # V-sized u32 -> f32
+            return w + bad.astype(jnp.uint32), i + 1
+
+        return jax.lax.while_loop(lambda c: c[1] < 3, body, (x, 0))
+
+    prog = Program(name="fx.drift", path="fx.py", fn=drift,
+                   args=(jnp.zeros(V, jnp.uint32),), v_elements=V,
+                   packed=True)
+    assert rules_of(analyze_program(prog)) == ["IR003"]
+
+
+def test_ir003_near_miss_scalar_and_int32_masses():
+    # The Beamer predicate's int32->float32 scalar masses and a masked
+    # int32 out-degree sum are the loop's bread and butter — clean.
+    @jax.jit
+    def masses(x, outdeg):
+        def body(c):
+            w, i = c
+            fs = (w != 0).sum(dtype=jnp.int32)
+            fe = jnp.where(w != 0, outdeg, 0).astype(jnp.float32).sum()
+            keep = (fs.astype(jnp.float32) + fe) > 0
+            return jnp.where(keep, w, w), i + 1
+
+        return jax.lax.while_loop(lambda c: c[1] < 3, body, (x, 0))
+
+    prog = Program(
+        name="fx.masses", path="fx.py", fn=masses,
+        args=(jnp.zeros(V, jnp.uint32), jnp.zeros(V, jnp.int32)),
+        v_elements=V, packed=True,
+    )
+    assert analyze_program(prog) == []
+
+
+# ---------------------------------------------------------------------------
+# IR004 — HBM budget proof.
+# ---------------------------------------------------------------------------
+
+def test_ir004_budget_exceeded_trips_and_ample_passes():
+    fn = jax.jit(lambda s: s * 2)
+    args = (jnp.zeros(4096, jnp.int32),)
+    tight = Program(name="fx.tight", path="fx.py", fn=fn, args=args,
+                    v_elements=V, budget_bytes=1024)
+    ample = Program(name="fx.ample", path="fx.py", fn=fn, args=args,
+                    v_elements=V, budget_bytes=1 << 30)
+    fs = analyze_program(tight)
+    assert rules_of(fs) == ["IR004"]
+    assert "cannot fit" in fs[0].message
+    assert analyze_program(ample) == []
+
+
+# ---------------------------------------------------------------------------
+# IR005 — collective / mesh-axis correctness.
+# ---------------------------------------------------------------------------
+
+def test_ir005_missing_required_exchange_trips():
+    mesh = _mesh()
+
+    def no_collective(x):
+        return shard_map(lambda xb: xb * 2, mesh=mesh, in_specs=P("graph"),
+                         out_specs=P("graph"))(x)
+
+    prog = Program(
+        name="fx.nocoll", path="fx.py", fn=jax.jit(no_collective),
+        args=(jnp.zeros(V * 2, jnp.uint32),), v_elements=V,
+        mesh_axes=frozenset({"graph"}), required_axes=frozenset({"graph"}),
+    )
+    fs = analyze_program(prog)
+    assert rules_of(fs) == ["IR005"]
+    assert "missing" in fs[0].snippet
+
+
+def test_ir005_out_specs_disagreement_trips():
+    mesh = _mesh()
+
+    def sharded_out(x):
+        return shard_map(lambda xb: xb * 2, mesh=mesh, in_specs=P("graph"),
+                         out_specs=P("graph"))(x)
+
+    prog = Program(
+        name="fx.outspec", path="fx.py", fn=jax.jit(sharded_out),
+        args=(jnp.zeros(V * 2, jnp.uint32),), v_elements=V,
+        mesh_axes=frozenset({"graph"}),
+        expected_out_names=(frozenset(),),  # caller expects replicated
+    )
+    fs = analyze_program(prog)
+    assert [f.snippet for f in fs] == ["ir:fx.outspec:out_specs"]
+
+
+def test_ir005_extra_collective_over_undeclared_axis_trips():
+    mesh = _mesh((2, 2), ("batch", "graph"))
+
+    def extra(x):
+        def inner(xb):
+            merged = jax.lax.psum(xb.astype(jnp.int32), "graph")
+            return jax.lax.psum(merged, "batch").astype(jnp.uint32)
+
+        return shard_map(inner, mesh=mesh, in_specs=P("graph"),
+                         out_specs=P())(x)
+
+    prog = Program(
+        name="fx.extra", path="fx.py", fn=jax.jit(extra),
+        args=(jnp.zeros(V * 16, jnp.uint32),), v_elements=V,
+        mesh_axes=frozenset({"graph"}),  # batch is NOT declared
+        required_axes=frozenset({"graph"}),
+        exchange_dtypes=("uint32", "int32", "bool"),
+    )
+    assert any(
+        f.rule == "IR005" and f.snippet.endswith("extra:batch")
+        for f in analyze_program(prog)
+    )
+
+
+def test_ir005_near_miss_declared_exchange_clean():
+    mesh = _mesh()
+
+    def merged(x):
+        def inner(xb):
+            return jax.lax.psum(xb.astype(jnp.int32), "graph").astype(
+                jnp.uint32
+            )
+
+        return shard_map(inner, mesh=mesh, in_specs=P("graph"),
+                         out_specs=P())(x)
+
+    prog = Program(
+        name="fx.ok", path="fx.py", fn=jax.jit(merged),
+        args=(jnp.zeros(V * 16, jnp.uint32),), v_elements=V,
+        mesh_axes=frozenset({"graph"}), required_axes=frozenset({"graph"}),
+    )
+    assert analyze_program(prog) == []
+
+
+# ---------------------------------------------------------------------------
+# IR006 — exchange payload format.
+# ---------------------------------------------------------------------------
+
+def _exchange_prog(dtype, name):
+    mesh = _mesh()
+
+    def prog_fn(x):
+        def inner(xb):
+            return jax.lax.psum(xb.astype(dtype), "graph").astype(
+                jnp.float32
+            )
+
+        return shard_map(inner, mesh=mesh, in_specs=P("graph"),
+                         out_specs=P())(x)
+
+    return Program(
+        name=name, path="fx.py", fn=jax.jit(prog_fn),
+        args=(jnp.zeros(V * 16, jnp.uint32),), v_elements=V,
+        mesh_axes=frozenset({"graph"}), required_axes=frozenset({"graph"}),
+    )
+
+
+def test_ir006_widened_exchange_payload_trips():
+    fs = analyze_program(_exchange_prog(jnp.float32, "fx.fat"))
+    assert rules_of(fs) == ["IR006"]
+    assert "float32" in fs[0].message
+
+
+def test_ir006_near_miss_packed_word_exchange():
+    mesh = _mesh()
+
+    def ok(x):
+        def inner(xb):
+            return jax.lax.psum(xb.astype(jnp.int32), "graph").astype(
+                jnp.uint32
+            )
+
+        return shard_map(inner, mesh=mesh, in_specs=P("graph"),
+                         out_specs=P())(x)
+
+    prog = Program(
+        name="fx.okex", path="fx.py", fn=jax.jit(ok),
+        args=(jnp.zeros(V * 16, jnp.uint32),), v_elements=V,
+        mesh_axes=frozenset({"graph"}), required_axes=frozenset({"graph"}),
+    )
+    assert analyze_program(prog) == []
+
+
+def test_ir006_control_scalar_reduce_never_flagged():
+    # The `changed` termination all-reduce is a 4-byte control scalar —
+    # under the exchange floor, any dtype.
+    mesh = _mesh()
+
+    def term(x):
+        def inner(xb):
+            changed = jax.lax.pmax((xb != 0).any().astype(jnp.float32),
+                                   "graph")
+            return xb * changed.astype(jnp.uint32)
+
+        return shard_map(inner, mesh=mesh, in_specs=P("graph"),
+                         out_specs=P("graph"))(x)
+
+    prog = Program(
+        name="fx.term", path="fx.py", fn=jax.jit(term),
+        args=(jnp.zeros(V * 2, jnp.uint32),), v_elements=V,
+        mesh_axes=frozenset({"graph"}), required_axes=frozenset({"graph"}),
+    )
+    assert analyze_program(prog) == []
+
+
+# ---------------------------------------------------------------------------
+# IR000 — unloadable programs fail loudly.
+# ---------------------------------------------------------------------------
+
+def test_ir000_unlowerable_program_is_an_error():
+    def broken(x):
+        raise TypeError("deliberately unlowerable")
+
+    prog = Program(name="fx.broken", path="fx.py", fn=broken,
+                   args=(jnp.zeros(4, jnp.int32),), v_elements=V)
+    fs = analyze_program(prog)
+    assert rules_of(fs) == ["IR000"]
+
+
+# ---------------------------------------------------------------------------
+# The repo registry: self-lint + cache.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.lint_ir
+def test_repo_ir_self_lint_clean_modulo_baseline():
+    """Every declared hot program lowers and passes the IR rules (the
+    tier-1 'what XLA sees is clean' gate — the cached twin of the CLI's
+    default run)."""
+    findings, meta = analyze_ir(use_cache=True)
+    assert len(meta["programs"]) + len(meta["skipped"]) >= 12, meta
+    baseline = Baseline.load(default_baseline_path())
+    fresh = [f for f in findings if not baseline.accepts(f)]
+    assert fresh == [], "\n".join(f.render() for f in fresh)
+    # The donation dogfood (this PR's fix) must stay fixed: no program
+    # may report an un-donated carry ever again without a baseline entry.
+    assert not any(f.rule == "IR001" for f in findings)
+
+
+@pytest.mark.lint_ir
+def test_ir_result_cache_hits_on_unchanged_tree(tmp_path):
+    f1, m1 = analyze_ir(use_cache=True, cache_dir=str(tmp_path))
+    assert m1["cache"] == "miss"
+    f2, m2 = analyze_ir(use_cache=True, cache_dir=str(tmp_path))
+    assert m2["cache"] == "hit"
+    assert [f.fingerprint() for f in f2] == [f.fingerprint() for f in f1]
+    assert any(name.startswith("ir_") for name in os.listdir(tmp_path))
+
+
+def test_ir_skip_records_program(monkeypatch):
+    from bfs_tpu.analysis import ir as ir_mod
+
+    def skipper():
+        raise ir_mod.SkipProgram("no mesh here")
+
+    findings, meta = analyze_ir({"fx.skipped": skipper})
+    assert findings == []
+    assert meta["skipped"] == {"fx.skipped": "no mesh here"}
+    assert meta["cache"] == "off"  # custom specs are never cached
+
+
+# ---------------------------------------------------------------------------
+# Donation is real at runtime: a stepped state is consumed.
+# ---------------------------------------------------------------------------
+
+def test_superstep_state_buffers_donated(tiny_graph):
+    from bfs_tpu.models.bfs import SuperstepRunner
+
+    runner = SuperstepRunner(tiny_graph, engine="push")
+    s0 = runner.init(0)
+    s1 = runner.step(s0)
+    assert int(s1.level) == 1
+    with pytest.raises(RuntimeError, match="deleted"):
+        np.asarray(jax.device_get(s0.dist))
+
+
+# ---------------------------------------------------------------------------
+# CLI: the --ir path exits non-zero on each rule fixture.
+# ---------------------------------------------------------------------------
+
+def _fixture_specs():
+    mesh_ok = len(jax.devices()) >= 2
+    specs = {
+        "IR001": lambda: _step_like(donate=False),
+        "IR004": lambda: Program(
+            name="fx.tight", path="fx.py", fn=jax.jit(lambda s: s * 2),
+            args=(jnp.zeros(4096, jnp.int32),), v_elements=V,
+            budget_bytes=1024,
+        ),
+    }
+
+    @jax.jit
+    def loopy(x):
+        def body(c):
+            jax.debug.print("lvl {}", c[1])
+            return c[0] * 2, c[1] + 1
+
+        return jax.lax.while_loop(lambda c: c[1] < 3, body, (x, 0))
+
+    specs["IR002"] = lambda: Program(
+        name="fx.cb", path="fx.py", fn=loopy,
+        args=(jnp.zeros(V, jnp.uint32),), v_elements=V,
+    )
+
+    @jax.jit
+    def drift(x):
+        def body(c):
+            w, i = c
+            return w + w.astype(jnp.float32).sum().astype(jnp.uint32), i + 1
+
+        return jax.lax.while_loop(lambda c: c[1] < 3, body, (x, 0))
+
+    specs["IR003"] = lambda: Program(
+        name="fx.drift", path="fx.py", fn=drift,
+        args=(jnp.zeros(V, jnp.uint32),), v_elements=V, packed=True,
+    )
+    if mesh_ok:
+        mesh = Mesh(np.array(jax.devices()[:2]).reshape(2), ("graph",))
+
+        def no_collective(x):
+            return shard_map(
+                lambda xb: xb * 2, mesh=mesh, in_specs=P("graph"),
+                out_specs=P("graph"),
+            )(x)
+
+        specs["IR005"] = lambda: Program(
+            name="fx.nocoll", path="fx.py", fn=jax.jit(no_collective),
+            args=(jnp.zeros(V * 2, jnp.uint32),), v_elements=V,
+            mesh_axes=frozenset({"graph"}),
+            required_axes=frozenset({"graph"}),
+        )
+        specs["IR006"] = lambda: _exchange_prog(jnp.float32, "fx.fat")
+    return specs
+
+
+@pytest.mark.parametrize("rule", ["IR001", "IR002", "IR003", "IR004",
+                                  "IR005", "IR006"])
+def test_cli_exits_nonzero_on_rule_fixture(rule, monkeypatch, capsys):
+    specs = _fixture_specs()
+    if rule not in specs:
+        pytest.skip("needs 2 devices")
+    from bfs_tpu.analysis import __main__ as cli
+    from bfs_tpu.analysis import ir as ir_mod
+
+    monkeypatch.setattr(ir_mod, "PROGRAM_SPECS", {rule: specs[rule]})
+    rc = cli.main(["--ir", "--no-cache", "--no-baseline"])
+    out = capsys.readouterr()
+    assert rc == 1, out.out + out.err
+    assert rule in out.out
+
+
+def test_cli_ir_subcommand_and_baseline_accept(monkeypatch, tmp_path,
+                                               capsys):
+    """`python -m bfs_tpu.analysis ir` == `--ir`; a justified baseline
+    entry turns the same fixture run green."""
+    from bfs_tpu.analysis import __main__ as cli
+    from bfs_tpu.analysis import ir as ir_mod
+
+    specs = _fixture_specs()
+    monkeypatch.setattr(ir_mod, "PROGRAM_SPECS", {"IR001": specs["IR001"]})
+    [finding] = analyze_program(specs["IR001"]())
+    bl = tmp_path / "baseline.txt"
+    bl.write_text(
+        f"{finding.rule}  {finding.fingerprint()}  fixture: accepted\n"
+    )
+    rc = cli.main(["ir", "--no-cache", "--baseline", str(bl)])
+    out = capsys.readouterr()
+    assert rc == 0, out.out + out.err
+
+
+def test_cli_ir_rejects_scoping_flags(capsys):
+    """--ir always runs the whole registry; silently dropping a path or
+    --changed scope would report a result the user never asked for."""
+    from bfs_tpu.analysis import __main__ as cli
+
+    for argv in (["--ir", "--changed"], ["--ir", "some/file.py"]):
+        rc = cli.main(argv)
+        out = capsys.readouterr()
+        assert rc == 2, (argv, out.out, out.err)
+        assert "cannot be scoped" in out.err
+
+
+def test_ir_finding_fingerprint_is_line_drift_proof():
+    [f] = analyze_program(_step_like(donate=False))
+    # Fingerprints hash (rule, path, ir:<program>:<detail>) — no line
+    # numbers involved, so source drift can never invalidate an entry.
+    assert f.snippet.startswith("ir:fx.step:donate:")
+    assert f.line == 0

@@ -1,0 +1,111 @@
+"""Edge-case coverage for tools/ledger_compare.py (ISSUE 8 satellite):
+a phase missing from one capture, ``--exact`` on captures without
+selected arms, and the non-zero exit codes — all asserted in-process
+(the tool is stdlib-only; its ``main`` returns the exit code)."""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def lc():
+    spec = importlib.util.spec_from_file_location(
+        "ledger_compare", os.path.join(REPO, "tools", "ledger_compare.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _write_ledger(path, phases, schedule=None):
+    """Raw ledger JSON (the `python -m bfs_tpu.profiling` shape), or a
+    bench headline when ``schedule`` is given."""
+    ledger = {"phases": {k: {"seconds": v} for k, v in phases.items()}}
+    if schedule is not None:
+        doc = {"details": {"superstep_phases": ledger,
+                           "direction_schedule": {"schedule": schedule}}}
+    else:
+        doc = ledger
+    path.write_text(json.dumps(doc))
+    return str(path)
+
+
+def _run(lc, monkeypatch, argv):
+    monkeypatch.setattr(sys, "argv", ["ledger_compare.py", *argv])
+    return lc.main()
+
+
+def test_missing_phase_tolerated_without_exact(lc, tmp_path, monkeypatch,
+                                               capsys):
+    before = _write_ledger(tmp_path / "b.json",
+                           {"vperm": 1e-3, "rowmin": 2e-3})
+    after = _write_ledger(tmp_path / "a.json", {"vperm": 1e-3})
+    rc = _run(lc, monkeypatch, [before, after])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "rowmin" in out and "—" in out  # shown as absent, not a crash
+
+
+def test_missing_phase_fails_exact(lc, tmp_path, monkeypatch, capsys):
+    before = _write_ledger(tmp_path / "b.json",
+                           {"vperm": 1e-3, "rowmin": 2e-3})
+    after = _write_ledger(tmp_path / "a.json", {"vperm": 1e-3})
+    rc = _run(lc, monkeypatch, [before, after, "--exact"])
+    err = capsys.readouterr().err
+    assert rc == 2
+    assert "rowmin" in err
+
+
+def test_exact_without_arms_or_schedule_passes(lc, tmp_path, monkeypatch,
+                                               capsys):
+    # Captures with no `selected` arm annotations and no direction
+    # schedule (pre-ISSUE-7 ledgers): --exact must compare what exists
+    # and pass on bit-identical phases.
+    phases = {"vperm": 1.25e-3, "net_apply": 3.5e-3}
+    before = _write_ledger(tmp_path / "b.json", phases)
+    after = _write_ledger(tmp_path / "a.json", phases)
+    rc = _run(lc, monkeypatch, [before, after, "--exact"])
+    captured = capsys.readouterr()
+    assert rc == 0
+    assert "exact match" in captured.err
+    assert "selected arms" not in captured.err
+
+
+def test_exact_catches_schedule_divergence(lc, tmp_path, monkeypatch,
+                                           capsys):
+    phases = {"vperm": 1e-3}
+    before = _write_ledger(tmp_path / "b.json", phases,
+                           schedule=["push", "pull"])
+    after = _write_ledger(tmp_path / "a.json", phases,
+                          schedule=["pull", "pull"])
+    rc = _run(lc, monkeypatch, [before, after, "--exact"])
+    assert rc == 2
+    assert "direction_schedule" in capsys.readouterr().err
+
+
+def test_regression_over_threshold_exits_nonzero(lc, tmp_path, monkeypatch,
+                                                 capsys):
+    before = _write_ledger(tmp_path / "b.json", {"net_apply": 1e-3})
+    after = _write_ledger(tmp_path / "a.json", {"net_apply": 2e-3})
+    rc = _run(lc, monkeypatch, [before, after])  # default 25% threshold
+    assert rc == 2
+    assert "REGRESSION" in capsys.readouterr().err
+    # The same delta under a generous threshold passes.
+    rc = _run(lc, monkeypatch, [before, after, "--threshold", "2.0"])
+    assert rc == 0
+
+
+def test_unparseable_capture_raises_systemexit(lc, tmp_path, monkeypatch):
+    bad = tmp_path / "bad.json"
+    bad.write_text("not json at all\nstill not json\n")
+    good = _write_ledger(tmp_path / "g.json", {"vperm": 1e-3})
+    with pytest.raises(SystemExit):
+        _run(lc, monkeypatch, [str(bad), good])
