@@ -25,6 +25,7 @@ reproduction's equivalent, split by failure mode:
 from .faults import FaultInjected, corrupt_file, fault_point, fault_spec
 from .journal import RunJournal, config_key
 from .retry import (
+    CircuitBreaker,
     PermanentError,
     RetryError,
     RetryPolicy,
@@ -34,6 +35,7 @@ from .retry import (
 )
 
 __all__ = [
+    "CircuitBreaker",
     "FaultInjected",
     "PermanentError",
     "RetryError",

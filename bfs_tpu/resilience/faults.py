@@ -13,13 +13,28 @@ set:
                                         exception path / SIGTERM-ish exits)
     BFS_TPU_FAULT=phase:<phase>[:nth]   alias for kill: (the spelling the
                                         issue tracker uses)
+    BFS_TPU_FAULT=delay:<phase>[:secs]  sleep ``secs`` (default 1.0) at
+                                        EVERY arrival — the hung-call
+                                        shape the serve watchdog exists
+                                        for (a wedged XLA dispatch looks
+                                        exactly like a sleep)
 
 ``nth`` (default 1) selects the nth arrival at that phase — so
 ``kill:repeat:2`` dies after the second timed repeat.  Per-item boundaries
 are named ``family:<item>`` (``repeat:0``, ``verify:17``) and a spec phase
 matches either the exact boundary name or the family prefix, so
 ``kill:verify:3`` means "the third verification boundary" without the
-caller knowing which root id that is.
+caller knowing which root id that is.  ``delay`` takes SECONDS (a float)
+where the others take ``nth``, and fires on every matching arrival: a
+degraded transport stays degraded until the operator (or the chaos
+schedule) clears the env var.
+
+The serving path exposes two boundaries of its own: ``serve.batch`` fires
+inside every watchdog-guarded device batch call (so ``delay:serve.batch:2``
+wedges the tick and ``raise:serve.batch`` fails it permanently), and
+``serve.verify`` fires inside the sampled integrity check (where a
+``raise`` is interpreted as a FAILED verdict — the injected-corruption
+shape that exercises executable quarantine).
 
 The corruption injectors simulate the non-crash failure modes the journal
 and checkpoint layers must reject: truncation (a torn write) and byte
@@ -48,11 +63,12 @@ def reset() -> None:
         _counts.clear()
 
 
-def fault_spec(env: str | None = None) -> tuple[str, str, int] | None:
-    """Parse ``BFS_TPU_FAULT`` into ``(action, phase, nth)`` or None.
+def fault_spec(env: str | None = None) -> tuple[str, str, float] | None:
+    """Parse ``BFS_TPU_FAULT`` into ``(action, phase, arg)`` or None.
 
-    ``action`` is ``'kill'`` or ``'raise'``; the documented ``phase:``
-    prefix is an alias for ``kill``."""
+    ``action`` is ``'kill'``, ``'raise'`` or ``'delay'`` (the documented
+    ``phase:`` prefix is an alias for ``kill``); ``arg`` is the 1-based
+    nth-arrival count for kill/raise and the sleep SECONDS for delay."""
     spec = env if env is not None else os.environ.get("BFS_TPU_FAULT", "")
     spec = spec.strip()
     if not spec:
@@ -60,13 +76,24 @@ def fault_spec(env: str | None = None) -> tuple[str, str, int] | None:
     action, _, rest = spec.partition(":")
     if action == "phase":
         action = "kill"
-    if action not in ("kill", "raise") or not rest:
+    if action not in ("kill", "raise", "delay") or not rest:
         raise ValueError(
             f"bad BFS_TPU_FAULT {spec!r}; use "
             "kill:<phase>[:nth] | raise:<phase>[:nth] | phase:<phase>[:nth]"
+            " | delay:<phase>[:seconds]"
         )
-    phase, nth = rest, 1
     head, _, tail = rest.rpartition(":")
+    if action == "delay":
+        phase, seconds = rest, 1.0
+        # A positive trailing float is the sleep; anything else (including
+        # "0", mirroring the nth rule below) is part of the phase NAME.
+        try:
+            if head and float(tail) > 0:
+                phase, seconds = head, float(tail)
+        except ValueError:
+            pass
+        return action, phase, seconds
+    phase, nth = rest, 1
     # nth is 1-based; a trailing 0 (or any non-positive integer) is part
     # of the phase NAME, not a count — so ``kill:repeat:0`` targets the
     # exact boundary "repeat:0" (first arrival) rather than parsing as an
@@ -84,6 +111,14 @@ def fault_point(name: str) -> None:
         return
     action, phase, nth = spec
     if name != phase and not name.startswith(phase + ":"):
+        return
+    if action == "delay":
+        # Every matching arrival sleeps: a degraded transport does not
+        # recover after one slow call, and the serve watchdog must see a
+        # REPEATABLY wedged boundary to prove its breaker interplay.
+        import time
+
+        time.sleep(nth)  # nth carries seconds for delay specs
         return
     with _lock:
         _counts[phase] = _counts.get(phase, 0) + 1

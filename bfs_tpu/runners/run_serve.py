@@ -85,6 +85,12 @@ def make_server(args, metrics=None):
         retry_policy=dataclasses.replace(
             DEFAULT_RETRY_POLICY, max_attempts=max(1, args.retries)
         ),
+        # Self-healing knobs (ISSUE 9): breaker per compiled executable,
+        # hung-call watchdog, sampled on-device integrity checks.
+        breaker_failures=args.breaker_failures,
+        breaker_cooldown_s=args.breaker_cooldown_s,
+        watchdog_s=args.watchdog_s,
+        verify_sample=args.verify_sample,
     )
 
 
@@ -173,6 +179,21 @@ def main(argv=None) -> int:
     ap.add_argument("--retries", type=int, default=3,
                     help="max device-path attempts per batch before oracle "
                     "degradation (transient failures only; 1 = no retry)")
+    ap.add_argument("--breaker-failures", type=int, default=3,
+                    help="consecutive permanent failures per compiled "
+                    "executable before its circuit opens and ticks "
+                    "short-circuit to the degraded path")
+    ap.add_argument("--breaker-cooldown-s", type=float, default=5.0,
+                    help="seconds an open circuit waits before admitting "
+                    "the half-open canary batch")
+    ap.add_argument("--watchdog-s", type=float, default=60.0,
+                    help="hung-call watchdog default budget in seconds "
+                    "(p99-informed per executable once history exists; "
+                    "0 disables)")
+    ap.add_argument("--verify-sample", type=int, default=0,
+                    help="re-verify one answered root on device every Kth "
+                    "executed tick (~28-byte verdict pull; a failed verdict "
+                    "quarantines the executable; 0 disables)")
     ap.add_argument("--queries", type=int, default=64, help="demo query count")
     ap.add_argument("--multi-frac", type=float, default=0.25)
     ap.add_argument("--multi-width", type=int, default=4)

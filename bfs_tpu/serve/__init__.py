@@ -14,19 +14,25 @@ in steady state.
     reply = server.query("g", 0).result()
     reply.dist, reply.parent          # canonical min-parent BFS tree
 
-Components: :class:`GraphRegistry` (layout + residency),
-:class:`ExecutableCache` (compiled programs keyed by (graph, engine,
-batch shape)), :class:`BfsServer` (admission queue, micro-batching,
-deadlines, transient-failure retry with backoff
-(:mod:`bfs_tpu.resilience.retry`), result LRU, oracle degradation).
+Components: :class:`GraphRegistry` (epoch-versioned layouts + residency:
+re-registering a name hot-swaps the graph while in-flight queries finish
+on their admission-time snapshot), :class:`ExecutableCache` (compiled
+programs keyed by (graph, epoch, engine, batch shape, direction)),
+:class:`BfsServer` (admission queue, micro-batching, deadlines,
+transient-failure retry with backoff (:mod:`bfs_tpu.resilience.retry`),
+result LRU, oracle degradation), :class:`ServeHealth` (ISSUE 9: circuit
+breaker per executable, hung-call watchdog, sampled on-device integrity
+checks — the self-healing layer).
 """
 
 from .registry import ENGINES, GraphRegistry, RegisteredGraph
 from .executor import ExecutableCache, build_batch_runner, run_oracle_batch
+from .health import HungCallError, ServeHealth, run_with_deadline
 from .server import (
     DEFAULT_RETRY_POLICY,
     AdmissionError,
     BfsServer,
+    CircuitOpenError,
     QueryTimeout,
     ServeError,
     ServeReply,
@@ -43,8 +49,12 @@ __all__ = [
     "run_oracle_batch",
     "AdmissionError",
     "BfsServer",
+    "CircuitOpenError",
+    "HungCallError",
     "QueryTimeout",
     "ServeError",
+    "ServeHealth",
     "ServeReply",
     "ServerClosed",
+    "run_with_deadline",
 ]

@@ -3,10 +3,12 @@
 Steady-state serving must never recompile: the round-5 ledger puts the
 bench-scale compile at ~830 s, and even the CPU-mesh test programs cost
 hundreds of ms — per-tick compiles would dominate every latency percentile.
-The cache here is keyed ``(graph, engine, batch_shape)``: the server pads
-every tick's source batch to a power-of-two bucket so a handful of shapes
-cover any traffic mix, and after warmup every tick is a cache hit (the
-loadgen report asserts exactly this).
+The cache here is keyed ``(graph, epoch, engine, batch_shape, direction)``:
+the server pads every tick's source batch to a power-of-two bucket so a
+handful of shapes cover any traffic mix, and after warmup every tick is a
+cache hit (the loadgen report asserts exactly this).  The EPOCH element
+makes a hot graph swap safe — an executable built for one snapshot can
+never be asked to serve another.
 
 For the pull/push engines the runner is an AOT artifact
 (``jit(...).lower(...).compile()``): the executable takes the device
@@ -77,9 +79,28 @@ class ExecutableCache:
                 self._cache.popitem(last=False)
 
     def drop_graph(self, name: str) -> None:
+        """Drop every cached runner for ``name`` across ALL epochs (the
+        unregister path; epoch swaps leave old-epoch entries to age out
+        of the LRU — their epoch-bearing keys can never serve the new
+        graph)."""
         with self._lock:
             for key in [k for k in self._cache if k[0] == name]:
                 del self._cache[key]
+
+    def drop_key(self, key: tuple) -> None:
+        """Drop ONE cached runner — the quarantine path: a failed
+        integrity verdict proves this executable wrong, so the half-open
+        canary must rebuild it rather than re-probe the same artifact."""
+        with self._lock:
+            self._cache.pop(key, None)
+
+    def __contains__(self, key: tuple) -> bool:
+        """Presence probe WITHOUT touching LRU order or hit counters —
+        the server uses it to decide whether a tick is a cold build (and
+        therefore needs the compile-floor watchdog budget) before calling
+        :meth:`get` under the watchdog."""
+        with self._lock:
+            return key in self._cache
 
     def __len__(self) -> int:
         with self._lock:
@@ -124,17 +145,25 @@ def _state_to_result(state, sources: np.ndarray, num_vertices: int) -> MultiBfsR
     )
 
 
-def build_batch_runner(registry, name: str, engine: str, batch: int):
+def build_batch_runner(registry, name: str, engine: str, batch: int,
+                       epoch: int | None = None):
     """AOT-compile (or bind) the batched multi-source program for one
-    ``(graph, engine, batch)`` shape.  The returned callable maps a padded
-    int32[batch] source array to a host :class:`MultiBfsResult`."""
+    ``(graph epoch, engine, batch)`` shape.  The returned callable maps a
+    padded int32[batch] source array to a host :class:`MultiBfsResult`.
+
+    ``epoch`` pins the runner to one graph snapshot (default: the current
+    epoch at build time): every per-call ``acquire`` goes through
+    :meth:`GraphRegistry.acquire_epoch`, so a runner built before a hot
+    swap keeps executing against ITS graph — the executable and the
+    operands it runs over can never mix epochs."""
     import jax
     import jax.numpy as jnp
 
     from ..analysis.runtime import guarded_region
     from ..models.multisource import _bfs_multi_fused, _bfs_multi_pull_fused
 
-    rec = registry.get(name)
+    rec = registry.get(name) if epoch is None else registry.get_epoch(name, epoch)
+    epoch = rec.epoch
     v = rec.num_vertices
 
     # The per-tick source upload is EXPLICIT device_put, not an implicit
@@ -189,7 +218,7 @@ def build_batch_runner(registry, name: str, engine: str, batch: int):
         return call
 
     if engine == "pull":
-        ell0, folds = registry.acquire(name, engine)
+        ell0, folds = registry.acquire_epoch(name, epoch, engine)
         compiled = _packed_runner_pair(
             lambda p: _bfs_multi_pull_fused.lower(
                 ell0, folds, jnp.zeros((batch,), jnp.int32), v, v, p
@@ -200,8 +229,9 @@ def build_batch_runner(registry, name: str, engine: str, batch: int):
         def run(sources: np.ndarray) -> MultiBfsResult:
             # Re-acquire per call: eviction may have dropped the operands,
             # and acquire re-uploads same-shaped buffers the executable
-            # accepts unchanged.
-            ell0, folds = registry.acquire(name, engine)
+            # accepts unchanged.  Epoch-pinned: a hot swap between ticks
+            # must not hand this runner the NEW graph's operands.
+            ell0, folds = registry.acquire_epoch(name, epoch, engine)
             with guarded_region(f"serve.device_batch/{name}/pull"):
                 state = compiled(ell0, folds, jax.device_put(sources))  # bfs_tpu: ok TRC004 explicit per-tick source upload
             return _state_to_result(state, sources, v)
@@ -209,7 +239,7 @@ def build_batch_runner(registry, name: str, engine: str, batch: int):
         return run
 
     if engine == "push":
-        src, dst = registry.acquire(name, engine)
+        src, dst = registry.acquire_epoch(name, epoch, engine)
         compiled = _packed_runner_pair(
             lambda p: _bfs_multi_fused.lower(
                 src, dst, jnp.zeros((batch,), jnp.int32), v, v, p
@@ -218,7 +248,7 @@ def build_batch_runner(registry, name: str, engine: str, batch: int):
 
         # bfs_tpu: hot
         def run(sources: np.ndarray) -> MultiBfsResult:
-            src, dst = registry.acquire(name, engine)
+            src, dst = registry.acquire_epoch(name, epoch, engine)
             with guarded_region(f"serve.device_batch/{name}/push"):
                 state = compiled(src, dst, jax.device_put(sources))  # bfs_tpu: ok TRC004 explicit per-tick source upload
             return _state_to_result(state, sources, v)
@@ -227,7 +257,7 @@ def build_batch_runner(registry, name: str, engine: str, batch: int):
 
     if engine == "relay":
         def run(sources: np.ndarray) -> MultiBfsResult:
-            eng = registry.acquire(name, engine)
+            eng = registry.acquire_epoch(name, epoch, engine)
             if sources.shape[0] % 32 == 0:
                 # Element-major mode, 32 trees per uint32 element; falls
                 # back to the vmapped path automatically past 31 levels

@@ -50,6 +50,7 @@ import numpy as np
 from ..models.bfs import check_sources
 from ..models.multisource import MultiBfsResult, collapse_multi_source
 from ..obs.spans import span as obs_span
+from ..resilience.faults import fault_point
 from ..resilience.retry import RetryPolicy, retry_call
 from ..utils.metrics import QueryRecord, ServeMetrics
 from .executor import (
@@ -58,6 +59,7 @@ from .executor import (
     build_batch_runner,
     run_oracle_batch,
 )
+from .health import ServeHealth
 from .registry import ENGINES, GraphRegistry
 
 #: Default device-path retry shape: short delays (a serving tick is
@@ -82,6 +84,11 @@ class QueryTimeout(ServeError):
 
 class ServerClosed(ServeError):
     """The server was shut down before the request could be served."""
+
+
+class CircuitOpenError(ServeError):
+    """The executable's circuit is open and no degraded path exists (the
+    graph was registered layout-only, so there is no host oracle)."""
 
 
 @dataclass
@@ -109,6 +116,8 @@ class _Request:
     submitted_at: float
     deadline: float | None
     oracle: bool  # tiny-graph degradation decided at admission
+    rec: object = None  # pinned RegisteredGraph snapshot (epoch at admission)
+    pinned: bool = False  # pin outstanding; released once via _unpin
     cache_key: tuple | None = None
     record: QueryRecord = field(default_factory=QueryRecord)
 
@@ -140,6 +149,13 @@ class BfsServer:
         oracle_max_vertices: int = 0,
         metrics: ServeMetrics | None = None,
         retry_policy: RetryPolicy | None = None,
+        breaker_failures: int = 3,
+        breaker_cooldown_s: float = 5.0,
+        watchdog_s: float = 60.0,
+        watchdog_multiplier: float = 8.0,
+        watchdog_min_s: float = 1.0,
+        watchdog_compile_floor_s: float = 1200.0,
+        verify_sample: int = 0,
     ):
         if engine not in ENGINES:
             raise ValueError(f"unknown engine {engine!r}; use one of {ENGINES}")
@@ -160,6 +176,26 @@ class BfsServer:
             retry_policy if retry_policy is not None else DEFAULT_RETRY_POLICY
         )
         self.exe_cache = ExecutableCache(exe_cache_size, metrics=self.metrics)
+        # The self-healing authority (ISSUE 9): circuit breaker per
+        # compiled executable, hung-call watchdog, sampled on-device
+        # integrity checks.  One object so the device path consults one
+        # gate; all its state transitions land in self.metrics.
+        self._health = ServeHealth(  # immutable after init
+            metrics=self.metrics,
+            breaker_failures=breaker_failures,
+            breaker_cooldown_s=breaker_cooldown_s,
+            watchdog_s=watchdog_s,
+            watchdog_multiplier=watchdog_multiplier,
+            watchdog_min_s=watchdog_min_s,
+            compile_floor_s=watchdog_compile_floor_s,
+            verify_sample=verify_sample,
+        )
+        # Epoch-retirement hook: per-epoch breaker cells / latency windows
+        # / checkers die with the epoch's device state, so periodic hot
+        # swaps never grow health state (or the report payload) unboundedly.
+        # A LISTENER, not an attribute overwrite — servers sharing one
+        # registry each subscribe their own health; close() detaches.
+        self.registry.add_retire_listener(self._health.forget_epoch)
         # Direction policy resolved ONCE: a malformed BFS_TPU_DIRECTION /
         # alpha / beta knob fails server construction loudly instead of
         # raising inside every tick (which would silently degrade every
@@ -194,10 +230,15 @@ class BfsServer:
             self._cond.notify_all()
         self._thread.join(timeout=30)
         with self._cond:
-            while self._pending:
-                req = self._pending.popleft()
-                if req.future.set_running_or_notify_cancel():
-                    req.future.set_exception(ServerClosed("server closed"))
+            drained = list(self._pending)
+            self._pending.clear()
+        for req in drained:
+            if req.future.set_running_or_notify_cancel():
+                req.future.set_exception(ServerClosed("server closed"))
+            self._unpin(req)
+        # Detach the health hook: a shared registry outlives this server
+        # and must not call into its dead ServeHealth.
+        self.registry.remove_retire_listener(self._health.forget_epoch)
 
     def pause(self) -> None:
         """Hold batch formation (admission continues) — lets tests and
@@ -212,7 +253,14 @@ class BfsServer:
 
     # ----------------------------------------------------------- admission --
     def register(self, name: str, graph, **kw):
-        """Convenience passthrough to :meth:`GraphRegistry.register`."""
+        """Register — or HOT-SWAP — a graph.  Re-registering an existing
+        name creates a new epoch (see :meth:`GraphRegistry.register`):
+        queries admitted after this call see the new graph, in-flight
+        queries finish on the snapshot they were admitted under, and the
+        old epoch's device operands are released when its last in-flight
+        reference drops.  Executable and result caches need no purge —
+        their keys carry the epoch, so old entries can never serve the
+        new graph and age out of their LRUs naturally."""
         return self.registry.register(name, graph, **kw)
 
     def unregister(self, name: str) -> None:
@@ -262,59 +310,86 @@ class BfsServer:
         engine = engine or self.default_engine
         if engine not in ENGINES:
             raise ValueError(f"unknown engine {engine!r}; use one of {ENGINES}")
-        rec = self.registry.get(graph)
-        sources = np.atleast_1d(np.asarray(sources, dtype=np.int32))
-        if sources.ndim != 1:
-            raise ValueError("sources must be a scalar or 1-D sequence")
-        if mode == "single" and sources.shape[0] != 1:
-            raise ValueError("mode='single' takes exactly one source")
-        check_sources(rec.num_vertices, sources)
-        now = time.monotonic()
-        future: Future = Future()
-        oracle = (
-            rec.graph is not None
-            and rec.num_vertices <= self.oracle_max_vertices
-        )
-        req = _Request(
-            graph=graph,
-            engine=engine,
-            mode=mode,
-            sources=sources,
-            future=future,
-            submitted_at=now,
-            deadline=(now + float(timeout_s)) if timeout_s is not None else None,
-            oracle=oracle,
-        )
-        req.cache_key = (graph, engine, mode, tuple(sources.tolist()))
-        cached = self._result_cache_get(req.cache_key)
-        if cached is not None:
-            dist, parent, num_levels = cached
-            self.metrics.bump("result_cache_hits")
-            rec_q = QueryRecord(
+        # Pin the CURRENT epoch at admission: this is the snapshot the
+        # caller observed, and the pin is what keeps it alive (layouts +
+        # device operands) through a hot swap until the reply lands.
+        rec = self.registry.pin(graph)
+        req: _Request | None = None
+        try:
+            sources = np.atleast_1d(np.asarray(sources, dtype=np.int32))
+            if sources.ndim != 1:
+                raise ValueError("sources must be a scalar or 1-D sequence")
+            if mode == "single" and sources.shape[0] != 1:
+                raise ValueError("mode='single' takes exactly one source")
+            check_sources(rec.num_vertices, sources)
+            now = time.monotonic()
+            future: Future = Future()
+            oracle = (
+                rec.graph is not None
+                and rec.num_vertices <= self.oracle_max_vertices
+            )
+            req = _Request(
                 graph=graph,
                 engine=engine,
-                status="result_cache",
-                num_sources=int(sources.shape[0]),
-                result_cache_hit=True,
+                mode=mode,
+                sources=sources,
+                future=future,
+                submitted_at=now,
+                deadline=(now + float(timeout_s)) if timeout_s is not None else None,
+                oracle=oracle,
+                rec=rec,
+                pinned=True,
             )
-            self.metrics.record_query(rec_q, ts=time.monotonic())
-            future.set_result(
-                ServeReply(graph, engine, mode, sources, dist, parent,
-                           num_levels, rec_q)
+            req.cache_key = (
+                graph, rec.epoch, engine, mode, tuple(sources.tolist())
             )
-            return future
-        self.metrics.bump("result_cache_misses")
-        with self._cond:
-            if self._closed:
-                raise ServerClosed("server is closed")
-            if len(self._pending) >= self.queue_depth:
-                self.metrics.bump("rejected")
-                raise AdmissionError(
-                    f"admission queue full ({self.queue_depth} pending)"
+            cached = self._result_cache_get(req.cache_key)
+            if cached is not None:
+                dist, parent, num_levels = cached
+                self.metrics.bump("result_cache_hits")
+                rec_q = QueryRecord(
+                    graph=graph,
+                    engine=engine,
+                    status="result_cache",
+                    epoch=rec.epoch,
+                    num_sources=int(sources.shape[0]),
+                    result_cache_hit=True,
                 )
-            self._pending.append(req)
-            self._cond.notify_all()
+                self.metrics.record_query(rec_q, ts=time.monotonic())
+                future.set_result(
+                    ServeReply(graph, engine, mode, sources, dist, parent,
+                               num_levels, rec_q)
+                )
+                self._unpin(req)
+                return future
+            self.metrics.bump("result_cache_misses")
+            with self._cond:
+                if self._closed:
+                    raise ServerClosed("server is closed")
+                if len(self._pending) >= self.queue_depth:
+                    self.metrics.bump("rejected")
+                    raise AdmissionError(
+                        f"admission queue full ({self.queue_depth} pending)"
+                    )
+                self._pending.append(req)
+                self._cond.notify_all()
+        except BaseException:
+            # Rejected/invalid requests never reached the queue: balance
+            # the admission pin before the error propagates.
+            if req is not None:
+                self._unpin(req)
+            else:
+                self.registry.unpin(rec)
+            raise
         return future
+
+    def _unpin(self, req: _Request) -> None:
+        """Release a request's epoch pin exactly once (every completion
+        path — reply, timeout, cancel, close, batch failure — funnels
+        through here; idempotent so overlapping paths are safe)."""
+        if req.pinned:
+            req.pinned = False
+            self.registry.unpin(req.rec)
 
     # --------------------------------------------------------- result cache --
     def _result_cache_get(self, key):
@@ -353,7 +428,8 @@ class BfsServer:
                 while self._pending:
                     req = self._pending.popleft()
                     compatible = (
-                        req.graph == first.graph
+                        req.rec is first.rec  # same graph AND same epoch:
+                        # a batch never mixes snapshots across a hot swap
                         and req.engine == first.engine
                         and req.oracle == first.oracle
                         and req.sources.shape[0] <= budget
@@ -381,6 +457,13 @@ class BfsServer:
                 for req in batch:
                     if not req.future.done():
                         req.future.set_exception(exc)
+            finally:
+                # Every request that entered a tick releases its epoch pin
+                # here, whatever path it took (reply, timeout, cancel,
+                # batch failure) — _unpin is idempotent, and this is the
+                # hook that lets a swapped-out epoch free its HBM.
+                for req in batch:
+                    self._unpin(req)
 
     def _execute_batch(self, batch: list[_Request]) -> None:
         formed_at = time.monotonic()
@@ -398,47 +481,98 @@ class BfsServer:
         first = live[0]
         all_sources = np.concatenate([r.sources for r in live])
         padded = bucket_for(all_sources.shape[0])
-        rec = self.registry.get(first.graph)
+        # The batch executes against the epoch its requests were ADMITTED
+        # under (every req in a batch shares one pinned rec — the coalescer
+        # requires it): a hot swap between admission and execution must not
+        # change the answer.
+        rec = first.rec
+        # One circuit per compiled executable; the exe key adds the
+        # direction policy because that is a compile-time input, not a
+        # health property.
+        circuit_key = (first.graph, rec.epoch, first.engine, padded)
+        exe_key = (
+            first.graph, rec.epoch, first.engine, padded,
+            self._direction_key,
+        )
         compile_hit: bool | None = None
         status = "ok"
+        device_attempted = False
         t0 = time.monotonic()
+
+        def _oracle_tick():
+            # The sequential fallback, shared by every degraded path.
+            # Padding exists only for compiled-shape stability; the
+            # sequential path runs the real sources, nothing more.
+            self.metrics.bump("oracle_served")
+            return run_oracle_batch(rec.graph, all_sources), "oracle", \
+                all_sources.shape[0]
+
         try:
             if first.oracle:
-                # Padding exists only for compiled-shape stability; the
-                # sequential path runs the real sources, nothing more.
-                result = run_oracle_batch(rec.graph, all_sources)
-                status = "oracle"
-                padded = all_sources.shape[0]
-                self.metrics.bump("oracle_served")
+                result, status, padded = _oracle_tick()
+            elif not self._health.allow(circuit_key):
+                # Circuit open: this executable failed permanently
+                # ``breaker_failures`` ticks in a row (or was quarantined
+                # by a failed integrity verdict).  Short-circuit straight
+                # to the degraded path — no retry loop, no watchdog wait —
+                # until the cooldown admits a canary.
+                self.metrics.bump("breaker_short_circuits")
+                if rec.graph is None:
+                    raise CircuitOpenError(
+                        f"circuit open for {circuit_key} and graph "
+                        f"{first.graph!r} was registered layout-only — no "
+                        "host oracle to degrade to"
+                    )
+                result, status, padded = _oracle_tick()
             else:
                 sources_padded = np.concatenate(
                     [all_sources,
                      np.full(padded - all_sources.shape[0], all_sources[0],
                              dtype=np.int32)]
                 )
+                deadlines = [r.deadline for r in live if r.deadline is not None]
 
                 def _device_tick():
-                    nonlocal compile_hit
-                    # The direction policy (resolved ONCE at server init —
-                    # a malformed knob fails construction, never a tick)
-                    # is part of the executable key (ISSUE 7): today the
-                    # relay batch runner reads the same env at build, so
-                    # the key keeps a stale-program reuse impossible when
-                    # the knob changes across server restarts; when the
-                    # batch programs grow in-program switching the key is
-                    # already right.  Auto-switching itself is an
-                    # IN-program lax.cond — steady-state ticks never
-                    # retrace however often the schedule flips direction.
-                    runner, compile_hit = self.exe_cache.get(
-                        (
-                            first.graph, first.engine, padded,
-                            self._direction_key,
-                        ),
-                        lambda: build_batch_runner(
-                            self.registry, first.graph, first.engine, padded
-                        ),
+                    def _guarded():
+                        nonlocal compile_hit
+                        # The direction policy (resolved ONCE at server
+                        # init — a malformed knob fails construction,
+                        # never a tick) is part of the executable key
+                        # (ISSUE 7): today the relay batch runner reads
+                        # the same env at build, so the key keeps a
+                        # stale-program reuse impossible when the knob
+                        # changes across server restarts; when the batch
+                        # programs grow in-program switching the key is
+                        # already right.  Auto-switching itself is an
+                        # IN-program lax.cond — steady-state ticks never
+                        # retrace however often the schedule flips
+                        # direction.
+                        runner, compile_hit = self.exe_cache.get(
+                            exe_key,
+                            lambda: build_batch_runner(
+                                self.registry, first.graph, first.engine,
+                                padded, epoch=rec.epoch,
+                            ),
+                        )
+                        # ``raise:serve.batch`` = a classified-permanent
+                        # device fault; ``delay:serve.batch:N`` = a wedged
+                        # XLA call the watchdog must catch.
+                        fault_point("serve.batch")
+                        return runner(sources_padded)
+
+                    # The watchdog deadline is p99-informed per circuit
+                    # key and tightened by the batch's earliest request
+                    # deadline — a wedged call times out (HungCallError,
+                    # permanent) instead of freezing the serve thread.
+                    # The BUILD runs inside the guarded call too: a wedged
+                    # compile must degrade the tick, not freeze the loop —
+                    # a cold tick's budget is floored at compile_floor_s
+                    # so an honest minutes-long compile never trips it.
+                    return self._health.run_guarded(
+                        circuit_key, _guarded, deadlines,
+                        describe=f"device batch ({first.graph}/{first.engine})",
+                        cold=exe_key not in self.exe_cache,
                     )
-                    return runner(sources_padded)
 
                 retried = {"n": 0}
 
@@ -451,7 +585,7 @@ class BfsServer:
                 # previously one flake degraded the whole tick.  Bounded by
                 # the batch's earliest deadline: a tick with 50 ms left
                 # must not sleep 500 ms to find out.
-                deadlines = [r.deadline for r in live if r.deadline is not None]
+                device_attempted = True
                 result = retry_call(
                     _device_tick,
                     policy=self.retry_policy,
@@ -463,16 +597,48 @@ class BfsServer:
                 )
                 if retried["n"]:
                     self.metrics.bump("device_retry_successes")
-        except Exception:
+                self._health.record_success(circuit_key)
+                # Sampled production integrity check: every Kth executed
+                # device tick re-verifies one answered root on device
+                # (~28-byte verdict pull).  A failed verdict is proof the
+                # executable is wrong — quarantine it (force-open the
+                # circuit AND drop the cached runner so the half-open
+                # canary rebuilds rather than re-probes the same artifact)
+                # and re-run this batch on the fallback path.
+                verdict = self._health.maybe_verify(rec, result, all_sources)
+                if verdict is not None:
+                    # maybe_verify only samples when rec.graph is present,
+                    # so the oracle re-run below always has a host graph.
+                    self._health.quarantine(
+                        circuit_key, f"integrity verdict {verdict}"
+                    )
+                    self.exe_cache.drop_key(exe_key)
+                    # A proven-wrong executable may already have fed the
+                    # result LRU on unsampled ticks (verify_sample > 1):
+                    # purge this graph epoch's cached answers too, or the
+                    # quarantine serves known-bad results as cache hits.
+                    with self._lock:
+                        for k in [
+                            k for k in self._result_cache
+                            if k[0] == first.graph and k[1] == rec.epoch
+                        ]:
+                            del self._result_cache[k]
+                    result, status, padded = _oracle_tick()
+                    compile_hit = None
+        except Exception as exc:
+            if device_attempted:
+                # Permanent failure or exhausted transient retries: one
+                # more consecutive strike against this executable (after
+                # ``breaker_failures`` of them the circuit opens and later
+                # ticks skip straight to the degraded path).
+                self._health.record_failure(circuit_key, repr(exc))
             if rec.graph is None:
                 raise
             # Device path failed permanently (OOM, lowering, a real bug) or
             # exhausted its transient retries: degrade to the sequential
             # oracle EXACTLY ONCE rather than failing the whole tick.
             self.metrics.bump("device_errors")
-            result = run_oracle_batch(rec.graph, all_sources)
-            status = "oracle"
-            padded = all_sources.shape[0]
+            result, status, padded = _oracle_tick()
             compile_hit = None
         service_s = time.monotonic() - t0
         self.metrics.bump("batches")
@@ -499,6 +665,7 @@ class BfsServer:
                 graph=req.graph,
                 engine=req.engine,
                 status=status,
+                epoch=rec.epoch,
                 num_sources=s,
                 batch_size=padded,
                 supersteps=result.num_levels,
@@ -536,12 +703,27 @@ class BfsServer:
     # -------------------------------------------------------------- reports --
     def report(self) -> dict:
         out = self.metrics.report()
+        epochs = {}
+        for n in self.registry.names():
+            # names() and epoch() are two lock acquisitions: a concurrent
+            # unregister between them must shrink the snapshot, not crash
+            # the monitoring caller.
+            try:
+                epochs[n] = self.registry.epoch(n)
+            except KeyError:
+                continue
         out["registry"] = {
-            "graphs": self.registry.names(),
+            "graphs": list(epochs),
+            "epochs": epochs,
             "resident_bytes": self.registry.resident_bytes(),
             "resident": [list(k) for k in self.registry.resident_keys()],
             "evictions": self.registry.evictions,
+            "evictions_deferred": self.registry.evictions_deferred,
             "budget_bytes": self.registry.device_budget_bytes,
         }
         out["executables_cached"] = len(self.exe_cache)
+        # Breaker snapshot (per-circuit state/failures/open-for) + watchdog
+        # budgets + integrity sampling state — the self-healing view the
+        # chaos driver asserts its transitions against.
+        out["health"] = self._health.report()
         return out

@@ -129,6 +129,7 @@ class QueryRecord:
     graph: str = ""
     engine: str = ""
     status: str = "ok"
+    epoch: int = 0  # graph epoch the answer was computed against (ISSUE 9)
     num_sources: int = 1
     batch_size: int = 0  # padded device batch the request rode in
     supersteps: int = 0

@@ -1,0 +1,73 @@
+"""Chaos-schedule gates for the self-healing serve layer (ISSUE 9).
+
+``tools/chaos_run.py --mode serve`` is the acceptance harness: injected
+permanent device faults, hung-call delays, a corrupt on-device answer,
+and a mid-load epoch swap, with every reply oracle-checked and every
+breaker/watchdog/integrity/epoch transition asserted in the final
+metrics snapshot.  The tier-1 smoke here runs a scaled-down schedule
+IN-PROCESS (jax is already warm in the test session); the full-size
+schedule runs the real CLI in a subprocess and is marked ``slow``.
+"""
+
+import os
+import subprocess
+import sys
+import types
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _chaos_run():
+    sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+    try:
+        import chaos_run
+    finally:
+        sys.path.pop(0)
+    return chaos_run
+
+
+@pytest.mark.chaos
+def test_chaos_serve_smoke():
+    """The whole self-healing schedule — breaker open/half-open/close,
+    watchdog-degraded hung ticks, integrity quarantine, epoch swap with
+    in-flight old-snapshot answers — at tier-1 size.  chaos_serve returns
+    non-zero on any wrong answer, frozen tick, or missing transition."""
+    chaos_run = _chaos_run()
+    args = types.SimpleNamespace(
+        scale=7,
+        edge_factor=4,
+        seed=3,
+        serve_engine="pull",
+        serve_requests=4,
+        serve_cooldown_s=0.3,
+        serve_delay_s=1.5,
+        serve_tick_timeout=120.0,
+    )
+    import random
+
+    assert chaos_run.chaos_serve(args, random.Random(3)) == 0
+    # The schedule restores the fault boundary on every path.
+    assert "BFS_TPU_FAULT" not in os.environ
+
+
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_chaos_serve_full_schedule():
+    """The real CLI, full-size schedule, fresh process (cold jax, env-var
+    fault transport end to end)."""
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO_ROOT, "tools", "chaos_run.py"),
+            "--mode", "serve", "--scale", "9", "--seed", "1",
+            "--serve-requests", "12",
+        ],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=900,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, (
+        f"serve chaos failed rc={proc.returncode}\n"
+        f"stdout:\n{proc.stdout[-4000:]}\nstderr:\n{proc.stderr[-4000:]}"
+    )
+    assert "serve chaos: ok" in proc.stdout

@@ -190,6 +190,28 @@ def test_fault_spec_parsing():
         fault_spec("explode:reference")
     with pytest.raises(ValueError):
         fault_spec("kill:")
+    # delay: takes SECONDS (a float) where kill/raise take nth; a
+    # non-positive or non-numeric tail is part of the phase NAME.
+    assert fault_spec("delay:serve.batch") == ("delay", "serve.batch", 1.0)
+    assert fault_spec("delay:serve.batch:2.5") == ("delay", "serve.batch", 2.5)
+    assert fault_spec("delay:repeat:0") == ("delay", "repeat:0", 1.0)
+    with pytest.raises(ValueError):
+        fault_spec("delay:")
+
+
+def test_fault_point_delay_sleeps_every_arrival(monkeypatch):
+    import time as _time
+
+    monkeypatch.setenv("BFS_TPU_FAULT", "delay:serve.batch:0.05")
+    reset()
+    t0 = _time.monotonic()
+    fault_point("serve.batch")
+    fault_point("serve.batch")  # EVERY arrival sleeps, not just the nth
+    assert _time.monotonic() - t0 >= 0.1
+    t0 = _time.monotonic()
+    fault_point("serve.verify")  # other boundaries unaffected
+    assert _time.monotonic() - t0 < 0.05
+    reset()
 
 
 def test_fault_point_raise_nth(monkeypatch):
@@ -283,6 +305,107 @@ def test_retry_respects_deadline():
     # Bounded by the deadline, not the 100 attempts.
     assert _time.monotonic() - t0 < 2.0
     assert calls["n"] < 100
+
+
+def test_retry_jitter_stays_within_cap():
+    import random
+
+    policy = RetryPolicy(
+        max_attempts=8, base_delay_s=0.05, max_delay_s=0.4, multiplier=2.0,
+        jitter=0.5,
+    )
+    rng = random.Random(123)
+    for attempt in range(1, 20):
+        base = min(0.05 * 2.0 ** (attempt - 1), 0.4)
+        for _ in range(50):
+            d = policy.delay(attempt, rng)
+            # Jitter is multiplicative ABOVE the backoff value: never
+            # below the deterministic delay, never past the (1 + jitter)
+            # factor over the capped exponential.
+            assert base <= d <= base * 1.5 + 1e-12
+
+
+def test_retry_delay_sleeps_never_exceed_deadline():
+    """The retry loop's SLEEPS are clipped to the remaining deadline: a
+    serving tick with 120 ms left must not sleep a full 500 ms backoff to
+    find out the device is still down."""
+    import time as _time
+
+    slept = []  # (requested seconds, remaining deadline when requested)
+    real_sleep = _time.sleep
+    deadline_s = 0.12
+    t0 = _time.monotonic()
+
+    def spy_sleep(s):
+        slept.append((s, deadline_s - (_time.monotonic() - t0)))
+        real_sleep(min(s, 0.01))  # keep the test fast; bound is on args
+
+    with pytest.raises(RetryError):
+        with pytest.MonkeyPatch.context() as mp:
+            mp.setattr("bfs_tpu.resilience.retry.time.sleep", spy_sleep)
+
+            def always():
+                raise TransientError("down")
+
+            retry_call(
+                always,
+                policy=RetryPolicy(
+                    max_attempts=100, base_delay_s=0.5, max_delay_s=2.0,
+                    jitter=0.5,
+                ),
+                deadline_s=deadline_s,
+            )
+    assert slept, "a transient failure with attempts left must back off"
+    # Every requested sleep was clipped to the wall clock REMAINING on the
+    # deadline when it was computed — the full 0.5 s+ backoff never made
+    # it through with only 0.12 s of budget.  The spy re-reads the clock
+    # AFTER retry_call computed the clip, so a few ms of scheduler /
+    # on_retry overhead sits between the two reads on a contended box —
+    # the tolerance absorbs that without letting a full backoff through.
+    for s, remaining in slept:
+        assert s <= max(remaining, 0) + 0.05
+        assert s <= deadline_s
+
+
+def test_retry_policy_deadline_tighter_of_two():
+    """retry_call takes the TIGHTER of policy.deadline_s and the explicit
+    deadline_s argument (a request deadline must never be outlived by a
+    generous policy default, and vice versa)."""
+    import time as _time
+
+    for policy_deadline, call_deadline in ((5.0, 0.1), (0.1, 5.0)):
+        calls = {"n": 0}
+
+        def always():
+            calls["n"] += 1
+            raise TransientError("down")
+
+        t0 = _time.monotonic()
+        with pytest.raises(RetryError):
+            retry_call(
+                always,
+                policy=RetryPolicy(
+                    max_attempts=1000, base_delay_s=0.02, jitter=0.0,
+                    deadline_s=policy_deadline,
+                ),
+                deadline_s=call_deadline,
+            )
+        assert _time.monotonic() - t0 < 1.0  # bounded by the 0.1 s limit
+        assert calls["n"] < 1000
+
+
+def test_default_classify_unknown_exception_is_permanent():
+    """An exception type AND message the classifier has never heard of
+    defaults to permanent — an unknown failure repeated is two failures,
+    not a recovery strategy."""
+
+    class WeirdVendorError(Exception):
+        pass
+
+    assert default_classify(WeirdVendorError("status 0x7f")) == "permanent"
+    assert default_classify(ArithmeticError("div")) == "permanent"
+    # ...unless the unknown type's MESSAGE carries a transient marker.
+    assert default_classify(WeirdVendorError("socket closed")) == "transient"
 
 
 def test_default_classify():

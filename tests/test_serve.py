@@ -157,7 +157,7 @@ def test_second_graph_evicts_first_under_capped_budget(served_graph):
         rb = srv.query("b", 0).result(TIMEOUT)
         # B displaced A via drop_device_operands (asserted on the memo).
         assert getattr(pg_a, "_device_ell", None) is None
-        assert registry.resident_keys() == [("b", "pull")]
+        assert registry.resident_keys() == [("b", 0, "pull")]
         assert registry.evictions == 1
         # A still serves correctly after re-upload, reusing its compiled
         # executable (operands are arguments, not baked-in constants).

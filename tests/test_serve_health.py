@@ -1,0 +1,739 @@
+"""Self-healing serve tests (ISSUE 9): circuit-breaker state machine,
+hung-call watchdog, sampled on-device integrity checks, epoch-versioned
+hot graph swaps — unit level (fake clocks, injected runners through the
+real ``ExecutableCache`` seam) plus server-level integration where the
+whole tick path (coalesce → breaker gate → watchdog → verify → fan-out)
+is the code under test.  Every served reply is still oracle-checked:
+self-healing must never change an answer, only where it was computed."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from bfs_tpu.graph.generators import gnm_graph
+from bfs_tpu.oracle.bfs import queue_bfs
+from bfs_tpu.resilience.retry import (
+    CircuitBreaker,
+    PermanentError,
+    RetryPolicy,
+)
+from bfs_tpu.serve import BfsServer, GraphRegistry, HungCallError
+from bfs_tpu.serve.executor import run_oracle_batch
+from bfs_tpu.serve.health import ServeHealth, run_with_deadline
+
+TIMEOUT = 300
+
+
+def _tick_key(graph, engine, padded, epoch=0):
+    from bfs_tpu.models.direction import resolve_direction
+
+    return (graph, epoch, engine, padded, resolve_direction().key())
+
+
+@pytest.fixture
+def graph():
+    return gnm_graph(60, 150, seed=7)
+
+
+def make_server(graph, **kw):
+    kw.setdefault(
+        "retry_policy", RetryPolicy(max_attempts=2, base_delay_s=0.0, jitter=0.0)
+    )
+    srv = BfsServer(engine="pull", max_batch=4, **kw)
+    srv.register("g", graph)
+    return srv
+
+
+# ------------------------------------------------------------------ breaker --
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+
+def test_breaker_opens_after_threshold_and_cools_down():
+    clock = FakeClock()
+    transitions = []
+    br = CircuitBreaker(
+        failure_threshold=3, cooldown_s=10.0, clock=clock,
+        on_transition=lambda k, old, new, why: transitions.append((k, old, new)),
+    )
+    key = ("g", 0, "pull", 4)
+    assert br.allow(key) and br.state(key) == "closed"
+    br.record_failure(key)
+    br.record_failure(key)
+    assert br.allow(key)  # two strikes: still closed
+    br.record_failure(key)
+    assert br.state(key) == "open"
+    assert not br.allow(key)  # short-circuit during cooldown
+    clock.t += 9.9
+    assert not br.allow(key)
+    clock.t += 0.2  # cooldown elapsed: next allow is the canary
+    assert br.state(key) == "half_open"
+    assert br.allow(key)
+    assert not br.allow(key)  # exactly ONE canary per probe window
+    br.record_success(key)
+    assert br.state(key) == "closed" and br.allow(key)
+    assert [(old, new) for _, old, new in transitions] == [
+        ("closed", "open"), ("open", "half_open"), ("half_open", "closed"),
+    ]
+
+
+def test_breaker_canary_failure_reopens():
+    clock = FakeClock()
+    br = CircuitBreaker(failure_threshold=1, cooldown_s=5.0, clock=clock)
+    br.record_failure("k", "boom")
+    clock.t += 5.1
+    assert br.allow("k")  # canary admitted
+    br.record_failure("k", "still broken")
+    assert br.state("k") == "open"
+    assert not br.allow("k")  # a FRESH cooldown from the canary failure
+    clock.t += 5.1
+    assert br.allow("k")
+    br.record_success("k")
+    assert br.state("k") == "closed"
+
+
+def test_breaker_force_open_is_immediate_quarantine():
+    clock = FakeClock()
+    br = CircuitBreaker(failure_threshold=99, cooldown_s=5.0, clock=clock)
+    assert br.allow("k")
+    br.force_open("k", "integrity verdict {'dist_gap': 1}")
+    assert br.state("k") == "open" and not br.allow("k")
+    snap = br.snapshot()
+    assert snap["k"]["state"] == "open"
+    assert "integrity" in snap["k"]["reason"]
+
+
+def test_breaker_forget_drops_matching_circuits():
+    br = CircuitBreaker(failure_threshold=1, cooldown_s=5.0, clock=FakeClock())
+    br.record_failure(("g", 0, "pull", 4))
+    br.record_failure(("g", 1, "pull", 4))
+    assert br.forget(lambda k: k[1] == 0) == 1
+    snap = br.snapshot()
+    assert "g/0/pull/4" not in snap and "g/1/pull/4" in snap
+    # A forgotten circuit restarts closed if the key ever comes back.
+    assert br.allow(("g", 0, "pull", 4))
+
+
+def test_breaker_success_resets_failure_streak():
+    br = CircuitBreaker(failure_threshold=2, cooldown_s=5.0, clock=FakeClock())
+    br.record_failure("k")
+    br.record_success("k")
+    br.record_failure("k")
+    assert br.state("k") == "closed"  # never two CONSECUTIVE failures
+
+
+def test_breaker_is_thread_safe_under_concurrent_hammering():
+    br = CircuitBreaker(failure_threshold=3, cooldown_s=0.0)
+    errs = []
+
+    def worker():
+        try:
+            for _ in range(200):
+                if br.allow("k"):
+                    br.record_failure("k")
+                else:
+                    br.record_success("k")
+        except Exception as exc:  # pragma: no cover
+            errs.append(exc)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert br.state("k") in ("closed", "open", "half_open")
+
+
+# ----------------------------------------------------------------- watchdog --
+
+
+def test_run_with_deadline_returns_value_and_propagates_errors():
+    assert run_with_deadline(lambda: 42, 5.0) == 42
+    with pytest.raises(ZeroDivisionError):
+        run_with_deadline(lambda: 1 / 0, 5.0)
+
+
+def test_run_with_deadline_times_out_a_wedged_call():
+    t0 = time.monotonic()
+    with pytest.raises(HungCallError):
+        run_with_deadline(lambda: time.sleep(5.0), 0.1, describe="wedge")
+    assert time.monotonic() - t0 < 2.0  # returned at the deadline, not 5 s
+
+
+def test_watchdog_budget_is_default_then_p99_informed():
+    from bfs_tpu.utils.metrics import ServeMetrics
+
+    h = ServeHealth(metrics=ServeMetrics(), watchdog_s=30.0,
+                    watchdog_multiplier=4.0, watchdog_min_s=0.5)
+    key = ("g", 0, "pull", 4)
+    assert h.budget_s(key) == 30.0  # no history: the configured default
+    for _ in range(ServeHealth.MIN_SAMPLES):
+        h.observe_latency(key, 0.01)
+    # multiplier x p99 = 0.04 floors at watchdog_min_s
+    assert h.budget_s(key) == 0.5
+    for _ in range(ServeHealth.MIN_SAMPLES):
+        h.observe_latency(key, 1.0)
+    assert h.budget_s(key) == pytest.approx(4.0)
+
+
+def test_watchdog_timeout_tightens_to_earliest_request_deadline():
+    from bfs_tpu.utils.metrics import ServeMetrics
+
+    h = ServeHealth(metrics=ServeMetrics(), watchdog_s=30.0, watchdog_min_s=0.5)
+    key = ("g", 0, "pull", 4)
+    now = time.monotonic()
+    # Earliest deadline 2 s out: timeout = remaining + grace, not 30 s.
+    t = h.timeout_for(key, [now + 2.0, now + 50.0], now=now)
+    assert t == pytest.approx(2.5, abs=0.01)
+    # Expired deadline: only the grace remains (never below min).
+    assert h.timeout_for(key, [now - 1.0], now=now) == 0.5
+    # Disabled watchdog: no timeout at all.
+    h2 = ServeHealth(metrics=ServeMetrics(), watchdog_s=0.0)
+    assert h2.timeout_for(key, [now + 2.0], now=now) is None
+
+
+def test_cold_tick_latency_stays_out_of_the_budget_window():
+    """A cold call's duration includes the AOT build; feeding it into the
+    p99 window would inflate the warm watchdog budget by ~multiplier ×
+    compile time for the next ~window of ticks."""
+    from bfs_tpu.utils.metrics import ServeMetrics
+
+    h = ServeHealth(metrics=ServeMetrics(), watchdog_s=5.0)
+    key = ("g", 0, "pull", 4)
+    h.run_guarded(key, lambda: time.sleep(0.05), [], cold=True)
+    assert h.report()["watchdog_budgets"] == {}
+    h.run_guarded(key, lambda: None, [], cold=False)
+    assert h.report()["watchdog_budgets"]["g/0/pull/4"]["samples"] == 1
+
+
+def test_hung_integrity_check_degrades_instead_of_freezing(
+    graph, monkeypatch
+):
+    """The sampled verify is device work on the serve thread: a wedge
+    inside the checker must land as check-couldn't-run under the
+    watchdog, not block every queue on every graph forever."""
+    from bfs_tpu.oracle.device import DeviceChecker
+
+    def wedged_check(self, *a, **kw):
+        time.sleep(5.0)
+        return {}
+
+    monkeypatch.setattr(DeviceChecker, "check", wedged_check)
+    with make_server(
+        graph, verify_sample=1, watchdog_s=0.3,
+        watchdog_compile_floor_s=0.4,
+    ) as srv:
+        t0 = time.monotonic()
+        reply = srv.query("g", 0).result(TIMEOUT)
+        assert time.monotonic() - t0 < 3.0, "serve loop froze in verify"
+        assert reply.record.status == "ok"  # the batch itself was fine
+        assert srv.metrics.count("integrity_check_errors") == 1
+        assert srv.metrics.count("integrity_failures") == 0
+
+
+def test_run_guarded_cold_floor_admits_an_honest_compile():
+    from bfs_tpu.utils.metrics import ServeMetrics
+
+    h = ServeHealth(
+        metrics=ServeMetrics(), watchdog_s=0.05, watchdog_min_s=0.01,
+        compile_floor_s=0.5,
+    )
+    key = ("g", 0, "pull", 4)
+    deadlines = [time.monotonic() + 0.02]
+    # Warm budget (0.05 s, deadline-tightened lower still) would kill a
+    # 0.15 s call...
+    with pytest.raises(HungCallError):
+        h.run_guarded(key, lambda: time.sleep(0.15) or "x", deadlines)
+    # ...but a COLD call (executable build included) is floored at
+    # compile_floor_s — an honest compile is never false-positived, and
+    # request deadlines do not tighten below the floor.
+    assert (
+        h.run_guarded(key, lambda: time.sleep(0.15) or "x", deadlines,
+                      cold=True)
+        == "x"
+    )
+    # A wedged compile still times out: the floor is finite.
+    with pytest.raises(HungCallError):
+        h.run_guarded(key, lambda: time.sleep(5.0), [], cold=True)
+
+
+def test_checker_cache_keeps_one_epoch_per_name():
+    """Each DeviceChecker pins its own device copy of the edge arrays
+    OUTSIDE the registry budget — inserting a current epoch's checker
+    must drop the same graph's other epochs."""
+    import types
+
+    from bfs_tpu.utils.metrics import ServeMetrics
+
+    g = gnm_graph(40, 90, seed=11)
+    h = ServeHealth(metrics=ServeMetrics(), verify_sample=1)
+    rec0 = types.SimpleNamespace(name="g", epoch=0, graph=g, retired=False)
+    h._checker(rec0)
+    assert list(h._checkers) == [("g", 0)]
+    rec1 = types.SimpleNamespace(name="g", epoch=1, graph=g, retired=False)
+    h._checker(rec1)
+    assert list(h._checkers) == [("g", 1)]
+    # A RETIRED epoch's checker (an in-flight batch straddling the swap)
+    # is transient: cached without evicting the current epoch's.
+    rec0.retired = True
+    h._checker(rec0)
+    assert set(h._checkers) == {("g", 0), ("g", 1)}
+
+
+# ------------------------------------------- server integration: breaker --
+
+
+class FailNThenGood:
+    """Raises PermanentError for the first ``fail_n`` calls, then serves
+    correct oracle results — the recovering-executable shape."""
+
+    def __init__(self, graph, fail_n):
+        self.graph = graph
+        self.fail_n = fail_n
+        self.calls = 0
+
+    def __call__(self, sources):
+        self.calls += 1
+        if self.calls <= self.fail_n:
+            raise PermanentError(f"poisoned executable (call {self.calls})")
+        return run_oracle_batch(self.graph, sources)
+
+
+def test_breaker_opens_short_circuits_then_canary_closes(graph):
+    with make_server(
+        graph, breaker_failures=2, breaker_cooldown_s=0.15, watchdog_s=0.0
+    ) as srv:
+        srv.exe_cache.put(_tick_key("g", "pull", 1), FailNThenGood(graph, 2))
+        # Two permanently failing ticks: each degrades to the oracle
+        # (correct answers) and strikes the circuit.  Distinct sources —
+        # a repeat would hit the result LRU and never reach the device.
+        for s in (0, 1):
+            reply = srv.query("g", s).result(TIMEOUT)
+            ds, _ = queue_bfs(graph, s)
+            assert reply.record.status == "oracle"
+            assert np.array_equal(reply.dist, ds)
+        assert srv.metrics.count("breaker_opened") == 1
+        # Circuit open: the next tick must short-circuit (no device call).
+        reply = srv.query("g", 2).result(TIMEOUT)
+        assert reply.record.status == "oracle"
+        assert srv.metrics.count("breaker_short_circuits") >= 1
+        # After the cooldown the canary tick goes back to the device path
+        # (the runner recovered) and the circuit closes.
+        time.sleep(0.2)
+        reply = srv.query("g", 3).result(TIMEOUT)
+        d3, _ = queue_bfs(graph, 3)
+        assert np.array_equal(reply.dist, d3)
+        assert reply.record.status == "ok"
+        assert srv.metrics.count("breaker_half_open") == 1
+        assert srv.metrics.count("breaker_closed") == 1
+        # Steady state again: device path, circuit closed.
+        reply = srv.query("g", 4).result(TIMEOUT)
+        assert reply.record.status == "ok"
+        snap = srv.report()["health"]["breaker"]
+        assert all(c["state"] == "closed" for c in snap.values())
+
+
+def test_transient_flakes_do_not_trip_the_breaker(graph):
+    from bfs_tpu.resilience.retry import TransientError
+
+    class Flaky:
+        def __init__(self):
+            self.calls = 0
+
+        def __call__(self, sources):
+            self.calls += 1
+            if self.calls % 2:
+                raise TransientError("tunnel hiccup")
+            return run_oracle_batch(graph, sources)
+
+    with make_server(graph, breaker_failures=1, watchdog_s=0.0) as srv:
+        srv.exe_cache.put(_tick_key("g", "pull", 1), Flaky())
+        for s in range(4):
+            reply = srv.query("g", s).result(TIMEOUT)
+            ds, _ = queue_bfs(graph, s)
+            assert np.array_equal(reply.dist, ds)
+        # Every tick flaked once and recovered within its retry loop: the
+        # breaker (threshold ONE) must never have opened.
+        assert srv.metrics.count("breaker_opened") == 0
+        assert srv.metrics.count("device_retries") >= 4
+
+
+# ------------------------------------------ server integration: watchdog --
+
+
+def test_hung_call_times_out_degrades_and_strikes_breaker(graph):
+    class Wedged:
+        def __init__(self):
+            self.calls = 0
+
+        def __call__(self, sources):
+            self.calls += 1
+            if self.calls == 1:
+                time.sleep(5.0)  # a wedged XLA dispatch
+            return run_oracle_batch(graph, sources)
+
+    with make_server(
+        graph, breaker_failures=2, watchdog_s=0.3, watchdog_min_s=0.05
+    ) as srv:
+        srv.exe_cache.put(_tick_key("g", "pull", 1), Wedged())
+        t0 = time.monotonic()
+        reply = srv.query("g", 0).result(TIMEOUT)
+        # The tick degraded to the oracle instead of freezing the server,
+        # and it did so around the watchdog budget, not the 5 s sleep.
+        assert time.monotonic() - t0 < 4.0
+        d0, _ = queue_bfs(graph, 0)
+        assert np.array_equal(reply.dist, d0)
+        assert reply.record.status == "oracle"
+        assert srv.metrics.count("watchdog_timeouts") == 1
+        # HungCallError is permanent: one breaker strike, no retry burn.
+        assert srv.metrics.count("device_retries") == 0
+        # The next tick is healthy (the wedge was one call).
+        reply = srv.query("g", 1).result(TIMEOUT)
+        assert reply.record.status == "ok"
+        assert srv.metrics.count("breaker_opened") == 0
+
+
+def test_injected_delay_fault_trips_watchdog(graph, monkeypatch):
+    """``BFS_TPU_FAULT=delay:serve.batch:5`` wedges the REAL device batch
+    call (no mock runner): the watchdog must catch it and the tick must
+    degrade with a correct answer."""
+    with make_server(graph, watchdog_s=0.3, watchdog_min_s=0.05) as srv:
+        srv.query("g", 5).result(TIMEOUT)  # compile outside the fault window
+        monkeypatch.setenv("BFS_TPU_FAULT", "delay:serve.batch:5")
+        t0 = time.monotonic()
+        reply = srv.query("g", 0).result(TIMEOUT)
+        assert time.monotonic() - t0 < 4.0
+        monkeypatch.delenv("BFS_TPU_FAULT")
+        d0, _ = queue_bfs(graph, 0)
+        assert np.array_equal(reply.dist, d0)
+        assert reply.record.status == "oracle"
+        assert srv.metrics.count("watchdog_timeouts") == 1
+
+
+def test_hung_build_times_out_instead_of_freezing_the_server(
+    graph, monkeypatch
+):
+    """The executable BUILD runs under the watchdog too (cold ticks get
+    the compile_floor_s budget): a wedged lower/compile must degrade the
+    tick like a wedged dispatch, not block the serve loop forever."""
+    import bfs_tpu.serve.server as server_mod
+
+    def wedged_build(*a, **kw):
+        time.sleep(5.0)
+        raise AssertionError("unreachable: the watchdog fires first")
+
+    monkeypatch.setattr(server_mod, "build_batch_runner", wedged_build)
+    with make_server(
+        graph, watchdog_s=0.2, watchdog_min_s=0.05,
+        watchdog_compile_floor_s=0.4,
+    ) as srv:
+        t0 = time.monotonic()
+        reply = srv.query("g", 0).result(TIMEOUT)
+        # Degraded around the 0.4 s cold floor, not the 5 s wedge.
+        assert time.monotonic() - t0 < 4.0
+        d0, _ = queue_bfs(graph, 0)
+        assert np.array_equal(reply.dist, d0)
+        assert reply.record.status == "oracle"
+        assert srv.metrics.count("watchdog_timeouts") == 1
+
+
+# ----------------------------------------- server integration: integrity --
+
+
+def test_sampled_integrity_check_passes_on_healthy_path(graph):
+    with make_server(graph, verify_sample=1, watchdog_s=0.0) as srv:
+        for s in range(3):
+            reply = srv.query("g", s).result(TIMEOUT)
+            assert reply.record.status == "ok"
+        assert srv.metrics.count("integrity_checks") == 3
+        assert srv.metrics.count("integrity_failures") == 0
+
+
+def test_integrity_failure_quarantines_and_reruns_on_fallback(
+    graph, monkeypatch
+):
+    with make_server(
+        graph, verify_sample=1, breaker_cooldown_s=0.15, watchdog_s=0.0
+    ) as srv:
+        reply = srv.query("g", 0).result(TIMEOUT)
+        assert reply.record.status == "ok"
+        n_exe = len(srv.exe_cache)
+        # Injected corruption: the next sampled verify FAILS its verdict.
+        monkeypatch.setenv("BFS_TPU_FAULT", "raise:serve.verify")
+        reply = srv.query("g", 1).result(TIMEOUT)
+        monkeypatch.delenv("BFS_TPU_FAULT")
+        # The batch re-ran on the fallback path and the answer is correct.
+        d1, _ = queue_bfs(graph, 1)
+        assert np.array_equal(reply.dist, d1)
+        assert reply.record.status == "oracle"
+        assert srv.metrics.count("integrity_failures") == 1
+        # Quarantine: circuit force-opened AND the cached runner dropped.
+        assert srv.metrics.count("breaker_opened") == 1
+        assert len(srv.exe_cache) == n_exe - 1
+        # While quarantined, ticks short-circuit (still correct).
+        reply = srv.query("g", 2).result(TIMEOUT)
+        assert reply.record.status == "oracle"
+        # After the cooldown the canary REBUILDS the executable (a compile
+        # miss, not a re-probe of the quarantined artifact) and closes.
+        time.sleep(0.2)
+        reply = srv.query("g", 3).result(TIMEOUT)
+        assert reply.record.status == "ok"
+        assert srv.metrics.count("breaker_closed") == 1
+        assert len(srv.exe_cache) == n_exe
+
+
+# --------------------------------------------------------------- epochs --
+
+
+def test_hot_swap_creates_epoch_and_in_flight_finishes_on_old(graph):
+    """The acceptance shape: queries admitted before a swap are answered
+    against the snapshot they were admitted under; queries admitted after
+    see the new graph."""
+    other = gnm_graph(60, 180, seed=8)  # same V, different edges
+    with make_server(graph, watchdog_s=0.0) as srv:
+        srv.query("g", 0).result(TIMEOUT)  # warm epoch 0
+        srv.pause()
+        # Admitted under epoch 0, still queued when the swap lands.
+        f_old = [srv.submit("g", [s]) for s in (3, 4)]
+        srv.register("g", other)  # hot swap -> epoch 1
+        f_new = [srv.submit("g", [s]) for s in (3, 4)]
+        srv.resume()
+        for s, f in zip((3, 4), f_old):
+            reply = f.result(TIMEOUT)
+            d, _ = queue_bfs(graph, s)
+            assert reply.record.epoch == 0
+            assert np.array_equal(reply.dist, d), "old-epoch answer wrong"
+        for s, f in zip((3, 4), f_new):
+            reply = f.result(TIMEOUT)
+            d, _ = queue_bfs(other, s)
+            assert reply.record.epoch == 1
+            assert np.array_equal(reply.dist, d), "new-epoch answer wrong"
+        assert srv.metrics.count("epochs_swapped") == 1
+        # The old epoch retired once its last in-flight pin dropped.
+        assert srv.metrics.count("epochs_retired") == 1
+        with pytest.raises(KeyError):
+            srv.registry.get_epoch("g", 0)
+        assert srv.registry.epoch("g") == 1
+
+
+def test_result_cache_is_epoch_keyed(graph):
+    other = gnm_graph(60, 180, seed=8)
+    with make_server(graph, watchdog_s=0.0) as srv:
+        srv.query("g", 0).result(TIMEOUT)
+        srv.query("g", 0).result(TIMEOUT)
+        assert srv.metrics.count("result_cache_hits") == 1
+        srv.register("g", other)
+        # Same source, new epoch: the old cached answer must NOT serve.
+        reply = srv.query("g", 0).result(TIMEOUT)
+        d, _ = queue_bfs(other, 0)
+        assert np.array_equal(reply.dist, d)
+        assert srv.metrics.count("result_cache_hits") == 1
+
+
+def test_swap_with_no_inflight_retires_old_epoch_immediately(graph):
+    reg = GraphRegistry()
+    reg.register("g", graph)
+    reg.acquire("g", "pull")
+    assert reg.resident_keys() == [("g", 0, "pull")]
+    reg.register("g", graph)
+    # No pins: epoch 0's operands were evicted at swap time.
+    assert reg.resident_keys() == []
+    assert reg.epoch("g") == 1
+    with pytest.raises(KeyError):
+        reg.get_epoch("g", 0)
+
+
+def test_pinned_epoch_survives_swap_until_unpin(graph):
+    reg = GraphRegistry()
+    reg.register("g", graph)
+    rec0 = reg.pin("g")
+    reg.acquire("g", "pull")
+    reg.register("g", graph)
+    # Pinned: epoch 0 and its operands stay alive through the swap.
+    assert reg.get_epoch("g", 0) is rec0
+    assert ("g", 0, "pull") in reg.resident_keys()
+    reg.unpin(rec0)
+    assert reg.resident_keys() == []
+    with pytest.raises(KeyError):
+        reg.get_epoch("g", 0)
+
+
+def test_epochs_are_monotonic_across_unregister(graph):
+    """An unregister/re-register cycle must NOT restart epoch numbering:
+    an in-flight query pinned to the old incarnation's epoch N would
+    silently resolve against a new graph that reused N and be answered
+    from the wrong snapshot."""
+    reg = GraphRegistry()
+    reg.register("g", graph)
+    rec0 = reg.pin("g")
+    assert rec0.epoch == 0
+    reg.unregister("g")
+    other = gnm_graph(60, 180, seed=21)
+    assert reg.register("g", other).epoch == 1
+    # The old incarnation's epoch is GONE, not aliased to the new graph.
+    with pytest.raises(KeyError):
+        reg.get_epoch("g", 0)
+
+
+def test_late_unpin_after_unregister_releases_exactly_once(graph):
+    """unregister force-drops a still-pinned retired epoch; the eventual
+    unpin must be a no-op — not a second _retire that re-fires listeners
+    and sweeps a re-registered incarnation's live residents."""
+    retired = []
+    reg = GraphRegistry()
+    reg.add_retire_listener(lambda n, e: retired.append((n, e)))
+    reg.register("g", graph)
+    rec0 = reg.pin("g")
+    reg.register("g", graph)  # swap; epoch 0 retired-but-pinned
+    reg.unregister("g")  # force-drop: fires for epochs 1 (current) and 0
+    assert sorted(retired) == [("g", 0), ("g", 1)]
+    reg.register("g", graph)  # new incarnation, epoch 2
+    reg.acquire("g", "pull")
+    assert ("g", 2, "pull") in reg.resident_keys()
+    reg.unpin(rec0)  # the in-flight work from before the unregister ends
+    assert sorted(retired) == [("g", 0), ("g", 1)], "released twice"
+    assert ("g", 2, "pull") in reg.resident_keys(), (
+        "late unpin swept the live incarnation's residency"
+    )
+
+
+def test_retire_listeners_fan_out_and_detach(graph):
+    """Multiple servers share one registry: each subscribes its own
+    listener (a slot would let the second server steal the hook) and a
+    removed listener stops firing."""
+    a, b = [], []
+    fa, fb = (lambda n, e: a.append(e)), (lambda n, e: b.append(e))
+    reg = GraphRegistry()
+    reg.add_retire_listener(fa)
+    reg.add_retire_listener(fb)
+    reg.register("g", graph)
+    reg.register("g", graph)  # swap retires epoch 0 -> both fire
+    assert a == [0] and b == [0]
+    reg.remove_retire_listener(fa)
+    reg.register("g", graph)  # retires epoch 1 -> only b fires
+    assert a == [0] and b == [0, 1]
+
+
+def test_retired_epoch_upload_race_does_not_leak_residency(graph):
+    """A watchdog-abandoned worker can finish acquire_for's out-of-lock
+    H2D upload AFTER the epoch's last unpin ran _retire: the late insert
+    must be refused, or the dead snapshot's device arrays stay resident
+    forever (with the default unlimited budget, _make_room never evicts)."""
+    reg = GraphRegistry()
+    reg.register("g", graph)
+    rec0 = reg.pin("g")
+    reg.register("g", graph)  # swap; epoch 0 retired-but-pinned
+    reg.unpin(rec0)  # last pin drops -> _retire evicts epoch 0
+    assert reg.resident_keys() == []
+    # The abandoned worker's upload completes now.
+    operands = reg.acquire_for(rec0, "pull")
+    assert operands is not None  # the (dead) caller still gets operands
+    assert ("g", 0, "pull") not in reg.resident_keys(), (
+        "retired epoch re-inserted into residency after _retire"
+    )
+
+
+def test_epoch_retirement_prunes_health_state(graph):
+    """Per-circuit breaker cells and latency windows are keyed by epoch;
+    retirement must prune them or every hot swap grows health state (and
+    the report payload) for the server's lifetime."""
+    with make_server(graph, watchdog_s=0.0) as srv:
+        srv.query("g", 0).result(TIMEOUT)  # cold tick: builds, no sample
+        srv.query("g", 1).result(TIMEOUT)  # warm tick: feeds the window
+        rep = srv.report()["health"]
+        assert any(k.split("/")[1] == "0" for k in rep["watchdog_budgets"])
+        srv.register("g", graph)  # hot swap, nothing in flight
+        srv.query("g", 2).result(TIMEOUT)
+        srv.query("g", 3).result(TIMEOUT)
+        rep = srv.report()["health"]
+        for section in (rep["watchdog_budgets"], rep["breaker"]):
+            assert not any(k.split("/")[1] == "0" for k in section), (
+                f"epoch-0 health state survived retirement: {section}"
+            )
+        assert any(k.split("/")[1] == "1" for k in rep["watchdog_budgets"])
+
+
+def test_report_tolerates_concurrent_unregister(graph, monkeypatch):
+    """names() and epoch() are separate lock acquisitions: a graph
+    unregistered between them must drop out of the snapshot, not raise
+    KeyError at the monitoring caller."""
+    with make_server(graph, watchdog_s=0.0) as srv:
+        real_names = srv.registry.names
+        monkeypatch.setattr(
+            srv.registry, "names", lambda: real_names() + ["gone"]
+        )
+        rep = srv.report()
+        assert rep["registry"]["graphs"] == ["g"]
+        assert rep["registry"]["epochs"] == {"g": 0}
+
+
+def test_unpinned_swap_releases_device_operands_of_old_layout(graph):
+    """Swap-time retirement must run the same release hooks as the
+    last-unpin path: the old rec is already out of _graphs when _retire
+    runs, so _evict needs the rec handed to it — otherwise an
+    externally-held pull layout keeps its device memo (multi-GB at
+    scale) alive after the swap."""
+    reg = GraphRegistry()
+    reg.register("g", graph)
+    reg.acquire("g", "pull")
+    pg = reg.layout("g", "pull")
+    assert getattr(pg, "_device_ell", None) is not None
+    reg.register("g", graph)  # unpinned swap retires epoch 0 immediately
+    assert reg.resident_keys() == []
+    assert getattr(pg, "_device_ell", None) is None, (
+        "swap-time _retire skipped drop_device_operands"
+    )
+
+
+def test_budget_eviction_happens_before_the_new_upload(graph, monkeypatch):
+    """Victims must leave the device BEFORE the incoming operands are
+    uploaded, or peak HBM is budget + incoming — the overshoot the
+    budget exists to prevent."""
+    import bfs_tpu.serve.registry as registry_mod
+
+    other = gnm_graph(60, 150, seed=9)
+    reg = GraphRegistry(device_budget_bytes=1)
+    reg.register("a", graph)
+    reg.register("b", other)
+    reg.acquire("a", "pull")
+    assert ("a", 0, "pull") in reg.resident_keys()
+    resident_at_upload = []
+    real_device_ell = registry_mod.device_ell
+
+    def spying_device_ell(layout):
+        resident_at_upload.append(list(reg.resident_keys()))
+        return real_device_ell(layout)
+
+    monkeypatch.setattr(registry_mod, "device_ell", spying_device_ell)
+    reg.acquire("b", "pull")
+    assert resident_at_upload == [[]], (
+        "victim still resident while the new operands uploaded"
+    )
+
+
+def test_budget_eviction_defers_on_pinned_epochs(graph):
+    other = gnm_graph(60, 150, seed=9)
+    reg = GraphRegistry(device_budget_bytes=1)
+    reg.register("a", graph)
+    reg.register("b", other)
+    rec_a = reg.pin("a")
+    reg.acquire("a", "pull")
+    # b's acquire would evict a (LRU), but a is pinned by in-flight work:
+    # the eviction is DEFERRED and both stay resident (budget overshoot).
+    reg.acquire("b", "pull")
+    assert reg.evictions_deferred == 1
+    assert ("a", 0, "pull") in reg.resident_keys()
+    assert ("b", 0, "pull") in reg.resident_keys()
+    reg.unpin(rec_a)
+    # Next acquire settles the budget: a (unpinned now) is evicted.
+    reg.acquire("b", "pull")
+    assert reg.resident_keys() == [("b", 0, "pull")]

@@ -50,8 +50,11 @@ def test_register_and_layout_memoized(tiny_graph):
         reg.layout("t", "bogus")
     with pytest.raises(KeyError):
         reg.get("unknown")
-    with pytest.raises(ValueError):
-        reg.register("t", tiny_graph)  # duplicate name
+    # Re-registering an existing name is a HOT SWAP, not an error
+    # (ISSUE 9): the new registration is the next epoch.
+    rec2 = reg.register("t", tiny_graph)
+    assert rec2.epoch == 1 and reg.get("t") is rec2
+    assert reg.layout("t", "pull") is not pg1  # new epoch, new layout memo
 
 
 def test_register_prebuilt_pull_layout(tiny_graph):
@@ -69,7 +72,7 @@ def test_acquire_marks_resident_and_release_drops(tiny_graph):
     reg = GraphRegistry()
     reg.register("t", tiny_graph)
     ell0, folds = reg.acquire("t", "pull")
-    assert reg.resident_keys() == [("t", "pull")]
+    assert reg.resident_keys() == [("t", 0, "pull")]
     assert reg.resident_bytes() > 0
     pg = reg.layout("t", "pull")
     assert getattr(pg, "_device_ell", None) is not None
@@ -93,13 +96,13 @@ def test_lru_eviction_under_capped_budget():
     # Second graph displaces the first: drop_device_operands clears the
     # memo on A's layout (asserted on the object, not log lines).
     reg.acquire("b", "pull")
-    assert reg.resident_keys() == [("b", "pull")]
+    assert reg.resident_keys() == [("b", 0, "pull")]
     assert getattr(pg_a, "_device_ell", None) is None
     assert reg.evictions == 1
 
     # Re-acquiring A re-uploads and displaces B in turn (LRU order).
     ell0_a2, _ = reg.acquire("a", "pull")
-    assert reg.resident_keys() == [("a", "pull")]
+    assert reg.resident_keys() == [("a", 0, "pull")]
     assert getattr(pg_a, "_device_ell", None) is not None
     assert reg.evictions == 2
 
@@ -118,9 +121,9 @@ def test_lru_order_tracks_use():
     reg.acquire("a", "pull")
     reg.device_budget_bytes = reg.resident_bytes()  # full: next evicts
     reg.acquire("b", "push")
-    assert ("b", "pull") not in reg.resident_keys()
-    assert ("a", "pull") in reg.resident_keys()
-    assert ("b", "push") in reg.resident_keys()
+    assert ("b", 0, "pull") not in reg.resident_keys()
+    assert ("a", 0, "pull") in reg.resident_keys()
+    assert ("b", 0, "push") in reg.resident_keys()
 
 
 def test_second_registry_hits_disk_cache(tmp_path, tiny_graph, monkeypatch):

@@ -9,12 +9,13 @@ cache hit, retry loop, fan-out — is the code under test."""
 import numpy as np
 import pytest
 
-def _tick_key(graph, engine, padded):
-    """The server's executable key now carries the direction policy
-    (ISSUE 7) — injected runners must use the same key shape."""
+def _tick_key(graph, engine, padded, epoch=0):
+    """The server's executable key carries the direction policy (ISSUE 7)
+    and the graph epoch (ISSUE 9) — injected runners must use the same
+    key shape."""
     from bfs_tpu.models.direction import resolve_direction
 
-    return (graph, engine, padded, resolve_direction().key())
+    return (graph, epoch, engine, padded, resolve_direction().key())
 
 
 from bfs_tpu.graph.generators import gnm_graph

@@ -18,9 +18,25 @@ steady-state compile hit rate) is the divergence check, and the kill
 proves a dead client never wedges or corrupts the serving artifacts
 (layout bundles, compile caches) it shares with the next run.
 
+The serve mode (ISSUE 9) is the SELF-HEALING acceptance schedule: one
+in-process :class:`~bfs_tpu.serve.BfsServer` driven through a scripted
+fault+swap sequence — classified-permanent device faults
+(``raise:serve.batch``, the in-process analog of a killed device call)
+until the circuit breaker opens, a cooldown canary that closes it again,
+hung-call delays (``delay:serve.batch:s``) the watchdog must convert
+into degraded ticks instead of a frozen server, a corrupt on-device
+answer (``raise:serve.verify`` = a failed integrity verdict) that must
+quarantine the executable, and a mid-load epoch swap whose in-flight
+queries must be answered against their admission-time snapshot.  EVERY
+reply is oracle-checked against the graph its epoch pinned; the driver
+exits non-zero on any wrong answer, any frozen tick (a future that never
+resolves inside ``--serve-tick-timeout``), or any missing breaker /
+watchdog / integrity / epoch transition in the final metrics snapshot.
+
 Usage (CPU, tiny config — the tier-1-adjacent shape):
     python tools/chaos_run.py --iterations 5 --seed 1
     python tools/chaos_run.py --mode loadgen --iterations 3
+    python tools/chaos_run.py --mode serve --scale 8
 
 Heavier configs pass through the usual BENCH_* env knobs.
 """
@@ -277,9 +293,226 @@ def chaos_loadgen(args, rng: random.Random) -> int:
     return 1 if failures else 0
 
 
+def chaos_serve(args, rng: random.Random) -> int:
+    """The in-process self-healing schedule (see module docstring).
+
+    Runs in THIS process so the driver can pause/resume the batcher,
+    hot-swap epochs mid-load, and reset fault-arrival counts between
+    injections — the faults themselves still travel through the same
+    ``BFS_TPU_FAULT`` boundary production would use."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    sys.path.insert(0, REPO_ROOT)
+    import numpy as np
+
+    from bfs_tpu.graph.generators import rmat_graph
+    from bfs_tpu.oracle.bfs import check, queue_bfs
+    from bfs_tpu.resilience import faults
+    from bfs_tpu.serve import BfsServer
+
+    failures: list[str] = []
+    seed = args.seed if args.seed is not None else 1
+    graph_a = rmat_graph(args.scale, args.edge_factor, seed=seed)
+    graph_b = rmat_graph(args.scale, args.edge_factor, seed=seed + 1)
+    v = graph_a.num_vertices
+    name = "chaos"
+    oracle: dict = {}
+    counter = [0]
+
+    def expect(gid, graph, s):
+        if (gid, s) not in oracle:
+            oracle[(gid, s)] = queue_bfs(graph, s)[0]
+        return oracle[(gid, s)]
+
+    def next_source() -> int:
+        # Distinct sources per query (7 is coprime with the power-of-two
+        # vertex count): a repeat would hit the result LRU and the tick
+        # under test would never execute.
+        counter[0] += 1
+        return (3 + 7 * counter[0]) % v
+
+    def set_fault(spec: str | None) -> None:
+        faults.reset()  # kill/raise fire on the nth ARRIVAL; fresh count
+        if spec is None:
+            os.environ.pop("BFS_TPU_FAULT", None)
+        else:
+            os.environ["BFS_TPU_FAULT"] = spec
+
+    def settle(reply_check, phase: str):
+        """Resolve one staged (future, expected) pair; frozen/errored
+        ticks and wrong answers are recorded, never raised."""
+        fut, s, gid, graph, want_status, want_epoch = reply_check
+        t0 = time.monotonic()
+        try:
+            reply = fut.result(timeout=args.serve_tick_timeout)
+        except Exception as exc:
+            failures.append(
+                f"{phase}: FROZEN or errored tick for source {s}: {exc!r}"
+            )
+            return None
+        wall = time.monotonic() - t0
+        od = expect(gid, graph, s)
+        if not np.array_equal(reply.dist, od) or check(
+            graph, reply.dist, reply.parent, [s]
+        ):
+            failures.append(
+                f"{phase}: WRONG answer for source {s} against graph "
+                f"{gid!r} (status={reply.record.status}, "
+                f"epoch={reply.record.epoch})"
+            )
+        if want_status is not None and reply.record.status != want_status:
+            failures.append(
+                f"{phase}: source {s} served status "
+                f"{reply.record.status!r}, schedule wanted {want_status!r}"
+            )
+        if want_epoch is not None and reply.record.epoch != want_epoch:
+            failures.append(
+                f"{phase}: source {s} answered from epoch "
+                f"{reply.record.epoch}, admitted under epoch {want_epoch}"
+            )
+        log(
+            f"{phase}: source={s} status={reply.record.status} "
+            f"epoch={reply.record.epoch} wait={wall * 1e3:.0f}ms"
+        )
+        return reply
+
+    try:
+        with BfsServer(
+            engine=args.serve_engine,
+            max_batch=4,
+            tick_s=0.0,
+            breaker_failures=2,
+            breaker_cooldown_s=args.serve_cooldown_s,
+            watchdog_s=30.0,
+            watchdog_min_s=0.2,
+            verify_sample=1,
+        ) as server:
+            server.register(name, graph_a)
+
+            def ask(phase, *, gid="a", graph=graph_a, timeout_s=None,
+                    want_status=None, want_epoch=None):
+                s = next_source()
+                fut = server.submit(name, [s], timeout_s=timeout_s)
+                return settle(
+                    (fut, s, gid, graph, want_status, want_epoch), phase
+                )
+
+            def recover(phase):
+                set_fault(None)
+                time.sleep(args.serve_cooldown_s + 0.1)
+                ask(phase, want_status="ok")  # the half-open canary closes
+
+            # Phase 1 — healthy load: every answer device-served, correct.
+            for _ in range(args.serve_requests):
+                ask("healthy", want_status="ok")
+
+            # Phase 2 — permanent device faults until the breaker opens;
+            # every faulted tick must still answer correctly (oracle
+            # degradation), and the circuit must be OPEN in the snapshot.
+            for _ in range(3):
+                set_fault("raise:serve.batch")
+                ask("device-fault", want_status="oracle")
+            states = [
+                cell["state"]
+                for cell in server.report()["health"]["breaker"].values()
+            ]
+            if "open" not in states:
+                failures.append(
+                    f"device-fault: no open circuit in snapshot ({states})"
+                )
+            recover("recovery")
+
+            # Phase 3 — hung calls: the delay wedges EVERY device attempt;
+            # the request-deadline-tightened watchdog must convert each
+            # into a degraded (still correct) tick, never a frozen server,
+            # and two wedges re-open the breaker.
+            set_fault(f"delay:serve.batch:{args.serve_delay_s}")
+            for _ in range(2):
+                ask("hung-call", timeout_s=0.5, want_status="oracle")
+            recover("recovery-2")
+
+            # Phase 4 — corrupt answer: a failed sampled verdict must
+            # quarantine the executable and re-run the batch on the
+            # fallback path.
+            set_fault("raise:serve.verify")
+            ask("integrity", want_status="oracle")
+            recover("recovery-3")
+
+            # Phase 5 — epoch swap MID-LOAD: queries staged before the
+            # swap must be answered against graph A (their admission-time
+            # snapshot), queries after it against graph B.
+            old_epoch = server.registry.epoch(name)
+            server.pause()
+            staged = []
+            for _ in range(3):
+                s = next_source()
+                staged.append((
+                    server.submit(name, [s]), s, "a", graph_a, None,
+                    old_epoch,
+                ))
+            server.register(name, graph_b)  # the hot swap
+            for _ in range(3):
+                s = next_source()
+                staged.append((
+                    server.submit(name, [s]), s, "b", graph_b, None,
+                    old_epoch + 1,
+                ))
+            server.resume()
+            for rc_ in staged:
+                settle(rc_, "epoch-swap")
+            disagree = any(
+                not np.array_equal(
+                    expect("a", graph_a, s), expect("b", graph_b, s)
+                )
+                for (_, s, gid, *_rest) in staged
+                if gid == "a"
+            )
+            if not disagree:
+                failures.append(
+                    "epoch-swap: graphs A and B agree on every staged "
+                    "source — the snapshot check proved nothing"
+                )
+
+            report = server.report()
+    finally:
+        set_fault(None)
+
+    # The self-healing transitions the schedule exercised must all be
+    # visible in the one metrics snapshot.
+    c = report["counters"]
+    for key, least in (
+        ("breaker_opened", 3),       # device-fault, hung-call, quarantine
+        ("breaker_half_open", 3),    # one canary per recovery
+        ("breaker_closed", 3),
+        ("breaker_short_circuits", 1),
+        ("watchdog_timeouts", 1),
+        ("integrity_failures", 1),
+        ("epochs_swapped", 1),
+        ("epochs_retired", 1),
+        ("oracle_served", 1),
+    ):
+        if c.get(key, 0) < least:
+            failures.append(
+                f"snapshot: counter {key}={c.get(key, 0)} < {least}"
+            )
+    log("serve chaos metrics snapshot:")
+    log(json.dumps(
+        {"counters": c, "health": report["health"],
+         "registry": report["registry"]},
+        indent=2, sort_keys=True, default=str,
+    ))
+    for f in failures:
+        log(f"FAIL: {f}")
+    log(
+        f"serve chaos: {'FAIL' if failures else 'ok'} "
+        f"({len(failures)} violation(s))"
+    )
+    return 1 if failures else 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--mode", default="bench", choices=("bench", "loadgen"))
+    ap.add_argument("--mode", default="bench",
+                    choices=("bench", "loadgen", "serve"))
     ap.add_argument("--iterations", type=int, default=5)
     ap.add_argument("--seed", type=int, default=None,
                     help="RNG seed for the kill schedule (default: time)")
@@ -299,12 +532,27 @@ def main(argv=None) -> int:
     # Loadgen shape.
     ap.add_argument("--requests", type=int, default=200)
     ap.add_argument("--loadgen-kill-max-s", type=float, default=20.0)
+    # Serve (self-healing) schedule shape.
+    ap.add_argument("--serve-engine", default="pull",
+                    choices=("pull", "push", "relay"))
+    ap.add_argument("--serve-requests", type=int, default=10,
+                    help="healthy-phase query count before the faults")
+    ap.add_argument("--serve-cooldown-s", type=float, default=0.5,
+                    help="breaker cooldown before each half-open canary")
+    ap.add_argument("--serve-delay-s", type=float, default=2.0,
+                    help="injected hung-call sleep (must exceed the "
+                    "deadline-tightened watchdog budget)")
+    ap.add_argument("--serve-tick-timeout", type=float, default=120.0,
+                    help="a reply not resolved within this bound is a "
+                    "FROZEN tick (hard failure)")
     args = ap.parse_args(argv)
 
     seed = args.seed if args.seed is not None else int(time.time())
     log(f"kill-schedule seed: {seed}")
     rng = random.Random(seed)
-    rc = chaos_bench(args, rng) if args.mode == "bench" else chaos_loadgen(args, rng)
+    rc = {
+        "bench": chaos_bench, "loadgen": chaos_loadgen, "serve": chaos_serve,
+    }[args.mode](args, rng)
     # Unified metrics snapshot (bfs_tpu.obs.MetricsRegistry — replaces the
     # bespoke retrace table): the driver itself runs no traced programs, so
     # non-empty retraces here mean an in-process leak; the bench/loadgen

@@ -125,6 +125,17 @@ def main(argv=None) -> int:
     ap.add_argument("--budget-mb", type=int, default=0)
     ap.add_argument("--seed", type=int, default=7)
     ap.add_argument("--no-check", action="store_true")
+    ap.add_argument("--breaker-failures", type=int, default=3,
+                    help="consecutive permanent failures per executable "
+                    "before its circuit opens")
+    ap.add_argument("--breaker-cooldown-s", type=float, default=5.0,
+                    help="open-circuit cooldown before the half-open canary")
+    ap.add_argument("--watchdog-s", type=float, default=60.0,
+                    help="hung-call watchdog default budget (0 disables)")
+    ap.add_argument("--verify-sample", type=int, default=0,
+                    help="on-device integrity check every Kth executed "
+                    "tick (0 disables); the run FAILS on any "
+                    "integrity_failures — the device answered wrong")
     ap.add_argument("--cache-dir", default="",
                     help="persistent layout-bundle dir (default off; pass "
                     "a dir — e.g. .bench_cache/layout — to measure "
@@ -158,6 +169,10 @@ def main(argv=None) -> int:
         max_batch=args.max_batch,
         tick_s=args.tick_ms / 1e3,
         queue_depth=args.queue_depth,
+        breaker_failures=args.breaker_failures,
+        breaker_cooldown_s=args.breaker_cooldown_s,
+        watchdog_s=args.watchdog_s,
+        verify_sample=args.verify_sample,
     ) as server:
         t_reg = time.perf_counter()
         server.register(name, graph)
@@ -275,6 +290,16 @@ def main(argv=None) -> int:
     if steady_rate < 1.0:
         print(
             f"FAIL: steady-state compile hit rate {steady_rate:.3f} < 1.0",
+            file=sys.stderr,
+        )
+        return 1
+    if post.get("integrity_failures", 0):
+        # The sampled DeviceChecker caught a wrong on-device answer on a
+        # HEALTHY run — that is a correctness bug, not noise, whatever the
+        # fallback re-run then returned to callers.
+        print(
+            f"FAIL: {post['integrity_failures']} sampled integrity "
+            "failure(s) on an uninjected run",
             file=sys.stderr,
         )
         return 1
