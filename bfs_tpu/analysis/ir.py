@@ -553,6 +553,24 @@ def _spec_superstep(engine: str):
     )
 
 
+def _spec_layout_device(prog_name: str):
+    """The device layout-builder programs (graph/relay_device.py — the
+    first-touch build path since ISSUE 10): classing histograms, relabel,
+    slot sorts, permutation assembly, CSR, mask compaction and the
+    pure-JAX Beneš route level.  Operands are captured from one real
+    device build of the tiny graph (route=jax: no native dependency)."""
+    from ..graph.relay_device import ir_operands
+
+    ops = _memo("layout_device_ops", lambda: ir_operands(_tiny_graph()))
+    fn, args, statics = ops[prog_name]
+    return Program(
+        name=prog_name, path="bfs_tpu/graph/relay_device.py",
+        fn=fn, args=args, static_kwargs=statics,
+        v_elements=_tiny_graph().num_vertices,
+        budget_bytes=_hbm_envelope(),
+    )
+
+
 def _need_devices(n: int):
     import jax
 
@@ -671,6 +689,23 @@ PROGRAM_SPECS = {
     "sharded.push_fused": _spec_sharded_push,
     "sharded.pull_fused": _spec_sharded_pull,
     "sharded.relay_fused": _spec_sharded_relay,
+    "layout.device_hist": lambda: _spec_layout_device("layout.device_hist"),
+    "layout.device_relabel": lambda: _spec_layout_device(
+        "layout.device_relabel"
+    ),
+    "layout.device_slots": lambda: _spec_layout_device("layout.device_slots"),
+    "layout.device_net_assembly": lambda: _spec_layout_device(
+        "layout.device_net_assembly"
+    ),
+    "layout.device_vperm_assembly": lambda: _spec_layout_device(
+        "layout.device_vperm_assembly"
+    ),
+    "layout.device_csr": lambda: _spec_layout_device("layout.device_csr"),
+    "layout.device_compact": lambda: _spec_layout_device(
+        "layout.device_compact"
+    ),
+    "layout.route_level": lambda: _spec_layout_device("layout.route_level"),
+    "layout.route_mid": lambda: _spec_layout_device("layout.route_mid"),
 }
 
 

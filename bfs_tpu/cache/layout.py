@@ -268,22 +268,32 @@ class LayoutCache:
 # ---------------------------------------------------------------------------
 
 def _load_or_build(graph, *, cache, tag, kind, key_fn, build_fn, to_arrays,
-                   from_arrays):
+                   from_arrays, build_meta: dict | None = None,
+                   prepare_build=None):
     """Shared load-or-build skeleton; the ``info`` dict contract lives in
     ONE place: ``cache`` ("hit"/"miss"/"disabled"), ``key``,
     ``load_seconds`` (hit) or ``save_seconds`` (miss), and
     ``build_seconds`` — on a hit the COLD build time recorded when the
     bundle was written, so every warm run can report its warm-vs-cold
-    speedup."""
+    speedup.  ``build_meta`` is builder provenance (flavor, per-stage
+    seconds): recorded in the bundle on a miss, replayed from the bundle's
+    ``meta`` on a hit, and merged into the returned info either way.
+    ``prepare_build`` runs only when a build is actually imminent, OUTSIDE
+    the timed window — once-per-process costs (module imports, worker-pool
+    start) stay off both the warm path and the build clock."""
     from ..obs.spans import span as obs_span
 
+    build_meta = build_meta if build_meta is not None else {}
     if cache is None:
+        if prepare_build is not None:
+            prepare_build()
         t0 = time.perf_counter()
         with obs_span("layout.build", kind=kind):
             obj = build_fn()
         return obj, {
             "cache": "disabled",
             "build_seconds": time.perf_counter() - t0,
+            **build_meta,
         }
     t0 = time.perf_counter()
     key = key_fn()
@@ -295,13 +305,22 @@ def _load_or_build(graph, *, cache, tag, kind, key_fn, build_fn, to_arrays,
         bump_artifact("layout_cache_hits")
         if tag:
             cache.tag(tag, key)
+        meta = doc["meta"]
         return obj, {
             "cache": "hit",
             "key": key,
             "load_seconds": time.perf_counter() - t0,
-            "build_seconds": float(doc["meta"].get("build_seconds", -1.0)),
+            "build_seconds": float(meta.get("build_seconds", -1.0)),
+            # provenance of the COLD build that wrote this bundle
+            **{
+                k: meta[k]
+                for k in ("builder", "build_stages")
+                if k in meta
+            },
         }
     bump_artifact("layout_cache_misses")
+    if prepare_build is not None:
+        prepare_build()
     t1 = time.perf_counter()
     with obs_span("layout.build", kind=kind):
         obj = build_fn()
@@ -316,6 +335,7 @@ def _load_or_build(graph, *, cache, tag, kind, key_fn, build_fn, to_arrays,
                 "build_seconds": build_seconds,
                 "num_vertices": int(obj.num_vertices),
                 "num_edges": int(obj.num_edges),
+                **build_meta,
             },
             tag=tag,
         )
@@ -324,14 +344,64 @@ def _load_or_build(graph, *, cache, tag, kind, key_fn, build_fn, to_arrays,
         "key": key,
         "build_seconds": build_seconds,
         "save_seconds": time.perf_counter() - t2,
+        **build_meta,
     }
 
 
+def resolve_builder(builder: str | None = None) -> str:
+    """Relay builder flavor: explicit arg > ``BFS_TPU_LAYOUT_BUILD`` >
+    ``device`` (the first-touch default since ISSUE 10; ``host`` is the
+    pinned oracle builder)."""
+    builder = builder or os.environ.get("BFS_TPU_LAYOUT_BUILD", "device")
+    if builder not in ("device", "host"):
+        raise ValueError(
+            f"unknown layout builder {builder!r}; use device|host"
+        )
+    return builder
+
+
 def load_or_build_relay(graph, *, cache: LayoutCache | None = None,
-                        tag: str | None = None):
+                        tag: str | None = None, builder: str | None = None):
     """``(RelayGraph, info)`` — disk-cached build of the relay layout
-    (info contract: :func:`_load_or_build`)."""
+    (info contract: :func:`_load_or_build`).
+
+    ``builder`` selects the DEVICE pipeline (graph/relay_device.py — the
+    default first-touch path) or the host oracle builder
+    (``BFS_TPU_LAYOUT_BUILD=host``); the resulting bundles are
+    byte-identical either way (parity-tested), so the flavor never splits
+    the content-addressed cache.  A device-build failure falls back to the
+    host builder with a logged warning — a build must never be less
+    available than it was before the device path existed."""
     from ..graph.relay import build_relay_graph, relay_from_arrays, relay_to_arrays
+
+    builder = resolve_builder(builder)
+    stage_times: dict = {}
+    build_meta = {"builder": builder, "build_stages": stage_times}
+    device_builder: list = []
+
+    def prepare():
+        # Import only when a build is imminent (warm hits never pay the
+        # module + worker-pool startup), and OUTSIDE the timed window —
+        # the host flavor's module is imported long before its build is
+        # timed, so the device flavor gets the same treatment.
+        if builder == "device" and not device_builder:
+            from ..graph.relay_device import build_relay_graph_device
+
+            device_builder.append(build_relay_graph_device)
+
+    def build():
+        if builder == "device":
+            try:
+                return device_builder[0](graph, stage_times=stage_times)
+            except Exception as exc:
+                logger.warning(
+                    "device layout build failed (%r); falling back to the "
+                    "host builder", exc,
+                )
+                stage_times.clear()
+                stage_times["fallback"] = repr(exc)
+                build_meta["builder"] = "host"  # what actually built it
+        return build_relay_graph(graph)
 
     return _load_or_build(
         graph,
@@ -339,9 +409,11 @@ def load_or_build_relay(graph, *, cache: LayoutCache | None = None,
         tag=tag,
         kind="relay",
         key_fn=lambda: relay_key(graph),
-        build_fn=lambda: build_relay_graph(graph),
+        build_fn=build,
         to_arrays=relay_to_arrays,
         from_arrays=relay_from_arrays,
+        build_meta=build_meta,
+        prepare_build=prepare,
     )
 
 

@@ -181,6 +181,51 @@ def _class_width(deg: np.ndarray) -> np.ndarray:
     return np.where((p2 >= 4) & (x <= three_quarter), three_quarter, p2)
 
 
+# --------------------------------------------------------------------------
+# Shared classing helpers.  `_class_width` above is the closed-form rule;
+# the helpers below are the TABLE form of the same math — a static ascending
+# candidate list plus searchsorted — which (a) is exact integer arithmetic
+# (no float log2), so the device builder (graph/relay_device.py) can run it
+# under jax's default 32-bit floats, and (b) turns the per-class Python
+# loops of the builders into single vectorized passes (the sharded
+# builder's per-shard classing below reuses them host-side).
+# --------------------------------------------------------------------------
+
+def width_candidates(max_width: int = 1 << 31) -> np.ndarray:
+    """Every value `_class_width` can produce, ascending: {2^k, 3*2^(k-1)}.
+    ``width = candidates[searchsorted(candidates, degree)]`` — the smallest
+    candidate >= degree — is exactly `_class_width(degree)`."""
+    out = [1, 2]
+    k = 2
+    while (1 << k) <= max_width:
+        out.append(3 << (k - 2))  # 3*2^(k-1) for the next power of two
+        out.append(1 << k)
+        k += 1
+    return np.array([c for c in out if c <= max_width], dtype=np.int64)
+
+
+def width_index(deg: np.ndarray, candidates: np.ndarray) -> np.ndarray:
+    """Index into ``candidates`` of `_class_width(deg)` (exact, integer)."""
+    x = np.maximum(np.asarray(deg, dtype=np.int64), 1)
+    return np.searchsorted(candidates, x, side="left").astype(np.int32)
+
+
+def ranked_placement(
+    group: np.ndarray, base_by_group: np.ndarray
+) -> np.ndarray:
+    """``pos[i] = base_by_group[group[i]] + rank``, where rank is item
+    ``i``'s stable rank within its group ordered by (group, original
+    index).  The vectorized form of the builders' per-class placement
+    loops (one `_sort_rank` pass instead of a Python loop over classes)."""
+    n = int(np.asarray(group).shape[0])
+    order, rank = _sort_rank(
+        np.asarray(group, dtype=np.int32), np.arange(n, dtype=np.int32)
+    )
+    out = np.empty(n, dtype=np.int64)
+    out[order] = base_by_group[np.asarray(group)[order]] + rank
+    return out
+
+
 def _pow2_at_least(n: int) -> int:
     n = max(int(n), 32)
     return 1 << (n - 1).bit_length()
@@ -436,176 +481,252 @@ class RelayGraph:
     adj_slot: np.ndarray  # int32[E]
 
 
-def build_relay_graph(graph: Graph | DeviceGraph) -> RelayGraph:
-    """Build the full relay layout (host side, once per graph).
-
-    Requires the native Beneš router; raises RuntimeError when unavailable.
-    """
-    _ensure_build_log()
-    if not benes.native_available():
-        raise RuntimeError("relay engine requires the native benes router")
+def extract_edges(graph: Graph | DeviceGraph):
+    """Host edge extraction shared by both builders: ``(src, dst, v, e)``."""
     if isinstance(graph, DeviceGraph):
         if graph.num_shards != 1:
             raise ValueError("build_relay_graph expects a single-shard graph")
-        flat_src = graph.src.reshape(-1)
-        flat_dst = graph.dst.reshape(-1)
+        flat_src = np.asarray(graph.src).reshape(-1)
+        flat_dst = np.asarray(graph.dst).reshape(-1)
         keep = flat_dst != graph.sentinel
         src = flat_src[keep].astype(np.int32)
         dst = flat_dst[keep].astype(np.int32)
         v = graph.num_vertices
     else:
-        src = graph.src.astype(np.int32)
-        dst = graph.dst.astype(np.int32)
+        src = np.asarray(graph.src).astype(np.int32)
+        dst = np.asarray(graph.dst).astype(np.int32)
         v = graph.num_vertices
-    e = int(src.shape[0])
+    return src, dst, int(v), int(src.shape[0])
 
-    with _phase("degrees"):
-        try:
-            from .native_gen import bincount_i32_native, native_available
 
-            if native_available():
-                indeg = bincount_i32_native(dst, v).astype(np.int64)
-                outdeg = bincount_i32_native(src, v).astype(np.int64)
-            else:
-                raise RuntimeError
-        except Exception:
-            indeg = np.bincount(dst, minlength=v)
-            outdeg = np.bincount(src, minlength=v)
-        in_w = _class_width(indeg)  # zero-indeg vertices get one INF slot
-        out_w = _class_width(outdeg)
+def seg_degrees(src: np.ndarray, dst: np.ndarray, v: int):
+    """Per-vertex degree-class widths (zero-indeg vertices get one INF
+    slot) — native bincount fast path."""
+    try:
+        from .native_gen import bincount_i32_native, native_available
 
-    # ---- dst side: aligned classes over the relabeled vertex space --------
-    widths, counts = np.unique(in_w, return_counts=True)
+        if native_available():
+            indeg = bincount_i32_native(dst, v).astype(np.int64)
+            outdeg = bincount_i32_native(src, v).astype(np.int64)
+        else:
+            raise RuntimeError
+    except Exception:
+        indeg = np.bincount(dst, minlength=v)
+        outdeg = np.bincount(src, minlength=v)
+    return _class_width(indeg), _class_width(outdeg)
+
+
+class LayoutMeta(NamedTuple):
+    """Static layout metadata derived from the two degree histograms — the
+    shapes every later segment (and the device builder's programs) key on."""
+
+    in_classes: tuple
+    out_classes: tuple
+    widths: np.ndarray
+    counts: np.ndarray
+    owidths: np.ndarray
+    ocounts: np.ndarray
+    vr: int
+    m1: int
+    m2: int
+    out_vb: int
+    n: int
+    vp: int
+
+
+def seg_classes_from_counts(
+    widths: np.ndarray, counts: np.ndarray,
+    owidths: np.ndarray, ocounts: np.ndarray, v: int,
+) -> LayoutMeta:
+    """Aligned classes + every derived static size from per-width counts.
+    The ONE home of the sizing formulas (vr/m1/m2/net/vperm): the host
+    builder reaches it through `seg_classes`, the device builder through
+    its histogram program — a drift between two copies would silently
+    break device/host bit-parity."""
     in_classes = _build_classes(widths, counts)
     vr = _round32(in_classes[-1].vb) if in_classes else 32
     m1 = in_classes[-1].sb if in_classes else 0
-
-    # relabel: class-major, old-id-minor; dummies at padded class tails
-    new2old = np.full(vr, -1, dtype=np.int32)
-    old2new = np.empty(v, dtype=np.int32)
-    order = np.argsort(in_w, kind="stable")  # stable: old-id-minor
-    in_map = _width_class_map(in_classes, widths)
-    pos = 0
-    for wv, cnt in zip(widths.tolist(), counts.tolist()):
-        cs = in_map[int(wv)]
-        ids = order[pos : pos + cnt]
-        new2old[cs.va : cs.va + cnt] = ids
-        old2new[ids] = (cs.va + np.arange(cnt)).astype(np.int32)
-        pos += cnt
-    assert pos == v
-
-    # ---- src side: aligned classes over out-order positions ---------------
-    owidths, ocounts = np.unique(out_w, return_counts=True)
     out_classes = _build_classes(owidths, ocounts)
-    out_space = out_classes[-1].vb if out_classes else 0
+    out_vb = out_classes[-1].vb if out_classes else 0
     m2 = out_classes[-1].sb if out_classes else 0
-
-    outpos_of_old = np.empty(v, dtype=np.int32)
-    oorder = np.argsort(out_w, kind="stable")
-    out_map = _width_class_map(out_classes, owidths)
-    pos = 0
-    for wv, cnt in zip(owidths.tolist(), ocounts.tolist()):
-        cs = out_map[int(wv)]
-        ids = oorder[pos : pos + cnt]
-        outpos_of_old[ids] = (cs.va + np.arange(cnt)).astype(np.int32)
-        pos += cnt
-    assert pos == v
-
-    # ---- L1 slots: edges sorted by (dst_new, src); rank = in-row position --
-    with _phase("l1 slots"):
-        dstn = _gather(old2new, dst)
-        order1, rank1 = _sort_rank(dstn, src)
-        base1, stride1 = _vertex_tables(in_classes, vr)
-        ds = _gather(dstn, order1)
-        l1_sorted = _slot_assign(base1, stride1, ds, rank1)  # slots < 2^28
-        src_l1 = np.full(m1, INF_DIST, dtype=np.int32)
-        _scatter(src_l1, l1_sorted, _gather(src, order1))  # ORIGINAL ids
-
-    # ---- L2 slots: edges grouped by src out-position ------------------------
-    # The within-row rank is FREE here: the big network routes any
-    # permutation, and the broadcast fills every rank slot of a source with
-    # the same bit, so any bijection of a source's edges onto its rank slots
-    # works.  A single counting pass replaces the full (srcpos, dst) radix
-    # sort (measured 272 s -> ~3 s at s25), assigning slots directly in edge
-    # order.
-    with _phase("l2 slots"):
-        srcpos = _gather(outpos_of_old, src)
-        rank2 = _rank_by_count(srcpos, out_classes[-1].vb)
-        base2, stride2 = _vertex_tables(out_classes, out_classes[-1].vb)
-        l2_by_edge = _slot_assign(base2, stride2, srcpos, rank2)
-
-    # ---- big network: L1 slot <- L2 slot -----------------------------------
     n = _pow2_at_least(max(m1, m2))
-    with _phase("net perm assembly"):
-        net = np.full(n, -1, dtype=np.int32)
-        l1_by_edge = np.empty(e, dtype=np.int32)
-        _scatter(l1_by_edge, order1, l1_sorted)
-        _scatter(net, l1_by_edge, l2_by_edge)
-        used = np.zeros(n, dtype=np.uint8)
-        _mark_used(l2_by_edge, used)
-        _pad_identity(net, used, n)
-    # One huge-page reservation held across BOTH routes (net + vperm):
-    # per-route reserve/free cycles pay kernel compaction twice and the
-    # second reservation can fall short on a fragmented allocator.
-    # vperm network size, computed up front so the huge-page hold covers
-    # the LARGER of the two routed networks (vp can exceed n on
-    # vertex-heavy, edge-sparse graphs).
-    out_vb = out_classes[-1].vb
     dummies = out_vb - v
     vp = _pow2_at_least(max(vr + dummies, out_vb, 32 * 128 * 2))
-    with benes.hugepage_reservation(max(n, vp)):
+    return LayoutMeta(
+        in_classes=tuple(in_classes), out_classes=tuple(out_classes),
+        widths=widths, counts=counts, owidths=owidths, ocounts=ocounts,
+        vr=vr, m1=m1, m2=m2, out_vb=out_vb, n=n, vp=vp,
+    )
+
+
+def seg_classes(in_w: np.ndarray, out_w: np.ndarray, v: int) -> LayoutMeta:
+    """Degree widths -> aligned classes + every derived static size."""
+    widths, counts = np.unique(in_w, return_counts=True)
+    owidths, ocounts = np.unique(out_w, return_counts=True)
+    return seg_classes_from_counts(widths, counts, owidths, ocounts, v)
+
+
+def seg_relabel_in(in_w: np.ndarray, meta: LayoutMeta):
+    """Class-major, old-id-minor relabeling (dst side): one vectorized
+    `ranked_placement` pass (the shared classing helper) instead of a
+    Python loop over classes."""
+    v = int(in_w.shape[0])
+    in_map = _width_class_map(meta.in_classes, meta.widths)
+    in_va = np.array(
+        [in_map[int(wv)].va for wv in meta.widths], dtype=np.int64
+    )
+    old2new = ranked_placement(
+        np.searchsorted(meta.widths, in_w), in_va
+    ).astype(np.int32)
+    new2old = np.full(meta.vr, -1, dtype=np.int32)
+    new2old[old2new] = np.arange(v, dtype=np.int32)
+    return new2old, old2new
+
+
+def seg_relabel_out(out_w: np.ndarray, meta: LayoutMeta):
+    """Out-order positions (src side), same vectorized placement."""
+    out_map = _width_class_map(meta.out_classes, meta.owidths)
+    out_va = np.array(
+        [out_map[int(wv)].va for wv in meta.owidths], dtype=np.int64
+    )
+    return ranked_placement(
+        np.searchsorted(meta.owidths, out_w), out_va
+    ).astype(np.int32)
+
+
+def seg_relabel(in_w: np.ndarray, out_w: np.ndarray, meta: LayoutMeta):
+    """Both sides of the relabeling (see `seg_relabel_in`/`_out`)."""
+    new2old, old2new = seg_relabel_in(in_w, meta)
+    return new2old, old2new, seg_relabel_out(out_w, meta)
+
+
+def seg_l1_slots(src, dst, old2new, meta: LayoutMeta):
+    """L1 slots: edges sorted by (dst_new, src); rank = in-row position
+    (the one REQUIRED sort: rank order == canonical min-parent)."""
+    dstn = _gather(old2new, dst)
+    order1, rank1 = _sort_rank(dstn, src)
+    base1, stride1 = _vertex_tables(meta.in_classes, meta.vr)
+    ds = _gather(dstn, order1)
+    l1_sorted = _slot_assign(base1, stride1, ds, rank1)  # slots < 2^28
+    src_l1 = np.full(meta.m1, INF_DIST, dtype=np.int32)
+    _scatter(src_l1, l1_sorted, _gather(src, order1))  # ORIGINAL ids
+    l1_by_edge = np.empty(src.shape[0], dtype=np.int32)
+    _scatter(l1_by_edge, order1, l1_sorted)
+    return src_l1, l1_by_edge, dstn
+
+
+def seg_l2_slots(src, outpos_of_old, meta: LayoutMeta):
+    """L2 slots: edges grouped by src out-position.  The within-row rank is
+    FREE (the big network routes any permutation and the broadcast fills
+    every rank slot of a source with the same bit), so a single counting
+    pass replaces the full (srcpos, dst) radix sort (measured
+    272 s -> ~3 s at s25), assigning slots directly in edge order."""
+    srcpos = _gather(outpos_of_old, src)
+    rank2 = _rank_by_count(srcpos, meta.out_classes[-1].vb)
+    base2, stride2 = _vertex_tables(meta.out_classes, meta.out_classes[-1].vb)
+    return _slot_assign(base2, stride2, srcpos, rank2)
+
+
+def seg_net_assembly(l1_by_edge, l2_by_edge, meta: LayoutMeta):
+    """Big network permutation: L1 slot <- L2 slot, identity-padded."""
+    net = np.full(meta.n, -1, dtype=np.int32)
+    _scatter(net, l1_by_edge, l2_by_edge)
+    used = np.zeros(meta.n, dtype=np.uint8)
+    _mark_used(l2_by_edge, used)
+    _pad_identity(net, used, meta.n)
+    return net
+
+
+def seg_vperm_assembly(outpos_of_old, old2new, meta: LayoutMeta):
+    """Small network permutation: vertex-space words -> out-order words.
+    Dummy out positions (padded rank-major class tails) must read zero:
+    wire them to the guaranteed-zero input region [vr, vp)."""
+    vperm = np.full(meta.vp, -1, dtype=np.int32)
+    real_mask = np.zeros(meta.out_vb, dtype=bool)
+    real_mask[outpos_of_old] = True
+    # real out positions <- relabeled id of their owning vertex
+    vperm[outpos_of_old] = old2new
+    dummy_positions = np.flatnonzero(~real_mask)
+    vperm[dummy_positions] = meta.vr + np.arange(dummy_positions.shape[0])
+    used = np.zeros(meta.vp, dtype=np.uint8)
+    _mark_used(vperm[vperm >= 0], used)
+    _pad_identity(vperm, used, meta.vp)
+    return vperm
+
+
+def seg_csr(srcn, dstn, l1_by_edge, meta: LayoutMeta):
+    """Sparse-path CSR over relabeled src ids.  Within-row order is free
+    (the sparse superstep re-sorts its own gathered candidates), so a
+    counting placement replaces the third full edge sort of the build."""
+    return _csr_fill(srcn, dstn, l1_by_edge, meta.vr)
+
+
+def build_relay_graph(graph: Graph | DeviceGraph) -> RelayGraph:
+    """Build the full relay layout (host side, once per graph).
+
+    Requires the native Beneš router; raises RuntimeError when unavailable.
+    The body is a sequential composition of the ``seg_*`` segment functions
+    above — the device builder (graph/relay_device.py) composes the SAME
+    segments as its measured host arm, overlapped with the routes.
+    """
+    _ensure_build_log()
+    if not benes.native_available():
+        raise RuntimeError("relay engine requires the native benes router")
+    src, dst, v, e = extract_edges(graph)
+
+    with _phase("degrees"):
+        in_w, out_w = seg_degrees(src, dst, v)
+
+    meta = seg_classes(in_w, out_w, v)
+    new2old, old2new, outpos_of_old = seg_relabel(in_w, out_w, meta)
+
+    with _phase("l1 slots"):
+        src_l1, l1_by_edge, dstn = seg_l1_slots(src, dst, old2new, meta)
+    with _phase("l2 slots"):
+        l2_by_edge = seg_l2_slots(src, outpos_of_old, meta)
+    with _phase("net perm assembly"):
+        net = seg_net_assembly(l1_by_edge, l2_by_edge, meta)
+
+    # One huge-page reservation held across BOTH routes (net + vperm):
+    # per-route reserve/free cycles pay kernel compaction twice and the
+    # second reservation can fall short on a fragmented allocator; the hold
+    # covers the LARGER of the two routed networks (vp can exceed n on
+    # vertex-heavy, edge-sparse graphs).
+    with benes.hugepage_reservation(max(meta.n, meta.vp)):
         with _phase("net route"):
             net_masks_full = benes.route_std(net, trusted=True)
         with _phase("net compact"):
-            net_masks, net_table = _compact_and_table(net_masks_full, n)
+            net_masks, net_table = _compact_and_table(net_masks_full, meta.n)
             del net_masks_full
-
-        # ---- small network: vertex-space words -> out-order words ----------
-        # Dummy out positions (padded rank-major class tails) must read zero:
-        # wire them to the guaranteed-zero input region [vr, vp).
-        vperm = np.full(vp, -1, dtype=np.int32)
-        real_mask = np.zeros(out_vb, dtype=bool)
-        for wv, cnt in zip(owidths.tolist(), ocounts.tolist()):
-            cs = out_map[int(wv)]
-            real_mask[cs.va : cs.va + cnt] = True
-        # real out positions <- relabeled id of their owning vertex
-        vperm[outpos_of_old] = old2new[np.arange(v)]
-        dummy_positions = np.flatnonzero(~real_mask)
-        vperm[dummy_positions] = vr + np.arange(dummy_positions.shape[0])
         with _phase("vperm route"):
-            used = np.zeros(vp, dtype=np.uint8)
-            _mark_used(vperm[vperm >= 0], used)
-            _pad_identity(vperm, used, vp)
+            vperm = seg_vperm_assembly(outpos_of_old, old2new, meta)
             vperm_masks_full = benes.route_std(vperm, trusted=True)
-            vperm_masks, vperm_table = _compact_and_table(vperm_masks_full, vp)
+            vperm_masks, vperm_table = _compact_and_table(
+                vperm_masks_full, meta.vp
+            )
             del vperm_masks_full
 
-    # ---- sparse-path CSR over relabeled src ids ----------------------------
-    # Within-row order is free: the sparse superstep min-merges its gathered
-    # candidates by a (dst, slot) sort of its own (models/bfs.py
-    # _sparse_superstep), so a counting placement replaces the third full
-    # edge sort of the build.
     with _phase("sparse CSR"):
         srcn = _gather(old2new, src)
-        adj_indptr, adj_dst, adj_slot = _csr_fill(srcn, dstn, l1_by_edge, vr)
+        adj_indptr, adj_dst, adj_slot = seg_csr(srcn, dstn, l1_by_edge, meta)
 
     return RelayGraph(
         num_vertices=v,
         num_edges=e,
-        vr=vr,
+        vr=meta.vr,
         new2old=new2old,
         old2new=old2new,
         vperm_masks=vperm_masks,
         vperm_table=vperm_table,
-        vperm_size=vp,
-        out_classes=tuple(out_classes),
-        out_space=out_vb,
+        vperm_size=meta.vp,
+        out_classes=meta.out_classes,
+        out_space=meta.out_vb,
         net_masks=net_masks,
         net_table=net_table,
-        net_size=n,
-        m1=m1,
-        m2=m2,
-        in_classes=tuple(in_classes),
+        net_size=meta.n,
+        m1=meta.m1,
+        m2=meta.m2,
+        in_classes=meta.in_classes,
         src_l1=src_l1,
         adj_indptr=adj_indptr.astype(np.int32),
         adj_dst=adj_dst,
@@ -731,42 +852,33 @@ def build_sharded_relay_graph(
     # ---- unified in-classes: per-width counts maxed over shards ------------
     # (The max is now within 1 of the mean by construction.)
     widths_all = np.unique(in_w)
-    counts = np.stack(
-        [
-            np.bincount(
-                np.searchsorted(widths_all, in_w[shard_of_old == s]),
-                minlength=widths_all.shape[0],
-            )
-            for s in range(n)
-        ],
-        axis=1,
+    nwidths = int(widths_all.shape[0])
+    in_widx = np.searchsorted(widths_all, in_w).astype(np.int64)
+    counts = (
+        np.bincount(shard_of_old * nwidths + in_widx, minlength=n * nwidths)
+        .reshape(n, nwidths)
+        .T
     )
     in_classes = _unified_classes(widths_all, counts)
     block = _round32(in_classes[-1].vb)
     m1 = in_classes[-1].sb
 
     # ---- relabel: shard-major, class-major, old-id-minor -------------------
-    new2old = np.full(n * block, -1, dtype=np.int64)
-    old2new = np.empty(v, dtype=np.int64)
-    cls_by_width = {}
-    for cs in in_classes:
-        cls_by_width.setdefault(cs.width, []).append(cs)
-    # map each vertex width -> its class (vertex-major classes have padded
-    # width; recover via ascending-width assignment like _build_classes)
+    # One vectorized `ranked_placement` pass over (shard, width) groups
+    # replaces the old per-shard-per-width Python loop (the classing
+    # helpers the device builder extracted, reused host-side — ISSUE 10):
+    # a vertex's new id is shard base + class slot start + its stable rank
+    # within the (shard, width) group, ordered by old id.
     width_to_class = _width_class_map(in_classes, widths_all)
-    for s in range(n):
-        own = np.flatnonzero(shard_of_old == s)
-        w_own = in_w[own]
-        order = np.argsort(w_own, kind="stable")
-        pos = 0
-        for wv in np.unique(w_own):
-            cs = width_to_class[int(wv)]
-            cnt = int(np.count_nonzero(w_own == wv))
-            ids = own[order[pos : pos + cnt]]
-            newids = s * block + cs.va + np.arange(cnt)
-            new2old[newids] = ids
-            old2new[ids] = newids
-            pos += cnt
+    va_by_widx = np.array(
+        [width_to_class[int(wv)].va for wv in widths_all], dtype=np.int64
+    )
+    group_base = (
+        np.arange(n, dtype=np.int64)[:, None] * block + va_by_widx[None, :]
+    ).reshape(-1)
+    old2new = ranked_placement(shard_of_old * nwidths + in_widx, group_base)
+    new2old = np.full(n * block, -1, dtype=np.int64)
+    new2old[old2new] = np.arange(v, dtype=np.int64)
 
     # ---- edge shard slices: grouped by the OWNER of the destination --------
     # Ownership is class-balanced (not contiguous in original ids), so the
@@ -813,32 +925,51 @@ def build_sharded_relay_graph(
     net_masks_l, net_tables = [], []
     src_l1 = np.full((n, m1), INF_DIST, dtype=np.int32)
 
+    # Static out-class lookup tables for the vectorized per-shard classing
+    # below (shared helpers with the device builder — ISSUE 10 satellite):
+    # position -> owning class (classes are contiguous [va, vb)) -> width
+    # index, plus each width's class slot start.
+    va_by_owidx = np.array(
+        [out_width_to_class[int(w)].va for w in owidths], dtype=np.int64
+    )
+    ova_bounds = np.array([c.va for c in out_classes], dtype=np.int64)
+    owidx_of_cls = np.searchsorted(
+        owidths, np.array([c.real_width for c in out_classes], dtype=np.int64)
+    )
+    owidx_of_pos = owidx_of_cls[
+        np.searchsorted(ova_bounds, np.arange(out_vb), side="right") - 1
+    ]
+
     # One huge-page hold across all 2n per-shard routes (see the
     # single-shard builder for why per-route reserve/free cycles lose).
     with benes.hugepage_reservation(max(net_size, vp)):
         for s in range(n):
             uids_s, uw_s = out_sparse[s]
             # out positions for this shard's sources (ascending ORIGINAL id
-            # within each width class)
+            # within each width class): one ranked_placement pass instead
+            # of the per-width Python loop.
+            owidx_s = np.searchsorted(owidths, uw_s).astype(np.int64)
+            outpos_s = ranked_placement(owidx_s, va_by_owidx)
             outpos_of_old = np.full(v, -1, dtype=np.int64)
-            oorder = np.argsort(uw_s, kind="stable")
+            outpos_of_old[uids_s] = outpos_s
             vperm = np.full(vp, -1, dtype=np.int32)
-            dummy_cursor = gtot
-            pos = 0
-            for wv in np.unique(uw_s):
-                cs = out_width_to_class[int(wv)]
-                cnt = int(np.count_nonzero(uw_s == wv))
-                ids = uids_s[oorder[pos : pos + cnt]]
-                outpos_of_old[ids] = cs.va + np.arange(cnt)
-                vperm[cs.va : cs.va + cnt] = old2new[ids]
-                ndum = cs.count - cnt
-                if ndum > 0:
-                    vperm[cs.va + cnt : cs.vb] = dummy_cursor + np.arange(ndum)
-                    dummy_cursor += ndum
-                pos += cnt
-            # remaining dummy positions of classes this shard has no members of
-            missing = np.flatnonzero(vperm[:out_vb] < 0)
-            vperm[missing] = dummy_cursor + np.arange(missing.shape[0])
+            vperm[outpos_s] = old2new[uids_s]
+            # Dummy out positions: tails of classes PRESENT in this shard
+            # get dummy ids first, walked in ascending-width class order
+            # with positions ascending within a class (the old
+            # dummy_cursor sequence, which is NOT ascending-position when
+            # a small-width vertex-major class follows a larger rank-major
+            # va); then positions of absent classes, ascending.
+            front = vperm[:out_vb]
+            cnt_by_owidx = np.bincount(owidx_s, minlength=owidths.shape[0])
+            present = cnt_by_owidx[owidx_of_pos] > 0
+            tail = np.flatnonzero((front < 0) & present)
+            tail = tail[np.argsort(owidx_of_pos[tail], kind="stable")]
+            front[tail] = gtot + np.arange(tail.shape[0], dtype=np.int64)
+            missing = np.flatnonzero(front < 0)
+            vperm[missing] = (
+                gtot + tail.shape[0] + np.arange(missing.shape[0])
+            )
             used = np.zeros(vp, dtype=bool)
             used[vperm[vperm >= 0]] = True
             _pad_identity(vperm, used, vp)

@@ -1,0 +1,813 @@
+"""Device-side relay layout construction: layout v4 built by XLA programs.
+
+The host builder (:func:`bfs_tpu.graph.relay.build_relay_graph`) is the last
+giant cold cost on the critical path — 506 s of host NumPy + native routing
+at s24 against a 0.75 s solve — so every NEW graph pays ~8 minutes before
+its first superstep (ROADMAP item 3).  GPU BFS frameworks build their
+device-resident representations on-accelerator with sort/scan primitives for
+exactly this reason (arxiv 1408.1605, 2606.05081).  This module rebuilds the
+class/slot/permutation construction as JAX device code and pipelines it:
+
+  * **width classing** — the ``{2^k, 3*2^(k-1)}`` degree-class rule as an
+    exact integer ``searchsorted`` over a static candidate table
+    (:func:`relay.width_candidates`) instead of float ``log2`` — bit-equal
+    to `_class_width` and safe under jax's default 32-bit floats;
+  * **relabeling / out-positions** — one stable ``lax.sort`` per side plus
+    a boundary ``cummax`` rank, replacing per-class Python placement loops;
+  * **L1/L2 slot assignment** — a stable two-key sort by (relabeled dst,
+    original src) for the canonical min-parent rank, a stable one-key sort
+    for the free L2 rank, and the class-table lookup as a ~60-entry
+    ``searchsorted`` gather;
+  * **permutation assembly + identity padding** — scatters plus a
+    cumsum-rank matching of free outputs to free inputs (ascending, exactly
+    the host `_pad_identity` tie-break);
+  * **mask pair-compaction + stage tables** — `_compact_and_table`'s Python
+    stage loop as one staged XLA program per network;
+  * **sparse CSR** — a stable sort by relabeled src (the host counting
+    sort's order exactly);
+  * **pipelining** — the vperm assembly/route, sparse CSR and compaction
+    run on a worker thread INSIDE the big-net route's window (the route is
+    walker-bound on one core; the sequential host builder serializes all
+    of it after the route).
+
+Two MEASURED arms exist per concern, selected the way this repo selects
+every kernel (probe/knob, honest default):
+
+  * **segments** (``BFS_TPU_LAYOUT_SEGMENTS=auto|xla|host``): the XLA
+    programs above, or the shared vectorized host segment functions
+    (``relay.seg_*`` — the exact code the host builder composes).  ``auto``
+    picks ``xla`` on accelerator backends and ``host`` on the CPU backend,
+    where XLA's scatter/sort primitives measure 5-13x slower than the
+    native radix/bincount helpers (same physical cores, no transfer — see
+    ARCHITECTURE §18 for the numbers).  Both arms are bit-identical.
+  * **route** (``BFS_TPU_LAYOUT_ROUTE=auto|native|jax``): the native C++
+    cycle walker (O(n log n) work, measured fastest everywhere we can
+    measure), or a pure-JAX parallel Beneš router — pointer-jumping
+    orbit-min cycle coloring, O(n log² n) — with NO native dependency.
+    Masks from the two arms differ bit-wise (any valid coloring routes the
+    permutation) but are semantically equivalent; every NON-mask field is
+    bit-identical to the host builder either way.
+
+Everything lands in the same :class:`~bfs_tpu.graph.relay.RelayGraph` /
+``relay_to_arrays`` schema, so disk bundles, serializers, the sparse rank
+flavor and every engine are unchanged.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import contextlib
+import functools
+import os
+import threading
+import time
+from typing import Any, Callable
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import benes
+from .csr import DeviceGraph, Graph, INF_DIST
+from .relay import (
+    COMPACT_MIN_D,
+    RelayGraph,
+    StageSpec,
+    _compact_and_table,
+    _ensure_build_log,
+    _gather,
+    _phase,
+    _width_class_map,
+    extract_edges,
+    seg_classes,
+    seg_classes_from_counts,
+    seg_csr,
+    seg_degrees,
+    seg_l1_slots,
+    seg_l2_slots,
+    seg_net_assembly,
+    seg_relabel,
+    seg_vperm_assembly,
+    width_candidates,
+)
+
+#: Candidate width table shipped to the device (int32: covers degrees to
+#: 2^30; a graph with a larger in/out degree falls back to the host
+#: builder — the metadata step detects the overflow and raises).
+_CANDIDATES = width_candidates(1 << 30).astype(np.int32)
+
+#: The builder's compiled-program memo: (name, arg avals, statics) ->
+#: AOT-compiled executable.  Programs compile once per shape set per
+#: process; the jax persistent compilation cache
+#: (config.enable_compile_cache) lets later processes load them from disk.
+_COMPILED: dict = {}
+
+
+def resolve_segments(segments: str | None = None) -> str:
+    """``xla`` (on-device programs) or ``host`` (shared vectorized numpy
+    segments): explicit arg > ``BFS_TPU_LAYOUT_SEGMENTS`` > backend
+    default (xla on accelerators, host on the CPU backend — measured)."""
+    segments = segments or os.environ.get("BFS_TPU_LAYOUT_SEGMENTS", "auto")
+    if segments in ("", "auto"):
+        return "host" if jax.default_backend() == "cpu" else "xla"
+    if segments not in ("xla", "host"):
+        raise ValueError(
+            f"unknown segment arm {segments!r}; use auto|xla|host"
+        )
+    return segments
+
+
+def resolve_route(route: str | None = None) -> str:
+    """The route arm: explicit arg > ``BFS_TPU_LAYOUT_ROUTE`` > native
+    where available (measured fastest on the build CPU), else jax."""
+    route = route or os.environ.get("BFS_TPU_LAYOUT_ROUTE", "auto")
+    if route in ("", "auto"):
+        return "native" if benes.native_available() else "jax"
+    if route not in ("native", "jax"):
+        raise ValueError(f"unknown route arm {route!r}; use auto|native|jax")
+    return route
+
+
+# --------------------------------------------------------------------------
+# Device programs (the ``xla`` segment arm).  Each is a pure jittable
+# function marked hot (no host transfers inside — policed by the AST lint)
+# and registered in analysis/ir.PROGRAM_SPECS via :func:`ir_operands`.
+# --------------------------------------------------------------------------
+
+# bfs_tpu: hot traced
+def _degree_hist_program(src, dst, candidates, *, num_vertices: int):
+    """Per-vertex width-class indices + per-width histograms.
+
+    Degrees beyond the candidate table scatter out of bounds and DROP, so
+    ``hist.sum() < V`` on the host flags the (absurd-degree) overflow."""
+    v = num_vertices
+    one = jnp.int32(1)
+    indeg = jnp.zeros(v, jnp.int32).at[dst].add(one)
+    outdeg = jnp.zeros(v, jnp.int32).at[src].add(one)
+    nc = candidates.shape[0]
+    in_widx = jnp.searchsorted(
+        candidates, jnp.maximum(indeg, 1), side="left"
+    ).astype(jnp.int32)
+    out_widx = jnp.searchsorted(
+        candidates, jnp.maximum(outdeg, 1), side="left"
+    ).astype(jnp.int32)
+    in_hist = jnp.zeros(nc, jnp.int32).at[in_widx].add(one, mode="drop")
+    out_hist = jnp.zeros(nc, jnp.int32).at[out_widx].add(one, mode="drop")
+    return in_widx, out_widx, in_hist, out_hist
+
+
+def _rank_in_runs(keys_sorted, idx):
+    """Stable rank within equal-key runs of an ascending-sorted key array:
+    ``idx - run_start`` via a boundary cummax."""
+    boundary = jnp.concatenate(
+        [jnp.ones((1,), bool), keys_sorted[1:] != keys_sorted[:-1]]
+    )
+    run_start = lax.cummax(jnp.where(boundary, idx, 0))
+    return idx - run_start
+
+
+def _place(widx, va_by_widx):
+    """Class-major, original-id-minor placement: position = class slot
+    start + stable rank within the width group (the device form of the
+    builders' per-class placement loops)."""
+    n = widx.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    ws, order = lax.sort((widx, idx), num_keys=1, is_stable=True)
+    pos_sorted = va_by_widx[ws] + _rank_in_runs(ws, idx)
+    return jnp.zeros(n, jnp.int32).at[order].set(pos_sorted)
+
+
+# bfs_tpu: hot traced
+def _relabel_program(in_widx, out_widx, in_va, out_va, *, vr: int):
+    """old->new relabeling (dst side) + out-order positions (src side)."""
+    v = in_widx.shape[0]
+    old2new = _place(in_widx, in_va)
+    new2old = jnp.full(vr, -1, jnp.int32).at[old2new].set(
+        jnp.arange(v, dtype=jnp.int32)
+    )
+    outpos_of_old = _place(out_widx, out_va)
+    return new2old, old2new, outpos_of_old
+
+
+def _base_stride(ids, va_bounds, sa, count, width, vmaj):
+    """Per-id slot table lookup: class index by ``searchsorted`` over the
+    contiguous class starts (~60 entries), then the rank-major
+    (``base = sa + p``, ``stride = count``) or vertex-major
+    (``base = sa + p*width``, ``stride = 1``) formula elementwise."""
+    ci = jnp.searchsorted(va_bounds, ids, side="right") - 1
+    p = ids - va_bounds[ci]
+    base = jnp.where(vmaj[ci], sa[ci] + p * width[ci], sa[ci] + p)
+    stride = jnp.where(vmaj[ci], 1, count[ci])
+    return base, stride
+
+
+# bfs_tpu: hot traced
+def _slots_program(
+    src, dst, old2new, outpos_of_old,
+    in_va_b, in_sa, in_cnt, in_w, in_vm,
+    out_va_b, out_sa, out_cnt, out_w, out_vm,
+    *, m1: int,
+):
+    """L1/L2 slot assignment.
+
+    L1: edges stable-sorted by (relabeled dst, ORIGINAL src) — the one
+    REQUIRED order (rank == canonical min-parent).  L2: stable sort by src
+    out-position alone; the within-row rank is free, and stability makes
+    it exactly the host `_rank_by_count` edge-order counting rank."""
+    e = src.shape[0]
+    idx = jnp.arange(e, dtype=jnp.int32)
+    dstn = old2new[dst]
+    ds, ss, order1 = lax.sort((dstn, src, idx), num_keys=2, is_stable=True)
+    r1 = _rank_in_runs(ds, idx)
+    base1, stride1 = _base_stride(ds, in_va_b, in_sa, in_cnt, in_w, in_vm)
+    l1_sorted = base1 + r1 * stride1
+    src_l1 = jnp.full(m1, INF_DIST, jnp.int32).at[l1_sorted].set(ss)
+    l1_by_edge = jnp.zeros(e, jnp.int32).at[order1].set(l1_sorted)
+
+    srcpos = outpos_of_old[src]
+    sp, order2 = lax.sort((srcpos, idx), num_keys=1, is_stable=True)
+    r2 = _rank_in_runs(sp, idx)
+    base2, stride2 = _base_stride(sp, out_va_b, out_sa, out_cnt, out_w, out_vm)
+    l2_by_edge = jnp.zeros(e, jnp.int32).at[order2].set(base2 + r2 * stride2)
+    return src_l1, l1_by_edge, l2_by_edge, dstn, old2new[src]
+
+
+def _pad_identity_traced(perm, used):
+    """Traced `_pad_identity`: identity wiring where both pair members are
+    free, then free outputs matched to free inputs ASCENDING (cumsum
+    ranks) — the host tie-break exactly."""
+    n = perm.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    both = (perm < 0) & ~used
+    perm = jnp.where(both, idx, perm)
+    used = used | both
+    fo = perm < 0
+    fi = ~used
+    ro = jnp.cumsum(fo.astype(jnp.int32)) - 1
+    ri = jnp.cumsum(fi.astype(jnp.int32)) - 1
+    pos_by_rank = (
+        jnp.zeros(n, jnp.int32)
+        .at[jnp.where(fo, ro, n)]
+        .set(idx, mode="drop")
+    )
+    target = pos_by_rank[jnp.where(fi, ri, 0)]
+    return perm.at[jnp.where(fi, target, n)].set(idx, mode="drop")
+
+
+# bfs_tpu: hot traced
+def _net_assembly_program(l1_by_edge, l2_by_edge, *, n: int):
+    """Big-network permutation assembly + identity padding."""
+    net = jnp.full(n, -1, jnp.int32).at[l1_by_edge].set(l2_by_edge)
+    used = jnp.zeros(n, bool).at[l2_by_edge].set(True)
+    return _pad_identity_traced(net, used)
+
+
+# bfs_tpu: hot traced
+def _vperm_assembly_program(
+    outpos_of_old, old2new, *, vp: int, vr: int, out_vb: int
+):
+    """vperm assembly: real out positions <- relabeled owner id, dummy
+    positions (ascending) <- the guaranteed-zero inputs [vr, vp)."""
+    vfront = (
+        jnp.full(out_vb, -1, jnp.int32).at[outpos_of_old].set(old2new)
+    )
+    real = jnp.zeros(out_vb, bool).at[outpos_of_old].set(True)
+    dummy_rank = jnp.cumsum((~real).astype(jnp.int32)) - 1
+    vfront = jnp.where(real, vfront, vr + dummy_rank)
+    vperm = jnp.concatenate([vfront, jnp.full(vp - out_vb, -1, jnp.int32)])
+    used = jnp.zeros(vp, bool).at[vfront].set(True)
+    return _pad_identity_traced(vperm, used)
+
+
+# bfs_tpu: hot traced
+def _csr_program(srcn, dstn, l1_by_edge, *, vr: int):
+    """Sparse-path CSR grouped by relabeled src: a stable sort reproduces
+    the host counting sort's edge order exactly."""
+    e = srcn.shape[0]
+    idx = jnp.arange(e, dtype=jnp.int32)
+    _, order = lax.sort((srcn, idx), num_keys=1, is_stable=True)
+    counts = jnp.zeros(vr, jnp.int32).at[srcn].add(jnp.int32(1))
+    cum = jnp.cumsum(counts)
+    indptr = jnp.concatenate([jnp.zeros(1, jnp.int32), cum, cum[-1:]])
+    return indptr, dstn[order], l1_by_edge[order]
+
+
+def _pack_words(bits):
+    """bool[n] -> uint32[n/32], standard little-endian packing."""
+    b = bits.reshape(-1, 32).astype(jnp.uint32)
+    return (b << jnp.arange(32, dtype=jnp.uint32)[None, :]).sum(
+        axis=1, dtype=jnp.uint32
+    )
+
+
+# bfs_tpu: hot traced
+def _route_level_program(perm, d, iters):
+    """One Beneš level: 2-color the input/output pair constraint cycles and
+    derive the two stage masks + the next-level sub-permutation.
+
+    Coloring: along a constraint cycle, outputs alternate between the
+    output-pair matching (``j <-> j^d``) and the shared-input matching
+    (``j <-> inv[perm[j]^d]``); two steps (``f``) preserve the subnetwork
+    side, so each cycle splits into exactly two f-orbits.  Pointer-jumping
+    ``min`` over ``iters >= log2(orbit)`` doublings yields a canonical
+    orbit representative; the orbit whose representative is SMALLER than
+    its pair-orbit's goes through the upper subnetwork — a deterministic
+    pure function of the permutation (identity cycles color upper, so
+    all-pad pairs route switch-free like the native router).
+    """
+    n = perm.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    inv = jnp.zeros(n, jnp.int32).at[perm].set(idx)
+    f = inv[perm[idx ^ d] ^ d]
+
+    def body(_, rg):
+        r, g = rg
+        return jnp.minimum(r, r[g]), g[g]
+
+    r, _ = lax.fori_loop(0, iters, body, (idx, f))
+    color = r > r[idx ^ d]  # True: routed through the lower subnetwork
+    low = (idx & d) == 0
+    obits = color & low            # output-stage swap bits (lower index)
+    ibits = color[inv] & low       # input-stage swap bits (lower index)
+    dst = jnp.where(obits[idx & ~d], idx ^ d, idx)
+    i0 = perm[dst]
+    perm_next = jnp.where(ibits[i0 & ~d], i0 ^ d, i0)
+    return _pack_words(ibits), _pack_words(obits), perm_next
+
+
+# bfs_tpu: hot traced
+def _route_mid_program(perm):
+    """The middle (d=1) stage: swap a pair iff its final sub-permutation
+    crosses it."""
+    n = perm.shape[0]
+    idx = jnp.arange(n, dtype=jnp.int32)
+    return _pack_words(((idx & 1) == 0) & (perm != idx))
+
+
+# bfs_tpu: hot traced
+def _compact_program(masks, *, n: int):
+    """`_compact_and_table`'s stage loop as one staged program: pair-compact
+    every stage with d >= COMPACT_MIN_D (keep the word rows at
+    ``(row & (d >> 5)) == 0``) and reduce each stage's stored nonzero word
+    range ``[first, last+1)`` (``(0, 0)`` when all-zero)."""
+    parts = []
+    nz = []
+    for s in range(benes.num_stages(n)):
+        d = benes.stage_distance(n, s)
+        w = masks[s]
+        if d >= COMPACT_MIN_D:
+            dw = d >> 5
+            w = w.reshape(-1, 2, dw)[:, 0, :].reshape(-1)
+        nzv = w != 0
+        first = jnp.argmax(nzv).astype(jnp.int32)
+        last = jnp.int32(w.shape[0]) - 1 - jnp.argmax(nzv[::-1]).astype(
+            jnp.int32
+        )
+        rng = jnp.where(
+            jnp.any(nzv),
+            jnp.stack([first, last + 1]),
+            jnp.zeros(2, jnp.int32),
+        )
+        parts.append(w)
+        nz.append(rng)
+    return jnp.concatenate(parts), jnp.stack(nz)
+
+
+# --------------------------------------------------------------------------
+# AOT compile memo (one compile per program per shape set per process; the
+# persistent compilation cache carries them across processes).
+# --------------------------------------------------------------------------
+
+@contextlib.contextmanager
+def _persist_small_compiles():
+    """The builder's programs compile in well under the persistent cache's
+    default 5 s write floor (config.enable_compile_cache) — drop the floor
+    to 0 around builder compiles so fresh processes load them from disk
+    instead of re-tracing, and restore it after."""
+    try:
+        if not jax.config.jax_compilation_cache_dir:
+            yield
+            return
+        prev = jax.config.jax_persistent_cache_min_compile_time_secs
+    except AttributeError:  # knob absent on this jax version
+        yield
+        return
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    try:
+        yield
+    finally:
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", prev
+        )
+
+
+#: Serializes builder compiles: the overlapped pipeline compiles from two
+#: threads, and both the persistent-cache floor swap (global jax config)
+#: and the ``compile_seconds`` accumulation need exclusion.
+_COMPILE_LOCK = threading.Lock()
+
+#: The overlapped pipeline's worker pool, pre-started at import (the
+#: import itself happens outside the timed build) so a cold first build
+#: never pays thread spawn latency on its critical path.  Two workers:
+#: two concurrent builds in one process (a serve registry racing two
+#: graphs) each still get a live worker.
+_TRACK_POOL = concurrent.futures.ThreadPoolExecutor(
+    max_workers=2, thread_name_prefix="relay-build"
+)
+_TRACK_POOL.submit(lambda: None)  # start a worker thread eagerly
+
+
+def _compiled(name: str, fn: Callable, args, statics: dict, times: dict):
+    """AOT lower+compile memo; compile seconds accumulate SEPARATELY from
+    the stage execution times (``times['compile_seconds']``) — a
+    once-per-shape artifact cost like the engines' own AOT programs."""
+    key = (
+        name,
+        tuple((tuple(a.shape), str(a.dtype)) for a in args),
+        tuple(sorted(statics.items())),
+    )
+    hit = _COMPILED.get(key)
+    if hit is None:
+        with _COMPILE_LOCK:
+            hit = _COMPILED.get(key)
+            if hit is None:
+                t0 = time.perf_counter()
+                with _persist_small_compiles():
+                    # statics bind as partial kwargs (closed-over Python
+                    # ints), so the jit signature is shape-only; the
+                    # executable is memoized here, never re-traced.
+                    hit = (
+                        jax.jit(functools.partial(fn, **statics))
+                        .lower(*args)
+                        .compile()
+                    )
+                _COMPILED[key] = hit
+                times["compile_seconds"] = (
+                    times.get("compile_seconds", 0.0)
+                    + time.perf_counter() - t0
+                )
+    return hit
+
+
+def route_masks_device(
+    perm, *, n: int, times: dict | None = None, _capture: dict | None = None
+):
+    """Pure-JAX Beneš router: STANDARD-packed masks ``uint32[stages, n/32]``
+    for ``y[j] = x[perm[j]]`` (same convention as
+    :func:`bfs_tpu.graph.benes.route_std`, different — but equivalent —
+    switch settings).  ``perm`` may be a device or host int32 array."""
+    if n < 32 or n & (n - 1):
+        raise ValueError(f"network size {n} is not a power of two >= 32")
+    times = {} if times is None else times
+    k = n.bit_length() - 1
+    perm = jnp.asarray(perm, jnp.int32)
+    if _capture is not None:
+        _capture["layout.route_level"] = (
+            _route_level_program,
+            (perm, jnp.int32(n >> 1), jnp.int32(n.bit_length())),
+            {},
+        )
+        _capture["layout.route_mid"] = (_route_mid_program, (perm,), {})
+    level = _compiled(
+        "layout.route_level", _route_level_program,
+        (perm, jnp.int32(0), jnp.int32(0)), {}, times,
+    )
+    masks_in, masks_out = [], []
+    for l in range(k - 1):
+        d = n >> (l + 1)
+        m_in, m_out, perm = level(
+            perm, jnp.int32(d), jnp.int32(max(d.bit_length(), 1))
+        )
+        masks_in.append(m_in)
+        masks_out.append(m_out)
+    mid = _compiled(
+        "layout.route_mid", _route_mid_program, (perm,), {}, times
+    )(perm)
+    return jnp.stack(masks_in + [mid] + masks_out[::-1])
+
+
+# --------------------------------------------------------------------------
+# The builder.
+# --------------------------------------------------------------------------
+
+def _class_device_tables(classes):
+    """The ~60-entry per-class lookup arrays `_base_stride` gathers."""
+    return (
+        np.array([c.va for c in classes], dtype=np.int32),
+        np.array([c.sa for c in classes], dtype=np.int32),
+        np.array([c.count for c in classes], dtype=np.int32),
+        np.array([c.width for c in classes], dtype=np.int32),
+        np.array([c.vertex_major for c in classes], dtype=bool),
+    )
+
+
+def _va_by_widx(classes, widths) -> np.ndarray:
+    """Class slot start per candidate-width index (0 where absent)."""
+    cmap = _width_class_map(classes, widths)
+    out = np.zeros(_CANDIDATES.shape[0], dtype=np.int32)
+    for wv in np.asarray(widths).tolist():
+        out[int(np.searchsorted(_CANDIDATES, wv))] = cmap[int(wv)].va
+    return out
+
+
+def _stage_table(n: int, nz: np.ndarray) -> tuple[StageSpec, ...]:
+    """StageSpec tuple from the compaction program's per-stage nonzero
+    ranges, with the host builder's 1024-word block quantization where the
+    stored word count is block-aligned."""
+    table = []
+    offset = 0
+    for s in range(benes.num_stages(n)):
+        d = benes.stage_distance(n, s)
+        compact = d >= COMPACT_MIN_D
+        nwords = n // 64 if compact else n // 32
+        lo, hi = int(nz[s, 0]), int(nz[s, 1])
+        if nwords % 1024 == 0 and hi > 0:
+            lo = (lo // 1024) * 1024
+            hi = ((hi - 1) // 1024 + 1) * 1024
+        table.append(
+            StageSpec(
+                d=d, offset=offset, nwords=nwords, compact=compact,
+                lo=lo, hi=hi,
+            )
+        )
+        offset += nwords
+    return tuple(table)
+
+
+def _route_and_compact(perm, n, route, arm, name, times, _capture):
+    """Route one network and compact its masks (either arms).  Runs on the
+    caller's thread — the builder overlaps the big-net call with the
+    vperm/CSR work on the main thread.  IR capture records the NET
+    network's programs only: both tracks share these program names, and a
+    last-writer-wins race between threads would make the captured operand
+    shapes nondeterministic."""
+    from ..obs.spans import span as obs_span
+
+    if name != "net":
+        _capture = None
+
+    with obs_span(f"layout.device.route_{name}"), _phase(f"dev {name} route"):
+        t0 = time.perf_counter()
+        if route == "native":
+            masks_full = benes.route_std(np.asarray(perm), trusted=True)
+        else:
+            masks_full = jax.block_until_ready(
+                route_masks_device(perm, n=n, times=times, _capture=_capture)
+            )
+        times[f"route_{name}"] = time.perf_counter() - t0
+    with _phase(f"dev {name} compact"):
+        t0 = time.perf_counter()
+        if arm == "xla":
+            args = (jnp.asarray(masks_full),)
+            if _capture is not None:
+                _capture["layout.device_compact"] = (
+                    _compact_program, args, dict(n=n)
+                )
+            exe = _compiled(
+                "layout.device_compact", _compact_program, args,
+                dict(n=n), times,
+            )
+            masks_d, nz = jax.block_until_ready(exe(*args))
+            masks, table = np.asarray(masks_d), _stage_table(
+                n, np.asarray(nz)
+            )
+        else:
+            masks, table = _compact_and_table(np.asarray(masks_full), n)
+        times[f"compact_{name}"] = time.perf_counter() - t0
+    return masks, table
+
+
+def build_relay_graph_device(
+    graph: Graph | DeviceGraph,
+    *,
+    route: str | None = None,
+    segments: str | None = None,
+    stage_times: dict | None = None,
+    _capture: dict | None = None,
+) -> RelayGraph:
+    """Build the full relay layout with the device pipeline (see module
+    docstring).  Bit-compatible with :func:`relay.build_relay_graph`:
+    identical classes/slots/permutations always; identical masks under the
+    ``native`` route arm.
+
+    ``stage_times`` (optional dict) is filled with per-stage wall seconds
+    plus ``compile_seconds`` (first-touch program compiles, amortized per
+    shape by the in-process memo and the persistent compilation cache) and
+    the resolved ``route``/``segments`` arms.  ``_capture`` collects each
+    XLA program's (fn, args, statics) for the analysis/ir registry.
+    """
+    _ensure_build_log()
+    times: dict[str, Any] = stage_times if stage_times is not None else {}
+    route = resolve_route(route)
+    arm = "xla" if _capture is not None else resolve_segments(segments)
+    times["route"] = route
+    times["segments"] = arm
+    times.setdefault("compile_seconds", 0.0)
+    if route == "native" and not benes.native_available():
+        raise RuntimeError("route='native' needs the native benes router")
+
+    from ..obs.spans import span as obs_span
+
+    def staged(name, program, args, statics):
+        if _capture is not None:
+            _capture[name] = (program, args, statics)
+        exe = _compiled(name, program, args, statics, times)
+        with _phase(f"dev {name}"):
+            t0 = time.perf_counter()
+            out = jax.block_until_ready(exe(*args))
+            times[name] = times.get(name, 0.0) + time.perf_counter() - t0
+        return out
+
+    def timed(name, fn):
+        with _phase(f"dev {name}"):
+            t0 = time.perf_counter()
+            out = fn()
+            times[name] = times.get(name, 0.0) + time.perf_counter() - t0
+        return out
+
+    # ---- ingest + classes (shapes for everything later) --------------------
+    t0 = time.perf_counter()
+    src_h, dst_h, v, e = extract_edges(graph)
+    if arm == "xla":
+        src = jax.device_put(src_h)
+        dst = jax.device_put(dst_h)
+        cand = jax.device_put(_CANDIDATES)
+    times["ingest"] = time.perf_counter() - t0
+
+    if arm == "xla":
+        in_widx, out_widx, in_hist, out_hist = staged(
+            "layout.device_hist", _degree_hist_program,
+            (src, dst, cand), dict(num_vertices=v),
+        )
+        t0 = time.perf_counter()
+        in_hist = np.asarray(in_hist)
+        out_hist = np.asarray(out_hist)
+        if int(in_hist.sum()) != v or int(out_hist.sum()) != v:
+            raise RuntimeError(
+                "graph degree exceeds the device builder's 2^30 width "
+                "table; use the host builder"
+            )
+        in_w = out_w = None
+        # The device histograms ARE the per-width counts; the class/sizing
+        # math lives in ONE place (`seg_classes_from_counts`) shared with
+        # the host builder.
+        meta = seg_classes_from_counts(
+            _CANDIDATES[in_hist > 0].astype(np.int64),
+            in_hist[in_hist > 0].astype(np.int64),
+            _CANDIDATES[out_hist > 0].astype(np.int64),
+            out_hist[out_hist > 0].astype(np.int64),
+            v,
+        )
+        times["classes"] = time.perf_counter() - t0
+    else:
+        in_w, out_w = timed("degrees", lambda: seg_degrees(src_h, dst_h, v))
+        meta = timed("classes", lambda: seg_classes(in_w, out_w, v))
+
+    # ---- relabel ------------------------------------------------------------
+    if arm == "xla":
+        in_va = jax.device_put(_va_by_widx(meta.in_classes, meta.widths))
+        out_va = jax.device_put(_va_by_widx(meta.out_classes, meta.owidths))
+        in_tabs = tuple(
+            jax.device_put(a) for a in _class_device_tables(meta.in_classes)
+        )
+        out_tabs = tuple(
+            jax.device_put(a) for a in _class_device_tables(meta.out_classes)
+        )
+        new2old, old2new, outpos_of_old = staged(
+            "layout.device_relabel", _relabel_program,
+            (in_widx, out_widx, in_va, out_va), dict(vr=meta.vr),
+        )
+    else:
+        new2old, old2new, outpos_of_old = timed(
+            "relabel", lambda: seg_relabel(in_w, out_w, meta)
+        )
+
+    # ---- overlapped tail: net route || (vperm network + sparse CSR) --------
+    # The big-net chain (L1/L2 slots -> net assembly -> route -> compact)
+    # stays on the MAIN thread — the critical path never waits on a thread
+    # handoff.  A worker builds/routes/compacts the vperm network and the
+    # sparse CSR — everything the sequential host builder serializes after
+    # the net route — but is GATED on the net route actually starting: the
+    # route is walker-bound on one core, so that window is when a second
+    # core is genuinely free (running the worker any earlier measurably
+    # inflates the critical path's own slot sorts on a 2-core build host —
+    # memory-bandwidth contention, not CPU).
+    box: dict[str, Any] = {}
+    route_started = threading.Event()
+
+    def tail_track():
+        route_started.wait()
+        if "slots" not in box:
+            return  # main track failed before reaching its route
+        src_l1, l1_by_edge, dstn, srcn = box["slots"]
+        if arm == "xla":
+            vperm = staged(
+                "layout.device_vperm_assembly", _vperm_assembly_program,
+                (outpos_of_old, old2new),
+                dict(vp=meta.vp, vr=meta.vr, out_vb=meta.out_vb),
+            )
+        else:
+            vperm = timed(
+                "vperm_assembly",
+                lambda: seg_vperm_assembly(outpos_of_old, old2new, meta),
+            )
+        box["vperm"] = _route_and_compact(
+            vperm, meta.vp, route, arm, "vperm", times, _capture
+        )
+        if arm == "xla":
+            box["csr"] = staged(
+                "layout.device_csr", _csr_program,
+                (srcn, dstn, l1_by_edge), dict(vr=meta.vr),
+            )
+        else:
+            def host_csr():
+                sn = _gather(old2new, src_h)
+                return seg_csr(sn, dstn, l1_by_edge, meta)
+
+            box["csr"] = timed("csr", host_csr)
+
+    # The hold sizes for BOTH routes (n + vp, not the host builder's
+    # sequential max): the vperm route runs INSIDE the net route's window
+    # here, and an exhausted pool would silently drop the second route's
+    # MAP_HUGETLB mapping to 4K pages — losing the measured +21-26% router
+    # speedup on exactly the cold path this builder exists to speed up.
+    with benes.hugepage_reservation(meta.n + meta.vp):
+        worker = _TRACK_POOL.submit(tail_track)
+        try:
+            if arm == "xla":
+                src_l1, l1_by_edge, l2_by_edge, dstn, srcn = staged(
+                    "layout.device_slots", _slots_program,
+                    (src, dst, old2new, outpos_of_old, *in_tabs, *out_tabs),
+                    dict(m1=meta.m1),
+                )
+                box["slots"] = (src_l1, l1_by_edge, dstn, srcn)
+                net = staged(
+                    "layout.device_net_assembly", _net_assembly_program,
+                    (l1_by_edge, l2_by_edge), dict(n=meta.n),
+                )
+            else:
+                src_l1, l1_by_edge, dstn = timed(
+                    "slots_l1",
+                    lambda: seg_l1_slots(src_h, dst_h, old2new, meta),
+                )
+                box["slots"] = (src_l1, l1_by_edge, dstn, None)
+                l2_by_edge = timed(
+                    "slots_l2",
+                    lambda: seg_l2_slots(src_h, outpos_of_old, meta),
+                )
+                net = timed(
+                    "net_assembly",
+                    lambda: seg_net_assembly(l1_by_edge, l2_by_edge, meta),
+                )
+            route_started.set()
+            net_masks, net_table = _route_and_compact(
+                net, meta.n, route, arm, "net", times, _capture
+            )
+        except BaseException:
+            # Unblock + drain the worker WITHOUT masking the main-track
+            # error (its own failure, if any, is secondary here).
+            route_started.set()
+            concurrent.futures.wait([worker])
+            raise
+        worker.result()  # join; re-raises a worker-track failure
+    vperm_masks, vperm_table = box["vperm"]
+    adj_indptr, adj_dst, adj_slot = box["csr"]
+
+    # ---- finalize: host-resident dataclass ---------------------------------
+    def finalize():
+        return RelayGraph(
+            num_vertices=v,
+            num_edges=e,
+            vr=meta.vr,
+            new2old=np.asarray(new2old),
+            old2new=np.asarray(old2new),
+            vperm_masks=np.asarray(vperm_masks),
+            vperm_table=vperm_table,
+            vperm_size=meta.vp,
+            out_classes=meta.out_classes,
+            out_space=meta.out_vb,
+            net_masks=np.asarray(net_masks),
+            net_table=net_table,
+            net_size=meta.n,
+            m1=meta.m1,
+            m2=meta.m2,
+            in_classes=meta.in_classes,
+            src_l1=np.asarray(src_l1),
+            adj_indptr=np.asarray(adj_indptr, dtype=np.int32),
+            adj_dst=np.asarray(adj_dst),
+            adj_slot=np.asarray(adj_slot),
+        )
+
+    return timed("finalize", finalize)
+
+
+def ir_operands(graph: Graph | DeviceGraph) -> dict:
+    """name -> (fn, args, statics) for every device-builder XLA program at
+    ``graph``'s scale — the analysis/ir registry lowers exactly these
+    (route=jax so the router programs are captured without the native
+    dependency)."""
+    capture: dict = {}
+    build_relay_graph_device(graph, route="jax", _capture=capture)
+    return capture

@@ -222,9 +222,21 @@ def main(argv=None) -> int:
         t0 = time.perf_counter()
         server.register(name, graph)
         server.query(name, 0).result(timeout=600)  # warm layout + first shape
-        logger.info(
-            "Graph registered and warm in %.2f s", time.perf_counter() - t0
-        )
+        li = server.registry.layout_info()
+        if li:  # non-relay engines build no relay layout
+            logger.info(
+                "Graph registered and warm in %.2f s (layout %s, "
+                "builder=%s, build %.2f s)",
+                time.perf_counter() - t0,
+                li.get("cache", "memo"),
+                li.get("builder", "host"),
+                float(li.get("build_seconds", -1.0)),
+            )
+        else:
+            logger.info(
+                "Graph registered and warm in %.2f s",
+                time.perf_counter() - t0,
+            )
         if args.repl:
             repl(server, name, graph.num_vertices)
             report = server.report()

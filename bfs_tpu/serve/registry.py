@@ -119,6 +119,10 @@ class GraphRegistry:
         self.metrics = metrics  # guarded-by: _lock
         self.evictions = 0  # guarded-by: _lock
         self.evictions_deferred = 0  # guarded-by: _lock
+        # Info dict of the most recent relay layout load-or-build (builder
+        # flavor, build/load seconds, per-stage timings) for register-time
+        # reporting; {} until the first relay layout is built.
+        self.last_layout_info: dict = {}  # guarded-by: _lock
         # Persistent layout bundles: a LayoutCache, a directory path, or
         # None (in-process memoization only — the default, so tests and
         # embedders opt in to disk writes explicitly).
@@ -367,6 +371,12 @@ class GraphRegistry:
             layout = rec.layouts.setdefault(engine, layout)
         return layout
 
+    def layout_info(self) -> dict:
+        """Snapshot of the most recent relay layout build/load info
+        (builder flavor, seconds, per-stage timings); {} before any."""
+        with self._lock:
+            return dict(self.last_layout_info)
+
     def attach_metrics(self, metrics) -> None:
         """Adopt a metrics sink unless one is already attached.  The
         lock-guarded form of the ``if registry.metrics is None:
@@ -396,13 +406,18 @@ class GraphRegistry:
     def _build_relay_layout(self, graph: Graph):
         """The RelayEngine constructor arg: the disk-cached RelayGraph when
         a layout cache is configured, else the host graph (the engine
-        builds the layout itself)."""
+        builds the layout itself).  The build info (builder flavor,
+        build/load seconds, per-stage timings) is kept in
+        ``last_layout_info`` so register-time surfaces (`bfs-tpu-serve`,
+        the load generator) can print what graph registration cost."""
         if self.layout_cache is None:
             return graph
         from ..cache.layout import load_or_build_relay
 
         rg, info = load_or_build_relay(graph, cache=self.layout_cache)
         self._note_disk(info)
+        with self._lock:
+            self.last_layout_info = dict(info)
         return rg
 
     # ---------------------------------------------------------- residency --

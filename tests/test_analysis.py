@@ -581,6 +581,20 @@ def test_repo_has_expected_hot_coverage():
         "bfs_tpu/models/bfs.py": ("_frontier_masses_words",),
         "bfs_tpu/obs/telemetry.py": ("record_direction",),
         "bfs_tpu/serve/executor.py": ("_state_to_result",),
+        # the device layout-builder programs (ISSUE 10 tentpole) are the
+        # first-touch build path — they must stay transfer-policed and
+        # IR-registered; deleting a pragma fails here
+        "bfs_tpu/graph/relay_device.py": (
+            "_degree_hist_program",
+            "_relabel_program",
+            "_slots_program",
+            "_net_assembly_program",
+            "_vperm_assembly_program",
+            "_csr_program",
+            "_route_level_program",
+            "_route_mid_program",
+            "_compact_program",
+        ),
     }
     for rel, fn_names in expectations.items():
         src = SourceFile(os.path.join(REPO, rel), REPO)

@@ -1,0 +1,265 @@
+"""Device-side relay layout builder (graph/relay_device.py) vs the host
+oracle builder: bit-parity on rmat/gnm/star/path fixtures across both
+segment arms, semantic equivalence of the pure-JAX route arm, end-to-end
+oracle-exact BFS through device-built layouts on every relay path, and the
+``BFS_TPU_LAYOUT_BUILD`` flavor knob in the bundle store."""
+
+import numpy as np
+import pytest
+
+from bfs_tpu.graph import benes
+from bfs_tpu.graph import relay
+from bfs_tpu.graph.csr import Graph
+from bfs_tpu.graph.generators import gnm_graph, path_graph, rmat_graph
+from bfs_tpu.graph.relay_device import (
+    build_relay_graph_device,
+    resolve_route,
+    resolve_segments,
+    route_masks_device,
+)
+from bfs_tpu.models.bfs import RelayEngine
+from bfs_tpu.oracle.bfs import canonical_bfs, check
+
+requires_native = pytest.mark.skipif(
+    not benes.native_available(), reason="native benes router unavailable"
+)
+
+
+def star_graph(n: int = 96) -> Graph:
+    """Hub 0 <-> every other vertex: one huge-width out class next to a
+    width-1 class — the vertex-major/rank-major mix in one fixture."""
+    edges = np.stack([np.zeros(n - 1, dtype=np.int64),
+                      np.arange(1, n, dtype=np.int64)], axis=1)
+    return Graph.from_undirected_edges(n, edges)
+
+
+def _fixtures():
+    return [
+        ("rmat", rmat_graph(9, 8, seed=7)),
+        ("gnm", gnm_graph(300, 1800, seed=3)),
+        ("star", star_graph()),
+        ("path", path_graph(70)),
+    ]
+
+
+_ARRAY_FIELDS = (
+    "new2old", "old2new", "src_l1", "adj_indptr", "adj_dst", "adj_slot",
+)
+_SCALAR_FIELDS = (
+    "num_vertices", "num_edges", "vr", "vperm_size", "out_space",
+    "net_size", "m1", "m2",
+)
+
+
+def _assert_same_construction(host, dev, tag):
+    """Classes/slots/permutation-level equality: every field EXCEPT the
+    routing masks is bit-identical (the 'identical classes/slots/perm'
+    half of the parity contract)."""
+    for f in _SCALAR_FIELDS:
+        assert getattr(host, f) == getattr(dev, f), (tag, f)
+    for f in _ARRAY_FIELDS:
+        np.testing.assert_array_equal(
+            getattr(host, f), getattr(dev, f), err_msg=f"{tag}:{f}"
+        )
+    assert repr(host.in_classes) == repr(dev.in_classes), tag
+    assert repr(host.out_classes) == repr(dev.out_classes), tag
+
+
+def _assert_same_masks(host, dev, tag):
+    np.testing.assert_array_equal(
+        host.net_masks, dev.net_masks, err_msg=f"{tag}:net_masks"
+    )
+    np.testing.assert_array_equal(
+        host.vperm_masks, dev.vperm_masks, err_msg=f"{tag}:vperm_masks"
+    )
+    assert repr(host.net_table) == repr(dev.net_table), tag
+    assert repr(host.vperm_table) == repr(dev.vperm_table), tag
+
+
+# ---- builder parity ---------------------------------------------------------
+
+@requires_native
+@pytest.mark.parametrize("segments", ["host", "xla"])
+def test_device_builder_bit_identical_native_route(segments):
+    """With the native route arm the device builder is BIT-IDENTICAL to the
+    host builder — masks, stage tables, classes, slots, CSR, everything —
+    on all four fixture shapes, under both segment arms."""
+    for tag, g in _fixtures():
+        host = relay.build_relay_graph(g)
+        dev = build_relay_graph_device(g, route="native", segments=segments)
+        _assert_same_construction(host, dev, f"{tag}/{segments}")
+        _assert_same_masks(host, dev, f"{tag}/{segments}")
+
+
+@requires_native
+@pytest.mark.parametrize("segments", ["host", "xla"])
+def test_jax_route_semantic_equivalence(segments):
+    """The pure-JAX route arm: identical classes/slots/perm (every
+    non-mask field bit-identical), masks allowed to differ — documented
+    semantic equivalence."""
+    for tag, g in [("rmat", rmat_graph(8, 8, seed=5)), ("path", path_graph(40))]:
+        host = relay.build_relay_graph(g)
+        dev = build_relay_graph_device(g, route="jax", segments=segments)
+        _assert_same_construction(host, dev, f"{tag}/{segments}")
+
+
+def test_jax_router_routes_arbitrary_permutations():
+    """route_masks_device's masks realize exactly ``y[j] = x[perm[j]]`` on
+    the standard stage topology (the same applier contract as the native
+    router), including the all-identity permutation, which must route
+    switch-free (zero masks -> shrunken stage ranges)."""
+    rng = np.random.default_rng(11)
+    for n in (32, 256, 4096):
+        perm = rng.permutation(n).astype(np.int32)
+        masks = np.asarray(route_masks_device(perm, n=n))
+        x = rng.integers(0, 1 << 30, size=n)
+        np.testing.assert_array_equal(
+            benes.apply_network_numpy(masks, x), x[perm]
+        )
+    ident = np.arange(1024, dtype=np.int32)
+    assert not np.asarray(route_masks_device(ident, n=1024)).any()
+
+
+def test_stage_times_and_arm_resolution():
+    g = gnm_graph(120, 500, seed=1)
+    times = {}
+    build_relay_graph_device(
+        g, route=resolve_route(None), stage_times=times
+    )
+    assert times["segments"] == resolve_segments(None)
+    assert times["route"] in ("native", "jax")
+    assert times["compile_seconds"] >= 0.0
+    stage_keys = [
+        k for k, v in times.items() if isinstance(v, float) and k not in (
+            "compile_seconds",
+        )
+    ]
+    # per-stage timings: the classing prelude, both routes, a compaction
+    assert any(k.startswith("route_net") for k in stage_keys)
+    assert any(k.startswith("route_vperm") for k in stage_keys)
+    assert any("compact" in k for k in stage_keys)
+    with pytest.raises(ValueError):
+        resolve_segments("gpu")
+    with pytest.raises(ValueError):
+        resolve_route("fastest")
+
+
+# ---- end-to-end BFS through device-built layouts ----------------------------
+
+@requires_native
+def test_bfs_oracle_exact_packed_and_sparse_paths():
+    """Oracle-exact BFS with canonical parents through a device-built
+    layout on the packed dense path and the sparse hybrid path."""
+    g = gnm_graph(200, 900, seed=5)
+    rg = build_relay_graph_device(g)
+    for sparse in (False, True):
+        eng = RelayEngine(rg, sparse_hybrid=sparse)
+        for s in (0, 17, 140):
+            r = eng.run(s)
+            dist, parent = canonical_bfs(g, s)
+            np.testing.assert_array_equal(r.dist, dist)
+            np.testing.assert_array_equal(r.parent, parent)
+            assert check(g, r.dist, r.parent, s) == []
+
+
+@requires_native
+def test_bfs_oracle_exact_multisource_path():
+    """Batched multi-source BFS through a device-built layout matches the
+    canonical per-source trees."""
+    g = gnm_graph(150, 600, seed=9)
+    rg = build_relay_graph_device(g)
+    eng = RelayEngine(rg)
+    sources = [0, 31, 77, 149]
+    res = eng.run_multi(sources)
+    for i, s in enumerate(sources):
+        dist, parent = canonical_bfs(g, s)
+        np.testing.assert_array_equal(res.dist[i], dist)
+        np.testing.assert_array_equal(res.parent[i], parent)
+        assert check(g, res.dist[i], res.parent[i], s) == []
+
+
+@requires_native
+def test_bfs_oracle_exact_jax_routed_layout():
+    """The no-native route arm end-to-end: a jax-routed device layout
+    still solves oracle-exactly (its masks differ from the native
+    router's but route the same permutation)."""
+    g = rmat_graph(8, 6, seed=2)
+    rg = build_relay_graph_device(g, route="jax")
+    eng = RelayEngine(rg, sparse_hybrid=True)
+    r = eng.run(3)
+    dist, parent = canonical_bfs(g, 3)
+    np.testing.assert_array_equal(r.dist, dist)
+    np.testing.assert_array_equal(r.parent, parent)
+    assert check(g, r.dist, r.parent, 3) == []
+
+
+# ---- the flavor knob in the bundle store ------------------------------------
+
+@requires_native
+def test_load_or_build_relay_builder_flavors(tmp_path, monkeypatch):
+    """Default first-touch path is the device builder; BFS_TPU_LAYOUT_BUILD
+    =host selects the oracle; bundle bytes are identical either way, and a
+    warm hit replays the cold build's provenance."""
+    from bfs_tpu.cache.layout import LayoutCache, load_or_build_relay
+    from bfs_tpu.graph.relay import relay_to_arrays
+
+    monkeypatch.delenv("BFS_TPU_LAYOUT_BUILD", raising=False)
+    g = gnm_graph(100, 300, seed=4)
+    cache = LayoutCache(str(tmp_path / "dev"))
+    rg, info = load_or_build_relay(g, cache=cache)
+    assert info["cache"] == "miss" and info["builder"] == "device"
+    assert info["build_stages"]["segments"] in ("host", "xla")
+    assert info["build_stages"]["route"] in ("native", "jax")
+    _, info_hit = load_or_build_relay(g, cache=cache)
+    assert info_hit["cache"] == "hit"
+    assert info_hit["builder"] == "device"  # provenance from bundle meta
+    assert "build_stages" in info_hit
+
+    monkeypatch.setenv("BFS_TPU_LAYOUT_BUILD", "host")
+    rg_host, info_host = load_or_build_relay(
+        g, cache=LayoutCache(str(tmp_path / "host"))
+    )
+    assert info_host["builder"] == "host"
+    a, b = relay_to_arrays(rg), relay_to_arrays(rg_host)
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=k)
+
+    monkeypatch.setenv("BFS_TPU_LAYOUT_BUILD", "banana")
+    with pytest.raises(ValueError):
+        load_or_build_relay(g, cache=None)
+
+
+def test_device_build_failure_falls_back_to_host(monkeypatch):
+    """A device-builder failure must degrade to the host oracle builder
+    (with the failure recorded), never fail the registration/build."""
+    import bfs_tpu.graph.relay_device as rd
+    from bfs_tpu.cache.layout import load_or_build_relay
+
+    def boom(*a, **kw):
+        raise RuntimeError("injected device-build failure")
+
+    monkeypatch.setattr(rd, "build_relay_graph_device", boom)
+    monkeypatch.delenv("BFS_TPU_LAYOUT_BUILD", raising=False)
+    g = gnm_graph(80, 240, seed=6)
+    rg, info = load_or_build_relay(g, cache=None)
+    assert info["builder"] == "host"
+    assert "injected device-build failure" in info["build_stages"]["fallback"]
+    host = relay.build_relay_graph(g)
+    np.testing.assert_array_equal(rg.src_l1, host.src_l1)
+
+
+def test_width_table_matches_class_width():
+    """The searchsorted candidate-table classing (device + sharded shared
+    helper) is exactly `_class_width` over the full degree range."""
+    cand = relay.width_candidates()
+    deg = np.concatenate([
+        np.arange(0, 4096),
+        (1 << np.arange(0, 30)).astype(np.int64),
+        (3 << np.arange(0, 28)).astype(np.int64),
+        (1 << np.arange(2, 30)) - 1,
+        (1 << np.arange(2, 30)) + 1,
+    ])
+    np.testing.assert_array_equal(
+        relay._class_width(deg), cand[relay.width_index(deg, cand)]
+    )

@@ -27,6 +27,57 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
+def _compare_builders(dg, scale: int, reps: int) -> None:
+    """Time ``reps`` interleaved UNCACHED builds per flavor and print the
+    medians — the ISSUE 10 build-seconds evidence table
+    (BENCHMARKS.md 'Layout build: device vs host')."""
+    import statistics
+
+    from bfs_tpu.graph.relay import build_relay_graph
+    from bfs_tpu.graph.relay_device import build_relay_graph_device
+
+    build_relay_graph(dg)  # warm both paths once (numpy/native/jit caches)
+    stages: dict = {}
+    build_relay_graph_device(dg, stage_times=stages)
+    host_s, dev_s, deltas = [], [], []
+    for i in range(reps):
+        # Alternate which flavor builds first: the SECOND build of a pair
+        # measures ~2-3 ms slower at toy scale (allocator/cache pollution
+        # from its predecessor), so a fixed order would bias the
+        # comparison by more than the effect being measured.
+        order = ("host", "device") if i % 2 == 0 else ("device", "host")
+        pair = {}
+        for flavor in order:
+            t0 = time.perf_counter()
+            if flavor == "host":
+                build_relay_graph(dg)
+            else:
+                build_relay_graph_device(dg)
+            pair[flavor] = time.perf_counter() - t0
+        host_s.append(pair["host"])
+        dev_s.append(pair["device"])
+        deltas.append(pair["host"] - pair["device"])
+    print(
+        json.dumps({
+            "scale": scale,
+            "reps": reps,
+            "host_build_s": {
+                "median": statistics.median(host_s), "min": min(host_s),
+            },
+            "device_build_s": {
+                "median": statistics.median(dev_s), "min": min(dev_s),
+            },
+            "paired_delta_s_median": statistics.median(deltas),
+            "device_wins": sum(1 for d in deltas if d > 0),
+            "device_stage_seconds": {
+                k: round(v, 5) if isinstance(v, float) else v
+                for k, v in stages.items()
+            },
+        }),
+        flush=True,
+    )
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument(
@@ -44,6 +95,14 @@ def main(argv=None) -> int:
     ap.add_argument("--compile", action="store_true",
                     help="also AOT-compile the fused relay program per "
                     "scale (TPU backends; populates the exe cache)")
+    ap.add_argument("--builder", choices=("auto", "device", "host"),
+                    default="auto",
+                    help="relay layout builder flavor for cold builds "
+                    "(default: BFS_TPU_LAYOUT_BUILD, i.e. device)")
+    ap.add_argument("--compare", type=int, metavar="N", default=0,
+                    help="instead of warming, time N interleaved UNCACHED "
+                    "builds per flavor per scale and print a "
+                    "device-vs-host build-seconds table")
     args = ap.parse_args(argv)
 
     from bfs_tpu.config import enable_compile_cache
@@ -70,6 +129,8 @@ def main(argv=None) -> int:
     )
 
     backend = _generator_backend()
+    if args.builder != "auto":
+        os.environ["BFS_TPU_LAYOUT_BUILD"] = args.builder
     for scale in scales:
         key = (
             f"{backend}_s{scale}_ef{args.edge_factor}_seed{args.seed}"
@@ -84,11 +145,17 @@ def main(argv=None) -> int:
             f"(V={dg.num_vertices} E={dg.num_edges})",
             flush=True,
         )
+        if args.compare:
+            _compare_builders(dg, scale, args.compare)
+            continue
         t0 = time.perf_counter()
         rg, build_seconds = load_or_build_relay(dg, key)
+        from bfs_tpu.bench import _LAST_RELAY_INFO
+
         print(
             f"s{scale}: relay layout ready in {time.perf_counter() - t0:.1f}s "
-            f"(cold build was {build_seconds:.1f}s)",
+            f"(cold build was {build_seconds:.1f}s, "
+            f"builder={_LAST_RELAY_INFO.get('builder', 'host')})",
             flush=True,
         )
         if args.pull:

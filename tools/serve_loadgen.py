@@ -180,10 +180,16 @@ def main(argv=None) -> int:
         from bfs_tpu.utils.metrics import artifact_report
 
         rep = artifact_report()
+        li = server.registry.layout_info()
+        flavor = (
+            f"; builder={li.get('builder', 'host')}, "
+            f"build {float(li.get('build_seconds', -1.0)):.2f}s"
+            if li else ""  # non-relay engines build no relay layout
+        )
         print(
             f"register+layout: {time.perf_counter() - t_reg:.2f}s "
             f"(layout cache: {rep.get('layout_cache_hits', 0)} hits / "
-            f"{rep.get('layout_cache_misses', 0)} misses)",
+            f"{rep.get('layout_cache_misses', 0)} misses{flavor})",
             flush=True,
         )
         t0 = time.perf_counter()
