@@ -58,6 +58,7 @@ _FLAVOR_ENV = (
     "BFS_TPU_DIRECTION", "BFS_TPU_DIRECTION_ALPHA", "BFS_TPU_DIRECTION_BETA",
     "BFS_TPU_PACKED", "BFS_TPU_PALLAS", "BFS_TPU_ROWMIN",
     "BFS_TPU_STATE_UPDATE", "BFS_TPU_IR_HBM_GB",
+    "BFS_TPU_EXCHANGE", "BFS_TPU_EXCHANGE_DIV",
 )
 
 #: Primitives whose presence in a loop body is a host round-trip (IR002).
@@ -487,6 +488,7 @@ def _spec_relay_fused():
     fused = _relay_fused_program(
         eng._static, eng.sparse_hybrid, eng._use_pallas(), eng.packed,
         False, eng.direction.key(), eng._phase_sel(),
+        eng.relay_graph.num_vertices,
     )
     return Program(
         name="relay.fused", path="bfs_tpu/models/bfs.py",
@@ -635,7 +637,13 @@ def _spec_sharded_pull():
     )
 
 
-def _spec_sharded_relay():
+def _spec_sharded_relay(flavor: str = "dense"):
+    """The sharded relay program family (ISSUE 11): ``dense`` is the
+    pull-only bitmap-arm baseline, ``exchange_auto`` compiles the
+    word-list/bitmap density cond with the telemetry byte accumulators,
+    and ``push`` ships the per-shard adjacency and the direction cond —
+    all three must pass IR005/IR006 (collective axes + u32/i32 exchange
+    payloads) and the donation/HBM rules."""
     from ..parallel.sharded import make_mesh
 
     _need_devices(2)
@@ -645,6 +653,8 @@ def _spec_sharded_relay():
         _own_word_table_dev,
         _prepare_relay,
         _relay_valid_words,
+        _sharded_adj_dev,
+        _sharded_adj_dummies,
         _sharded_relay_mask_args,
         _sharded_relay_static,
     )
@@ -656,15 +666,27 @@ def _spec_sharded_relay():
     import jax.numpy as jnp
 
     static = _sharded_relay_static(srg, 2, False, packed)
+    sparse = flavor == "push"
+    if sparse:
+        adj = _sharded_adj_dev(srg, packed)
+        outdeg = jnp.asarray(srg.outdeg)
+        direction = ("auto", 14.0, 24.0, srg.num_vertices, srg.num_edges)
+    else:
+        adj = _sharded_adj_dummies(2)
+        outdeg = jnp.zeros((1,), jnp.int32)
+        direction = None
+    exchange = ("auto", 8) if flavor != "dense" else ("bitmap", 8)
+    telemetry = flavor != "dense"
     return Program(
-        name="sharded.relay_fused", path="bfs_tpu/parallel/sharded.py",
+        name=f"sharded.relay_{flavor}", path="bfs_tpu/parallel/sharded.py",
         fn=_bfs_sharded_relay_fused,
         args=(
             vperm_arg, net_arg, _relay_valid_words(srg),
-            _own_word_table_dev(srg), jnp.int32(0),
+            _own_word_table_dev(srg), *adj, outdeg, jnp.int32(0),
         ),
         static_kwargs=dict(
-            mesh=mesh, static=static, max_levels=16, telemetry=False
+            mesh=mesh, static=static, max_levels=16, telemetry=telemetry,
+            direction=direction, exchange=exchange, sparse=sparse,
         ),
         v_elements=srg.num_vertices, packed=packed,
         budget_bytes=_hbm_envelope(),
@@ -688,7 +710,11 @@ PROGRAM_SPECS = {
     "superstep.pull_step": lambda: _spec_superstep("pull"),
     "sharded.push_fused": _spec_sharded_push,
     "sharded.pull_fused": _spec_sharded_pull,
-    "sharded.relay_fused": _spec_sharded_relay,
+    "sharded.relay_dense": lambda: _spec_sharded_relay("dense"),
+    "sharded.relay_exchange_auto": lambda: _spec_sharded_relay(
+        "exchange_auto"
+    ),
+    "sharded.relay_push": lambda: _spec_sharded_relay("push"),
     "layout.device_hist": lambda: _spec_layout_device("layout.device_hist"),
     "layout.device_relabel": lambda: _spec_layout_device(
         "layout.device_relabel"

@@ -394,10 +394,13 @@ def run_cell(spec: dict) -> dict:
         # vertex slot) + the scalar termination all-reduce; per-shard static
         # layout bytes let "would N real chips win?" be modeled from data.
         if eng == "relay":
-            # Compact exchange (parallel/sharded._exchange_compact): only
-            # words holding real vertices travel — n_shards * kw words,
-            # ~V/8 bytes flat in shard count (the naive block-bit gather
-            # grew with per-shard class padding: VERDICT r4 weak #4).
+            # Bitmap-arm exchange bytes (parallel/exchange.py): only words
+            # holding real vertices travel — n_shards * kw words, ~V/8
+            # bytes flat in shard count (the naive block-bit gather grew
+            # with per-shard class padding: VERDICT r4 weak #4).  This is
+            # the static upper arm; the auto arm's word-list levels ship
+            # less — the MULTICHIP bench (BENCH_MESH) measures the real
+            # per-level bytes via telemetry.
             from .parallel.sharded import _own_word_table_dev
 
             gwords = layout.num_shards * _own_word_table_dev(layout).shape[1]

@@ -772,6 +772,19 @@ class ShardedRelayGraph:
     m2: int
     in_classes: tuple[ClassSlice, ...]  # over local [0, block)
     src_l1: np.ndarray  # int32[n, m1]; ORIGINAL src ids, INF padding
+    # Per-shard dst-owned adjacency (ROADMAP item 1 / ISSUE 11): shard s's
+    # CSR over GLOBAL relabeled src ids holding, per edge into an owned
+    # destination, the LOCAL dst id [0, block) and that edge's L1 slot —
+    # the operands the sharded push (sparse gather) superstep needs, so
+    # the direction-optimizing schedule runs across the mesh.  Rows are
+    # padded to the max per-shard edge count for uniform SPMD shapes.
+    # ``outdeg`` is the per-GLOBAL-new-id out-degree table (0 at dummies)
+    # the Beamer predicate reads.  None on layouts built before this
+    # field existed (dense-only fallback).
+    adj_indptr: np.ndarray | None = None  # int32[n, n*block + 2]
+    adj_dst: np.ndarray | None = None  # int32[n, emax]; LOCAL dst ids
+    adj_slot: np.ndarray | None = None  # int32[n, emax]; L1 slots
+    outdeg: np.ndarray | None = None  # int32[n*block]
 
 
 def _merge_tables(tables: list[tuple[StageSpec, ...]]) -> tuple[StageSpec, ...]:
@@ -924,6 +937,7 @@ def build_sharded_relay_graph(
     vperm_masks_l, vperm_tables = [], []
     net_masks_l, net_tables = [], []
     src_l1 = np.full((n, m1), INF_DIST, dtype=np.int32)
+    adj_parts: list = []  # futures during the loop, (indptr, dst, slot) after
 
     # Static out-class lookup tables for the vectorized per-shard classing
     # below (shared helpers with the device builder — ISSUE 10 satellite):
@@ -998,6 +1012,29 @@ def build_sharded_relay_graph(
             l1_by_edge[o1] = l1_sorted
             l2_by_edge = np.empty(ee - es, dtype=np.int64)
             l2_by_edge[o2] = l2_sorted
+
+            # ---- per-shard dst-owned adjacency (the push body's CSR) ---
+            # Grouped by GLOBAL relabeled src id — the all-gathered
+            # frontier's id space — holding (local dst, L1 slot) per
+            # edge; the within-row order is free (the push superstep
+            # re-sorts its gathered candidates by (dst, slot)), so the
+            # shared counting-sort fill (`_csr_fill`, native fast path)
+            # does it in one pass, same as the single-chip builder's
+            # sparse CSR segment.  Submitted to the device builder's
+            # worker pool BEFORE this shard's net route starts (the
+            # PR 10 overlap idiom: the route is walker-bound on one
+            # core, the fill is numpy on another), resolved after the
+            # loop.
+            from .relay_device import _TRACK_POOL
+
+            srcn_g = old2new[s_src].astype(np.int32)
+            adj_parts.append(
+                _TRACK_POOL.submit(
+                    _csr_fill, srcn_g, dstn.astype(np.int32),
+                    l1_by_edge.astype(np.int32), gtot,
+                )
+            )
+
             net[l1_by_edge] = l2_by_edge
             used = np.zeros(net_size, dtype=bool)
             used[l2_by_edge] = True
@@ -1007,6 +1044,22 @@ def build_sharded_relay_graph(
             del nm_full
             net_masks_l.append(nm)
             net_tables.append(nt)
+
+    # Resolve the overlapped adjacency fills (re-raises a worker failure).
+    adj_parts = [p.result() for p in adj_parts]
+
+    # Uniform SPMD shapes for the adjacency rows: pad every shard's edge
+    # arrays to the max per-shard count (padded tail entries are never
+    # addressed — each shard's indptr bounds its own real entries).
+    emax = max(1, max(p[1].shape[0] for p in adj_parts))
+    adj_indptr = np.stack([p[0] for p in adj_parts])
+    adj_dst = np.zeros((n, emax), np.int32)
+    adj_slot = np.zeros((n, emax), np.int32)
+    for s, (_, d_s, sl_s) in enumerate(adj_parts):
+        adj_dst[s, : d_s.shape[0]] = d_s
+        adj_slot[s, : sl_s.shape[0]] = sl_s
+    outdeg_new = np.zeros(gtot, np.int32)
+    outdeg_new[old2new] = np.bincount(src, minlength=v).astype(np.int32)
 
     return ShardedRelayGraph(
         num_vertices=v,
@@ -1027,6 +1080,10 @@ def build_sharded_relay_graph(
         m2=m2,
         in_classes=tuple(in_classes),
         src_l1=src_l1,
+        adj_indptr=adj_indptr,
+        adj_dst=adj_dst,
+        adj_slot=adj_slot,
+        outdeg=outdeg_new,
     )
 
 

@@ -413,6 +413,10 @@ _COMPILE_LOCK = threading.Lock()
 #: never pays thread spawn latency on its critical path.  Two workers:
 #: two concurrent builds in one process (a serve registry racing two
 #: graphs) each still get a live worker.
+#: Shared build-overlap pool: this builder's tail track AND the sharded
+#: builder's per-shard adjacency fills (relay.build_sharded_relay_graph,
+#: ISSUE 11) ride it — host numpy work overlapped with the native route's
+#: single-walker window.
 _TRACK_POOL = concurrent.futures.ThreadPoolExecutor(
     max_workers=2, thread_name_prefix="relay-build"
 )

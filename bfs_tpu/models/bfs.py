@@ -475,22 +475,21 @@ def _take_sparse(st, outdeg, vr: int, num_adj_entries: int):
 # bfs_tpu: hot traced
 def _frontier_masses_words(st, outdeg, vr: int):
     """(occupancy int32, out-edge mass float32) of a word-packed frontier
-    — the Beamer predicate's inputs (models/direction.py take_pull), one
-    popcount + one masked sum per superstep.  Float32 mass: counts are
-    integer-exact below 2^24 and far from any threshold above it."""
-    from ..ops import relay as R
+    — the Beamer predicate's inputs, delegated to the ONE shared
+    definition (models/direction.frontier_masses_words) the sharded relay
+    program also compiles, so mesh and single-chip schedules see
+    identical masses."""
+    from .direction import frontier_masses_words
 
-    fsize = jax.lax.population_count(st.fwords).sum(dtype=jnp.int32)
-    bools = R.unpack_std(st.fwords, vr)
-    fe = jnp.where(bools != 0, outdeg, 0).astype(jnp.float32).sum()
-    return fsize, fe
+    return frontier_masses_words(st.fwords, outdeg, vr)
 
 
 @functools.lru_cache(maxsize=16)
 def _relay_fused_program(static, sparse: bool, use_pallas: bool,
                          packed: bool = False, telemetry: bool = False,
                          direction: tuple | None = None,
-                         phase_sel: tuple | None = None):
+                         phase_sel: tuple | None = None,
+                         num_real: int | None = None):
     """Jitted relay BFS loop (v4), cached per static layout shape.
 
     With ``sparse``, small frontiers (under the SPARSE_BV/BE budgets) take
@@ -652,6 +651,14 @@ def _relay_fused_program(static, sparse: bool, use_pallas: bool,
 
             alpha, beta = dir_alpha, dir_beta
             mu0 = outdeg.astype(jnp.float32).sum()
+            # The occupancy threshold keys on the REAL vertex count when
+            # the caller supplies it (RelayEngine does): the padded vr is
+            # layout-dependent, and the sharded relay program — whose
+            # padded space differs — must compile the SAME predicate so
+            # mesh and single-chip schedules are bit-identical (ISSUE 11
+            # mesh-parity; direction.py's push/pull programs already use
+            # real V).
+            v_thresh = vr if num_real is None else num_real
 
             def decide(st, mu, prev_pull):
                 fsize, fe = _frontier_masses_words(st, outdeg, vr)
@@ -662,7 +669,9 @@ def _relay_fused_program(static, sparse: bool, use_pallas: bool,
                 bv, be = sparse_budgets(vr, adj_dst.shape[0])
                 budget_ok = (fsize <= bv) & (fe <= jnp.float32(be))
                 use_pull = (
-                    take_pull(prev_pull, fsize, fe, m_u, vr, alpha, beta)
+                    take_pull(
+                        prev_pull, fsize, fe, m_u, v_thresh, alpha, beta
+                    )
                     | ~budget_ok
                 )
                 return use_pull, m_u
@@ -1578,6 +1587,7 @@ class RelayEngine:
         fused = _relay_fused_program(
             self._static, self.sparse_hybrid, self._use_pallas(), packed,
             telemetry, self.direction.key(), self._phase_sel(),
+            self.relay_graph.num_vertices,
         )
         args = (
             source_new, *self._tensors, *self._sparse_tensors_for(packed)

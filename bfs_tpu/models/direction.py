@@ -135,6 +135,29 @@ def frontier_masses(frontier_bool, outdeg):
     return fsize, fedges
 
 
+# bfs_tpu: hot traced
+def frontier_masses_words(fwords, outdeg, n: int):
+    """Word-packed twin of :func:`frontier_masses`: (occupancy int32,
+    out-edge mass float32) from standard-packed frontier words over an
+    ``n``-element id space — ONE popcount + one masked out-degree sum.
+    THE single definition of the Beamer predicate's inputs for every
+    word-frontier program: the single-chip relay loop
+    (models/bfs._frontier_masses_words delegates here) and the sharded
+    relay's replicated global-mass computation compile exactly this, so
+    mesh and single-chip schedules see identical masses (float32 sums of
+    per-vertex integers — exact below 2^24, which is what makes the
+    ISSUE 11 bit-identical schedule parity provable rather than
+    approximate)."""
+    import jax
+
+    from ..ops.relay import unpack_std
+
+    fsize = jax.lax.population_count(fwords).sum(dtype=jnp.int32)
+    bools = unpack_std(fwords, n)
+    fe = jnp.where(bools != 0, outdeg, 0).astype(jnp.float32).sum()
+    return fsize, fe
+
+
 def _host_outdeg(num_vertices: int, src: np.ndarray) -> np.ndarray:
     """Out-degree per vertex id from the (possibly padded) edge-source
     array: int32[V+1] with an inert sentinel slot, matching the engines'

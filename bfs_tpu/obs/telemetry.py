@@ -138,6 +138,44 @@ def record_direction(dacc, level, code):
     return dacc.at[_slot(level)].set(jnp.asarray(code, jnp.int32))
 
 
+# ---------------------------------------------------------- exchange bytes --
+# The sharded relay exchange (parallel/exchange.py) accumulates its
+# bytes-on-the-wire and the arm that shipped them per level, riding the
+# SAME int32[TEL_SLOTS] accumulator shape and the same one-pull-at-exit
+# contract as the level curve and the direction schedule.  Slot ``l``
+# holds the payload bytes of the exchange that shipped the level-``l``
+# frontier (int32 is exact: one superstep's payload is bounded by the
+# flat arm's ``n * block/32 * 4`` bytes, far below 2^31).  Levels past
+# TEL_SLOTS clamp into the last slot, which then aggregates the whole
+# deep tail — still exact for any search shorter than ~4M supersteps at
+# the flat payload; consumers (exchange_report, the sharded ledger) use
+# the loop-exit superstep count for per-superstep math, never the
+# clamped slot count.
+
+
+def init_bytes_acc(slots: int = TEL_SLOTS):
+    """int32[slots] exchange-bytes accumulator (slot 0 stays 0: the
+    source frontier is seeded by init, nothing shipped)."""
+    import jax.numpy as jnp
+
+    return jnp.zeros((slots,), jnp.int32)
+
+
+# bfs_tpu: hot traced
+def record_exchange(bacc, aacc, level, nbytes, arm):
+    """Record one superstep's exchange: payload bytes added into the
+    bytes accumulator, the arm code (parallel/exchange.py EX_*) set in
+    the arm accumulator — both at the slot of the level this exchange's
+    frontier settled."""
+    import jax.numpy as jnp
+
+    s = _slot(level)
+    return (
+        bacc.at[s].add(jnp.asarray(nbytes, jnp.int32)),
+        aacc.at[s].set(jnp.asarray(arm, jnp.int32)),
+    )
+
+
 def direction_schedule(dirs, *, mode: str, alpha: float, beta: float) -> dict:
     """JSON-ready schedule from the host direction accumulator (post
     :func:`read_telemetry`): per-level push/pull labels, switch count, and

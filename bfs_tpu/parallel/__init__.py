@@ -1,1 +1,2 @@
+from .exchange import ExchangeConfig, resolve_exchange  # noqa: F401
 from .sharded import make_mesh, bfs_sharded, bfs_sharded_multi, GRAPH_AXIS, BATCH_AXIS  # noqa: F401

@@ -100,3 +100,17 @@ def pcast_varying(x, axes):
     if pcast is None:
         return x
     return pcast(x, tuple(axes), to="varying")
+
+
+def pcast_carry(tree, axes):
+    """:func:`pcast_varying` mapped over a pytree of loop-carry leaves.
+
+    The sharded relay's carry grew replicated-initialized leaves whose
+    BODY outputs derive from graph-axis-varying values (the telemetry
+    accumulators fed the all-gathered frontier words, the Beamer
+    ``mu``/``prev`` fed the frontier masses): new jax's replication
+    checker requires the init side of such a ``while_loop`` carry to be
+    cast to "varying" up front, exactly like the frontier words
+    themselves.  Identity on jax 0.4.x (same contract as
+    :func:`pcast_varying`)."""
+    return jax.tree_util.tree_map(lambda x: pcast_varying(x, axes), tree)

@@ -32,7 +32,7 @@ import numpy as np
 
 from jax.sharding import Mesh, PartitionSpec as P
 
-from .compat import pcast_varying, shard_map as _shard_map
+from .compat import pcast_carry, pcast_varying, shard_map as _shard_map
 
 from ..graph.csr import DeviceGraph, Graph, build_device_graph
 from ..graph.ell import ShardedPullGraph, build_sharded_pull_graph
@@ -339,150 +339,321 @@ _SHARDED_AOT_CACHE: dict = {}
 _SHARDED_AOT_CACHE_MAX = 8
 
 
+def _sharded_push_candidates(
+    fw, adj_indptr, adj_dst, adj_slot, unreached, *,
+    gtot: int, block: int, bv: int, be: int, packed: bool,
+):
+    """Push (sparse gather) candidate producer for one shard: extract the
+    GLOBAL frontier list from the all-gathered words, fan out to this
+    shard's dst-owned adjacency slice, min-merge per owned destination by
+    a (local dst, slot) sort, and emit candidates in the SAME per-owned-
+    vertex format as the dense relay pipeline (min L1 slot unpacked, min
+    within-row rank ``| PACKED_SENTINEL`` packed) — so the shared
+    superstep tail (sieve, exchange, state update) is body-agnostic and
+    the two bodies are bit-exact for any schedule.
+
+    ``unreached``: bool[block] — the SIEVE applied at the producer: a
+    settled destination never yields a candidate, so its bit can never
+    re-enter the exchange.  The shapes are the clamped sparse budgets
+    (``bv`` global frontier vertices, ``be`` edges into this shard);
+    dispatch guarantees they hold (models/bfs.sparse_budgets — the same
+    derivation the predicate uses, so capacity and dispatch can never
+    disagree)."""
+    from ..models.bfs import _extract_frontier_list
+    from ..ops.packed import PACKED_SENTINEL
+
+    flist = _extract_frontier_list(fw, gtot, bv)
+    deg = adj_indptr[flist + 1] - adj_indptr[flist]  # 0 at the gtot fill
+    cum = jnp.cumsum(deg)
+    starts = adj_indptr[flist]
+    j = jnp.arange(be, dtype=jnp.int32)
+    owner = jnp.searchsorted(cum, j, side="right").astype(jnp.int32)
+    owner_c = jnp.clip(owner, 0, bv - 1)
+    prev = jnp.where(owner_c > 0, cum[jnp.maximum(owner_c - 1, 0)], 0)
+    eidx = starts[owner_c] + (j - prev)
+    valid = j < cum[-1]
+    eidx = jnp.where(valid, eidx, 0)
+    dstv = adj_dst[eidx]  # LOCAL owned ids [0, block)
+    slot = adj_slot[eidx]  # L1 slots (unpacked) / within-row ranks (packed)
+    dk, sk = jax.lax.sort(
+        (jnp.where(valid, dstv, jnp.int32(block)), slot), num_keys=2
+    )
+    first = (
+        jnp.concatenate([jnp.ones(1, bool), dk[1:] != dk[:-1]])
+        & (dk < block)
+    )
+    upd = first & unreached[jnp.clip(dk, 0, block - 1)]
+    tgt = jnp.where(upd, dk, jnp.int32(block))  # block = dropped
+    if packed:
+        return (
+            jnp.full(block, PACKED_SENTINEL, jnp.uint32)
+            .at[tgt].set(sk.astype(jnp.uint32), mode="drop")
+        )
+    return (
+        jnp.full(block, INT32_MAX, jnp.int32)
+        .at[tgt].set(sk, mode="drop")
+    )
+
+
 @functools.partial(
-    jax.jit, static_argnames=("mesh", "static", "max_levels", "telemetry")
+    jax.jit,
+    static_argnames=(
+        "mesh", "static", "max_levels", "telemetry", "direction",
+        "exchange", "sparse",
+    ),
 )
 def _bfs_sharded_relay_fused(
-    vperm_masks, net_masks, valid_words, own_words, source_new, *,
+    vperm_masks, net_masks, valid_words, own_words,
+    adj_indptr, adj_dst, adj_slot, outdeg, source_new, *,
     mesh, static, max_levels, telemetry: bool = False,
+    direction: tuple | None = None, exchange: tuple = ("bitmap", 8),
+    sparse: bool = False,
 ):
     """Vertex-partitioned relay BFS (v4): per-shard Beneš layouts (one
-    unified SPMD program, per-device mask data), frontier exchanged as a
-    bit-packed all-gather (1 bit/vertex over ICI per superstep).  State
-    lives in the GLOBAL RELABELED space — dist/parent fully distributed,
-    parent VALUES are per-shard L1 slot indices (converted to original src
-    ids on the host, bfs_sharded).
+    unified SPMD program, per-device mask data), frontier exchanged
+    through the compressed-exchange arms of :mod:`..parallel.exchange`.
+    State lives in the GLOBAL RELABELED space — dist/parent fully
+    distributed, parent VALUES are per-shard L1 slot indices (converted
+    to original src ids on the host, bfs_sharded).
+
+    ``exchange`` is the resolved :class:`~.exchange.ExchangeConfig` key:
+    ``flat`` all-gathers the whole owned word range (the oracle),
+    ``bitmap`` the sieved real-word table, ``auto``/``delta`` the
+    word-list arm with its on-device density fallback.  The superstep is
+    structured for OVERLAP: the exchange collective on the new frontier
+    words is issued as soon as the improvement mask exists, BEFORE the
+    O(V/n) state writes — the gathered words land in a fresh buffer (the
+    previous frontier stays live as the candidate pipeline's operand, a
+    double-buffered carry), so XLA's scheduler can fly the all-gather
+    over the local state update and the termination ``pmax`` rides the
+    same window.
+
+    ``direction`` — ``(mode, alpha, beta, V_real, E_real)`` — selects the
+    superstep body per level once ``sparse`` ships the per-shard
+    dst-owned adjacency: ``pull`` runs the dense relay pipeline every
+    superstep, ``push`` the sparse gather wherever the static budgets
+    allow (the legacy hybrid dispatch), ``auto`` the Beamer predicate
+    (models/direction.py take_pull — the SAME single definition the
+    single-chip programs compile, fed the real V/E so the schedule is
+    bit-identical to the single-chip relay engine's for the same graph
+    and thresholds).  Both bodies emit candidates in one format; the
+    decision is a pure function of replicated on-device state (the
+    global frontier words), so no collective and no host sync is needed
+    to agree on the branch.
 
     With ``packed`` in ``static`` each shard carries ONE uint32
     ``level:6|rank:26`` word per owned vertex (half the per-superstep
     state HBM bytes), the update is one lexicographic min, and the
     dist/parent-slot outputs are unpacked once at loop exit — the
-    exchange is untouched (it ships frontier bits either way).  The loop
-    caps at PACKED_MAX_LEVELS; ``changed`` is returned so the host
-    wrapper can detect a cap exit and re-run unpacked.
+    exchange ships frontier bits either way.  The loop caps at
+    PACKED_MAX_LEVELS; ``changed`` is returned so the host wrapper can
+    detect a cap exit and re-run unpacked.
 
     With ``telemetry`` (static) the carry additionally holds the
-    per-level occupancy accumulator AND the direction-schedule
-    accumulator (obs/telemetry.py), fed the GLOBAL all-gathered frontier
-    words — identical on every shard, so the accs stay replicated with no
-    extra collective — and returned as fifth/sixth outputs for ONE pull
-    at loop exit.  Every sharded superstep records DIR_PULL: the sharded
-    layout ships no per-shard adjacency yet, so the dense relay pipeline
-    is the only correct body on the mesh (the push flavor needs the
-    dst-owned adjacency slice — ROADMAP item 1's exchange work);
-    ``bfs_sharded`` rejects ``direction='push'`` for the same reason."""
+    per-level occupancy, direction-schedule, exchange-bytes and
+    exchange-arm accumulators (obs/telemetry.py), fed the GLOBAL
+    all-gathered frontier words — identical on every shard, so the accs
+    stay replicated with no extra collective — and returned as outputs
+    4..7 for ONE pull at loop exit."""
     from ..ops.packed import PACKED_SENTINEL, level_word, packed_cap
     from ..ops.relay import pack_std, unpack_relay_packed
+    from .exchange import ExchangeConfig, make_exchange
 
     n = mesh.shape[GRAPH_AXIS]
     block = static[0]
     in_classes = static[7]
     packed = static[-1]
     nw = block // 32
+    gtot = n * block
     cap = packed_cap(max_levels) if packed else max_levels
+    ex_cfg = ExchangeConfig(*exchange)
+    mode = direction[0] if direction is not None else None
+    if mode in ("auto", "push") and not sparse:
+        # No adjacency operands shipped: the dense relay is the only
+        # body.  Normalized here (not silently at the engine) so the
+        # recorded schedule stays honest for any direct program caller.
+        mode = None
+    if mode in ("auto", "push"):
+        from ..models.bfs import sparse_budgets
 
-    def inner(vperm_blk, net_blk, valid_blk, own_all, source):
+        # STATIC Python values (jit static_argnames tuple members), cast
+        # at trace-build time — never a device sync.
+        dir_alpha = float(direction[1])  # bfs_tpu: ok TRC002 static tuple member
+        dir_beta = float(direction[2])  # bfs_tpu: ok TRC002 static tuple member
+        v_real = int(direction[3])  # bfs_tpu: ok TRC002 static tuple member
+        e_real = int(direction[4])  # bfs_tpu: ok TRC002 static tuple member
+        bv, _ = sparse_budgets(gtot, gtot)
+        _, be = sparse_budgets(gtot, adj_dst.shape[-1])
+        _, be_pred = sparse_budgets(gtot, e_real)
+
+    def inner(vperm_blk, net_blk, valid_blk, own_all, indptr, adj_d,
+              adj_s, outdeg, source):
         vperm_blk = _strip_shard_dim(vperm_blk)
         net_blk = _strip_shard_dim(net_blk)
         valid_blk = valid_blk[0]
         own_local = own_all[jax.lax.axis_index(GRAPH_AXIS)]
+        if sparse:
+            indptr = indptr[0]
+            adj_d = adj_d[0]
+            adj_s = adj_s[0]
         fwords = _packed_source_frontier(source, block, n)
+        exchange_fn = make_exchange(
+            ex_cfg, own_all.shape[1], nw, GRAPH_AXIS
+        )
 
-        def cond(carry):
-            level, changed = carry[-2], carry[-1]
-            return changed & (level < cap)
+        def cond(c):
+            return c["changed"] & (c["level"] < cap)
+
+        def dense_cand(fw):
+            return _relay_candidates_shard(
+                fw, vperm_blk, net_blk, valid_blk, static=static
+            )
+
+        def push_cand(fw, unreached):
+            return _sharded_push_candidates(
+                fw, indptr, adj_d, adj_s, unreached,
+                gtot=gtot, block=block, bv=bv, be=be, packed=packed,
+            )
+
+        if mode in ("auto", "push"):
+            from ..models.direction import frontier_masses_words
+
+            def global_masses(fw):
+                # Replicated math on replicated inputs (the all-gathered
+                # words + the replicated outdeg table): every shard
+                # computes the identical masses, no collective needed to
+                # agree on the branch.
+                return frontier_masses_words(fw, outdeg, gtot)
+
+            def budget_ok(fsize, fe):
+                return (fsize <= bv) & (fe <= jnp.float32(be_pred))
 
         if telemetry:
             from ..obs import telemetry as T
 
-            # accs ride BEFORE (level, changed) so cond's carry[-2:] holds.
-            acc0 = T.init_level_acc()
-            dir0 = T.init_dir_acc()
+        def body(c):
+            fw, level = c["fw"], c["level"]
+            if packed:
+                pk = c["pk"]
+                unreached = pk == PACKED_SENTINEL
+            else:
+                dist, parent = c["dist"], c["parent"]
+                unreached = dist == INT32_MAX
 
+            # ---- per-superstep body selection (pure replicated math) ----
+            if mode == "auto":
+                from ..models.direction import take_pull
+
+                fsize, fe = global_masses(fw)
+                m_u = jnp.maximum(c["mu"] - fe, 0.0)
+                use_pull = (
+                    take_pull(
+                        c["prev"], fsize, fe, m_u, v_real, dir_alpha,
+                        dir_beta,
+                    )
+                    | ~budget_ok(fsize, fe)
+                )
+            elif mode == "push":
+                fsize, fe = global_masses(fw)
+                use_pull = ~budget_ok(fsize, fe)
+            else:
+                use_pull = None
+
+            if use_pull is None:
+                cand = dense_cand(fw)
+            else:
+                cand = jax.lax.cond(
+                    use_pull,
+                    dense_cand,
+                    lambda f: push_cand(f, unreached),
+                    fw,
+                )
+
+            # ---- improvement mask + the SIEVE (settled never ships) -----
+            level2 = level + 1
+            if packed:
+                candw = cand | level_word(level2)
+                improved = candw < pk
+            else:
+                improved = (cand != INT32_MAX) & unreached
+
+            # ---- exchange issued BEFORE the state writes (overlap) ------
+            fw2, xbytes, xarm = exchange_fn(
+                pack_std(improved), own_local, own_all
+            )
+            changed = (
+                jax.lax.pmax(improved.any().astype(jnp.int32), GRAPH_AXIS)
+                > 0
+            )
+
+            # ---- local state update (flies under the collective) --------
+            out = dict(c)
+            if packed:
+                out["pk"] = jnp.minimum(pk, candw)
+            else:
+                out["dist"] = jnp.where(improved, level2, dist)
+                out["parent"] = jnp.where(improved, cand, parent)
+            out["fw"] = fw2
+            out["level"] = level2
+            out["changed"] = changed
+            if mode == "auto":
+                out["mu"] = m_u
+                out["prev"] = use_pull
+            if telemetry:
+                out["occ"] = T.record_frontier_words(c["occ"], fw2, level2)
+                if use_pull is None:
+                    code = jnp.int32(T.DIR_PULL)
+                else:
+                    code = jnp.where(
+                        use_pull, jnp.int32(T.DIR_PULL),
+                        jnp.int32(T.DIR_PUSH),
+                    )
+                out["dirs"] = T.record_direction(c["dirs"], level2, code)
+                out["xb"], out["xa"] = T.record_exchange(
+                    c["xb"], c["xa"], level2, xbytes, xarm
+                )
+            return out
+
+        carry = {
+            "fw": fwords,
+            "level": jnp.int32(0),
+            "changed": jnp.bool_(True),
+        }
         if packed:
             lo = jax.lax.axis_index(GRAPH_AXIS).astype(jnp.int32) * block
             ids_local = lo + jnp.arange(block, dtype=jnp.int32)
-            pk0 = jnp.where(
+            carry["pk"] = jnp.where(
                 ids_local == source, jnp.uint32(0), PACKED_SENTINEL
             )
-
-            def body(carry):
-                pk, fw, level, _ = carry
-                cand = _relay_candidates_shard(
-                    fw, vperm_blk, net_blk, valid_blk, static=static
-                )
-                pk2 = jnp.minimum(pk, cand | level_word(level + 1))
-                improved = pk2 != pk
-                fw = _exchange_compact(
-                    pack_std(improved), own_local, own_all, nw
-                )
-                changed = (
-                    jax.lax.pmax(
-                        improved.any().astype(jnp.int32), GRAPH_AXIS
-                    )
-                    > 0
-                )
-                return pk2, fw, level + 1, changed
-
-            if telemetry:
-
-                def body_t(carry):
-                    pk, fw, acc, dirs, level, ch = carry
-                    pk2, fw2, level2, changed = body((pk, fw, level, ch))
-                    acc = T.record_frontier_words(acc, fw2, level2)
-                    dirs = T.record_direction(dirs, level2, T.DIR_PULL)
-                    return pk2, fw2, acc, dirs, level2, changed
-
-                pk, _, acc, dirs, level, changed = jax.lax.while_loop(
-                    cond, body_t,
-                    (pk0, fwords, acc0, dir0, jnp.int32(0), jnp.bool_(True)),
-                )
-                dist, parent = unpack_relay_packed(pk, in_classes, block)
-                return dist, parent, level, changed, acc, dirs
-            pk, _, level, changed = jax.lax.while_loop(
-                cond, body, (pk0, fwords, jnp.int32(0), jnp.bool_(True))
-            )
-            dist, parent = unpack_relay_packed(pk, in_classes, block)
-            return dist, parent, level, changed
-
-        dist, parent = _init_block_state(source, block)
-
-        def body(carry):
-            dist, parent, fw, level, _ = carry
-            cand = _relay_candidates_shard(
-                fw, vperm_blk, net_blk, valid_blk, static=static
-            )
-            improved = (cand != INT32_MAX) & (dist == INT32_MAX)
-            level = level + 1
-            dist = jnp.where(improved, level, dist)
-            parent = jnp.where(improved, cand, parent)
-            fw = _exchange_compact(pack_std(improved), own_local, own_all, nw)
-            changed = (
-                jax.lax.pmax(improved.any().astype(jnp.int32), GRAPH_AXIS) > 0
-            )
-            return dist, parent, fw, level, changed
-
+        else:
+            carry["dist"], carry["parent"] = _init_block_state(source, block)
+        # Replicated-initialized leaves whose body outputs derive from
+        # graph-axis-varying values: cast the init side like the frontier
+        # words (compat.pcast_carry — identity on jax 0.4.x).
+        extras = {}
+        if mode == "auto":
+            extras["mu"] = outdeg.astype(jnp.float32).sum()
+            extras["prev"] = jnp.bool_(False)
         if telemetry:
+            extras["occ"] = T.init_level_acc()
+            extras["dirs"] = T.init_dir_acc()
+            extras["xb"] = T.init_bytes_acc()
+            extras["xa"] = T.init_dir_acc()
+        carry.update(pcast_carry(extras, (GRAPH_AXIS,)))
 
-            def body_t(carry):
-                dist, parent, fw, acc, dirs, level, ch = carry
-                dist, parent, fw2, level2, changed = body(
-                    (dist, parent, fw, level, ch)
-                )
-                acc = T.record_frontier_words(acc, fw2, level2)
-                dirs = T.record_direction(dirs, level2, T.DIR_PULL)
-                return dist, parent, fw2, acc, dirs, level2, changed
-
-            dist, parent, _, acc, dirs, level, changed = jax.lax.while_loop(
-                cond, body_t,
-                (dist, parent, fwords, acc0, dir0, jnp.int32(0),
-                 jnp.bool_(True)),
+        out = jax.lax.while_loop(cond, body, carry)
+        if packed:
+            dist, parent = unpack_relay_packed(
+                out["pk"], in_classes, block
             )
-            return dist, parent, level, changed, acc, dirs
-        dist, parent, _, level, changed = jax.lax.while_loop(
-            cond, body, (dist, parent, fwords, jnp.int32(0), jnp.bool_(True))
-        )
-        return dist, parent, level, changed
+        else:
+            dist, parent = out["dist"], out["parent"]
+        if telemetry:
+            return (
+                dist, parent, out["level"], out["changed"],
+                out["occ"], out["dirs"], out["xb"], out["xa"],
+            )
+        return dist, parent, out["level"], out["changed"]
 
     fn = _shard_map(
         inner,
@@ -492,10 +663,14 @@ def _bfs_sharded_relay_fused(
             _mask_specs(net_masks),
             P(GRAPH_AXIS, None),
             P(),
+            P(GRAPH_AXIS, None),
+            P(GRAPH_AXIS, None),
+            P(GRAPH_AXIS, None),
+            P(),
             P(),
         ),
         out_specs=(
-            (P(GRAPH_AXIS), P(GRAPH_AXIS), P(), P(), P(), P())
+            (P(GRAPH_AXIS), P(GRAPH_AXIS), P(), P(), P(), P(), P(), P())
             if telemetry
             else (P(GRAPH_AXIS), P(GRAPH_AXIS), P(), P())
         ),
@@ -506,7 +681,10 @@ def _bfs_sharded_relay_fused(
         # over batch; it is simply replicated along it.
         axis_names={GRAPH_AXIS, BATCH_AXIS},
     )
-    return fn(vperm_masks, net_masks, valid_words, own_words, source_new)
+    return fn(
+        vperm_masks, net_masks, valid_words, own_words,
+        adj_indptr, adj_dst, adj_slot, outdeg, source_new,
+    )
 
 
 @functools.partial(
@@ -694,25 +872,17 @@ def _own_word_table_dev(srg):
 
 
 def _exchange_compact(improved_words, own_local, own_all, nw: int):
-    """Compact frontier exchange: local packed words -> global packed
-    words.  ``improved_words``: uint32[..., nw] (this shard's new frontier
-    bits); ``own_local``: int32[kw] this shard's real-word indices;
-    ``own_all``: int32[n, kw] every shard's table (replicated).  Returns
-    uint32[..., n*nw] — the same global standard-packed frontier the full
-    all-gather produced, built from an ``n*kw``-word exchange."""
-    n = own_all.shape[0]
+    """Compact (bitmap-arm) frontier exchange: local packed words ->
+    global packed words, via the ONE bitmap wire-move implementation
+    (parallel/exchange.bitmap_gather — the multi-source program and the
+    single-source arms must never diverge).  ``improved_words``:
+    uint32[..., nw] (this shard's new frontier bits); ``own_local``:
+    int32[kw] this shard's real-word indices; ``own_all``: int32[n, kw]
+    every shard's table (replicated).  Returns uint32[..., n*nw]."""
+    from .exchange import bitmap_gather
+
     send = jnp.take(improved_words, own_local, axis=-1)
-    if send.ndim == 1:
-        gath = jax.lax.all_gather(send, GRAPH_AXIS)  # [n, kw]
-    else:
-        gath = jax.lax.all_gather(send, GRAPH_AXIS, axis=1)  # [s_l, n, kw]
-    base = (jnp.arange(n, dtype=jnp.int32) * nw)[:, None]
-    flat_idx = (own_all + base).reshape(-1)
-    lead = improved_words.shape[:-1]
-    out = jnp.zeros((*lead, n * nw), jnp.uint32)
-    return out.at[..., flat_idx].set(
-        gath.reshape(*lead, -1), unique_indices=False
-    )
+    return bitmap_gather(send, own_all, nw, GRAPH_AXIS)
 
 
 def _relay_valid_words(srg):
@@ -725,6 +895,55 @@ def _relay_valid_words(srg):
             [valid_slot_words(srg.src_l1[s], srg.net_size)
              for s in range(srg.num_shards)]
         )
+    )
+
+
+def _sharded_adj_ranks(srg) -> np.ndarray:
+    """Per-edge within-row RANKS from the per-shard adjacency's L1 slots
+    (slot = base + rank*stride inverted with the shared local vertex
+    tables) — the packed carry's parent-field flavor, derived host-side
+    once so the layout stays slot-based (same contract as the single-chip
+    engine's ``_adj_ranks``)."""
+    from ..graph.relay import _vertex_tables
+
+    base1, stride1 = _vertex_tables(list(srg.in_classes), srg.block)
+    d = np.clip(srg.adj_dst, 0, srg.block - 1)
+    return (
+        (srg.adj_slot - base1[d]) // np.maximum(stride1[d], 1)
+    ).astype(np.int32)
+
+
+def _sharded_adj_dev(srg, packed: bool):
+    """Device-resident per-shard adjacency operands ``(indptr, dst,
+    slot-or-rank)``, memoized per flavor on the layout object (layout
+    data, like the masks — must not land inside a caller's timed
+    repeats).  Raises if this layout predates per-shard adjacency."""
+    if srg.adj_dst is None:
+        raise ValueError(
+            "this ShardedRelayGraph ships no per-shard adjacency "
+            "(pre-exchange layout); rebuild with build_sharded_relay_graph"
+        )
+    key = "_adj_dev_ranks" if packed else "_adj_dev_slots"
+    cached = getattr(srg, key, None)
+    if cached is None:
+        third = _sharded_adj_ranks(srg) if packed else srg.adj_slot
+        cached = (
+            jnp.asarray(srg.adj_indptr),
+            jnp.asarray(srg.adj_dst),
+            jnp.asarray(third),
+        )
+        object.__setattr__(srg, key, cached)
+    return cached
+
+
+def _sharded_adj_dummies(n: int):
+    """1-element traced-and-dropped adjacency stand-ins for the dense-only
+    program flavors (mirrors RelayEngine's hybrid-off dummies: the fused
+    program keeps ONE signature, XLA drops the unused operands)."""
+    return (
+        jnp.zeros((n, 1), jnp.int32),
+        jnp.zeros((n, 1), jnp.int32),
+        jnp.zeros((n, 1), jnp.int32),
     )
 
 
@@ -779,6 +998,7 @@ def bfs_sharded(
     applier: str = "auto",
     telemetry: bool = False,
     direction: str | None = None,
+    exchange: str | None = None,
 ):
     """Single-source BFS sharded over the mesh's ``graph`` axis.
 
@@ -794,32 +1014,35 @@ def bfs_sharded(
         the direct analogue of the reference's map/shuffle/reduce, kept for
         differential testing.
 
-    ``telemetry`` (relay engine only) carries the per-level occupancy
-    and direction-schedule accumulators through the sharded loop
-    (obs/telemetry.py) and returns ``(BfsResult, level_curve)`` — one
-    extra replicated pull at exit, the curve carrying
-    ``direction_schedule``.
+    ``telemetry`` (relay engine only) carries the per-level occupancy,
+    direction-schedule and exchange accumulators through the sharded
+    loop (obs/telemetry.py) and returns ``(BfsResult, level_curve)`` —
+    one extra replicated pull at exit, the curve carrying
+    ``direction_schedule`` and ``exchange`` (bytes-on-the-wire per
+    level, per-level arm schedule).
 
     ``direction`` resolves like the single-chip engine's knob
-    (BFS_TPU_DIRECTION; models/direction.py).  The sharded relay layout
-    ships no per-shard adjacency yet, so the dense relay (pull) body is
-    the only correct body on the mesh: ``'pull'``/``'auto'`` both run it
-    (auto records an all-pull schedule); ``'push'`` raises — the sparse
-    gather flavor needs the dst-owned adjacency slice that ROADMAP item
-    1's compressed-exchange work adds.
+    (BFS_TPU_DIRECTION; models/direction.py).  With the per-shard
+    dst-owned adjacency the sharded builder now ships, every mode runs
+    across the mesh: ``'pull'`` is the dense relay pipeline every
+    superstep, ``'push'`` the sparse gather body wherever the static
+    budgets allow, ``'auto'`` the Beamer predicate — bit-identical
+    schedules to the single-chip relay engine for the same graph and
+    thresholds.  A prebuilt pre-adjacency layout still runs
+    ``'pull'``/``'auto'`` (dense only) and rejects ``'push'``.
+
+    ``exchange`` resolves the frontier-exchange arm
+    (BFS_TPU_EXCHANGE; parallel/exchange.py):
+    ``auto|bitmap|delta|flat``, flat being the uncompressed oracle.  All
+    arms are bit-identical in results; only wire bytes differ.
     """
     from ..models.direction import resolve_direction
+    from .exchange import resolve_exchange
 
     mesh = mesh if mesh is not None else make_mesh()
     if telemetry and engine != "relay":
         raise ValueError("telemetry is carried by the sharded relay engine only")
     dir_cfg = resolve_direction(direction)
-    if engine == "relay" and dir_cfg.mode == "push":
-        raise ValueError(
-            "direction='push' is unavailable on the sharded relay engine: "
-            "the sharded layout ships no per-shard adjacency (use 'pull' "
-            "or 'auto')"
-        )
     if engine == "relay":
         from ..ops.packed import (
             packed_rank_fits,
@@ -827,44 +1050,68 @@ def bfs_sharded(
             resolve_packed,
         )
 
+        ex_cfg = resolve_exchange(exchange)
         srg = _prepare_relay(graph, mesh)
         check_sources(srg.num_vertices, source)
         max_levels = int(max_levels) if max_levels is not None else srg.num_vertices
         source_new = jnp.int32(int(srg.old2new[source]))
         use_pallas = _resolve_sharded_applier(applier)
         vperm_arg, net_arg = _sharded_relay_mask_args(srg, use_pallas)
-        args = (
-            vperm_arg, net_arg, _relay_valid_words(srg),
-            _own_word_table_dev(srg), source_new,
+        n = _graph_shards(mesh)
+        has_adj = srg.adj_dst is not None
+        if dir_cfg.mode == "push" and not has_adj:
+            raise ValueError(
+                "direction='push' needs the per-shard adjacency this "
+                "ShardedRelayGraph predates; rebuild it with "
+                "build_sharded_relay_graph (use 'pull' or 'auto' to run "
+                "dense-only)"
+            )
+        sparse = has_adj and dir_cfg.mode in ("auto", "push")
+        direction_static = (
+            dir_cfg.mode, dir_cfg.alpha, dir_cfg.beta,
+            srg.num_vertices, srg.num_edges,
         )
+        outdeg_dev = (
+            jnp.asarray(srg.outdeg)
+            if sparse and srg.outdeg is not None
+            else jnp.zeros((1,), jnp.int32)
+        )
+        sparse = sparse and srg.outdeg is not None
 
         def run_prog(packed: bool):
-            static = _sharded_relay_static(
-                srg, _graph_shards(mesh), use_pallas, packed
+            static = _sharded_relay_static(srg, n, use_pallas, packed)
+            adj = (
+                _sharded_adj_dev(srg, packed)
+                if sparse
+                else _sharded_adj_dummies(n)
+            )
+            args = (
+                vperm_arg, net_arg, _relay_valid_words(srg),
+                _own_word_table_dev(srg), *adj, outdeg_dev, source_new,
+            )
+            kwargs = dict(
+                mesh=mesh, static=static, max_levels=max_levels,
+                telemetry=telemetry, direction=direction_static,
+                exchange=ex_cfg.key(), sparse=sparse,
             )
             if use_pallas:
                 from ..models.bfs import RelayEngine
 
-                key = ("single", static, mesh, max_levels, telemetry)
+                key = ("single", static, mesh, max_levels, telemetry,
+                       direction_static, ex_cfg.key(), sparse)
                 compiled = _SHARDED_AOT_CACHE.get(key)
                 if compiled is None:
                     from ..models.bfs import compile_exe_cached
 
                     compiled = compile_exe_cached(
-                        _bfs_sharded_relay_fused.lower(
-                            *args, mesh=mesh, static=static,
-                            max_levels=max_levels, telemetry=telemetry,
-                        ),
+                        _bfs_sharded_relay_fused.lower(*args, **kwargs),
                         RelayEngine._COMPILER_OPTIONS,
                     )
                     while len(_SHARDED_AOT_CACHE) >= _SHARDED_AOT_CACHE_MAX:
                         _SHARDED_AOT_CACHE.pop(next(iter(_SHARDED_AOT_CACHE)))
                     _SHARDED_AOT_CACHE[key] = compiled
                 return compiled(*args)
-            return _bfs_sharded_relay_fused(
-                *args, mesh=mesh, static=static, max_levels=max_levels,
-                telemetry=telemetry,
-            )
+            return _bfs_sharded_relay_fused(*args, **kwargs)
 
         packed = resolve_packed(packed_rank_fits(srg.in_classes))
         out = run_prog(packed)
@@ -889,12 +1136,19 @@ def bfs_sharded(
             read_telemetry,
         )
         from ..ops.packed import PACKED_MAX_LEVELS
+        from .exchange import exchange_report
 
-        fv, dirs = read_telemetry((out[4], out[5]))
+        fv, dirs, xb, xa = read_telemetry(
+            (out[4], out[5], out[6], out[7])
+        )
         cap = min(PACKED_MAX_LEVELS, max_levels) if packed else max_levels
         curve = level_curve(fv, cap=cap)
         curve["direction_schedule"] = direction_schedule(
             dirs, mode=dir_cfg.mode, alpha=dir_cfg.alpha, beta=dir_cfg.beta
+        )
+        curve["exchange"] = exchange_report(
+            xb, xa, ex_cfg, int(_own_word_table_dev(srg).shape[1]),
+            srg.block // 32, n, num_levels=result.num_levels,
         )
         return result, curve
     if engine == "pull":

@@ -225,6 +225,16 @@ class RunJournal:
             return 0, None
         if _HEADER_PHASE not in records and good:
             return 0, None
+        if not records and os.path.getsize(self.path) > 0:
+            # Zero valid records in a NON-EMPTY file: this is not a torn
+            # tail — it is a foreign file at the journal path (the classic
+            # case: a pre-journal-schema capture like the round-1..5
+            # MULTICHIP_r0*.json driver outputs, which parse as JSON but
+            # carry no record sequence).  Truncating it (the old torn-tail
+            # path) would DESTROY evidence; rotate it aside instead and
+            # start a fresh journal.
+            self.invalidated = "foreign/pre-journal file"
+            return 0, None
         return good, records
 
     def _rotate(self) -> None:

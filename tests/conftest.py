@@ -13,6 +13,17 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Tier-1 compile-budget pin: default the direction knob to the dense
+# (pull) program so the many tests that run engines with DEFAULT knobs
+# compile the cheapest superstep body on this 2-core container.  Every
+# direction/exchange behavior has dedicated coverage that passes
+# `direction=`/`exchange=` explicitly (test_direction.py,
+# test_direction_sharded.py, test_exchange.py) — explicit arguments win
+# over this env default, and a caller-exported BFS_TPU_DIRECTION is
+# respected (setdefault).
+os.environ.setdefault("BFS_TPU_DIRECTION", "pull")
+os.environ.setdefault("BFS_TPU_EXCHANGE", "bitmap")
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax

@@ -214,6 +214,65 @@ def test_direction_forced_resume_replays_schedule(
 
 
 @pytest.mark.slow
+def test_multichip_bench_journals_and_rotates_prejournal_capture(
+    cache_dir, tmp_path
+):
+    """ISSUE 11: the MULTICHIP bench journals its phases like the
+    single-chip run, and its resume path ROTATES a pre-journal-schema
+    file at the journal path (the round-1..5 ``MULTICHIP_r0*.json``
+    capture shape) instead of truncating it — evidence is never
+    destroyed, and the fresh run completes the same headline."""
+    from bfs_tpu.graph import benes
+
+    if not benes.native_available():
+        pytest.skip("native benes router unavailable")
+    env = {
+        "BENCH_ENGINE": "relay",
+        "BENCH_MESH": "2",
+        "BENCH_ROOTS": "2",
+        "BENCH_REPEATS": "1",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    }
+    p1, lines1 = run_bench(cache_dir, tmp_path, extra_env=env, timeout=420)
+    assert p1.returncode == 0, p1.stderr[-2000:]
+    head = lines1[-1]
+    assert head["metric"].startswith("rmat8_multichip2")
+    ex = head["details"]["exchange"]
+    assert ex["total_bytes"] == sum(ex["bytes_per_level"])
+    assert head["details"]["sharded_phases"]["shards"] == 2
+    assert head["details"]["check"].startswith("passed (2/2")
+
+    # A second invocation is a pure replay.
+    p2, lines2 = run_bench(cache_dir, tmp_path, extra_env=env, timeout=420)
+    assert p2.returncode == 0, p2.stderr[-2000:]
+    assert "replaying headline" in p2.stderr
+    assert lines2[-1] == head
+
+    # Overwrite the journal with a pre-journal multichip capture (the
+    # old driver schema: JSON, but no record sequence).  The next run
+    # must rotate it aside — NOT truncate it, NOT crash — and re-run.
+    journals = [
+        f for f in os.listdir(tmp_path) if f.endswith(".jsonl")
+    ]
+    assert len(journals) == 1
+    jpath = os.path.join(str(tmp_path), journals[0])
+    legacy = (
+        '{"n_devices": 8, "rc": 0, "ok": true, "skipped": false,\n'
+        ' "tail": "relay legs verified\\n"}\n'
+    )
+    with open(jpath, "w") as f:
+        f.write(legacy)
+    p3, lines3 = run_bench(cache_dir, tmp_path, extra_env=env, timeout=420)
+    assert p3.returncode == 0, p3.stderr[-2000:]
+    assert lines3, "post-rotation run emitted no headline"
+    stale = jpath + ".stale.0"
+    assert os.path.exists(stale), "pre-journal capture was not rotated"
+    assert open(stale).read() == legacy, "rotated evidence was mutated"
+    for k in ("roots", "vertices_reached", "num_vertices"):
+        assert lines3[-1]["details"][k] == head["details"][k], k
+
+
+@pytest.mark.slow
 def test_raise_mode_fault_then_resume(cache_dir, golden, tmp_path):
     # raise: mode dies with a traceback (exception path, not SIGKILL) —
     # the journal must still carry every phase completed before the fault.

@@ -1,0 +1,229 @@
+"""Compressed frontier exchange (ISSUE 11): arm parity + wire accounting.
+
+The contract under test: every exchange arm (flat / bitmap / delta, and
+auto's per-superstep density selection) ships DIFFERENT bytes but the
+SAME frontier — dist, parent and the direction schedule must be
+bit-identical across arms on every mesh size, including the >62-level
+packed-cap fallback rerun.  Fixture shapes follow the direction suite:
+an R-MAT (hubs spanning shards), a star (shallow, dense explosion) and a
+path deeper than the packed cap.
+
+Budget note: every (layout, mesh, arm) triple is one sharded XLA compile
+on the 2-core container, so results AND schedules come from one
+telemetry-carrying run each, layouts are built once per fixture, and the
+full arm x mesh matrix runs on the R-MAT only (star at x2, the deep path
+at x8 — the shapes that exercise what the smaller matrix cannot)."""
+
+import numpy as np
+import pytest
+
+from bfs_tpu.graph import benes
+from bfs_tpu.graph.generators import gnm_graph, path_graph, rmat_graph
+from bfs_tpu.graph.relay import build_sharded_relay_graph
+from bfs_tpu.oracle.bfs import canonical_bfs, check, queue_bfs
+from bfs_tpu.parallel.exchange import (
+    EX_BITMAP,
+    EX_DELTA,
+    ExchangeConfig,
+    exchange_report,
+    resolve_exchange,
+)
+from bfs_tpu.parallel.sharded import bfs_sharded, make_mesh
+
+pytestmark = pytest.mark.skipif(
+    not benes.native_available(), reason="native benes router unavailable"
+)
+
+ARMS = ("flat", "bitmap", "delta", "auto")
+
+
+def star_graph(n: int = 256):
+    from bfs_tpu.graph.csr import Graph
+
+    hub = np.zeros(n - 1, np.int32)
+    leaves = np.arange(1, n, dtype=np.int32)
+    return Graph(
+        n, np.concatenate([hub, leaves]), np.concatenate([leaves, hub])
+    )
+
+
+def run_arm(srg, mesh, arm, s=0, direction="auto"):
+    return bfs_sharded(
+        srg, s, mesh=mesh, engine="relay", telemetry=True,
+        direction=direction, exchange=arm,
+    )
+
+
+def assert_same(res_a, curve_a, res_b, curve_b):
+    np.testing.assert_array_equal(res_a.dist, res_b.dist)
+    np.testing.assert_array_equal(res_a.parent, res_b.parent)
+    assert res_a.num_levels == res_b.num_levels
+    assert (
+        curve_a["direction_schedule"]["schedule"]
+        == curve_b["direction_schedule"]["schedule"]
+    )
+    assert curve_a["occupancy"] == curve_b["occupancy"]
+
+
+# ---------------------------------------------------------------------------
+# Config / knob surface (no device work).
+# ---------------------------------------------------------------------------
+
+def test_resolve_exchange_env_knobs(monkeypatch):
+    monkeypatch.setenv("BFS_TPU_EXCHANGE", "delta")
+    monkeypatch.setenv("BFS_TPU_EXCHANGE_DIV", "4")
+    cfg = resolve_exchange()
+    assert (cfg.mode, cfg.budget_div) == ("delta", 4)
+    assert resolve_exchange("flat").mode == "flat"  # argument wins
+    monkeypatch.setenv("BFS_TPU_EXCHANGE", "zip")
+    with pytest.raises(ValueError):
+        resolve_exchange()
+    monkeypatch.setenv("BFS_TPU_EXCHANGE", "auto")
+    monkeypatch.setenv("BFS_TPU_EXCHANGE_DIV", "0")
+    with pytest.raises(ValueError):
+        resolve_exchange()
+
+
+def test_delta_budget_sizing():
+    # auto: ceil(kw/div); forced delta: the whole compact space (the
+    # word-list arm must be able to ship ANY superstep).
+    assert ExchangeConfig("auto", 8).delta_budget(64) == 8
+    assert ExchangeConfig("auto", 8).delta_budget(3) == 1
+    assert ExchangeConfig("delta", 8).delta_budget(64) == 64
+
+
+def test_exchange_report_accounting():
+    bacc = np.zeros(128, np.int64)
+    aacc = np.zeros(128, np.int64)
+    # levels 1..3: delta, bitmap, delta
+    bacc[1], aacc[1] = 64, EX_DELTA
+    bacc[2], aacc[2] = 256, EX_BITMAP
+    bacc[3], aacc[3] = 64, EX_DELTA
+    rep = exchange_report(
+        bacc, aacc, ExchangeConfig("auto", 8), kw=8, nw=10, num_shards=8
+    )
+    assert rep["schedule"] == ["delta", "bitmap", "delta"]
+    assert rep["bytes_per_level"] == [64, 256, 64]
+    assert rep["total_bytes"] == 384
+    # flat baseline: 3 executed levels x n * nw * 4 bytes
+    assert rep["flat_total_bytes"] == 3 * 8 * 10 * 4
+    assert rep["reduction_vs_flat"] == rep["flat_total_bytes"] / 384
+    assert rep["delta_supersteps"] == 2 and rep["bitmap_supersteps"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Arm parity: bit-identical results + schedules across arms and meshes.
+# ---------------------------------------------------------------------------
+
+def _arms_parity(g, meshes, arms):
+    d_ref, _ = queue_bfs(g, 0)
+    _, p_ref = canonical_bfs(g, 0)
+    for n in meshes:
+        srg = build_sharded_relay_graph(g, n)
+        mesh = make_mesh(graph=n)
+        base = None
+        for arm in arms:
+            res, curve = run_arm(srg, mesh, arm)
+            np.testing.assert_array_equal(res.dist, d_ref)
+            np.testing.assert_array_equal(res.parent, p_ref)
+            assert check(g, res.dist, res.parent, 0) == []
+            if base is None:
+                base = (res, curve)
+            else:
+                assert_same(*base, res, curve)
+            ex = curve["exchange"]
+            assert ex["arm"] == arm
+            assert len(ex["bytes_per_level"]) == len(ex["schedule"])
+            assert ex["total_bytes"] == sum(ex["bytes_per_level"])
+            if arm == "flat":
+                assert set(ex["schedule"]) == {"flat"}
+                assert ex["total_bytes"] == ex["flat_total_bytes"]
+            else:
+                assert "flat" not in ex["schedule"]
+            if arm in ("bitmap", "auto"):
+                # the sieved arms never exceed the flat baseline (forced
+                # delta may: B = kw makes it a forcing/parity arm, not a
+                # byte win)
+                assert ex["total_bytes"] <= ex["flat_total_bytes"]
+
+
+def test_rmat_arms_parity_x2():
+    """Tier-1 core: all four arms, bit-identical, on the x2 mesh."""
+    _arms_parity(rmat_graph(9, 8, seed=11), (2,), ARMS)
+
+
+@pytest.mark.slow
+def test_rmat_arms_parity_x1_x8():
+    """The full mesh sweep (x1 degenerate collectives, x8 widest): every
+    arm, same contract.  Slow lane: each (mesh, arm) is one sharded XLA
+    compile on the 2-core container."""
+    _arms_parity(rmat_graph(9, 8, seed=11), (1, 8), ARMS)
+
+
+@pytest.mark.slow
+def test_star_arms_parity_x2():
+    g = star_graph(256)
+    srg = build_sharded_relay_graph(g, 2)
+    mesh = make_mesh(graph=2)
+    outs = [run_arm(srg, mesh, arm, s=3) for arm in ("flat", "delta", "auto")]
+    d, _ = queue_bfs(g, 3)
+    np.testing.assert_array_equal(outs[0][0].dist, d)
+    for res, curve in outs[1:]:
+        assert_same(*outs[0], res, curve)
+
+
+def test_deep_path_unpacked_fallback_x8():
+    """>62 levels under sharding: the packed program exits on its level
+    cap, the wrapper reruns unpacked, and the word-list arm stays
+    bit-identical to the oracle through the whole fallback (the flat-arm
+    twin of this run is in the slow sweep; arm-vs-arm equality at depth
+    is covered there)."""
+    g = path_graph(257)
+    srg = build_sharded_relay_graph(g, 8)
+    mesh = make_mesh(graph=8)
+    d, p = queue_bfs(g, 0)
+    res_d, curve_d = run_arm(srg, mesh, "delta")
+    np.testing.assert_array_equal(res_d.dist, d)
+    np.testing.assert_array_equal(res_d.parent, p)
+    assert res_d.num_levels == 257
+    # Forced delta sizes its budget at kw, so every superstep takes the
+    # word-list branch at its static 2B-word payload.
+    ex = curve_d["exchange"]
+    assert set(ex["schedule"]) == {"delta"}
+    # (levels beyond TEL_SLOTS clamp into the last accumulator slot, so
+    # the final entry aggregates the >127-level tail — skip it)
+    assert all(
+        b == 8 * ex["budget_words"] * 4 * 2
+        for b in ex["bytes_per_level"][:-1]
+    )
+    assert ex["supersteps"] == 257  # exact even past the slot clamp
+
+
+@pytest.mark.slow
+def test_deep_path_flat_parity_x8():
+    """Flat-oracle twin of the deep-path fallback: bit-identical dist,
+    parents, occupancy and direction schedule at 257 levels."""
+    g = path_graph(257)
+    srg = build_sharded_relay_graph(g, 8)
+    mesh = make_mesh(graph=8)
+    res_d, curve_d = run_arm(srg, mesh, "delta")
+    res_f, curve_f = run_arm(srg, mesh, "flat")
+    assert_same(res_d, curve_d, res_f, curve_f)
+
+
+@pytest.mark.slow
+def test_auto_arm_selects_by_density():
+    """On a G(n,m) with a dense middle, auto must take delta on the
+    sparse rim levels and fall back to bitmap only where the frontier
+    outgrows the word-list budget — and the total must beat flat."""
+    g = gnm_graph(1 << 10, 3 << 10, seed=5)
+    deg = np.bincount(np.asarray(g.src), minlength=g.num_vertices)
+    s = int(np.argmax(deg))
+    srg = build_sharded_relay_graph(g, 8)
+    mesh = make_mesh(graph=8)
+    res_a, curve_a = run_arm(srg, mesh, "auto", s=s)
+    res_f, curve_f = run_arm(srg, mesh, "flat", s=s)
+    assert_same(res_a, curve_a, res_f, curve_f)
+    ea = curve_a["exchange"]
+    assert "delta" in ea["schedule"], ea["schedule"]
+    assert ea["total_bytes"] < curve_f["exchange"]["total_bytes"]

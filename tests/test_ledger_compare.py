@@ -109,3 +109,74 @@ def test_unparseable_capture_raises_systemexit(lc, tmp_path, monkeypatch):
     good = _write_ledger(tmp_path / "g.json", {"vperm": 1e-3})
     with pytest.raises(SystemExit):
         _run(lc, monkeypatch, [str(bad), good])
+
+
+# ---------------------------------------------------------------------------
+# Sharded (MULTICHIP) captures — ISSUE 11 satellite.
+# ---------------------------------------------------------------------------
+
+def _write_sharded(path, *, search_s=2e-3, bytes_total=448,
+                   schedule=("bitmap", "delta"), per_shard_bytes=112):
+    doc = {"details": {
+        "sharded_phases": {
+            "shards": 2,
+            "phases": {
+                "full_search": {"seconds": search_s,
+                                "bytes_exchanged": bytes_total},
+                "full_superstep": {"seconds": search_s / 4,
+                                   "bytes_exchanged": bytes_total // 4},
+            },
+            "per_shard": [
+                {"shard": s, "real_words": 10, "adj_entries": 500 + s,
+                 "exchange_bytes_share": per_shard_bytes}
+                for s in range(2)
+            ],
+        },
+        "exchange": {"schedule": list(schedule),
+                     "total_bytes": bytes_total},
+        "direction_schedule": {"schedule": ["pull", "pull"]},
+    }}
+    path.write_text(json.dumps(doc))
+    return str(path)
+
+
+def test_sharded_capture_renders_bytes_and_shards(lc, tmp_path, monkeypatch,
+                                                  capsys):
+    before = _write_sharded(tmp_path / "b.json", bytes_total=1600,
+                            schedule=["flat", "flat"], per_shard_bytes=800)
+    after = _write_sharded(tmp_path / "a.json")
+    rc = _run(lc, monkeypatch, [before, after])
+    out = capsys.readouterr().out
+    assert rc == 0  # bytes DROPPED — the compressed-exchange win
+    assert "exchange bytes" in out
+    assert "1600 -> 448" in out
+    assert "| shard |" in out and "| 0 |" in out and "| 1 |" in out
+
+
+def test_sharded_bytes_increase_is_a_regression(lc, tmp_path, monkeypatch,
+                                                capsys):
+    before = _write_sharded(tmp_path / "b.json", bytes_total=448)
+    after = _write_sharded(tmp_path / "a.json", bytes_total=1600,
+                           schedule=["flat", "flat"])
+    rc = _run(lc, monkeypatch, [before, after])
+    assert rc == 2
+    assert "bytes" in capsys.readouterr().err
+
+
+def test_sharded_exact_catches_arm_schedule_drift(lc, tmp_path, monkeypatch,
+                                                  capsys):
+    before = _write_sharded(tmp_path / "b.json")
+    after = _write_sharded(tmp_path / "a.json",
+                           schedule=("bitmap", "bitmap"))
+    rc = _run(lc, monkeypatch, [before, after, "--exact"])
+    assert rc == 2
+    assert "exchange_schedule" in capsys.readouterr().err
+
+
+def test_sharded_exact_passes_on_identical_captures(lc, tmp_path,
+                                                    monkeypatch, capsys):
+    before = _write_sharded(tmp_path / "b.json")
+    after = _write_sharded(tmp_path / "a.json")
+    rc = _run(lc, monkeypatch, [before, after, "--exact"])
+    assert rc == 0
+    assert "exact match" in capsys.readouterr().err

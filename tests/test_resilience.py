@@ -118,6 +118,30 @@ def test_journal_malformed_but_parseable_records_trim_not_crash(tmp_path):
         os.remove(jr.path)
 
 
+def test_journal_foreign_prejournal_file_rotates_not_truncates(tmp_path):
+    """ISSUE 11 satellite (unit form; the subprocess MULTICHIP twin is in
+    test_bench_resume's slow lane): a NON-journal file at the journal
+    path — the pre-journal-schema MULTICHIP_r0*.json capture shape, valid
+    JSON with no record sequence — must be rotated aside as evidence,
+    never truncated to zero by the torn-tail trim."""
+    path = str(tmp_path / "mc.jsonl")
+    legacy = (
+        '{"n_devices": 8, "rc": 0, "ok": true, "skipped": false,\n'
+        ' "tail": "relay legs verified\\n"}\n'
+    )
+    with open(path, "w") as f:
+        f.write(legacy)
+    jr = RunJournal(path, CFG)
+    assert jr.invalidated == "foreign/pre-journal file"
+    jr.put("reference", {"x": 1})  # fresh journal works
+    jr.close()
+    assert os.path.exists(path + ".stale.0")
+    assert open(path + ".stale.0").read() == legacy  # bytes preserved
+    jr2 = RunJournal(path, CFG)
+    assert jr2.get("reference") == {"x": 1}
+    jr2.close()
+
+
 def test_journal_config_mismatch_rotates_fresh(tmp_path):
     jr = RunJournal.open_for(str(tmp_path), CFG)
     jr.put("reference", {"x": 1})

@@ -224,17 +224,25 @@ def test_hung_integrity_check_degrades_instead_of_freezing(
     from bfs_tpu.oracle.device import DeviceChecker
 
     def wedged_check(self, *a, **kw):
-        time.sleep(5.0)
+        time.sleep(30.0)
         return {}
 
     monkeypatch.setattr(DeviceChecker, "check", wedged_check)
+    # Budget sizing, tuned for a deep-in-the-suite run on the 2-core
+    # container: the cold BATCH call (AOT build included) is floored at
+    # compile_floor_s and must never be false-positived into 'oracle' —
+    # late in a long pytest process a cold serve compile was measured
+    # over the old 1.2 s floor, which flipped the 'ok' assertion.  The
+    # wedge (30 s) dwarfs every budget, so the verify kill at the floor
+    # (~3 s; the checker is cold on its first sample) still proves the
+    # loop cannot freeze.
     with make_server(
-        graph, verify_sample=1, watchdog_s=0.3,
-        watchdog_compile_floor_s=0.4,
+        graph, verify_sample=1, watchdog_s=1.0,
+        watchdog_compile_floor_s=3.0,
     ) as srv:
         t0 = time.monotonic()
         reply = srv.query("g", 0).result(TIMEOUT)
-        assert time.monotonic() - t0 < 3.0, "serve loop froze in verify"
+        assert time.monotonic() - t0 < 10.0, "serve loop froze in verify"
         assert reply.record.status == "ok"  # the batch itself was fine
         assert srv.metrics.count("integrity_check_errors") == 1
         assert srv.metrics.count("integrity_failures") == 0

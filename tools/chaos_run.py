@@ -62,6 +62,13 @@ BENCH_PHASES = [
     "repeat:2", "provisional", "profile", "verify", "verify:2", "headline",
 ]
 
+#: MULTICHIP (BENCH_MESH) journal phases — no scale fallback / probe /
+#: provisional boundaries; the exchange curve is its own phase.
+MULTICHIP_PHASES = [
+    "graph", "layout", "reference", "roots", "repeat", "exchange_curve",
+    "verify", "headline",
+]
+
 DETERMINISTIC_DETAILS = (
     "roots", "directed_edges_traversed", "vertices_reached",
     "supersteps_last_root", "num_vertices", "num_directed_edges",
@@ -85,6 +92,17 @@ def bench_env(args, journal_dir: str) -> dict:
     env["BFS_TPU_CACHE_DIR"] = args.cache_dir
     env["BFS_TPU_JOURNAL_DIR"] = journal_dir
     env.pop("BFS_TPU_FAULT", None)
+    if args.mesh:
+        # MULTICHIP journals (ISSUE 11): sharded relay on an n-shard
+        # virtual mesh — the engine is forced and the virtual CPU
+        # platform must expose enough devices BEFORE jax initializes.
+        env["BENCH_ENGINE"] = "relay"
+        env["BENCH_MESH"] = str(args.mesh)
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
     return env
 
 
@@ -134,6 +152,25 @@ def diff_schedule(final: dict, golden: dict) -> list[str]:
             "function of graph + thresholds)"
         ]
     return []
+
+
+def diff_exchange(final: dict, golden: dict) -> list[str]:
+    """MULTICHIP determinism: the exchange arm schedule and the per-level
+    bytes-on-the-wire are pure functions of (graph, arm config), so a
+    resumed run must reproduce the golden run's exactly — a drift means
+    the resume re-ran a DIFFERENT exchange than it journaled."""
+    eg = golden["details"].get("exchange")
+    ef = final["details"].get("exchange")
+    if not isinstance(eg, dict):
+        return []
+    bad = []
+    for k in ("arm", "schedule", "bytes_per_level", "total_bytes"):
+        if not isinstance(ef, dict) or ef.get(k) != eg.get(k):
+            bad.append(
+                f"details.exchange.{k}: resumed "
+                f"{(ef or {}).get(k)!r} != golden {eg.get(k)!r}"
+            )
+    return bad
 
 
 def diff_ledgers(final: dict, replayed: dict) -> list[str]:
@@ -193,7 +230,12 @@ def chaos_bench(args, rng: random.Random) -> int:
     # The profile boundary only exists on the relay path; picking it for
     # other engines would silently burn the iteration without a kill.
     engine = os.environ.get("BENCH_ENGINE", args.engine)
-    phases = [p for p in BENCH_PHASES if p != "profile" or engine == "relay"]
+    if args.mesh:
+        phases = list(MULTICHIP_PHASES)
+    else:
+        phases = [
+            p for p in BENCH_PHASES if p != "profile" or engine == "relay"
+        ]
     failures = 0
     for it in range(args.iterations):
         with tempfile.TemporaryDirectory(prefix="chaos_j_") as journal_dir:
@@ -229,6 +271,8 @@ def chaos_bench(args, rng: random.Random) -> int:
                 continue
             final = lines[-1]
             bad = diff_headline(final, golden) + diff_schedule(final, golden)
+            if args.mesh:
+                bad += diff_exchange(final, golden)
             # One more invocation over the completed journal is a pure
             # replay: its ledger + schedule must be bit-identical to the
             # resumed run's (ledger_compare --exact).
@@ -525,6 +569,10 @@ def main(argv=None) -> int:
     ap.add_argument("--roots", type=int, default=3)
     ap.add_argument("--repeats", type=int, default=2)
     ap.add_argument("--engine", default="push")
+    ap.add_argument("--mesh", type=int, default=0,
+                    help="bench mode: run the MULTICHIP (BENCH_MESH=n) "
+                    "sharded-relay bench on an n-shard virtual mesh and "
+                    "chaos its journal phases (forces engine=relay)")
     ap.add_argument("--cache-dir",
                     default=os.path.join(tempfile.gettempdir(), "chaos_cache"),
                     help="shared artifact cache across all runs (graph npz "

@@ -56,27 +56,47 @@ def load_doc(path: str) -> dict:
 
 
 def extract(doc: dict, path: str):
-    """(phases {name: seconds}, full ledger dict, direction_schedule|None)."""
+    """(phases {name: seconds}, full ledger dict, direction_schedule|None,
+    bytes {name: exchange bytes}, per_shard rows, exchange arm schedule).
+
+    Understands BOTH capture shapes: single-chip headlines
+    (``details.superstep_phases``) and sharded MULTICHIP headlines
+    (``details.sharded_phases`` — per-shard rows + the exchange-bytes
+    column riding each phase record, plus ``details.exchange.schedule``,
+    the per-level arm record)."""
     ledger = doc
     details = doc.get("details")
     if isinstance(details, dict):
         ledger = details.get("superstep_phases")
+        if not isinstance(ledger, dict):
+            ledger = details.get("sharded_phases")
     if not isinstance(ledger, dict) or "phases" not in ledger:
         raise SystemExit(
             f"{path}: no superstep phase ledger found (need a bench "
-            "headline with details.superstep_phases or a raw ledger JSON)"
+            "headline with details.superstep_phases or "
+            "details.sharded_phases, or a raw ledger JSON)"
         )
     phases = {
         name: float(rec["seconds"])
         for name, rec in ledger["phases"].items()
         if isinstance(rec, dict) and "seconds" in rec
     }
+    xbytes = {
+        name: int(rec["bytes_exchanged"])
+        for name, rec in ledger["phases"].items()
+        if isinstance(rec, dict) and "bytes_exchanged" in rec
+    }
+    per_shard = ledger.get("per_shard")
     sched = None
+    xsched = None
     if isinstance(details, dict):
         ds = details.get("direction_schedule")
         if isinstance(ds, dict):
             sched = ds.get("schedule")
-    return phases, ledger, sched
+        ex = details.get("exchange")
+        if isinstance(ex, dict):
+            xsched = ex.get("schedule")
+    return phases, ledger, sched, xbytes, per_shard, xsched
 
 
 def fmt_s(s: float) -> str:
@@ -100,12 +120,13 @@ def main() -> int:
     )
     args = ap.parse_args()
 
-    pb, lb, sb = extract(load_doc(args.before), args.before)
-    pa, la, sa = extract(load_doc(args.after), args.after)
+    pb, lb, sb, xb, shb, xsb = extract(load_doc(args.before), args.before)
+    pa, la, sa, xa, sha, xsa = extract(load_doc(args.after), args.after)
 
     names = [p for p in PHASE_ORDER if p in pb or p in pa]
     names += [p for p in sorted(set(pb) | set(pa)) if p not in names]
 
+    has_bytes = bool(xb or xa)
     rows = []
     regressed, mismatched = [], []
     for name in names:
@@ -122,13 +143,55 @@ def main() -> int:
         elif not args.exact and delta > args.threshold:
             regressed.append((name, delta))
 
-    print("| phase | before | after | delta |")
-    print("|---|---|---|---|")
+    if has_bytes:
+        print("| phase | before | after | delta | exchange bytes |")
+        print("|---|---|---|---|---|")
+    else:
+        print("| phase | before | after | delta |")
+        print("|---|---|---|---|")
     for name, b, a, delta in rows:
         bs = fmt_s(b) if b is not None else "—"
         as_ = fmt_s(a) if a is not None else "—"
         ds = f"{delta * 100:+.1f}%" if delta is not None else "—"
-        print(f"| {name} | {bs} | {as_} | {ds} |")
+        if has_bytes:
+            bb, ba = xb.get(name), xa.get(name)
+            xs = (
+                f"{bb if bb is not None else '—'} -> "
+                f"{ba if ba is not None else '—'}"
+            )
+            print(f"| {name} | {bs} | {as_} | {ds} | {xs} |")
+            # Wire bytes are deterministic per (config, arm): more bytes
+            # after than before is a regression of exactly the thing a
+            # compressed-exchange PR claims (flat -> auto must shrink).
+            if bb is not None and ba is not None:
+                if args.exact and bb != ba:
+                    mismatched.append(f"{name}:bytes")
+                elif (
+                    not args.exact and bb > 0
+                    and (ba - bb) / bb > args.threshold
+                ):
+                    regressed.append((f"{name}:bytes", (ba - bb) / bb))
+        else:
+            print(f"| {name} | {bs} | {as_} | {ds} |")
+
+    if shb or sha:
+        print()
+        print("| shard | real_words | adj_entries | exchange bytes |")
+        print("|---|---|---|---|")
+        for row_b, row_a in zip(shb or [], sha or []):
+            s = row_b.get("shard", row_a.get("shard"))
+            rw = f"{row_b.get('real_words')} -> {row_a.get('real_words')}"
+            ae = f"{row_b.get('adj_entries')} -> {row_a.get('adj_entries')}"
+            eb = (
+                f"{row_b.get('exchange_bytes_share')} -> "
+                f"{row_a.get('exchange_bytes_share')}"
+            )
+            print(f"| {s} | {rw} | {ae} | {eb} |")
+        if args.exact and (shb or []) != (sha or []):
+            mismatched.append("per_shard")
+
+    if args.exact and xsb != xsa:
+        mismatched.append("exchange_schedule")
 
     for side, led in (("before", lb), ("after", la)):
         sel = {

@@ -54,21 +54,28 @@ for applier in ("pallas", "xla"):
     vperm_arg, net_arg = S._sharded_relay_mask_args(srg, use_pallas)
     valid = S._relay_valid_words(srg)
     src_new = jnp.int32(int(srg.old2new[source]))
-    args = (vperm_arg, net_arg, valid, S._own_word_table_dev(srg), src_new)
+    # Dense-only flavor (direction=None, adjacency dummies): the applier
+    # comparison this tool measures is the Beneš-network superstep.
+    args = (
+        vperm_arg, net_arg, valid, S._own_word_table_dev(srg),
+        *S._sharded_adj_dummies(1), jnp.zeros((1,), jnp.int32), src_new,
+    )
     max_levels = srg.num_vertices
     t0 = time.perf_counter()
     from bfs_tpu.models.bfs import RelayEngine
+    from bfs_tpu.parallel.exchange import resolve_exchange
 
     compiled = S._bfs_sharded_relay_fused.lower(
-        *args, mesh=mesh, static=static, max_levels=max_levels
+        *args, mesh=mesh, static=static, max_levels=max_levels,
+        exchange=resolve_exchange().key(),
     ).compile(compiler_options=RelayEngine._COMPILER_OPTIONS)
     t_compile = time.perf_counter() - t0
-    dist, parent, level = compiled(*args)
+    dist, parent, level, _changed = compiled(*args)
     levels = int(np.asarray(jax.device_get(level)))  # warm + sync
     times = []
     for _ in range(5):
         t0 = time.perf_counter()
-        dist, parent, level = compiled(*args)
+        dist, parent, level, _changed = compiled(*args)
         _ = int(np.asarray(jax.device_get(level)))
         times.append(time.perf_counter() - t0)
     t = float(np.median(times))
