@@ -19,13 +19,21 @@ Passes:
   host round-trips, dtype drift, HBM budgets and collective correctness
   (:mod:`bfs_tpu.analysis.ir`).  Imports jax; results are cached
   content-addressed so repeat runs are instant (``--no-cache`` forces).
+* ``--hlo`` (or the ``hlo`` subcommand) — the HLO-grade pass: COMPILES
+  every hot program and walks the optimized HLO module + executable
+  metadata for realized donation, compiler-backed HBM proofs, loop-body
+  fusion breaks, compiled collective drift and opaque escapes
+  (:mod:`bfs_tpu.analysis.hlo`).  Same caching discipline;
+  ``--update-fingerprints`` regenerates the committed per-program
+  footprint fingerprints, ``--snapshot PATH`` writes the metrics rows
+  for ``tools/hlo_diff.py``.
 
 ``--changed`` lints only files named by ``git diff --name-only HEAD``
 (the pre-commit spelling).  ``--write-baseline`` rewrites the baseline
 file from the current AST findings (errors only, warnings never need
-baselining) with TODO justifications to fill in; with ``--ir`` it
-PRINTS the baseline lines instead (the IR section is curated by hand,
-never clobbered).  ``--no-baseline`` shows everything.
+baselining) with TODO justifications to fill in; with ``--ir`` or
+``--hlo`` it PRINTS the baseline lines instead (those sections are
+curated by hand, never clobbered).  ``--no-baseline`` shows everything.
 """
 
 from __future__ import annotations
@@ -89,6 +97,8 @@ def main(argv=None) -> int:
     argv = list(argv)
     if argv and argv[0] == "ir":  # subcommand spelling of --ir
         argv = ["--ir"] + argv[1:]
+    elif argv and argv[0] == "hlo":  # subcommand spelling of --hlo
+        argv = ["--hlo"] + argv[1:]
     ap = argparse.ArgumentParser(
         prog="python -m bfs_tpu.analysis",
         description=__doc__.splitlines()[0],
@@ -112,8 +122,19 @@ def main(argv=None) -> int:
     ap.add_argument("--ir", action="store_true",
                     help="run the IR-grade pass instead (lowers the hot "
                          "fused programs to jaxprs; imports jax)")
+    ap.add_argument("--hlo", action="store_true",
+                    help="run the HLO-grade pass instead (COMPILES the hot "
+                         "programs and walks the optimized HLO + executable "
+                         "metadata; imports jax)")
     ap.add_argument("--no-cache", action="store_true",
-                    help="IR pass: ignore the content-addressed result cache")
+                    help="IR/HLO pass: ignore the content-addressed result "
+                         "cache")
+    ap.add_argument("--update-fingerprints", action="store_true",
+                    help="HLO pass: rewrite the committed per-program "
+                         "footprint fingerprint file from this run")
+    ap.add_argument("--snapshot", default=None, metavar="PATH",
+                    help="HLO pass: also write the per-program metrics "
+                         "rows to PATH (the tools/hlo_diff.py input)")
     ap.add_argument("--changed", action="store_true",
                     help="AST pass: lint only files in `git diff "
                          "--name-only HEAD`")
@@ -127,26 +148,100 @@ def main(argv=None) -> int:
     root = os.path.abspath(args.root) if args.root else _repo_root()
     baseline_path = args.baseline or default_baseline_path()
 
-    if args.ir:
+    if args.ir and args.hlo:
+        print("analysis: --ir and --hlo are separate passes — run one at "
+              "a time", file=sys.stderr)
+        return 2
+    if (args.update_fingerprints or args.snapshot) and not args.hlo:
+        print("analysis: --update-fingerprints/--snapshot only apply to "
+              "the --hlo pass", file=sys.stderr)
+        return 2
+
+    if args.ir or args.hlo:
+        pass_name = "--ir" if args.ir else "--hlo"
         if args.paths or args.changed:
             print(
-                "analysis: --ir always analyzes the whole hot-program "
-                "registry — it cannot be scoped by paths or --changed",
+                f"analysis: {pass_name} always analyzes the whole "
+                "hot-program registry — it cannot be scoped by paths or "
+                "--changed",
                 file=sys.stderr,
             )
             return 2
-        from . import ir
+        if args.ir:
+            from . import ir
 
-        findings, meta = ir.analyze_ir(
-            use_cache=not args.no_cache, root=root
-        )
-        # Stale enforcement below only looks at IR-family entries: an IR
-        # run says nothing about whether AST findings still exist.  And a
-        # run that SKIPPED programs (e.g. the mesh specs below 2 devices)
-        # proves nothing about their entries either — fingerprints don't
-        # name programs, so any skip exempts the whole family.
+            findings, meta = ir.analyze_ir(
+                use_cache=not args.no_cache, root=root
+            )
+            rule_family = lambda r: r.startswith("IR")  # noqa: E731
+        else:
+            from . import hlo
+
+            findings, meta = hlo.analyze_hlo(
+                use_cache=not args.no_cache, root=root
+            )
+            rule_family = lambda r: r.startswith("HLO")  # noqa: E731
+            if args.snapshot:
+                with open(args.snapshot, "w", encoding="utf-8") as fh:
+                    json.dump(
+                        {"env": hlo.current_env(),
+                         "programs": meta["fingerprints"]},
+                        fh, indent=1, sort_keys=True,
+                    )
+                print(f"analysis: wrote HLO metrics snapshot to "
+                      f"{args.snapshot}", file=sys.stderr)
+            if args.update_fingerprints:
+                # A program that failed to compile OR was skipped (e.g.
+                # a pre-set XLA_FLAGS leaving too few devices for the
+                # mesh specs) has no metrics row — writing now would
+                # silently DROP it from the committed file and surface
+                # later as a confusing set-inequality failure instead of
+                # the actual cause.
+                broken = [f for f in findings if f.rule == "HLO000"]
+                if broken or meta["skipped"]:
+                    for f in broken:
+                        print(f.render())
+                    reasons = []
+                    if broken:
+                        reasons.append(f"{len(broken)} program(s) failed "
+                                       "to compile (HLO000 above)")
+                    if meta["skipped"]:
+                        reasons.append(
+                            f"{len(meta['skipped'])} program(s) skipped "
+                            f"({sorted(meta['skipped'])})"
+                        )
+                    print(
+                        "analysis: refusing to write fingerprints — "
+                        + " and ".join(reasons)
+                        + "; the committed file must cover the full "
+                        "registry",
+                        file=sys.stderr,
+                    )
+                    return 1
+                # Show what this run found BEFORE re-pinning: a regress
+                # finding written over silently would green every later
+                # run against the regressed counts.
+                for f in findings:
+                    print(f.render())
+                path = hlo.default_fingerprints_path()
+                hlo.write_fingerprints(path, meta["fingerprints"])
+                print(
+                    f"analysis: wrote {len(meta['fingerprints'])} program "
+                    f"fingerprint(s) to {path}"
+                    + (f" — the {len(findings)} finding(s) above are now "
+                       "pinned as the new counts; commit with a "
+                       "justification" if findings else
+                       " — commit with a justification for any regressed "
+                       "row"),
+                )
+                return 0
+        # Stale enforcement below only looks at the pass's own entries:
+        # an IR/HLO run says nothing about whether AST findings still
+        # exist.  And a run that SKIPPED programs (e.g. the mesh specs
+        # below 2 devices) proves nothing about their entries either —
+        # fingerprints don't name programs, so any skip exempts the
+        # whole family.
         default_surface = not meta["skipped"]
-        rule_family = lambda r: r.startswith("IR")  # noqa: E731
     else:
         if args.changed:
             paths = _changed_files(root)
@@ -171,7 +266,9 @@ def main(argv=None) -> int:
             return 2
         findings = analyze_paths(paths, root)
         meta = None
-        rule_family = lambda r: not r.startswith("IR")  # noqa: E731
+        rule_family = lambda r: not (  # noqa: E731
+            r.startswith("IR") or r.startswith("HLO")
+        )
 
     baseline = (
         Baseline(path=baseline_path)
@@ -181,37 +278,40 @@ def main(argv=None) -> int:
 
     if args.write_baseline:
         errors = [f for f in findings if f.severity == "error"]
-        if args.ir:
-            # Never clobber the committed file from the IR pass: its
-            # entries span BOTH passes.  Print the lines to curate in.
+        if args.ir or args.hlo:
+            # Never clobber the committed file from the IR/HLO passes:
+            # its entries span ALL passes.  Print the lines to curate in.
+            which = "IR" if args.ir else "HLO"
             print(Baseline.render(errors), end="")
             print(
-                f"analysis: {len(errors)} IR finding(s) rendered above — "
-                "paste the justified ones into the baseline's IR section",
+                f"analysis: {len(errors)} {which} finding(s) rendered "
+                f"above — paste the justified ones into the baseline's "
+                f"{which} section",
                 file=sys.stderr,
             )
             return 0
-        # Regenerating the AST section must not drop the hand-curated IR
-        # entries living in the same file: carry them over verbatim.
-        kept_ir = [
+        # Regenerating the AST section must not drop the hand-curated
+        # IR/HLO entries living in the same file: carry them over
+        # verbatim.
+        kept = [
             f"{rule}  {fp}  {just}".rstrip()
             for fp, (rule, just) in baseline.entries.items()
-            if rule.startswith("IR")
+            if rule.startswith("IR") or rule.startswith("HLO")
         ]
         with open(baseline_path, "w", encoding="utf-8") as f:
             f.write(Baseline.render(errors))
-            if kept_ir:
+            if kept:
                 f.write(
-                    "\n# -- IR-pass entries (curated by hand; carried "
+                    "\n# -- IR/HLO-pass entries (curated by hand; carried "
                     "over by --write-baseline) --\n"
                 )
-                f.write("\n".join(kept_ir) + "\n")
+                f.write("\n".join(kept) + "\n")
         print(
             f"analysis: wrote {len(errors)} accepted finding(s) to "
             f"{baseline_path}"
-            + (f" (+{len(kept_ir)} IR entr"
-               f"{'y' if len(kept_ir) == 1 else 'ies'} carried over)"
-               if kept_ir else "")
+            + (f" (+{len(kept)} IR/HLO entr"
+               f"{'y' if len(kept) == 1 else 'ies'} carried over)"
+               if kept else "")
             + " — fill in the justifications"
         )
         return 0
@@ -254,11 +354,16 @@ def main(argv=None) -> int:
             f"warning(s), {accepted} baseline-accepted"
         )
         if meta is not None:
+            tag = "hlo" if args.hlo else "ir"
             summary += (
-                f" [ir: {len(meta['programs'])} program(s), cache "
+                f" [{tag}: {len(meta['programs'])} program(s), cache "
                 f"{meta['cache']}"
                 + (f", skipped {sorted(meta['skipped'])}"
                    if meta["skipped"] else "")
+                + (f", fingerprints {meta['fingerprint_status']}"
+                   if "fingerprint_status" in meta else "")
+                + (f", unfingerprinted {sorted(meta['unfingerprinted'])}"
+                   if meta.get("unfingerprinted") else "")
                 + "]"
             )
         if stale:

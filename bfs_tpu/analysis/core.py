@@ -138,6 +138,38 @@ RULES: dict[str, tuple[str, str]] = {
               "exchange payload regressed: a collective moves a V-scale "
               "payload whose dtype/width is outside the program's "
               "declared exchange format"),
+    # -- HLO-grade rules (bfs_tpu.analysis.hlo — compiles the hot
+    # programs and walks the OPTIMIZED HLO + executable metadata; the
+    # third rung: AST = source, jaxpr = what we ask, HLO = what XLA
+    # emits) --------------------------------------------------------------
+    "HLO000": ("error",
+               "hot program failed to compile for HLO analysis — a "
+               "policed executable that cannot be built is unpoliced"),
+    "HLO001": ("error",
+               "declared donation dropped by the compiler: the carry's "
+               "parameter is absent from the executable's "
+               "input_output_alias map, so its HBM silently doubles at "
+               "runtime with the jaxpr rung still green"),
+    "HLO002": ("error",
+               "compiler-backed HBM proof failed: XLA's own buffer "
+               "assignment (arguments+outputs+temps+code) exceeds the "
+               "declared budget, or temp bytes regressed >10% over the "
+               "committed per-program fingerprint"),
+    "HLO003": ("error",
+               "fusion break: copy/transpose/bitcast-convert "
+               "materialized inside the superstep while body, or the "
+               "emitted fusion/loop-materialization count grew over the "
+               "committed fingerprint"),
+    "HLO004": ("error",
+               "compiled collective drift: a collective in a program "
+               "declaring no mesh axes, a required exchange compiled "
+               "away, a loop payload outside the declared exchange "
+               "dtypes, or a loop-collective count changed vs the "
+               "fingerprint (hoisted/duplicated)"),
+    "HLO005": ("error",
+               "custom-call/infeed/outfeed/send/recv survives to the "
+               "optimized HLO of a hot program — an opaque escape from "
+               "the fused-XLA contract"),
 }
 
 

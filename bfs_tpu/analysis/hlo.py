@@ -1,0 +1,556 @@
+"""HLO-grade static analysis: compile the hot fused programs and check
+what XLA actually *emitted*, not just what we asked for.
+
+The three analyzer rungs now police every altitude of a hot program:
+
+* AST (:mod:`.core` — TRC/RCD/LCK/OBS): what the SOURCE says;
+* jaxpr (:mod:`.ir` — IR000-IR006): what we ASK XLA to do — donation
+  annotations, loop-body eqns, declared collectives;
+* HLO (this module — HLO000-HLO005): what XLA actually EMITS — every
+  entry in :data:`bfs_tpu.analysis.ir.PROGRAM_SPECS` is
+  ``.lower(...).compile()``d and the **optimized** HLO module plus the
+  compiled-executable metadata are walked.  IR001 proved the donation
+  annotation exists; HLO001 proves the executable realized the aliasing.
+  IR004's HBM proof was a hand-rolled static estimate; HLO002 is XLA's
+  own buffer assignment (``compiled.memory_analysis()``).
+
+Rules (:mod:`.hlo_rules` implements the walks):
+
+* **HLO001** — donation declared (the spec's ``donate`` map, IR001's
+  input) but the parameter is absent from the compiled executable's
+  ``input_output_alias`` map: the declaration was silently dropped and
+  the carry's HBM doubles at runtime.
+* **HLO002** — XLA's buffer assignment (argument/output/temp/generated-
+  code bytes) checked against ``BFS_TPU_IR_HBM_GB`` as a *compiler-
+  backed* footprint proof, plus a temp-bytes tripwire: a program whose
+  temp bytes regress >10% over the committed per-program fingerprint
+  (``hlo_fingerprints.json``) fails lint.
+* **HLO003** — ``copy``/``transpose``/``bitcast-convert`` ops
+  materialized *inside* the superstep ``while`` body (the fusion-break
+  detector), plus a fusion-count fingerprint per program: more emitted
+  kernels than the committed count is a fusion break.
+* **HLO004** — collectives surviving to optimized HLO cross-checked
+  against the declared exchange arms: a collective in a program that
+  declares no mesh axes, a required exchange axis whose compiled module
+  has NO collective at all, a loop collective moving a payload outside
+  the declared exchange dtypes, and a loop-collective-count fingerprint
+  (catches an all-gather XLA hoists out of — or duplicates into — the
+  loop where the source shows exactly one).
+* **HLO005** — ``custom-call``/infeed/outfeed/host send-recv surviving
+  to optimized HLO in a hot program: an opaque escape hatch in a path
+  every byte of which is supposed to be fused XLA.
+
+Like the IR pass this module imports jax and is loaded only by the
+``--hlo`` CLI path and the HLO tests.  Compiling every program costs
+~30 s cold, so results are content-addressed exactly like the IR cache
+(sources + jax version + backend + device count + flavor env +
+fingerprint file; ``.bench_cache/hlo/``, ``BFS_TPU_HLO_CACHE``).
+Findings share ``baseline.txt`` with line-drift-proof
+``hlo:<program>:<detail>`` fingerprints.
+
+The committed fingerprint file ``hlo_fingerprints.json`` pins one
+metrics row per program (temp bytes, fusion count, loop-collective and
+loop-materialization counts) for the environment it was generated in;
+regression rules only fire when the current backend/jax/device-count
+matches that environment, so a TPU run never diffs against CPU counts.
+``bfs-tpu-lint --hlo --update-fingerprints`` regenerates it.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import re
+from dataclasses import dataclass, field
+
+from .core import Finding
+from .ir import (
+    PROGRAM_SPECS,
+    Program,
+    SkipProgram,
+    _ensure_jax_env,
+    _FLAVOR_ENV,
+    _source_fingerprint,
+    repo_root,
+)
+
+#: Bump to invalidate every cached HLO result (rule semantics changed).
+HLO_VERSION = 1
+
+#: Temp-bytes regression tolerance over the committed fingerprint.
+TEMP_REGRESSION_RATIO = 0.10
+
+#: HLO collective opcodes that move payload between devices.
+COLLECTIVE_OPS = frozenset({
+    "all-reduce", "all-gather", "all-to-all", "collective-permute",
+    "reduce-scatter", "collective-broadcast",
+})
+
+#: Materialized-layout opcodes HLO003 polices inside loop bodies.
+MATERIALIZE_OPS = frozenset({"copy", "transpose", "bitcast-convert"})
+
+#: Opcodes that escape the fused-XLA contract entirely (HLO005).
+ESCAPE_OPS = frozenset({"custom-call", "infeed", "outfeed", "send", "recv"})
+
+_DTYPE_BYTES = {
+    "pred": 1, "s8": 1, "u8": 1, "s16": 2, "u16": 2, "f16": 2, "bf16": 2,
+    "s32": 4, "u32": 4, "f32": 4, "s64": 8, "u64": 8, "f64": 8,
+    "c64": 8, "c128": 16,
+}
+
+#: HLO element type -> the numpy-style dtype names the spec's
+#: ``exchange_dtypes`` declares (the IR006/HLO004 shared vocabulary).
+HLO_TO_NUMPY_DTYPE = {
+    "pred": "bool", "s8": "int8", "u8": "uint8", "s16": "int16",
+    "u16": "uint16", "s32": "int32", "u32": "uint32", "s64": "int64",
+    "u64": "uint64", "f16": "float16", "bf16": "bfloat16",
+    "f32": "float32", "f64": "float64",
+}
+
+_SHAPE_RE = re.compile(r"(\w+)\[([\d,]*)\]")
+# `%name = <shape> <opcode>(` — shape is non-greedy so the first
+# word-followed-by-( after it is the opcode (tuple shapes contain
+# brackets/braces but never a bare `word(`).
+_INST_RE = re.compile(r"^\s+(?:ROOT\s+)?%?[\w.\-]+\s*=\s*(.*?)\s([\w\-]+)\(")
+_COMP_RE = re.compile(r"^(ENTRY\s+)?%?([\w.\-]+)\s*\(.*\)\s*->.*\{\s*$")
+_CALLED_RE = re.compile(
+    r"(?:calls|to_apply|body|condition|select|scatter)=%([\w.\-]+)"
+)
+_BRANCHES_RE = re.compile(r"branch_computations=\{([^}]*)\}")
+# One aliased-parameter entry inside the module header's
+# `input_output_alias={ {out_idx}: (param, {param_idx}, kind) }` map.
+_ALIAS_RE = re.compile(r"\((\d+),\s*\{[\d,\s]*\},\s*(?:may|must)-alias\)")
+
+
+def shape_bytes(shape: str) -> int:
+    """Total bytes of an HLO shape string (tuples summed)."""
+    total = 0
+    for dt, dims in _SHAPE_RE.findall(shape):
+        if dt not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES[dt]
+    return total
+
+
+def shape_dtypes(shape: str) -> list[str]:
+    """HLO element types appearing in a shape string, in order."""
+    return [dt for dt, _ in _SHAPE_RE.findall(shape) if dt in _DTYPE_BYTES]
+
+
+@dataclass
+class Instruction:
+    opcode: str
+    shape: str
+    text: str
+
+    @property
+    def nbytes(self) -> int:
+        return shape_bytes(self.shape)
+
+    def called(self) -> list[str]:
+        """Computation names this instruction invokes.  Fusion
+        sub-computations are excluded on purpose: ops inside a fusion
+        are codegenned into ONE kernel and never materialize."""
+        if self.opcode == "fusion":
+            return []
+        names = _CALLED_RE.findall(self.text)
+        m = _BRANCHES_RE.search(self.text)
+        if m:
+            names.extend(
+                x.strip().lstrip("%") for x in m.group(1).split(",")
+                if x.strip()
+            )
+        return names
+
+
+@dataclass
+class HloModule:
+    """One parsed optimized-HLO text module."""
+
+    header: str = ""
+    computations: dict = field(default_factory=dict)  # name -> [Instruction]
+    entry: str = ""
+
+    @property
+    def aliased_params(self) -> frozenset:
+        """Entry parameter numbers the executable aliases to an output —
+        the compiled reality of donation.  The alias-entry shape
+        ``(param, {indices}, may|must-alias)`` appears nowhere else in a
+        module header, so the whole header is scanned (the map itself
+        nests braces, which defeats a non-greedy region match)."""
+        if "input_output_alias" not in self.header:
+            return frozenset()
+        return frozenset(int(p) for p in _ALIAS_RE.findall(self.header))
+
+    def instructions(self):
+        for name, insts in self.computations.items():
+            for inst in insts:
+                yield name, inst
+
+    def loop_computations(self) -> frozenset:
+        """Names of computations that execute once per loop iteration:
+        every ``while`` body and condition, transitively through called
+        computations (conditional branches, sort comparators) but NOT
+        through fusion sub-computations."""
+        seeds: list[str] = []
+        for _name, inst in self.instructions():
+            if inst.opcode == "while":
+                seeds.extend(inst.called())  # body= and condition=
+        seen: set[str] = set()
+        work = list(seeds)
+        while work:
+            comp = work.pop()
+            if comp in seen:
+                continue
+            seen.add(comp)
+            for inst in self.computations.get(comp, ()):
+                work.extend(inst.called())
+        return frozenset(seen)
+
+    def loop_instructions(self):
+        for comp in self.loop_computations():
+            for inst in self.computations.get(comp, ()):
+                yield comp, inst
+
+
+def parse_hlo(text: str) -> HloModule:
+    """Parse optimized-HLO module text into computations of opcoded
+    instructions.  Tolerant by construction: an unrecognized line is
+    simply not an instruction."""
+    mod = HloModule()
+    lines = text.splitlines()
+    if lines:
+        mod.header = lines[0]
+    cur: list[Instruction] | None = None
+    for line in lines:
+        if not line.startswith(" "):
+            m = _COMP_RE.match(line.strip())
+            if m:
+                name = m.group(2)
+                cur = mod.computations.setdefault(name, [])
+                if m.group(1):
+                    mod.entry = name
+                continue
+            if line.strip() == "}":
+                cur = None
+            continue
+        if cur is None:
+            continue
+        m = _INST_RE.match(line)
+        if m:
+            cur.append(Instruction(
+                opcode=m.group(2), shape=m.group(1), text=line,
+            ))
+    return mod
+
+
+# --------------------------------------------------------------------------
+# Compile + per-program metrics.
+# --------------------------------------------------------------------------
+
+def compile_program(prog: Program) -> tuple[HloModule, dict]:
+    """``.lower(...).compile()`` the spec's program and return the parsed
+    optimized module plus XLA's buffer-assignment stats.
+
+    Jit-wrapped spec fns are lowered DIRECTLY (``fn.lower(...)``): an
+    outer ``jax.jit`` wrapper would silently drop the inner pjit's
+    donation — exactly the failure mode HLO001 polices, so the analyzer
+    must not introduce it itself.  Plain fns get the wrapper (they never
+    declare donation)."""
+    import jax
+
+    fn = prog.fn
+    if hasattr(fn, "lower"):
+        lowered = fn.lower(*prog.args, **prog.static_kwargs)
+    else:
+        # One-shot per analyzed program per cold run; the result cache
+        # means the fresh callable identity never recurs at steady state.
+        lowered = jax.jit(  # bfs_tpu: ok RCD001 analyzer compiles once per program, result content-address-cached
+            lambda *a: fn(*a, **prog.static_kwargs)
+        ).lower(*prog.args)
+    compiled = lowered.compile()
+    module = parse_hlo(compiled.as_text())
+    ma = compiled.memory_analysis()
+    mem = {}
+    if ma is not None:
+        mem = {
+            "argument_bytes": int(getattr(ma, "argument_size_in_bytes", 0)),
+            "output_bytes": int(getattr(ma, "output_size_in_bytes", 0)),
+            "temp_bytes": int(getattr(ma, "temp_size_in_bytes", 0)),
+            "alias_bytes": int(getattr(ma, "alias_size_in_bytes", 0)),
+            "generated_code_bytes": int(
+                getattr(ma, "generated_code_size_in_bytes", 0)
+            ),
+        }
+    return module, mem
+
+
+def materialize_floor(prog: Program) -> int:
+    """HLO003's byte floor: a packed frontier-word array is V/32 uint32
+    words = V/8 bytes, the smallest per-superstep buffer whose copy
+    matters — everything at or above it (word arrays, V-sized state,
+    E-sized candidates) is policed; loop-carry scalar copies are not."""
+    return max(prog.v_elements // 8, 64)
+
+
+def program_metrics(prog: Program, module: HloModule, mem: dict) -> dict:
+    """The per-program fingerprint row: the compiled-artifact shape a PR
+    must not silently regress."""
+    floor = materialize_floor(prog)
+    fusions = sum(
+        1 for _c, i in module.instructions() if i.opcode == "fusion"
+    )
+    instructions = sum(1 for _ in module.instructions())
+    collectives = sum(
+        1 for _c, i in module.instructions() if i.opcode in COLLECTIVE_OPS
+    )
+    loop_coll = sum(
+        1 for _c, i in module.loop_instructions()
+        if i.opcode in COLLECTIVE_OPS
+    )
+    loop_mat = sum(
+        1 for _c, i in module.loop_instructions()
+        if i.opcode in MATERIALIZE_OPS and i.nbytes >= floor
+    )
+    return {
+        "fusions": fusions,
+        "instructions": instructions,
+        "collectives": collectives,
+        "loop_collectives": loop_coll,
+        "loop_materializations": loop_mat,
+        "temp_bytes": int(mem.get("temp_bytes", 0)),
+        "argument_bytes": int(mem.get("argument_bytes", 0)),
+        "output_bytes": int(mem.get("output_bytes", 0)),
+        "alias_bytes": int(mem.get("alias_bytes", 0)),
+        "generated_code_bytes": int(mem.get("generated_code_bytes", 0)),
+    }
+
+
+def analyze_compiled(
+    prog: Program, fingerprint: dict | None = None
+) -> tuple[list[Finding], dict]:
+    """All HLO findings for one program plus its metrics row.
+    ``fingerprint`` is the committed metrics row to diff against (None =
+    no regression checks — a new or foreign-environment program)."""
+    from .hlo_rules import check_compiled
+
+    def make_finding(rule: str, detail: str, message: str) -> Finding:
+        return Finding(
+            rule=rule, path=prog.path, line=0, col=0,
+            message=f"[{prog.name}] {message}",
+            snippet=f"hlo:{prog.name}:{detail}",
+        )
+
+    try:
+        module, mem = compile_program(prog)
+    except SkipProgram:
+        raise
+    except Exception as exc:
+        return [make_finding(
+            "HLO000", "compile",
+            f"could not compile to an executable: "
+            f"{type(exc).__name__}: {exc}",
+        )], {}
+    metrics = program_metrics(prog, module, mem)
+    findings = check_compiled(prog, module, mem, metrics, fingerprint,
+                              make_finding)
+    seen, out = set(), []
+    for f in findings:
+        key = (f.rule, f.snippet)
+        if key not in seen:
+            seen.add(key)
+            out.append(f)
+    out.sort(key=lambda f: (f.path, f.rule, f.snippet))
+    return out, metrics
+
+
+# --------------------------------------------------------------------------
+# Committed fingerprints.
+# --------------------------------------------------------------------------
+
+def default_fingerprints_path() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "hlo_fingerprints.json"
+    )
+
+
+def current_env() -> dict:
+    import jax
+
+    return {
+        "backend": jax.default_backend(),
+        "devices": len(jax.devices()),
+        "jax": jax.__version__,
+    }
+
+
+def load_fingerprints(path: str | None = None) -> tuple[str, dict]:
+    """``(status, programs)`` where status is ``match`` (regression rules
+    active), ``foreign`` (file from another backend/jax/device-count —
+    counts not comparable, rules skipped) or ``missing``."""
+    path = path or default_fingerprints_path()
+    if not os.path.exists(path):
+        return "missing", {}
+    try:
+        with open(path, encoding="utf-8") as fh:
+            doc = json.load(fh)
+        programs = doc.get("programs", {})
+        env = doc.get("env", {})
+    except (ValueError, OSError):
+        return "missing", {}
+    if env != current_env():
+        return "foreign", programs
+    return "match", programs
+
+
+#: The metric keys a fingerprint row pins (regression-checked subset +
+#: the context columns tools/hlo_diff.py renders).
+FINGERPRINT_KEYS = (
+    "temp_bytes", "fusions", "loop_collectives", "loop_materializations",
+    "collectives", "argument_bytes", "output_bytes", "alias_bytes",
+)
+
+
+def write_fingerprints(path: str, fingerprints: dict) -> None:
+    doc = {
+        "env": current_env(),
+        "programs": {
+            name: {k: row[k] for k in FINGERPRINT_KEYS if k in row}
+            for name, row in sorted(fingerprints.items())
+        },
+    }
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+
+
+# --------------------------------------------------------------------------
+# Content-addressed result cache + the repo entry point.
+# --------------------------------------------------------------------------
+
+def default_cache_dir(root: str | None = None) -> str:
+    env = os.environ.get("BFS_TPU_HLO_CACHE", "")
+    if env:
+        return env
+    return os.path.join(root or repo_root(), ".bench_cache", "hlo")
+
+
+def _cache_key(root: str, fingerprints_path: str) -> str:
+    import jax
+
+    h = hashlib.blake2b(digest_size=16)
+    h.update(_source_fingerprint(root).encode())
+    h.update(jax.__version__.encode())
+    h.update(jax.default_backend().encode())
+    h.update(str(len(jax.devices())).encode())
+    h.update(str(HLO_VERSION).encode())
+    h.update(",".join(sorted(PROGRAM_SPECS)).encode())
+    for env in _FLAVOR_ENV:
+        h.update(f"{env}={os.environ.get(env, '')};".encode())
+    # The committed fingerprint file is a rule input: edit it and the
+    # regression findings change, so the cache must miss.
+    try:
+        with open(fingerprints_path, "rb") as fh:
+            h.update(fh.read())
+    except OSError:
+        h.update(b"no-fingerprints")
+    return h.hexdigest()
+
+
+def _finding_to_dict(f: Finding) -> dict:
+    return {
+        "rule": f.rule, "path": f.path, "line": f.line, "col": f.col,
+        "message": f.message, "snippet": f.snippet,
+    }
+
+
+def analyze_hlo(
+    specs: dict | None = None,
+    *,
+    use_cache: bool = True,
+    cache_dir: str | None = None,
+    root: str | None = None,
+    fingerprints_path: str | None = None,
+) -> tuple[list[Finding], dict]:
+    """Run the HLO pass over ``specs`` (default: the canonical
+    :data:`~bfs_tpu.analysis.ir.PROGRAM_SPECS` registry).  Returns
+    ``(findings, meta)``; ``meta`` carries cache disposition, skipped
+    programs, the per-program metrics rows (``meta['fingerprints']``)
+    and the committed-fingerprint status.  Custom specs are never
+    cached — only the canonical registry is content-addressed."""
+    _ensure_jax_env()
+    root = root or repo_root()
+    fingerprints_path = fingerprints_path or default_fingerprints_path()
+    custom = specs is not None
+    specs = specs if custom else PROGRAM_SPECS
+    fp_status, committed = load_fingerprints(fingerprints_path)
+    meta: dict = {
+        "cache": "off" if (custom or not use_cache) else "miss",
+        "programs": [], "skipped": {}, "fingerprints": {},
+        "fingerprint_status": fp_status,
+        "unfingerprinted": [],
+    }
+
+    cache_path = None
+    if not custom and use_cache:
+        key = _cache_key(root, fingerprints_path)
+        cache_path = os.path.join(
+            cache_dir or default_cache_dir(root), f"hlo_{key}.json"
+        )
+        if os.path.exists(cache_path):
+            try:
+                with open(cache_path, encoding="utf-8") as fh:
+                    doc = json.load(fh)
+                meta.update(doc.get("meta", {}))
+                meta["cache"] = "hit"
+                return [Finding(**d) for d in doc["findings"]], meta
+            except (ValueError, KeyError, TypeError):
+                pass  # corrupt cache entry: recompute and overwrite
+
+    findings: list[Finding] = []
+    for name, build in specs.items():
+        fingerprint = committed.get(name) if fp_status == "match" else None
+        try:
+            prog = build()
+            result, metrics = analyze_compiled(prog, fingerprint)
+        except SkipProgram as exc:
+            meta["skipped"][name] = str(exc)
+            continue
+        except Exception as exc:
+            findings.append(Finding(
+                rule="HLO000", path="bfs_tpu/analysis/hlo.py", line=0, col=0,
+                message=f"[{name}] spec builder failed: "
+                        f"{type(exc).__name__}: {exc}",
+                snippet=f"hlo:{name}:builder",
+            ))
+            continue
+        meta["programs"].append(name)
+        if metrics:
+            meta["fingerprints"][name] = metrics
+        if fp_status == "match" and name not in committed:
+            meta["unfingerprinted"].append(name)
+        findings.extend(result)
+
+    findings.sort(key=lambda f: (f.path, f.rule, f.snippet))
+    if cache_path is not None:
+        try:
+            os.makedirs(os.path.dirname(cache_path), exist_ok=True)
+            tmp = f"{cache_path}.tmp.{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(
+                    {"meta": {k: v for k, v in meta.items()
+                              if k != "cache"},
+                     "findings": [_finding_to_dict(f) for f in findings]},
+                    fh,
+                )
+            os.replace(tmp, cache_path)
+        except OSError:
+            pass
+    return findings, meta

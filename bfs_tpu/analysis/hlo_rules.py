@@ -1,0 +1,267 @@
+"""The HLO001-HLO005 walks over a compiled program's optimized module.
+
+Split from :mod:`.hlo` the way :mod:`.collectives` is split from
+:mod:`.ir`: hlo.py owns compiling, parsing, caching and fingerprints;
+this module owns what a finding *is*.  Every check receives the parsed
+:class:`~bfs_tpu.analysis.hlo.HloModule`, XLA's buffer-assignment stats,
+the freshly computed metrics row and (when the committed fingerprint
+file matches the current environment) the committed row to diff against.
+"""
+
+from __future__ import annotations
+
+from .hlo import (
+    COLLECTIVE_OPS,
+    ESCAPE_OPS,
+    HLO_TO_NUMPY_DTYPE,
+    MATERIALIZE_OPS,
+    TEMP_REGRESSION_RATIO,
+    materialize_floor,
+)
+
+_WIDE_NUMPY = frozenset({"int64", "uint64", "float64"})
+
+
+def check_compiled(prog, module, mem, metrics, fingerprint, make_finding):
+    """All HLO-rule findings for one compiled program."""
+    findings = []
+    findings += check_donation_realized(prog, module, mem, make_finding)
+    findings += check_buffer_assignment(
+        prog, mem, metrics, fingerprint, make_finding
+    )
+    findings += check_loop_materialization(
+        prog, module, metrics, fingerprint, make_finding
+    )
+    findings += check_compiled_collectives(
+        prog, module, metrics, fingerprint, make_finding
+    )
+    findings += check_escapes(prog, module, make_finding)
+    return findings
+
+
+# --------------------------------------------------------------------------
+# HLO001 — declared donation must be REALIZED by the executable.
+# --------------------------------------------------------------------------
+
+def check_donation_realized(prog, module, mem, make_finding):
+    """The spec's ``donate`` map names carries IR001 already proved are
+    *declared* donated.  Here the compiled executable itself must list
+    the corresponding entry parameters in ``input_output_alias`` — a
+    declaration the compiler dropped (nested-jit inlining, an
+    aliasing-hostile layout) doubles the carry's HBM with the jaxpr rung
+    still green."""
+    if not prog.donate:
+        return []
+    if not hasattr(prog.fn, "lower"):
+        # The analyzer had to wrap the fn in an outer jit to compile it,
+        # which itself drops inner donation — aliasing is unprovable.
+        return [make_finding(
+            "HLO001", "unprovable",
+            "spec declares donated carries but its fn is not a jit "
+            "artifact — the compiled executable cannot be checked for "
+            "realized aliasing; register the jitted program object",
+        )]
+    import jax
+
+    ranges, start = [], 0
+    for a in prog.args:
+        n = len(jax.tree_util.tree_leaves(a))
+        ranges.append((start, start + n))
+        start += n
+    aliased = module.aliased_params
+    findings = []
+    for argidx, label in sorted(prog.donate.items()):
+        lo, _hi = ranges[argidx]
+        leaves = jax.tree_util.tree_leaves(prog.args[argidx])
+        missing = 0
+        for off, leaf in enumerate(leaves):
+            size = int(getattr(leaf, "size", 0))
+            if size >= prog.v_elements and (lo + off) not in aliased:
+                missing += size * leaf.dtype.itemsize
+        if missing:
+            findings.append(make_finding(
+                "HLO001", f"donate:{label}",
+                f"carry '{label}' is declared donated but the compiled "
+                f"executable's input_output_alias map does not alias its "
+                f"parameter(s) — the donation was dropped between jaxpr "
+                f"and buffer assignment; +{missing} dead input bytes "
+                f"stay live next to the output (executable alias bytes: "
+                f"{mem.get('alias_bytes', 0)})",
+            ))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# HLO002 — compiler-backed HBM proof + temp-bytes tripwire.
+# --------------------------------------------------------------------------
+
+def check_buffer_assignment(prog, mem, metrics, fingerprint, make_finding):
+    findings = []
+    if prog.budget_bytes and mem:
+        # alias bytes appear in BOTH the argument and the output totals
+        # but occupy ONE buffer (that is what a realized donation means)
+        # — subtract once or a donated V-sized carry double-counts.
+        total = (
+            mem.get("argument_bytes", 0) + mem.get("output_bytes", 0)
+            + mem.get("temp_bytes", 0) + mem.get("generated_code_bytes", 0)
+            - mem.get("alias_bytes", 0)
+        )
+        if total > prog.budget_bytes:
+            findings.append(make_finding(
+                "HLO002", "budget",
+                f"XLA's buffer assignment needs {total} bytes (arguments "
+                f"{mem.get('argument_bytes', 0)} + outputs "
+                f"{mem.get('output_bytes', 0)} + temps "
+                f"{mem.get('temp_bytes', 0)} + generated code "
+                f"{mem.get('generated_code_bytes', 0)} - aliased "
+                f"{mem.get('alias_bytes', 0)}) — over the declared "
+                f"{prog.budget_bytes}-byte budget; unlike IR004's static "
+                "estimate this is the compiler's own allocation, not a "
+                "bound",
+            ))
+    if fingerprint and "temp_bytes" in fingerprint:
+        base = int(fingerprint["temp_bytes"])
+        now = int(metrics.get("temp_bytes", 0))
+        if now > base * (1 + TEMP_REGRESSION_RATIO):
+            pct = (now - base) * 100.0 / base if base else float("inf")
+            findings.append(make_finding(
+                "HLO002", "regress:temp",
+                f"temp buffer bytes regressed {base} -> {now} "
+                f"(+{pct:.0f}%, tripwire is "
+                f"+{TEMP_REGRESSION_RATIO:.0%}) vs the committed "
+                "fingerprint — a new scratch buffer or a lost in-place "
+                "update in the hot program; re-fingerprint only with "
+                "justification (bfs-tpu-lint --hlo --update-fingerprints)",
+            ))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# HLO003 — materialized layout ops inside the superstep while body.
+# --------------------------------------------------------------------------
+
+def check_loop_materialization(prog, module, metrics, fingerprint,
+                               make_finding):
+    floor = materialize_floor(prog)
+    per_op: dict[str, tuple[int, int]] = {}
+    for _comp, inst in module.loop_instructions():
+        if inst.opcode in MATERIALIZE_OPS and inst.nbytes >= floor:
+            n, b = per_op.get(inst.opcode, (0, 0))
+            per_op[inst.opcode] = (n + 1, b + inst.nbytes)
+    findings = []
+    for op in sorted(per_op):
+        n, b = per_op[op]
+        findings.append(make_finding(
+            "HLO003", f"loop:{op}",
+            f"{n} materialized '{op}' op(s) ({b} bytes/iteration at lint "
+            f"scale, floor {floor}) inside the superstep while body — a "
+            "buffer XLA copies every superstep that the source never "
+            "asked for (fusion break or copy insertion on a multi-read "
+            "carry)",
+        ))
+    if fingerprint:
+        if "fusions" in fingerprint and (
+            metrics.get("fusions", 0) > int(fingerprint["fusions"])
+        ):
+            findings.append(make_finding(
+                "HLO003", "regress:fusions",
+                f"emitted fusion count grew "
+                f"{fingerprint['fusions']} -> {metrics.get('fusions')} vs "
+                "the committed fingerprint — a previously fused region "
+                "now launches as separate kernels",
+            ))
+        if "loop_materializations" in fingerprint and (
+            metrics.get("loop_materializations", 0)
+            > int(fingerprint["loop_materializations"])
+        ):
+            findings.append(make_finding(
+                "HLO003", "regress:loop-materialize",
+                f"materialized copy/transpose ops in the while body grew "
+                f"{fingerprint['loop_materializations']} -> "
+                f"{metrics.get('loop_materializations')} vs the committed "
+                "fingerprint — per-superstep HBM traffic nobody asked for",
+            ))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# HLO004 — collectives as compiled, vs the declared exchange.
+# --------------------------------------------------------------------------
+
+def check_compiled_collectives(prog, module, metrics, fingerprint,
+                               make_finding):
+    findings = []
+    all_colls = [
+        inst for _c, inst in module.instructions()
+        if inst.opcode in COLLECTIVE_OPS
+    ]
+    if prog.mesh_axes is None and all_colls:
+        ops = sorted({i.opcode for i in all_colls})
+        findings.append(make_finding(
+            "HLO004", "unexpected",
+            f"{len(all_colls)} collective op(s) ({', '.join(ops)}) in the "
+            "optimized module of a program that declares NO mesh axes — "
+            "per-call device traffic nobody budgeted",
+        ))
+    if prog.required_axes and not all_colls:
+        findings.append(make_finding(
+            "HLO004", "missing-collective",
+            f"spec requires an exchange over "
+            f"{sorted(prog.required_axes)} but the optimized module "
+            "contains no collective at all — the per-superstep merge "
+            "was compiled away (the compiled twin of IR005)",
+        ))
+    allowed = frozenset(prog.exchange_dtypes)
+    for _comp, inst in module.loop_instructions():
+        if inst.opcode not in COLLECTIVE_OPS:
+            continue
+        if inst.nbytes < prog.exchange_floor:
+            continue  # control-plane scalar (the `changed` reduce etc.)
+        for dt in shape_numpy_dtypes(inst.shape):
+            if dt in _WIDE_NUMPY or dt not in allowed:
+                findings.append(make_finding(
+                    "HLO004", f"payload:{inst.opcode}:{dt}",
+                    f"loop-body collective '{inst.opcode}' moves a "
+                    f"{inst.nbytes}-byte {dt} payload; the declared "
+                    f"exchange format is {sorted(allowed)} — the "
+                    "compiled wire format drifted from the spec",
+                ))
+    if fingerprint and "loop_collectives" in fingerprint:
+        base = int(fingerprint["loop_collectives"])
+        now = int(metrics.get("loop_collectives", 0))
+        if now != base:
+            what = "duplicated into" if now > base else "hoisted out of"
+            findings.append(make_finding(
+                "HLO004", "regress:collectives",
+                f"loop-body collective count changed {base} -> {now} vs "
+                f"the committed fingerprint — XLA {what} the superstep "
+                "loop a collective the source shows once; per-superstep "
+                "ICI traffic changed shape",
+            ))
+    return findings
+
+
+def shape_numpy_dtypes(shape: str) -> list[str]:
+    from .hlo import shape_dtypes
+
+    return [HLO_TO_NUMPY_DTYPE.get(dt, dt) for dt in shape_dtypes(shape)]
+
+
+# --------------------------------------------------------------------------
+# HLO005 — opaque escapes from the fused-XLA contract.
+# --------------------------------------------------------------------------
+
+def check_escapes(prog, module, make_finding):
+    per_op: dict[str, int] = {}
+    for _comp, inst in module.instructions():
+        if inst.opcode in ESCAPE_OPS:
+            per_op[inst.opcode] = per_op.get(inst.opcode, 0) + 1
+    return [
+        make_finding(
+            "HLO005", f"escape:{op}",
+            f"{n} '{op}' op(s) survive to the optimized HLO of a hot "
+            "program — an opaque host/library escape in a path that is "
+            "supposed to be fused XLA end to end",
+        )
+        for op, n in sorted(per_op.items())
+    ]

@@ -43,6 +43,9 @@ _EXEMPT_METHODS = {"__init__", "__new__", "__post_init__", "__del__"}
 _LOCK_FACTORIES = {
     "threading.Lock", "threading.RLock", "threading.Condition",
     "Lock", "RLock", "Condition", "threading.Semaphore",
+    # analysis.runtime.make_lock — the lock-order recorder's factory
+    # (ISSUE 12): classes building their lock through it still OWN one.
+    "make_lock", "runtime.make_lock",
 }
 _MUTABLE_FACTORIES = {
     "dict", "list", "set", "OrderedDict", "collections.OrderedDict",

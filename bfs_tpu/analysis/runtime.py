@@ -1,6 +1,6 @@
 """Runtime sanitizers: the dynamic half of the analysis pass.
 
-Two families, both zero-overhead when disabled:
+Three families, all zero-overhead when disabled:
 
 **Transfer guard** — :func:`guarded_region` wraps a block in
 ``jax.transfer_guard("disallow")`` when ``BFS_TPU_TRANSFER_GUARD`` is set,
@@ -23,6 +23,21 @@ hit rate" failure and bench recompile stalls become diagnosable:
 ``tools/chaos_run.py`` on exit, and any monitor can poll it.  Counting is
 lock-guarded and works under ``jit``, ``lower()``, grad, and vmap alike
 (anything that re-executes the traced body).
+
+**Lock-order recorder** — the dynamic complement to the LCK001/LCK002
+static rules (ISSUE 12 satellite).  The static checker proves every
+``# guarded-by:`` field is accessed under its lock; it cannot see the
+ORDER two locks are taken in across threads, which is where deadlocks
+live.  Under ``BFS_TPU_LOCK_ORDER=1`` the serve/registry/executor/health
+locks are built by :func:`make_lock` as recording proxies: every
+"acquired B while holding A" event adds the edge A→B to a process-global
+order graph, and an edge that closes a cycle (B→…→A already recorded —
+the two-thread AB/BA deadlock shape) is recorded as a violation
+(``BFS_TPU_LOCK_ORDER=raise`` raises :class:`LockOrderError` at the
+acquisition instead).  ``lock_order_report()`` returns the edges and
+cycles; the chaos serve test asserts it stays cycle-free under the full
+fault+swap schedule.  With the env unset :func:`make_lock` returns a
+plain ``threading.Lock``/``RLock`` — zero overhead, identical types.
 """
 
 from __future__ import annotations
@@ -158,6 +173,166 @@ def retrace_report() -> dict[str, int]:
 def reset_retrace_counts() -> None:
     with _lock:
         _retrace_counts.clear()
+
+
+# --------------------------------------------------------------------------
+# Lock-order recording.
+# --------------------------------------------------------------------------
+
+class LockOrderError(RuntimeError):
+    """An acquisition closed a cycle in the lock-order graph — the
+    two-thread deadlock shape, caught at the acquire that creates it."""
+
+
+_lock_edges: dict[tuple[str, str], int] = {}  # guarded-by: _lock
+_lock_cycles: list[list[str]] = []  # guarded-by: _lock
+_lock_tls = threading.local()
+
+
+def lock_order_mode() -> str | None:
+    """``'record'`` / ``'raise'`` / None (off — the default)."""
+    raw = os.environ.get("BFS_TPU_LOCK_ORDER", "").strip().lower()
+    if raw in ("", "0", "off", "false"):
+        return None
+    return "raise" if raw == "raise" else "record"
+
+
+def _held_stack() -> list:
+    stack = getattr(_lock_tls, "held", None)
+    if stack is None:
+        stack = _lock_tls.held = []
+    return stack
+
+
+# bfs_tpu: holds _lock
+def _find_path(src: str, dst: str) -> list[str] | None:
+    """A path src -> ... -> dst in the edge graph (caller holds _lock)."""
+    stack, seen = [(src, [src])], {src}
+    while stack:
+        node, path = stack.pop()
+        if node == dst:
+            return path
+        for a, b in _lock_edges:
+            if a == node and b not in seen:
+                seen.add(b)
+                stack.append((b, path + [b]))
+    return None
+
+
+def _record_acquire(name: str) -> None:
+    """Called BEFORE blocking on ``name``: the ordering edge exists the
+    moment the thread commits to the acquisition, whether or not it ever
+    returns (that is exactly the deadlocked case)."""
+    held = _held_stack()
+    cycle = None
+    with _lock:
+        for h in held:
+            if h == name:
+                continue  # reentrant acquire orders nothing
+            edge = (h, name)
+            if edge not in _lock_edges:
+                # New edge h -> name: a cycle exists iff name already
+                # reaches h through previously recorded edges.
+                path = _find_path(name, h)
+                if path is not None:
+                    cycle = path + [name]
+                    _lock_cycles.append(cycle)
+            _lock_edges[edge] = _lock_edges.get(edge, 0) + 1
+    if cycle is not None and lock_order_mode() == "raise":
+        raise LockOrderError(
+            "lock-order cycle: " + " -> ".join(cycle)
+            + " (acquired '" + name + "' while holding '"
+            + cycle[-2] + "')"
+        )
+
+
+class _OrderedLock:
+    """A recording proxy around a real lock.  Supports the ``with``
+    protocol, plain acquire/release, and ``threading.Condition`` over it
+    (Condition only needs acquire/release for a non-RLock inner)."""
+
+    def __init__(self, name: str, inner):
+        self._name = name
+        self._inner = inner
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        # Only BLOCKING acquires order locks: a try-acquire can never be
+        # the blocked arm of a deadlock, and Condition._is_owned probes
+        # with acquire(0) while holding arbitrary other locks — recording
+        # those would fabricate reversed edges and false cycles.  The
+        # blocking edge is recorded BEFORE the call on purpose: the
+        # deadlocked interleaving is exactly the one that never returns.
+        if blocking:
+            _record_acquire(self._name)
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            _held_stack().append(self._name)
+        return got
+
+    def release(self):
+        self._inner.release()
+        held = _held_stack()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == self._name:
+                del held[i]
+                break
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __repr__(self):
+        return f"<_OrderedLock {self._name} {self._inner!r}>"
+
+
+def make_lock(name: str, kind: str = "lock"):
+    """Build a named lock for a ``# guarded-by:`` field.
+
+    With ``BFS_TPU_LOCK_ORDER`` unset (the default, read at CONSTRUCTION
+    time) this returns a plain ``threading.Lock``/``RLock`` — identical
+    behavior and cost to before.  With it set, a recording proxy.  The
+    name keys the order graph, so all instances of one class share a
+    node — the checker orders lock CLASSES, not instances (two locks of
+    the same name nested record nothing)."""
+    inner = threading.RLock() if kind == "rlock" else threading.Lock()
+    if lock_order_mode() is None:
+        return inner
+    return _OrderedLock(name, inner)
+
+
+def lock_order_report() -> dict:
+    """``{"edges": {"a->b": count}, "cycles": [[...], ...]}`` — cycles is
+    non-empty iff some interleaving of the recorded acquisitions can
+    deadlock."""
+    with _lock:
+        return {
+            "edges": {f"{a}->{b}": n for (a, b), n in sorted(_lock_edges.items())},
+            "cycles": [list(c) for c in _lock_cycles],
+        }
+
+
+def reset_lock_order() -> None:
+    with _lock:
+        _lock_edges.clear()
+        _lock_cycles.clear()
+
+
+def assert_lock_order_clean() -> None:
+    """Raise :class:`LockOrderError` if any recorded cycle exists — the
+    chaos-test exit gate."""
+    report = lock_order_report()
+    if report["cycles"]:
+        raise LockOrderError(
+            f"{len(report['cycles'])} lock-order cycle(s): "
+            + "; ".join(" -> ".join(c) for c in report["cycles"])
+        )
 
 
 def format_retrace_report(baseline: dict[str, int] | None = None) -> str:

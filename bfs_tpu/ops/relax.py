@@ -222,6 +222,24 @@ def relax_superstep_packed(
 
 
 # bfs_tpu: hot traced
+def _batched_push_candidates(frontier, src, dst, num_segments: int):
+    """Edge-major batched candidates: gather the frontier per EDGE
+    (``frontier.T[src]`` -> (E, S)) and run ONE segment_min over the
+    leading edge axis, transposing back at the end.  The vmap-over-rows
+    spelling computed the same values but made XLA:CPU materialize a
+    layout-changing (E, S) copy of the whole candidate buffer inside the
+    while body every superstep (HLO003's first dogfood catch — E*S*4
+    bytes/superstep of copy traffic); edge-major keeps the gather, the
+    where and the scatter-min in one natural layout and the closing
+    transpose fuses into the elementwise consumer."""
+    active = frontier.T[src]  # (E, S)
+    cand = jnp.where(active, src[:, None], INT32_MAX)
+    return jax.ops.segment_min(
+        cand, dst, num_segments=num_segments, indices_are_sorted=True
+    ).T
+
+
+# bfs_tpu: hot traced
 def relax_superstep_batched_packed(
     state: PackedBfsState,
     src: jax.Array,
@@ -231,15 +249,9 @@ def relax_superstep_batched_packed(
     batch_axis_name: str | None = None,
 ) -> PackedBfsState:
     """Packed twin of :func:`relax_superstep_batched`."""
-    num_segments = state.packed.shape[-1]
-
-    def seg(cand):
-        return jax.ops.segment_min(
-            cand, dst, num_segments=num_segments, indices_are_sorted=True
-        )
-
-    active = state.frontier[:, src]
-    cand_parent = jax.vmap(seg)(jnp.where(active, src, INT32_MAX))
+    cand_parent = _batched_push_candidates(
+        state.frontier, src, dst, state.packed.shape[-1]
+    )
     if axis_name is not None:
         cand_parent = jax.lax.pmin(cand_parent, axis_name)
     return apply_candidates_packed(
@@ -296,15 +308,9 @@ def relax_superstep_batched(
     ``batch_axis_name`` reduces the termination flag across a sharded sources
     axis (data-parallel axis) so every device agrees on loop exit.
     """
-    num_segments = state.dist.shape[-1]
-
-    def seg(cand):
-        return jax.ops.segment_min(
-            cand, dst, num_segments=num_segments, indices_are_sorted=True
-        )
-
-    active = state.frontier[:, src]
-    cand_parent = jax.vmap(seg)(jnp.where(active, src, INT32_MAX))
+    cand_parent = _batched_push_candidates(
+        state.frontier, src, dst, state.dist.shape[-1]
+    )
     if axis_name is not None:
         cand_parent = jax.lax.pmin(cand_parent, axis_name)
     return apply_candidates(state, cand_parent, batch_axis_name=batch_axis_name)

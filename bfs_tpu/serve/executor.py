@@ -25,6 +25,7 @@ from collections import OrderedDict
 
 import numpy as np
 
+from ..analysis.runtime import make_lock
 from ..models.multisource import MultiBfsResult
 
 
@@ -38,7 +39,7 @@ class ExecutableCache:
     def __init__(self, capacity: int = 64, metrics=None):
         self.capacity = capacity  # immutable after init
         self.metrics = metrics  # ServeMetrics is internally locked
-        self._lock = threading.Lock()
+        self._lock = make_lock("executor._lock")
         self._cache: OrderedDict[tuple, object] = OrderedDict()  # guarded-by: _lock
         self.hits = 0  # guarded-by: _lock
         self.misses = 0  # guarded-by: _lock

@@ -58,6 +58,7 @@ import threading
 import time
 from collections import OrderedDict, deque
 
+from ..analysis.runtime import make_lock
 from ..obs import get_registry, instant
 from ..resilience.faults import FaultInjected, fault_point
 from ..resilience.retry import CircuitBreaker, PermanentError
@@ -156,7 +157,7 @@ class ServeHealth:
             cooldown_s=breaker_cooldown_s,
             on_transition=self._on_transition,
         )
-        self._lock = threading.Lock()
+        self._lock = make_lock("health._lock")
         self._latency: dict[tuple, _LatencyWindow] = {}  # guarded-by: _lock
         self._ticks = 0  # executed device ticks, drives sampling — guarded-by: _lock
         # (name, epoch) -> DeviceChecker; small LRU (epochs churn on swap).

@@ -53,6 +53,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..analysis.runtime import make_lock
 from ..graph.csr import DeviceGraph, Graph, build_device_graph
 from ..graph.ell import PullGraph, build_pull_graph, device_ell, drop_device_operands
 
@@ -106,7 +107,7 @@ class GraphRegistry:
         metrics=None,
         layout_cache=None,
     ):
-        self._lock = threading.RLock()
+        self._lock = make_lock("registry._lock", "rlock")
         self._graphs: dict[str, RegisteredGraph] = {}  # guarded-by: _lock
         # Replaced epochs still pinned by in-flight work, keyed
         # (name, epoch); entries leave when their last pin drops.

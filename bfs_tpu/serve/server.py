@@ -47,6 +47,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..analysis.runtime import make_lock
 from ..models.bfs import check_sources
 from ..models.multisource import MultiBfsResult, collapse_multi_source
 from ..obs.spans import span as obs_span
@@ -203,7 +204,7 @@ class BfsServer:
         from ..models.direction import resolve_direction
 
         self._direction_key = resolve_direction().key()  # immutable after init
-        self._lock = threading.Lock()
+        self._lock = make_lock("server._lock")
         self._cond = threading.Condition(self._lock)  # holding _cond == holding _lock
         self._result_cache: OrderedDict[tuple, tuple] = OrderedDict()  # guarded-by: _lock
         self._result_cache_size = int(result_cache_size)  # immutable after init

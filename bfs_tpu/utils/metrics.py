@@ -27,6 +27,8 @@ import json
 import threading
 from dataclasses import dataclass, field, asdict
 
+from ..analysis.runtime import make_lock
+
 _artifact_lock = threading.Lock()
 _artifact_counters: dict[str, int] = {}  # guarded-by: _artifact_lock
 
@@ -155,7 +157,7 @@ class ServeMetrics:
     def __init__(self, max_records: int = 100_000):
         from collections import deque
 
-        self._lock = threading.Lock()
+        self._lock = make_lock("metrics._lock")
         self.records: deque[QueryRecord] = deque(maxlen=max_records)  # guarded-by: _lock
         self.counters: dict[str, int] = {}  # guarded-by: _lock
         self._first_ts: float | None = None  # guarded-by: _lock

@@ -6,6 +6,7 @@ baseline" gate), the CLI exit codes, and the runtime sanitizers
 
 from __future__ import annotations
 
+import json
 import os
 import subprocess
 import sys
@@ -524,11 +525,13 @@ def test_repo_self_lint_clean_modulo_baseline():
     assert fresh_errors == [], "\n".join(f.render() for f in fresh_errors)
     # Stale AST entries fail the self-lint too (ISSUE 8 satellite): an
     # accepted finding that no longer exists must be pruned, or the
-    # baseline rots into a list of things nobody can re-triage.  IR
-    # entries are not exercised by this pass and don't count here.
+    # baseline rots into a list of things nobody can re-triage.  IR/HLO
+    # entries are not exercised by this pass and don't count here (their
+    # own self-lint tests enforce staleness for their families).
     stale_ast = [
         fp for fp in baseline.stale()
-        if not baseline.entries[fp][0].startswith("IR")
+        if not (baseline.entries[fp][0].startswith("IR")
+                or baseline.entries[fp][0].startswith("HLO"))
     ]
     assert stale_ast == [], (
         "stale baseline entries (fixed or edited — prune them): "
@@ -672,7 +675,8 @@ def test_cli_rules_catalog():
     proc = _run_cli(["--rules"])
     assert proc.returncode == 0
     for rule in ("TRC001", "TRC006", "RCD001", "RCD005", "LCK001", "LCK002",
-                 "OBS001", "IR001", "IR004", "IR006"):
+                 "OBS001", "IR001", "IR004", "IR006", "HLO001", "HLO003",
+                 "HLO005"):
         assert rule in proc.stdout
 
 
@@ -698,19 +702,25 @@ def test_cli_stale_baseline_fails_default_run(tmp_path):
     assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
-def test_cli_write_baseline_carries_ir_entries_over(tmp_path):
+def test_cli_write_baseline_carries_ir_and_hlo_entries_over(tmp_path):
     """The AST --write-baseline regenerates its own section but must not
-    drop the hand-curated IR entries sharing the file."""
+    drop the hand-curated IR *or* HLO entries sharing the file (ISSUE 12
+    satellite: PR 8 special-cased IR only)."""
     bl = tmp_path / "baseline.txt"
     shipped = open(
         os.path.join(REPO, "bfs_tpu", "analysis", "baseline.txt"),
         encoding="utf-8",
     ).read()
-    bl.write_text(shipped + "IR001  cafecafe0000  fixture: justified\n")
+    bl.write_text(shipped
+                  + "IR001  cafecafe0000  fixture: justified\n"
+                  + "HLO003  beefbeef0000  fixture: also justified\n")
     proc = _run_cli(["--write-baseline", "--baseline", str(bl)])
     assert proc.returncode == 0, proc.stdout + proc.stderr
     rewritten = bl.read_text()
     assert "IR001  cafecafe0000  fixture: justified" in rewritten
+    assert "HLO003  beefbeef0000  fixture: also justified" in rewritten
+    # The shipped HLO section's real entries survive too.
+    assert "HLO003  15602bda2246" in rewritten
     assert "carried over" in proc.stdout
 
 
@@ -863,3 +873,204 @@ def test_hot_region_decorator_registers_and_statically_hot(tmp_path):
             return x.item()
         """)
     assert rules_of(fs) == ["TRC001"]
+
+
+# ---------------------------------------------------------------------------
+# Lock-order recorder (ISSUE 12 satellite): the dynamic complement to
+# LCK001/002 — order, not coverage.
+# ---------------------------------------------------------------------------
+
+def test_make_lock_plain_when_disabled(monkeypatch):
+    import threading
+
+    from bfs_tpu.analysis.runtime import make_lock
+
+    monkeypatch.delenv("BFS_TPU_LOCK_ORDER", raising=False)
+    assert isinstance(make_lock("x"), type(threading.Lock()))
+    assert isinstance(make_lock("x", "rlock"), type(threading.RLock()))
+
+
+def test_lock_order_cycle_detected_across_threads(monkeypatch):
+    import threading
+
+    from bfs_tpu.analysis import runtime as art
+
+    monkeypatch.setenv("BFS_TPU_LOCK_ORDER", "1")
+    art.reset_lock_order()
+    A, B = art.make_lock("fx.A"), art.make_lock("fx.B")
+
+    def ab():
+        with A:
+            with B:
+                pass
+
+    def ba():
+        with B:
+            with A:
+                pass
+
+    for target in (ab, ba):
+        t = threading.Thread(target=target)
+        t.start()
+        t.join()
+    report = art.lock_order_report()
+    assert report["edges"] == {"fx.A->fx.B": 1, "fx.B->fx.A": 1}
+    assert report["cycles"] == [["fx.A", "fx.B", "fx.A"]]
+    with pytest.raises(art.LockOrderError, match="fx.A -> fx.B -> fx.A"):
+        art.assert_lock_order_clean()
+    art.reset_lock_order()
+
+
+def test_lock_order_consistent_nesting_is_clean(monkeypatch):
+    from bfs_tpu.analysis import runtime as art
+
+    monkeypatch.setenv("BFS_TPU_LOCK_ORDER", "1")
+    art.reset_lock_order()
+    A, B, C = (art.make_lock(n) for n in ("fx.A", "fx.B", "fx.C"))
+    for _ in range(3):  # same A -> B -> C order every time: no cycle
+        with A:
+            with B:
+                with C:
+                    pass
+    report = art.lock_order_report()
+    assert report["cycles"] == []
+    assert set(report["edges"]) == {"fx.A->fx.B", "fx.A->fx.C",
+                                    "fx.B->fx.C"}
+    art.assert_lock_order_clean()
+    art.reset_lock_order()
+
+
+def test_lock_order_reentrant_rlock_records_nothing(monkeypatch):
+    from bfs_tpu.analysis import runtime as art
+
+    monkeypatch.setenv("BFS_TPU_LOCK_ORDER", "1")
+    art.reset_lock_order()
+    R = art.make_lock("fx.R", "rlock")
+    with R:
+        with R:  # reentrant re-acquire orders nothing
+            pass
+    assert art.lock_order_report() == {"edges": {}, "cycles": []}
+    art.reset_lock_order()
+
+
+def test_lock_order_raise_mode_raises_at_the_acquire(monkeypatch):
+    import threading
+
+    from bfs_tpu.analysis import runtime as art
+
+    monkeypatch.setenv("BFS_TPU_LOCK_ORDER", "raise")
+    art.reset_lock_order()
+    A, B = art.make_lock("fx.A"), art.make_lock("fx.B")
+
+    def ab():
+        with A:
+            with B:
+                pass
+
+    t = threading.Thread(target=ab)
+    t.start()
+    t.join()
+    with B:
+        with pytest.raises(art.LockOrderError, match="cycle"):
+            A.acquire()
+    art.reset_lock_order()
+
+
+def test_lock_order_condition_over_recorded_lock(monkeypatch):
+    """server.py builds threading.Condition(self._lock) — the proxy must
+    keep that working (wait/notify round-trip through a recorded lock)."""
+    import threading
+
+    from bfs_tpu.analysis import runtime as art
+
+    monkeypatch.setenv("BFS_TPU_LOCK_ORDER", "1")
+    art.reset_lock_order()
+    L = art.make_lock("fx.cond_lock")
+    cond = threading.Condition(L)
+    hits = []
+
+    def waiter():
+        with cond:
+            while not hits:
+                if not cond.wait(timeout=5.0):
+                    return
+            hits.append("woke")
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    import time
+
+    time.sleep(0.05)
+    with cond:
+        hits.append("set")
+        cond.notify()
+    t.join(timeout=5.0)
+    assert hits == ["set", "woke"]
+    assert art.lock_order_report()["cycles"] == []
+    art.reset_lock_order()
+
+
+def test_hlo_fingerprints_pin_program_specs_coverage():
+    """Deleting a PROGRAM_SPECS entry or its committed HLO fingerprint
+    row fails tier-1 (ISSUE 12 satellite) — the two sets must stay equal
+    and at least as large as the ISSUE 11 pin.  Importing the registry
+    NAMES needs no jax (the builders are lazy)."""
+    from bfs_tpu.analysis.ir import PROGRAM_SPECS
+
+    path = os.path.join(REPO, "bfs_tpu", "analysis",
+                        "hlo_fingerprints.json")
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    committed = set(doc["programs"])
+    registry = set(PROGRAM_SPECS)
+    assert len(registry) >= 25
+    assert registry - committed == set(), (
+        "programs missing HLO fingerprint coverage — run "
+        "`bfs-tpu-lint --hlo --update-fingerprints`"
+    )
+    assert committed - registry == set(), (
+        "committed fingerprints for programs the registry no longer "
+        "declares — a hot program silently left PROGRAM_SPECS"
+    )
+    for name, row in doc["programs"].items():
+        assert {"temp_bytes", "fusions", "loop_collectives",
+                "loop_materializations"} <= set(row), name
+
+
+def test_lock_order_nonblocking_probe_records_no_edge(monkeypatch):
+    """Condition._is_owned probes with acquire(0) while holding arbitrary
+    other locks — a try-acquire can never be the blocked arm of a
+    deadlock, so it must not fabricate (reversed) ordering edges."""
+    from bfs_tpu.analysis import runtime as art
+
+    monkeypatch.setenv("BFS_TPU_LOCK_ORDER", "1")
+    art.reset_lock_order()
+    A, B = art.make_lock("fx.A"), art.make_lock("fx.B")
+    with A:
+        with B:
+            pass  # genuine blocking edge A -> B
+    with B:
+        assert A.acquire(False)  # probe: succeeds, but orders nothing
+        A.release()
+    report = art.lock_order_report()
+    assert report["edges"] == {"fx.A->fx.B": 1}  # no fx.B->fx.A
+    assert report["cycles"] == []
+    art.reset_lock_order()
+
+
+def test_lck002_sees_make_lock_as_lock_owner(tmp_path):
+    """Classes that build their lock through analysis.runtime.make_lock
+    (the lock-order recorder factory) still OWN a lock — an unannotated
+    mutable field must keep its LCK002 warning."""
+    fs = lint(tmp_path, """
+        from bfs_tpu.analysis.runtime import make_lock
+
+        class C:
+            def __init__(self):
+                self._lock = make_lock("c._lock")
+                self.pending = {}
+
+            def g(self):
+                return self.pending
+        """)
+    assert "LCK002" in rules_of(fs)

@@ -1,0 +1,762 @@
+"""Tests for bfs_tpu.analysis.hlo — the HLO-grade pass (ISSUE 12): every
+rule must trip on a fixture program and stay quiet on its near-miss, the
+repo's own hot-program registry must compile clean modulo the baseline
+with every PROGRAM_SPECS entry fingerprinted, the content-addressed
+result cache must hit on an unchanged tree, the CLI must exit non-zero on
+each rule fixture and reject scoping, and HLO001 needs its runtime proof:
+a deliberately un-donated twin of a shipping step program trips while the
+fixed program's executable reports the realized alias.
+
+The repo-wide registry runs carry the ``lint_hlo`` marker so a quick
+``-m 'not lint_hlo'`` selection can skip the (cached, but cold-compiled)
+jax work; plain tier-1 runs them.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from bfs_tpu.analysis import Baseline, default_baseline_path
+from bfs_tpu.analysis.hlo import (
+    analyze_compiled,
+    analyze_hlo,
+    compile_program,
+    parse_hlo,
+)
+from bfs_tpu.analysis.ir import Program
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+V = 64
+INT32_MAX = np.iinfo(np.int32).max
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+def _mesh(shape=(2,), names=("graph",)):
+    n = int(np.prod(shape))
+    if len(jax.devices()) < n:
+        pytest.skip(f"needs {n} devices")
+    return Mesh(np.array(jax.devices()[:n]).reshape(shape), names)
+
+
+# ---------------------------------------------------------------------------
+# HLO001 — declared donation must be REALIZED by the executable.
+# ---------------------------------------------------------------------------
+
+def _donated_inner():
+    return jax.jit(lambda s: s + 1, donate_argnums=0)
+
+
+def test_hlo001_dropped_donation_trips():
+    # Wrapping a donating jit in an OUTER jit silently drops the
+    # donation — the exact failure mode the rule exists for.
+    inner = _donated_inner()
+    outer = jax.jit(lambda s: inner(s))
+    prog = Program(
+        name="fx.dropped", path="fx.py", fn=outer,
+        args=(jnp.zeros(V, jnp.int32),), v_elements=V,
+        donate={0: "state"},
+    )
+    fs, _m = analyze_compiled(prog)
+    assert rules_of(fs) == ["HLO001"]
+    assert "input_output_alias" in fs[0].message
+
+
+def test_hlo001_near_miss_realized_alias():
+    prog = Program(
+        name="fx.kept", path="fx.py", fn=_donated_inner(),
+        args=(jnp.zeros(V, jnp.int32),), v_elements=V,
+        donate={0: "state"},
+    )
+    fs, metrics = analyze_compiled(prog)
+    assert fs == []
+    # The executable itself reports the alias — the compiler-backed half.
+    assert metrics["alias_bytes"] == V * 4
+
+
+def test_hlo001_runtime_proof_on_shipping_step_program():
+    """The acceptance proof: a deliberately un-donated twin of the
+    shipping superstep program trips HLO001; the shipped program's
+    compiled executable realizes the alias (non-zero alias bytes in
+    XLA's own memory analysis)."""
+    from bfs_tpu.analysis.ir import PROGRAM_SPECS
+
+    spec = PROGRAM_SPECS["superstep.push_step"]()
+    twin = Program(
+        name="fx.undonated_step", path=spec.path,
+        fn=jax.jit(lambda s: spec.fn(s)),  # outer jit drops donation
+        args=spec.args, v_elements=spec.v_elements, donate=spec.donate,
+    )
+    fs, _m = analyze_compiled(twin)
+    assert any(f.rule == "HLO001" for f in fs), rules_of(fs)
+    fixed_fs, metrics = analyze_compiled(spec)
+    assert not any(f.rule == "HLO001" for f in fixed_fs)
+    assert metrics["alias_bytes"] > 0
+
+
+# ---------------------------------------------------------------------------
+# HLO002 — compiler-backed budget + temp-bytes tripwire.
+# ---------------------------------------------------------------------------
+
+def test_hlo002_budget_exceeded_trips_and_ample_passes():
+    fn = jax.jit(lambda s: s * 2)
+    args = (jnp.zeros(4096, jnp.int32),)
+    tight = Program(name="fx.tight", path="fx.py", fn=fn, args=args,
+                    v_elements=V, budget_bytes=1024)
+    ample = Program(name="fx.ample", path="fx.py", fn=fn, args=args,
+                    v_elements=V, budget_bytes=1 << 30)
+    fs, _m = analyze_compiled(tight)
+    assert rules_of(fs) == ["HLO002"]
+    assert "buffer assignment" in fs[0].message
+    fs, _m = analyze_compiled(ample)
+    assert fs == []
+
+
+def _temps_prog(name):
+    # A reduce forces a real temp buffer in XLA's assignment.
+    return Program(
+        name=name, path="fx.py",
+        fn=jax.jit(lambda s: (s * 2).sum() + s),
+        args=(jnp.zeros(4096, jnp.int32),), v_elements=V,
+        budget_bytes=1 << 30,
+    )
+
+
+def test_hlo002_temp_regression_vs_fingerprint():
+    _fs, metrics = analyze_compiled(_temps_prog("fx.probe"))
+    temp = metrics["temp_bytes"]
+    assert temp > 0
+    # >10% over the committed row trips ...
+    fs, _m = analyze_compiled(
+        _temps_prog("fx.regressed"),
+        fingerprint={"temp_bytes": int(temp / 1.5)},
+    )
+    assert [f.snippet for f in fs] == ["hlo:fx.regressed:regress:temp"]
+    # ... within 10% stays quiet (same compile, same bytes).
+    fs, _m = analyze_compiled(
+        _temps_prog("fx.steady"), fingerprint={"temp_bytes": temp},
+    )
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# HLO003 — materialized ops inside the while body.
+# ---------------------------------------------------------------------------
+
+def _loop_copy_prog(name="fx.loopcopy"):
+    @jax.jit
+    def loop_copy(x):
+        def body(c):
+            x, i = c
+            y = x.at[i].set(x[(i + 1) % V] + 1)
+            # Both the old and the new array stay live -> copy insertion.
+            return jnp.where((x.sum() + y.sum()) % 2 == 0, y, x), i + 1
+
+        return jax.lax.while_loop(lambda c: c[1] < 5, body,
+                                  (x, jnp.int32(0)))
+
+    return Program(name=name, path="fx.py", fn=loop_copy,
+                   args=(jnp.zeros(V, jnp.int32),), v_elements=V)
+
+
+def test_hlo003_loop_copy_trips():
+    fs, metrics = analyze_compiled(_loop_copy_prog())
+    assert rules_of(fs) == ["HLO003"]
+    assert fs[0].snippet == "hlo:fx.loopcopy:loop:copy"
+    assert metrics["loop_materializations"] >= 1
+
+
+def test_hlo003_near_miss_elementwise_loop():
+    @jax.jit
+    def clean(x):
+        def body(c):
+            return c[0] * 2 + 1, c[1] + 1
+
+        return jax.lax.while_loop(lambda c: c[1] < 5, body,
+                                  (x, jnp.int32(0)))
+
+    fs, metrics = analyze_compiled(Program(
+        name="fx.loopclean", path="fx.py", fn=clean,
+        args=(jnp.zeros(V, jnp.int32),), v_elements=V,
+    ))
+    assert fs == []
+    assert metrics["loop_materializations"] == 0
+
+
+def test_hlo003_fusion_count_regression_vs_fingerprint():
+    _fs, metrics = analyze_compiled(_loop_copy_prog("fx.probe2"))
+    base = dict(metrics)
+    # Committed fingerprint claims FEWER fusions than compiled now ->
+    # fusion-break tripwire; loop-materialize tripwire likewise.
+    fs, _m = analyze_compiled(
+        _loop_copy_prog("fx.broke"),
+        fingerprint={"fusions": metrics["fusions"] - 1,
+                     "loop_materializations": 0,
+                     "temp_bytes": metrics["temp_bytes"]},
+    )
+    snippets = sorted(f.snippet for f in fs if "regress" in f.snippet)
+    assert snippets == [
+        "hlo:fx.broke:regress:fusions",
+        "hlo:fx.broke:regress:loop-materialize",
+    ]
+    # Matching fingerprint: only the (baselineable) loop:copy finding.
+    fs, _m = analyze_compiled(_loop_copy_prog("fx.same"), fingerprint=base)
+    assert [f.snippet for f in fs] == ["hlo:fx.same:loop:copy"]
+
+
+# ---------------------------------------------------------------------------
+# HLO004 — compiled collectives vs the declared exchange.
+# ---------------------------------------------------------------------------
+
+def _coll_loop_prog(dtype, name, **kwargs):
+    mesh = _mesh()
+
+    def outer(x):
+        def inner(xb):
+            def body(c):
+                y, i = c
+                merged = jax.lax.psum(y.astype(dtype), "graph")
+                return y + merged.astype(y.dtype), i + 1
+
+            return jax.lax.while_loop(
+                lambda c: c[1] < 3, body, (xb, jnp.int32(0))
+            )[0]
+
+        # check_rep=False: jax-0.4.x has no replication rule for while.
+        return shard_map(inner, mesh=mesh, in_specs=P("graph"),
+                         out_specs=P("graph"), check_rep=False)(x)
+
+    kwargs.setdefault("mesh_axes", frozenset({"graph"}))
+    kwargs.setdefault("required_axes", frozenset({"graph"}))
+    return Program(
+        name=name, path="fx.py", fn=jax.jit(outer),
+        args=(jnp.zeros(V * 16, jnp.uint32),), v_elements=V, **kwargs,
+    )
+
+
+def test_hlo004_widened_loop_payload_trips():
+    fs, metrics = analyze_compiled(_coll_loop_prog(jnp.float32, "fx.fat"))
+    assert rules_of(fs) == ["HLO004"]
+    assert fs[0].snippet == "hlo:fx.fat:payload:all-reduce:float32"
+    assert metrics["loop_collectives"] >= 1
+
+
+def test_hlo004_near_miss_declared_payload():
+    fs, _m = analyze_compiled(_coll_loop_prog(jnp.int32, "fx.okc"))
+    assert fs == []
+
+
+def test_hlo004_collective_in_meshless_program_trips():
+    prog = _coll_loop_prog(jnp.int32, "fx.unexp",
+                           mesh_axes=None, required_axes=frozenset())
+    fs, _m = analyze_compiled(prog)
+    assert [f.snippet for f in fs] == ["hlo:fx.unexp:unexpected"]
+
+
+def test_hlo004_required_exchange_compiled_away_trips():
+    mesh = _mesh()
+
+    def no_collective(x):
+        return shard_map(lambda xb: xb * 2, mesh=mesh, in_specs=P("graph"),
+                         out_specs=P("graph"))(x)
+
+    prog = Program(
+        name="fx.nocoll", path="fx.py", fn=jax.jit(no_collective),
+        args=(jnp.zeros(V * 2, jnp.uint32),), v_elements=V,
+        mesh_axes=frozenset({"graph"}), required_axes=frozenset({"graph"}),
+    )
+    fs, _m = analyze_compiled(prog)
+    assert [f.snippet for f in fs] == ["hlo:fx.nocoll:missing-collective"]
+
+
+def test_hlo004_loop_collective_count_change_trips_both_ways():
+    for claimed, word in ((2, "hoisted"), (0, "duplicated")):
+        fs, _m = analyze_compiled(
+            _coll_loop_prog(jnp.int32, "fx.moved"),
+            fingerprint={"loop_collectives": claimed},
+        )
+        assert [f.snippet for f in fs] == ["hlo:fx.moved:regress:collectives"]
+        assert word in fs[0].message
+
+
+# ---------------------------------------------------------------------------
+# HLO005 — opaque escapes.
+# ---------------------------------------------------------------------------
+
+def test_hlo005_custom_call_trips():
+    # linalg lowers to a lapack custom-call on the CPU backend.
+    prog = Program(
+        name="fx.chol", path="fx.py",
+        fn=jax.jit(lambda a: jnp.linalg.cholesky(a)),
+        args=(jnp.eye(8, dtype=jnp.float32) * 4,), v_elements=4,
+    )
+    fs, _m = analyze_compiled(prog)
+    assert [f.snippet for f in fs] == ["hlo:fx.chol:escape:custom-call"]
+
+
+def test_hlo005_near_miss_pure_xla():
+    prog = Program(
+        name="fx.pure", path="fx.py",
+        fn=jax.jit(lambda a: (a * 2).sum()),
+        args=(jnp.zeros(64, jnp.float32),), v_elements=4,
+    )
+    fs, _m = analyze_compiled(prog)
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# HLO000 — uncompilable programs fail loudly.
+# ---------------------------------------------------------------------------
+
+def test_hlo000_uncompilable_program_is_an_error():
+    def broken(x):
+        raise TypeError("deliberately uncompilable")
+
+    prog = Program(name="fx.broken", path="fx.py", fn=broken,
+                   args=(jnp.zeros(4, jnp.int32),), v_elements=V)
+    fs, metrics = analyze_compiled(prog)
+    assert rules_of(fs) == ["HLO000"]
+    assert metrics == {}
+
+
+# ---------------------------------------------------------------------------
+# The HLO text parser itself.
+# ---------------------------------------------------------------------------
+
+def test_parse_hlo_walks_while_bodies_and_aliases():
+    fn = jax.jit(lambda s: s + 1, donate_argnums=0)
+    module, _mem = compile_program(Program(
+        name="fx.p", path="fx.py", fn=fn,
+        args=(jnp.zeros(V, jnp.int32),), v_elements=V,
+    ))
+    assert module.aliased_params == frozenset({0})
+    assert module.entry
+    # A while program's loop computations are found transitively.
+    @jax.jit
+    def loopy(x):
+        def body(c):
+            return c[0] + jnp.where(c[0] > 0, 1, 2), c[1] + 1
+
+        return jax.lax.while_loop(lambda c: c[1] < 3, body,
+                                  (x, jnp.int32(0)))
+
+    module, _mem = compile_program(Program(
+        name="fx.w", path="fx.py", fn=loopy,
+        args=(jnp.zeros(V, jnp.int32),), v_elements=V,
+    ))
+    loop_comps = module.loop_computations()
+    assert loop_comps, "while body/condition not discovered"
+    assert all(name in module.computations for name in loop_comps)
+
+
+def test_shape_bytes_tuple_and_scalar():
+    from bfs_tpu.analysis.hlo import shape_bytes
+
+    assert shape_bytes("s32[64]{0}") == 256
+    assert shape_bytes("u32[2,10]{1,0}") == 80
+    assert shape_bytes("pred[]") == 1
+    assert shape_bytes("(s32[4]{0}, u32[8]{0})") == 16 + 32
+
+
+# ---------------------------------------------------------------------------
+# The repo registry: self-lint + fingerprint coverage + cache.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.lint_hlo
+def test_repo_hlo_self_lint_clean_modulo_baseline():
+    """Every declared hot program COMPILES and passes the HLO rules (the
+    tier-1 'what XLA emits is clean' gate — the compiled twin of the IR
+    self-lint)."""
+    findings, meta = analyze_hlo(use_cache=True)
+    # Hot-coverage pin: the registry keeps >= 25 programs and every one
+    # is compiled (or explicitly skipped), never silently dropped.
+    assert len(meta["programs"]) + len(meta["skipped"]) >= 25, meta
+    # The committed fingerprint file must match the container env and
+    # cover every compiled program — deleting a program's HLO coverage
+    # fails tier-1 here.
+    assert meta["fingerprint_status"] == "match", meta["fingerprint_status"]
+    assert meta["unfingerprinted"] == [], meta["unfingerprinted"]
+    baseline = Baseline.load(default_baseline_path())
+    fresh = [f for f in findings if not baseline.accepts(f)]
+    assert fresh == [], "\n".join(f.render() for f in fresh)
+    # Donation realization must stay proven on every declared carry: the
+    # CPU backend realizes all four step-program aliases today, and a
+    # jax upgrade that stops realizing them must fail here loudly.
+    assert not any(f.rule == "HLO001" for f in findings)
+
+
+def _small_registry():
+    return {
+        "fx.small_a": lambda: Program(
+            name="fx.small_a", path="fx.py",
+            fn=jax.jit(lambda s: s * 2 + 1),
+            args=(jnp.zeros(V, jnp.int32),), v_elements=V,
+        ),
+        "fx.small_b": lambda: _loop_copy_prog("fx.small_b"),
+    }
+
+
+@pytest.mark.lint_hlo
+def test_hlo_result_cache_hits_on_unchanged_tree(tmp_path, monkeypatch):
+    from bfs_tpu.analysis import hlo as hlo_mod
+
+    monkeypatch.setattr(hlo_mod, "PROGRAM_SPECS", _small_registry())
+    f1, m1 = analyze_hlo(use_cache=True, cache_dir=str(tmp_path))
+    assert m1["cache"] == "miss"
+    f2, m2 = analyze_hlo(use_cache=True, cache_dir=str(tmp_path))
+    assert m2["cache"] == "hit"
+    assert [f.fingerprint() for f in f2] == [f.fingerprint() for f in f1]
+    assert m2["fingerprints"] == m1["fingerprints"]
+    assert any(name.startswith("hlo_") for name in os.listdir(tmp_path))
+
+
+def test_hlo_skip_records_program():
+    from bfs_tpu.analysis.ir import SkipProgram
+
+    def skipper():
+        raise SkipProgram("no mesh here")
+
+    findings, meta = analyze_hlo({"fx.skipped": skipper})
+    assert findings == []
+    assert meta["skipped"] == {"fx.skipped": "no mesh here"}
+    assert meta["cache"] == "off"  # custom specs are never cached
+
+
+def test_hlo_foreign_fingerprint_env_disables_regression(tmp_path):
+    """A fingerprint file generated on another backend/jax must not
+    produce regression findings — its counts are not comparable."""
+    from bfs_tpu.analysis.hlo import current_env, load_fingerprints
+
+    fp = tmp_path / "fp.json"
+    fp.write_text(json.dumps({
+        "env": {"backend": "tpu", "devices": 4, "jax": "9.9.9"},
+        "programs": {"fx.small_b": {"temp_bytes": 1, "fusions": 0,
+                                    "loop_materializations": 0}},
+    }))
+    status, programs = load_fingerprints(str(fp))
+    assert status == "foreign" and "fx.small_b" in programs
+    findings, meta = analyze_hlo(
+        _small_registry(), fingerprints_path=str(fp)
+    )
+    assert meta["fingerprint_status"] == "foreign"
+    assert not any("regress" in f.snippet for f in findings)
+    # Same rows under the CURRENT env: the regressions fire.
+    fp2 = tmp_path / "fp2.json"
+    fp2.write_text(json.dumps({
+        "env": current_env(),
+        "programs": {"fx.small_b": {"temp_bytes": 1, "fusions": 0,
+                                    "loop_materializations": 0}},
+    }))
+    findings, meta = analyze_hlo(
+        _small_registry(), fingerprints_path=str(fp2)
+    )
+    assert meta["fingerprint_status"] == "match"
+    assert any("regress" in f.snippet for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# CLI: the --hlo path.
+# ---------------------------------------------------------------------------
+
+def _fixture_specs():
+    mesh_ok = len(jax.devices()) >= 2
+    inner = _donated_inner()
+    outer = jax.jit(lambda s: inner(s))
+    specs = {
+        "HLO001": lambda: Program(
+            name="fx.dropped", path="fx.py", fn=outer,
+            args=(jnp.zeros(V, jnp.int32),), v_elements=V,
+            donate={0: "state"},
+        ),
+        "HLO002": lambda: Program(
+            name="fx.tight", path="fx.py", fn=jax.jit(lambda s: s * 2),
+            args=(jnp.zeros(4096, jnp.int32),), v_elements=V,
+            budget_bytes=1024,
+        ),
+        "HLO003": lambda: _loop_copy_prog(),
+        "HLO005": lambda: Program(
+            name="fx.chol", path="fx.py",
+            fn=jax.jit(lambda a: jnp.linalg.cholesky(a)),
+            args=(jnp.eye(8, dtype=jnp.float32) * 4,), v_elements=4,
+        ),
+    }
+    if mesh_ok:
+        specs["HLO004"] = lambda: _coll_loop_prog(jnp.float32, "fx.fat")
+    return specs
+
+
+@pytest.mark.parametrize("rule", ["HLO001", "HLO002", "HLO003", "HLO004",
+                                  "HLO005"])
+def test_cli_exits_nonzero_on_rule_fixture(rule, monkeypatch, capsys):
+    specs = _fixture_specs()
+    if rule not in specs:
+        pytest.skip("needs 2 devices")
+    from bfs_tpu.analysis import __main__ as cli
+    from bfs_tpu.analysis import hlo as hlo_mod
+
+    monkeypatch.setattr(hlo_mod, "PROGRAM_SPECS", {rule: specs[rule]})
+    rc = cli.main(["--hlo", "--no-cache", "--no-baseline"])
+    out = capsys.readouterr()
+    assert rc == 1, out.out + out.err
+    assert rule in out.out
+
+
+def test_cli_hlo_subcommand_and_baseline_accept(monkeypatch, tmp_path,
+                                                capsys):
+    """`python -m bfs_tpu.analysis hlo` == `--hlo`; a justified baseline
+    entry turns the same fixture run green."""
+    from bfs_tpu.analysis import __main__ as cli
+    from bfs_tpu.analysis import hlo as hlo_mod
+
+    specs = _fixture_specs()
+    monkeypatch.setattr(hlo_mod, "PROGRAM_SPECS",
+                        {"HLO003": specs["HLO003"]})
+    [finding], _m = analyze_compiled(specs["HLO003"]())
+    bl = tmp_path / "baseline.txt"
+    bl.write_text(
+        f"{finding.rule}  {finding.fingerprint()}  fixture: accepted\n"
+    )
+    rc = cli.main(["hlo", "--no-cache", "--baseline", str(bl)])
+    out = capsys.readouterr()
+    assert rc == 0, out.out + out.err
+
+
+def test_cli_hlo_rejects_scoping_flags(capsys):
+    from bfs_tpu.analysis import __main__ as cli
+
+    for argv in (["--hlo", "--changed"], ["--hlo", "some/file.py"]):
+        rc = cli.main(argv)
+        out = capsys.readouterr()
+        assert rc == 2, (argv, out.out, out.err)
+        assert "cannot be scoped" in out.err
+    rc = cli.main(["--ir", "--hlo"])
+    out = capsys.readouterr()
+    assert rc == 2
+    assert "one at a time" in out.err
+    for argv in (["--update-fingerprints"], ["--snapshot", "x.json"],
+                 ["--ir", "--update-fingerprints"]):
+        rc = cli.main(argv)
+        out = capsys.readouterr()
+        assert rc == 2, argv
+        assert "--hlo" in out.err
+
+
+def test_cli_stale_hlo_entry_fails_default_surface(monkeypatch, tmp_path,
+                                                   capsys):
+    """A stale `hlo:` fingerprint fails a default-surface --hlo run
+    exactly like `ir:` ones (ISSUE 12 satellite) — and entries from the
+    OTHER families are not this pass's business."""
+    from bfs_tpu.analysis import __main__ as cli
+    from bfs_tpu.analysis import hlo as hlo_mod
+
+    clean = {"fx.clean": lambda: Program(
+        name="fx.clean", path="fx.py", fn=jax.jit(lambda s: s * 2),
+        args=(jnp.zeros(V, jnp.int32),), v_elements=V,
+    )}
+    monkeypatch.setattr(hlo_mod, "PROGRAM_SPECS", clean)
+    bl = tmp_path / "baseline.txt"
+    bl.write_text("HLO003  deadbeef0000  a dead hlo entry\n")
+    rc = cli.main(["--hlo", "--no-cache", "--baseline", str(bl)])
+    out = capsys.readouterr()
+    assert rc == 1, out.out + out.err
+    assert "STALE" in out.err
+    # An AST-family entry in the same file is NOT stale for this pass.
+    bl.write_text("TRC001  deadbeef0000  an ast entry\n")
+    rc = cli.main(["--hlo", "--no-cache", "--baseline", str(bl)])
+    out = capsys.readouterr()
+    assert rc == 0, out.out + out.err
+
+
+def test_cli_hlo_write_baseline_prints_never_clobbers(monkeypatch,
+                                                      tmp_path, capsys):
+    from bfs_tpu.analysis import __main__ as cli
+    from bfs_tpu.analysis import hlo as hlo_mod
+
+    specs = _fixture_specs()
+    monkeypatch.setattr(hlo_mod, "PROGRAM_SPECS",
+                        {"HLO003": specs["HLO003"]})
+    bl = tmp_path / "baseline.txt"
+    bl.write_text("TRC001  cafecafe0000  keep me\n")
+    rc = cli.main(["--hlo", "--no-cache", "--write-baseline",
+                   "--baseline", str(bl)])
+    out = capsys.readouterr()
+    assert rc == 0
+    assert "HLO003" in out.out  # candidate line printed
+    assert "HLO section" in out.err
+    assert bl.read_text() == "TRC001  cafecafe0000  keep me\n"  # untouched
+
+
+def test_cli_hlo_snapshot_writes_metrics(monkeypatch, tmp_path, capsys):
+    from bfs_tpu.analysis import __main__ as cli
+    from bfs_tpu.analysis import hlo as hlo_mod
+
+    monkeypatch.setattr(hlo_mod, "PROGRAM_SPECS", _small_registry())
+    snap = tmp_path / "snap.json"
+    cli.main(["--hlo", "--no-cache", "--no-baseline",
+              "--snapshot", str(snap)])
+    capsys.readouterr()
+    doc = json.loads(snap.read_text())
+    assert set(doc["programs"]) == {"fx.small_a", "fx.small_b"}
+    assert doc["env"]["backend"] == jax.default_backend()
+    assert "temp_bytes" in doc["programs"]["fx.small_a"]
+
+
+def test_hlo_finding_fingerprint_is_line_drift_proof():
+    [f], _m = analyze_compiled(_loop_copy_prog())
+    assert f.snippet == "hlo:fx.loopcopy:loop:copy"
+    assert f.line == 0
+
+
+# ---------------------------------------------------------------------------
+# tools/hlo_diff.py — the compiled-artifact ledger_compare twin.
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def hlo_diff():
+    spec = importlib.util.spec_from_file_location(
+        "hlo_diff", os.path.join(REPO, "tools", "hlo_diff.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _snap(path, programs, env=None):
+    path.write_text(json.dumps(
+        {"env": env or {}, "programs": programs}
+    ))
+    return str(path)
+
+
+_BASE_ROW = {"temp_bytes": 1000, "fusions": 10, "loop_collectives": 2,
+             "loop_materializations": 1}
+
+
+def test_hlo_diff_detects_synthetic_fusion_break(hlo_diff, tmp_path,
+                                                 capsys):
+    old = _snap(tmp_path / "old.json", {"relay.fused": dict(_BASE_ROW)})
+    broke = dict(_BASE_ROW, fusions=12, temp_bytes=1300)
+    new = _snap(tmp_path / "new.json", {"relay.fused": broke})
+    rc = hlo_diff.main([old, new])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "| relay.fused |" in out  # markdown delta table
+    assert "fusion break" in out
+    assert "+30%" in out
+
+
+def test_hlo_diff_clean_and_regression_axes(hlo_diff, tmp_path, capsys):
+    old = _snap(tmp_path / "o.json", {"p": dict(_BASE_ROW)})
+    assert hlo_diff.main([old, old]) == 0
+    capsys.readouterr()
+    # A hoisted loop collective is a regression even though the count
+    # went DOWN — the wire shape changed.
+    hoisted = _snap(tmp_path / "h.json",
+                    {"p": dict(_BASE_ROW, loop_collectives=1)})
+    assert hlo_diff.main([old, hoisted]) == 1
+    assert "hoisted" in capsys.readouterr().out
+    # A removed program is a coverage regression.
+    gone = _snap(tmp_path / "g.json", {})
+    assert hlo_diff.main([old, gone]) == 1
+    assert "disappeared" in capsys.readouterr().out
+    # A new program is informational only.
+    grown = _snap(tmp_path / "n.json",
+                  {"p": dict(_BASE_ROW), "q": dict(_BASE_ROW)})
+    assert hlo_diff.main([old, grown]) == 0
+
+
+def test_hlo_diff_rejects_foreign_environments(hlo_diff, tmp_path, capsys):
+    old = _snap(tmp_path / "a.json", {"p": dict(_BASE_ROW)},
+                env={"backend": "cpu", "devices": 8, "jax": "0.4.37"})
+    new = _snap(tmp_path / "b.json", {"p": dict(_BASE_ROW)},
+                env={"backend": "tpu", "devices": 4, "jax": "0.4.37"})
+    assert hlo_diff.main([old, new]) == 2
+    assert "not comparable" in capsys.readouterr().err
+
+
+def test_hlo_diff_reads_committed_fingerprints(hlo_diff, capsys):
+    """The committed fingerprint file is itself a valid diff input — the
+    TPU-window before/after spelling is one command against it."""
+    path = os.path.join(REPO, "bfs_tpu", "analysis",
+                        "hlo_fingerprints.json")
+    assert hlo_diff.main([path, path]) == 0
+    assert "no regressions" in capsys.readouterr().out
+
+
+def test_cli_update_fingerprints_refuses_on_compile_failure(
+        monkeypatch, tmp_path, capsys):
+    """--update-fingerprints must not silently drop a program whose
+    compile failed — the row would vanish from the committed file with
+    exit 0 and only resurface as a set-inequality test failure later."""
+    from bfs_tpu.analysis import __main__ as cli
+    from bfs_tpu.analysis import hlo as hlo_mod
+
+    def broken():
+        raise TypeError("deliberately uncompilable spec")
+
+    out_path = tmp_path / "fp.json"
+    monkeypatch.setattr(hlo_mod, "default_fingerprints_path",
+                        lambda: str(out_path))
+    monkeypatch.setattr(hlo_mod, "PROGRAM_SPECS", {"fx.broken": broken})
+    rc = cli.main(["--hlo", "--no-cache", "--update-fingerprints"])
+    out = capsys.readouterr()
+    assert rc == 1, out.out + out.err
+    assert "refusing" in out.err and "HLO000" in out.out
+    assert not out_path.exists()
+    # With a compiling registry the same spelling writes the file.
+    monkeypatch.setattr(hlo_mod, "PROGRAM_SPECS", _small_registry())
+    rc = cli.main(["--hlo", "--no-cache", "--update-fingerprints"])
+    out = capsys.readouterr()
+    assert rc == 0, out.out + out.err
+    doc = json.loads(out_path.read_text())
+    assert set(doc["programs"]) == {"fx.small_a", "fx.small_b"}
+
+
+def test_hlo002_budget_does_not_double_count_realized_alias():
+    """A donated carry appears in BOTH argument and output bytes but
+    occupies one buffer — the budget proof must subtract the alias or a
+    fitting donated program false-trips at ~2x its real footprint."""
+    n = 4096
+    prog = Program(
+        name="fx.aliased", path="fx.py",
+        fn=jax.jit(lambda s: s + 1, donate_argnums=0),
+        args=(jnp.zeros(n, jnp.int32),), v_elements=V,
+        donate={0: "state"},
+        budget_bytes=int(n * 4 * 1.5),  # fits once, not twice
+    )
+    fs, metrics = analyze_compiled(prog)
+    assert metrics["alias_bytes"] == n * 4
+    assert fs == [], [f.render() for f in fs]
+
+
+def test_cli_update_fingerprints_refuses_on_skipped_program(
+        monkeypatch, tmp_path, capsys):
+    from bfs_tpu.analysis import __main__ as cli
+    from bfs_tpu.analysis import hlo as hlo_mod
+    from bfs_tpu.analysis.ir import SkipProgram
+
+    def skipper():
+        raise SkipProgram("too few devices")
+
+    out_path = tmp_path / "fp.json"
+    monkeypatch.setattr(hlo_mod, "default_fingerprints_path",
+                        lambda: str(out_path))
+    monkeypatch.setattr(hlo_mod, "PROGRAM_SPECS",
+                        {**_small_registry(), "fx.skipped": skipper})
+    rc = cli.main(["--hlo", "--no-cache", "--update-fingerprints"])
+    out = capsys.readouterr()
+    assert rc == 1, out.out + out.err
+    assert "skipped" in out.err and "fx.skipped" in out.err
+    assert not out_path.exists()

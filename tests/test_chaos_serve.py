@@ -29,11 +29,21 @@ def _chaos_run():
 
 
 @pytest.mark.chaos
-def test_chaos_serve_smoke():
+def test_chaos_serve_smoke(monkeypatch):
     """The whole self-healing schedule — breaker open/half-open/close,
     watchdog-degraded hung ticks, integrity quarantine, epoch swap with
     in-flight old-snapshot answers — at tier-1 size.  chaos_serve returns
-    non-zero on any wrong answer, frozen tick, or missing transition."""
+    non-zero on any wrong answer, frozen tick, or missing transition.
+
+    Runs under ``BFS_TPU_LOCK_ORDER=1`` (ISSUE 12 satellite): every
+    serve/registry/executor/health lock acquisition records its ordering
+    edges, and the schedule must finish with a CYCLE-FREE lock-order
+    graph — the dynamic complement to the LCK001/002 static rules,
+    exercised by the most lock-contended path the repo has."""
+    from bfs_tpu.analysis import runtime as art
+
+    monkeypatch.setenv("BFS_TPU_LOCK_ORDER", "1")
+    art.reset_lock_order()
     chaos_run = _chaos_run()
     args = types.SimpleNamespace(
         scale=7,
@@ -50,6 +60,12 @@ def test_chaos_serve_smoke():
     assert chaos_run.chaos_serve(args, random.Random(3)) == 0
     # The schedule restores the fault boundary on every path.
     assert "BFS_TPU_FAULT" not in os.environ
+    # The fault+swap schedule nests locks (server tick -> registry
+    # acquire, health -> metrics): edges must exist and no interleaving
+    # of them may deadlock.
+    report = art.lock_order_report()
+    assert report["cycles"] == [], report
+    assert report["edges"], "no lock nesting recorded — recorder not wired"
 
 
 @pytest.mark.chaos

@@ -1,0 +1,163 @@
+#!/usr/bin/env python3
+"""Diff two HLO fingerprint snapshots: the ledger_compare twin for
+compiled artifacts, so a TPU-window before/after is one command.
+
+Feeds on any of the shapes the HLO pass emits:
+
+* a metrics snapshot (``bfs-tpu-lint --hlo --snapshot out.json``);
+* the committed ``bfs_tpu/analysis/hlo_fingerprints.json``;
+* a cached result file from ``.bench_cache/hlo/`` (the
+  ``meta.fingerprints`` rows are used).
+
+Prints a per-program markdown delta table (temp bytes, fusion count,
+loop collectives, loop materializations) and exits non-zero when any
+program REGRESSED: temp bytes grew more than ``--threshold`` (default
+10% — the HLO002 tripwire), the emitted fusion count grew (fusion
+break), the loop-collective count changed (a collective hoisted out of
+or duplicated into the superstep loop), the loop materialization count
+grew, or a program present before is gone after (a hot program that
+silently left the registry is a coverage regression, not a win).
+
+Environments must match (backend/jax/devices) when both snapshots carry
+one — comparing CPU fusion counts against TPU counts proves nothing and
+exits 2.
+
+No jax import: runs anywhere the repo does (the lint-stub discipline of
+tools/obs_dashboard.py and tools/ledger_compare.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+#: The columns rendered and the regression predicate per metric.
+COLUMNS = ("temp_bytes", "fusions", "loop_collectives",
+           "loop_materializations")
+
+
+def load_programs(path: str) -> tuple[dict, dict]:
+    """``(env, programs)`` from any supported file shape."""
+    with open(path, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    if not isinstance(doc, dict):
+        raise SystemExit(f"{path}: not a JSON object")
+    meta = doc.get("meta", {})
+    if "programs" in doc and isinstance(doc["programs"], dict):
+        return doc.get("env", {}), doc["programs"]
+    if isinstance(meta.get("fingerprints"), dict):  # cached result file
+        return {}, meta["fingerprints"]
+    # Bare {program: metrics-row} mapping.
+    if doc and all(isinstance(v, dict) for v in doc.values()):
+        return {}, doc
+    raise SystemExit(f"{path}: no fingerprint rows found")
+
+
+def fmt_delta(old, new, pct: bool = False) -> str:
+    if old == new:
+        return "="
+    d = new - old
+    s = f"{'+' if d > 0 else ''}{d}"
+    if pct and old:
+        s += f" ({d * 100.0 / old:+.0f}%)"
+    return s
+
+
+def diff(old: dict, new: dict, threshold: float):
+    """``(markdown_lines, regressions)`` for two program->metrics maps."""
+    lines = [
+        "| program | temp bytes | Δ | fusions | Δ | loop colls | Δ "
+        "| loop mats | Δ |",
+        "|---|---:|---:|---:|---:|---:|---:|---:|---:|",
+    ]
+    regressions: list[str] = []
+    for name in sorted(set(old) | set(new)):
+        o, n = old.get(name), new.get(name)
+        if n is None:
+            lines.append(f"| {name} | — | REMOVED | | | | | | |")
+            regressions.append(
+                f"{name}: program disappeared from the fingerprint set "
+                "(hot-coverage regression)"
+            )
+            continue
+        if o is None:
+            lines.append(
+                f"| {name} (new) | {n.get('temp_bytes', 0)} | | "
+                f"{n.get('fusions', 0)} | | {n.get('loop_collectives', 0)} "
+                f"| | {n.get('loop_materializations', 0)} | |"
+            )
+            continue
+        cells = [name]
+        for col in COLUMNS:
+            ov, nv = int(o.get(col, 0)), int(n.get(col, 0))
+            cells.append(str(nv))
+            cells.append(fmt_delta(ov, nv, pct=(col == "temp_bytes")))
+        lines.append("| " + " | ".join(cells) + " |")
+        ot, nt = int(o.get("temp_bytes", 0)), int(n.get("temp_bytes", 0))
+        if nt > ot * (1 + threshold):
+            regressions.append(
+                f"{name}: temp bytes {ot} -> {nt} "
+                f"(+{(nt - ot) * 100.0 / ot if ot else float('inf'):.0f}%, "
+                f"threshold +{threshold:.0%})"
+            )
+        of, nf = int(o.get("fusions", 0)), int(n.get("fusions", 0))
+        if nf > of:
+            regressions.append(
+                f"{name}: fusion count {of} -> {nf} (fusion break: more "
+                "emitted kernels)"
+            )
+        oc = int(o.get("loop_collectives", 0))
+        nc = int(n.get("loop_collectives", 0))
+        if nc != oc:
+            what = "duplicated into" if nc > oc else "hoisted out of"
+            regressions.append(
+                f"{name}: loop collectives {oc} -> {nc} (collective "
+                f"{what} the superstep loop)"
+            )
+        om = int(o.get("loop_materializations", 0))
+        nm = int(n.get("loop_materializations", 0))
+        if nm > om:
+            regressions.append(
+                f"{name}: loop materializations {om} -> {nm} (new "
+                "while-body copy/transpose)"
+            )
+    return lines, regressions
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Diff two HLO fingerprint snapshots (markdown table; "
+                    "non-zero exit on regression)."
+    )
+    ap.add_argument("old", help="before snapshot (JSON)")
+    ap.add_argument("new", help="after snapshot (JSON)")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="temp-bytes regression tolerance (default 0.10)")
+    args = ap.parse_args(argv)
+
+    old_env, old_programs = load_programs(args.old)
+    new_env, new_programs = load_programs(args.new)
+    if old_env and new_env and old_env != new_env:
+        print(
+            f"hlo_diff: environments differ ({old_env} vs {new_env}) — "
+            "compiled-artifact counts are not comparable across "
+            "backend/jax/device-count", file=sys.stderr,
+        )
+        return 2
+
+    lines, regressions = diff(old_programs, new_programs, args.threshold)
+    print("\n".join(lines))
+    print()
+    if regressions:
+        print(f"hlo_diff: {len(regressions)} regression(s):")
+        for r in regressions:
+            print(f"  REGRESSED  {r}")
+        return 1
+    print(f"hlo_diff: no regressions across {len(new_programs)} program(s) "
+          f"(threshold +{args.threshold:.0%})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
