@@ -27,6 +27,15 @@ Passes:
   ``--update-fingerprints`` regenerates the committed per-program
   footprint fingerprints, ``--snapshot PATH`` writes the metrics rows
   for ``tools/hlo_diff.py``.
+* ``--pallas`` (or the ``pallas`` subcommand) — the kernel-grade pass:
+  RUNS every registered Pallas kernel at lint scale under a
+  ``pallas_call`` spy and checks VMEM residency, (8,128)/MXU tile
+  alignment, grid write-aliasing, dynamic-slice bounds and
+  interpret-vs-XLA-twin bit parity (:mod:`bfs_tpu.analysis.pallas`).
+  Same caching discipline.
+* ``--all`` (or the ``all`` subcommand) — every pass in one run with
+  merged baseline handling and a single exit code: the pre-merge gate
+  surface ``tools/ci_gate.sh`` chains after tier-1.
 
 ``--changed`` lints only files named by ``git diff --name-only HEAD``
 (the pre-commit spelling).  ``--write-baseline`` rewrites the baseline
@@ -91,6 +100,153 @@ def _changed_files(root: str) -> list[str]:
     return picked
 
 
+def _default_ast_paths(root: str) -> list[str]:
+    """The default AST lint surface — ONE definition, shared by the
+    plain run and the --all composite so they can never diverge."""
+    return [
+        p for p in (
+            os.path.join(root, "bfs_tpu"),
+            os.path.join(root, "tools"),
+            os.path.join(root, "bench.py"),
+        ) if os.path.exists(p)
+    ]
+
+
+def _family(rule: str) -> str:
+    for fam in ("IR", "HLO", "PAL"):
+        if rule.startswith(fam):
+            return fam
+    return "AST"
+
+
+def _meta_suffix(meta: dict, tag: str, noun: str) -> str:
+    """The per-pass bracket detail a jax-pass summary carries —
+    including the HLO fingerprint status, whose 'missing'/'foreign'
+    states mean the regression tripwires are OFF and must be visible
+    on every surface that runs the pass."""
+    built = meta.get("programs", meta.get("kernels", []))
+    return (
+        f"{tag}: {len(built)} {noun}(s), cache {meta['cache']}"
+        + (f", skipped {sorted(meta['skipped'])}"
+           if meta["skipped"] else "")
+        + (f", fingerprints {meta['fingerprint_status']}"
+           if "fingerprint_status" in meta else "")
+        + (f", unfingerprinted {sorted(meta['unfingerprinted'])}"
+           if meta.get("unfingerprinted") else "")
+    )
+
+
+def _report(args, findings, baseline, stale_filter, label, meta_suffix,
+            json_extra) -> int:
+    """Shared tail of every lint run (single-pass AND --all): apply the
+    baseline, enforce stale entries through ``stale_filter``, render
+    text or JSON, return the exit code.  ONE definition so the two
+    surfaces can never diverge on output or exit semantics."""
+    fresh = [f for f in findings if not baseline.accepts(f)]
+    new_errors = [f for f in fresh if f.severity == "error"]
+    warnings = [f for f in fresh if f.severity == "warning"]
+    accepted = len(findings) - len(fresh)
+    # stale() reads baseline.used, which accepts() populates above.
+    stale = [
+        fp for fp in baseline.stale()
+        if stale_filter(baseline.entries[fp][0])
+    ]
+
+    if args.as_json:
+        print(json.dumps(
+            {
+                "findings": [
+                    {
+                        "rule": f.rule, "severity": f.severity,
+                        "path": f.path, "line": f.line, "col": f.col,
+                        "message": f.message,
+                        "fingerprint": f.fingerprint(),
+                    }
+                    for f in fresh
+                ],
+                "accepted_by_baseline": accepted,
+                "stale_baseline_entries": stale,
+                **json_extra,
+            },
+            indent=2,
+        ))
+    else:
+        for f in fresh:
+            print(f.render())
+        summary = (
+            f"analysis{label}: {len(new_errors)} error(s), "
+            f"{len(warnings)} warning(s), {accepted} baseline-accepted"
+            + meta_suffix
+        )
+        if stale:
+            summary += (
+                f", {len(stale)} STALE baseline entr"
+                f"{'y' if len(stale) == 1 else 'ies'} (fixed or edited — "
+                "prune them; stale entries FAIL the self-lint)"
+            )
+        print(summary, file=sys.stderr)
+
+    if new_errors or stale or (args.strict and warnings):
+        return 1
+    return 0
+
+
+def _run_all(args, root: str, baseline_path: str) -> int:
+    """The ``--all`` composite surface: AST + IR + HLO + Pallas in one
+    run, one merged baseline pass, one exit code.  Scoping flags are
+    rejected before this is called (the jax passes cannot be scoped, so
+    neither can the composite).  Stale-entry enforcement is per family:
+    the AST half always covers its default surface; a jax pass that
+    SKIPPED programs (e.g. the mesh specs below 2 devices) proves
+    nothing about its entries and exempts its family, exactly like the
+    single-pass runs."""
+    if args.paths or args.changed:
+        print(
+            "analysis: --all always analyzes the default surface plus "
+            "the whole hot-program registries — it cannot be scoped by "
+            "paths or --changed",
+            file=sys.stderr,
+        )
+        return 2
+    findings = analyze_paths(_default_ast_paths(root), root)
+    from . import hlo, ir, pallas
+
+    metas = {}
+    for fam, run in (
+        ("IR", lambda: ir.analyze_ir(
+            use_cache=not args.no_cache, root=root)),
+        ("HLO", lambda: hlo.analyze_hlo(
+            use_cache=not args.no_cache, root=root)),
+        ("PAL", lambda: pallas.analyze_pallas(
+            use_cache=not args.no_cache, root=root)),
+    ):
+        fam_findings, meta = run()
+        findings.extend(fam_findings)
+        metas[fam] = meta
+    enforced = {"AST": True}
+    for fam, meta in metas.items():
+        enforced[fam] = not meta["skipped"]
+
+    baseline = (
+        Baseline(path=baseline_path)
+        if args.no_baseline
+        else Baseline.load(baseline_path)
+    )
+    per_pass = "; ".join(
+        _meta_suffix(metas[fam], tag, noun)
+        for fam, tag, noun in (("IR", "ir", "program"),
+                               ("HLO", "hlo", "program"),
+                               ("PAL", "pal", "kernel"))
+    )
+    return _report(
+        args, findings, baseline,
+        stale_filter=lambda r: enforced[_family(r)],
+        label="[--all]", meta_suffix=f" [{per_pass}]",
+        json_extra={"passes": {"ir": metas["IR"], "hlo": metas["HLO"],
+                               "pal": metas["PAL"]}},
+    )
+
+
 def main(argv=None) -> int:
     if argv is None:
         argv = sys.argv[1:]
@@ -99,6 +255,10 @@ def main(argv=None) -> int:
         argv = ["--ir"] + argv[1:]
     elif argv and argv[0] == "hlo":  # subcommand spelling of --hlo
         argv = ["--hlo"] + argv[1:]
+    elif argv and argv[0] == "pallas":  # subcommand spelling of --pallas
+        argv = ["--pallas"] + argv[1:]
+    elif argv and argv[0] == "all":  # subcommand spelling of --all
+        argv = ["--all"] + argv[1:]
     ap = argparse.ArgumentParser(
         prog="python -m bfs_tpu.analysis",
         description=__doc__.splitlines()[0],
@@ -126,6 +286,15 @@ def main(argv=None) -> int:
                     help="run the HLO-grade pass instead (COMPILES the hot "
                          "programs and walks the optimized HLO + executable "
                          "metadata; imports jax)")
+    ap.add_argument("--pallas", action="store_true",
+                    help="run the Pallas kernel-grade pass instead (runs "
+                         "every registered kernel at lint scale: VMEM "
+                         "proofs, tile alignment, grid-aliasing, ds "
+                         "bounds, interpret-vs-XLA parity; imports jax)")
+    ap.add_argument("--all", action="store_true", dest="all_passes",
+                    help="run every pass (AST + IR + HLO + Pallas) with "
+                         "merged baseline handling and one exit code — "
+                         "the pre-merge gate surface (tools/ci_gate.sh)")
     ap.add_argument("--no-cache", action="store_true",
                     help="IR/HLO pass: ignore the content-addressed result "
                          "cache")
@@ -148,17 +317,31 @@ def main(argv=None) -> int:
     root = os.path.abspath(args.root) if args.root else _repo_root()
     baseline_path = args.baseline or default_baseline_path()
 
-    if args.ir and args.hlo:
-        print("analysis: --ir and --hlo are separate passes — run one at "
+    picked = [f for f, on in (("--ir", args.ir), ("--hlo", args.hlo),
+                              ("--pallas", args.pallas)) if on]
+    if len(picked) > 1:
+        print(f"analysis: {' and '.join(picked)} are separate passes — "
+              "run one at a time", file=sys.stderr)
+        return 2
+    if args.all_passes and picked:
+        print(f"analysis: --all already includes {picked[0]} — run one at "
               "a time", file=sys.stderr)
         return 2
     if (args.update_fingerprints or args.snapshot) and not args.hlo:
         print("analysis: --update-fingerprints/--snapshot only apply to "
               "the --hlo pass", file=sys.stderr)
         return 2
+    if args.all_passes and args.write_baseline:
+        print("analysis: --write-baseline spans one pass at a time — run "
+              "it without --all (AST regenerates, --ir/--hlo/--pallas "
+              "print candidates)", file=sys.stderr)
+        return 2
 
-    if args.ir or args.hlo:
-        pass_name = "--ir" if args.ir else "--hlo"
+    if args.all_passes:
+        return _run_all(args, root, baseline_path)
+
+    if args.ir or args.hlo or args.pallas:
+        pass_name = picked[0]
         if args.paths or args.changed:
             print(
                 f"analysis: {pass_name} always analyzes the whole "
@@ -173,14 +356,21 @@ def main(argv=None) -> int:
             findings, meta = ir.analyze_ir(
                 use_cache=not args.no_cache, root=root
             )
-            rule_family = lambda r: r.startswith("IR")  # noqa: E731
+            rule_family = lambda r: _family(r) == "IR"  # noqa: E731
+        elif args.pallas:
+            from . import pallas
+
+            findings, meta = pallas.analyze_pallas(
+                use_cache=not args.no_cache, root=root
+            )
+            rule_family = lambda r: _family(r) == "PAL"  # noqa: E731
         else:
             from . import hlo
 
             findings, meta = hlo.analyze_hlo(
                 use_cache=not args.no_cache, root=root
             )
-            rule_family = lambda r: r.startswith("HLO")  # noqa: E731
+            rule_family = lambda r: _family(r) == "HLO"  # noqa: E731
             if args.snapshot:
                 with open(args.snapshot, "w", encoding="utf-8") as fh:
                     json.dump(
@@ -253,22 +443,14 @@ def main(argv=None) -> int:
             paths = [os.path.abspath(p) for p in args.paths]
             default_surface = False
         else:
-            paths = [
-                p for p in (
-                    os.path.join(root, "bfs_tpu"),
-                    os.path.join(root, "tools"),
-                    os.path.join(root, "bench.py"),
-                ) if os.path.exists(p)
-            ]
+            paths = _default_ast_paths(root)
             default_surface = True
         if not paths:
             print("analysis: nothing to lint", file=sys.stderr)
             return 2
         findings = analyze_paths(paths, root)
         meta = None
-        rule_family = lambda r: not (  # noqa: E731
-            r.startswith("IR") or r.startswith("HLO")
-        )
+        rule_family = lambda r: _family(r) == "AST"  # noqa: E731
 
     baseline = (
         Baseline(path=baseline_path)
@@ -278,10 +460,11 @@ def main(argv=None) -> int:
 
     if args.write_baseline:
         errors = [f for f in findings if f.severity == "error"]
-        if args.ir or args.hlo:
-            # Never clobber the committed file from the IR/HLO passes:
-            # its entries span ALL passes.  Print the lines to curate in.
-            which = "IR" if args.ir else "HLO"
+        if args.ir or args.hlo or args.pallas:
+            # Never clobber the committed file from the IR/HLO/Pallas
+            # passes: its entries span ALL passes.  Print the lines to
+            # curate in.
+            which = "IR" if args.ir else ("PAL" if args.pallas else "HLO")
             print(Baseline.render(errors), end="")
             print(
                 f"analysis: {len(errors)} {which} finding(s) rendered "
@@ -291,92 +474,48 @@ def main(argv=None) -> int:
             )
             return 0
         # Regenerating the AST section must not drop the hand-curated
-        # IR/HLO entries living in the same file: carry them over
+        # IR/HLO/Pallas entries living in the same file: carry them over
         # verbatim.
         kept = [
             f"{rule}  {fp}  {just}".rstrip()
             for fp, (rule, just) in baseline.entries.items()
-            if rule.startswith("IR") or rule.startswith("HLO")
+            if _family(rule) != "AST"
         ]
         with open(baseline_path, "w", encoding="utf-8") as f:
             f.write(Baseline.render(errors))
             if kept:
                 f.write(
-                    "\n# -- IR/HLO-pass entries (curated by hand; carried "
-                    "over by --write-baseline) --\n"
+                    "\n# -- IR/HLO/PAL-pass entries (curated by hand; "
+                    "carried over by --write-baseline) --\n"
                 )
                 f.write("\n".join(kept) + "\n")
         print(
             f"analysis: wrote {len(errors)} accepted finding(s) to "
             f"{baseline_path}"
-            + (f" (+{len(kept)} IR/HLO entr"
+            + (f" (+{len(kept)} IR/HLO/PAL entr"
                f"{'y' if len(kept) == 1 else 'ies'} carried over)"
                if kept else "")
             + " — fill in the justifications"
         )
         return 0
 
-    fresh = [f for f in findings if not baseline.accepts(f)]
-    new_errors = [f for f in fresh if f.severity == "error"]
-    warnings = [f for f in fresh if f.severity == "warning"]
-    accepted = len(findings) - len(fresh)
+    if meta is not None:
+        tag = "hlo" if args.hlo else ("pal" if args.pallas else "ir")
+        noun = "kernel" if args.pallas else "program"
+        meta_suffix = f" [{_meta_suffix(meta, tag, noun)}]"
+        json_extra = {"ir": meta}
+    else:
+        meta_suffix = ""
+        json_extra = {}
     # Stale entries: only enforced when the run covered the full default
     # surface of its pass — a single-file lint matching nothing proves
     # nothing — and only for the pass's own rule family.
-    stale = [
-        fp for fp in baseline.stale()
-        if rule_family(baseline.entries[fp][0])
-    ] if default_surface else []
-
-    if args.as_json:
-        print(json.dumps(
-            {
-                "findings": [
-                    {
-                        "rule": f.rule, "severity": f.severity,
-                        "path": f.path, "line": f.line, "col": f.col,
-                        "message": f.message,
-                        "fingerprint": f.fingerprint(),
-                    }
-                    for f in fresh
-                ],
-                "accepted_by_baseline": accepted,
-                "stale_baseline_entries": stale,
-                **({"ir": meta} if meta is not None else {}),
-            },
-            indent=2,
-        ))
-    else:
-        for f in fresh:
-            print(f.render())
-        summary = (
-            f"analysis: {len(new_errors)} error(s), {len(warnings)} "
-            f"warning(s), {accepted} baseline-accepted"
-        )
-        if meta is not None:
-            tag = "hlo" if args.hlo else "ir"
-            summary += (
-                f" [{tag}: {len(meta['programs'])} program(s), cache "
-                f"{meta['cache']}"
-                + (f", skipped {sorted(meta['skipped'])}"
-                   if meta["skipped"] else "")
-                + (f", fingerprints {meta['fingerprint_status']}"
-                   if "fingerprint_status" in meta else "")
-                + (f", unfingerprinted {sorted(meta['unfingerprinted'])}"
-                   if meta.get("unfingerprinted") else "")
-                + "]"
-            )
-        if stale:
-            summary += (
-                f", {len(stale)} STALE baseline entr"
-                f"{'y' if len(stale) == 1 else 'ies'} (fixed or edited — "
-                "prune them; stale entries FAIL the self-lint)"
-            )
-        print(summary, file=sys.stderr)
-
-    if new_errors or stale or (args.strict and warnings):
-        return 1
-    return 0
+    return _report(
+        args, findings, baseline,
+        stale_filter=(rule_family if default_surface
+                      else (lambda r: False)),
+        label="", meta_suffix=meta_suffix, json_extra=json_extra,
+    )
 
 
 if __name__ == "__main__":

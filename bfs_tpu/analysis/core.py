@@ -170,6 +170,38 @@ RULES: dict[str, tuple[str, str]] = {
                "custom-call/infeed/outfeed/send/recv survives to the "
                "optimized HLO of a hot program — an opaque escape from "
                "the fused-XLA contract"),
+    # -- Pallas kernel-grade rules (bfs_tpu.analysis.pallas — runs every
+    # registered kernel at lint scale under a pallas_call spy; the
+    # fourth rung: AST = source, jaxpr = what we ask, HLO = what XLA
+    # emits, PAL = what the hand-written kernels do) ----------------------
+    "PAL000": ("error",
+               "pallas kernel failed to build/run for analysis, the "
+               "spec no longer reaches its pallas_call, or a "
+               "pallas_call site is missing from KERNEL_SPECS — an "
+               "unregistered kernel is an unpoliced kernel"),
+    "PAL001": ("error",
+               "VMEM residency proof failed: double-buffered block "
+               "bytes + declared scratch exceed the per-core budget "
+               "(BFS_TPU_PAL_VMEM_MB, default 16 MB) — Mosaic refuses "
+               "or spills this on a real chip"),
+    "PAL002": ("error",
+               "tile misalignment: a block dimension violates the "
+               "(8,128) sublane/lane tiling for its dtype (or the "
+               "128x128 MXU tiling for a declared MXU kernel) — the "
+               "padded lanes burn compute every grid step"),
+    "PAL003": ("error",
+               "grid write-aliasing: two grid steps map the same output "
+               "block (a data race unless accumulation is declared), or "
+               "output blocks are left unwritten (garbage output)"),
+    "PAL004": ("error",
+               "dynamic-slice bounds: a grid block or manual pl.ds DMA "
+               "window reads outside its ref, or a non-dividing tile "
+               "size silently drops the array's tail rows"),
+    "PAL005": ("error",
+               "interpret-vs-XLA parity broken: the kernel's "
+               "interpret-mode output is not bit-identical to its "
+               "shipping XLA fallback twin — one of the two is wrong "
+               "on every backend that selects it"),
 }
 
 
@@ -421,11 +453,20 @@ def hot_regions(src: SourceFile) -> list[HotRegion]:
 # Baseline.
 # --------------------------------------------------------------------------
 
+#: Rules that can NEVER be baselined: a PAL005 parity break means one of
+#: the two kernel twins computes wrong answers — accepting it would turn
+#: the lint green while results are wrong.  An entry for these rules is
+#: ignored (and therefore reported stale on a default-surface run, which
+#: forces it to be pruned).
+NEVER_BASELINE = frozenset({"PAL005"})
+
+
 @dataclass
 class Baseline:
     """The committed accepted-findings file.  ``entries`` maps fingerprint
     -> (rule, justification); ``used`` tracks which entries matched this
-    run so the CLI can warn about stale ones."""
+    run so the CLI can warn about stale ones.  Rules in
+    :data:`NEVER_BASELINE` are never accepted regardless of entries."""
 
     path: str | None = None
     entries: dict[str, tuple[str, str]] = field(default_factory=dict)
@@ -450,6 +491,8 @@ class Baseline:
         return bl
 
     def accepts(self, finding: Finding) -> bool:
+        if finding.rule in NEVER_BASELINE:
+            return False
         fp = finding.fingerprint()
         if fp in self.entries:
             self.used.add(fp)

@@ -1,0 +1,295 @@
+"""The PAL001-PAL004 walks over captured ``pallas_call`` records.
+
+Split from :mod:`.pallas` the way :mod:`.hlo_rules` is split from
+:mod:`.hlo`: pallas.py owns the registry, the capture spy, the windows
+helpers and the cache; this module owns what a finding *is*.  Every
+check receives the spec's built :class:`~bfs_tpu.analysis.pallas.
+KernelCase` plus the :class:`~bfs_tpu.analysis.pallas.CallRecord` list
+the spy captured from the SHIPPING wrapper — real grids, real
+BlockSpecs, real scratch shapes.  (PAL005, the parity oracle, lives in
+pallas.py's analyze_kernel because it needs the run's result.)
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+
+def check_kernel(spec, case, records, make_finding):
+    """All static PAL findings for one captured kernel case."""
+    findings = []
+    vmem_peak = 0
+    for rec in records:
+        vmem = record_vmem_bytes(rec)
+        vmem_peak = max(vmem_peak, vmem)
+        findings += check_vmem(rec, vmem, make_finding)
+        findings += check_tiles(rec, case, make_finding)
+        findings += check_grid_aliasing(rec, case, make_finding)
+        findings += check_block_bounds(rec, make_finding)
+    findings += check_windows(case, make_finding)
+    spec._vmem_bytes = vmem_peak  # meta reporting (analyze_pallas)
+    return findings
+
+
+# --------------------------------------------------------------------------
+# Grid-step enumeration shared by the aliasing and bounds walks.
+# --------------------------------------------------------------------------
+
+#: Sanity cap on enumerated grid steps — lint-scale grids are tiny; a
+#: runaway grid means the spec built bench-scale operands by mistake.
+MAX_GRID_STEPS = 65536
+
+
+def grid_steps(grid):
+    if not grid:
+        return [()]
+    total = int(math.prod(grid))
+    if total > MAX_GRID_STEPS:
+        raise ValueError(
+            f"grid {grid} has {total} steps — lint cases must stay tiny"
+        )
+    return list(np.ndindex(*tuple(int(g) for g in grid)))
+
+
+def block_index(info, step):
+    """The block-index tuple a BlockSpec maps one grid step to."""
+    if info.index_map is None:
+        idx = step
+    else:
+        idx = info.index_map(*step)
+    if not isinstance(idx, tuple):
+        idx = (idx,)
+    return tuple(int(i) for i in idx)
+
+
+# --------------------------------------------------------------------------
+# PAL001 — VMEM residency proof.
+# --------------------------------------------------------------------------
+
+def record_vmem_bytes(rec) -> int:
+    """Per-grid-step VMEM for one call: grid-blocked operands/outputs are
+    double-buffered by the Pallas pipeline (the next block streams in
+    while this one computes), explicit VMEM scratch counts at its full
+    declared shape (DMA depth is already a dimension of it).  Unblocked
+    ``memory_space`` refs stay in HBM and cost nothing here — their
+    windows are PAL004's business."""
+    total = 0
+    for info in rec.in_specs + rec.out_specs:
+        if info.block_shape is None:
+            continue
+        total += 2 * int(math.prod(info.block_shape)) * info.itemsize
+    return total + rec.scratch_bytes
+
+
+def check_vmem(rec, vmem, make_finding):
+    from .pallas import vmem_budget_bytes
+
+    budget = vmem_budget_bytes()
+    if vmem <= budget:
+        return []
+    blocked = sum(
+        2 * int(math.prod(i.block_shape)) * i.itemsize
+        for i in rec.in_specs + rec.out_specs
+        if i.block_shape is not None
+    )
+    return [make_finding(
+        "PAL001", f"vmem:{rec.kernel_name}",
+        f"kernel '{rec.kernel_name}' needs {vmem} bytes of VMEM per grid "
+        f"step (2x {blocked // 2} double-buffered block bytes + "
+        f"{rec.scratch_bytes} declared scratch "
+        f"{[s for s, _d in rec.scratch_shapes]}) — over the "
+        f"{budget}-byte budget (BFS_TPU_PAL_VMEM_MB); Mosaic will refuse "
+        "or spill this on a real chip where no CPU test can see it",
+    )]
+
+
+# --------------------------------------------------------------------------
+# PAL002 — (8, 128) sublane/lane tiling + MXU readiness.
+# --------------------------------------------------------------------------
+
+def _sublane_unit(itemsize: int) -> int:
+    # f32/u32: 8 sublanes; bf16/u16: 16; int8/fp8: 32.
+    return {4: 8, 2: 16, 1: 32}.get(itemsize, 8)
+
+
+def check_tiles(rec, case, make_finding):
+    findings = []
+    for info in rec.in_specs + rec.out_specs:
+        block = info.block_shape
+        if block is None:
+            continue
+        lane = block[-1]
+        sub = block[-2] if len(block) >= 2 else None
+        unit = _sublane_unit(info.itemsize)
+        bad = []
+        if lane % 128 != 0:
+            bad.append(f"lane dim {lane} % 128 != 0")
+        if sub is not None and sub % unit != 0:
+            bad.append(f"sublane dim {sub} % {unit} != 0")
+        if bad:
+            findings.append(make_finding(
+                "PAL002",
+                f"tile:{rec.kernel_name}:{info.label}:"
+                f"{'x'.join(map(str, block))}",
+                f"kernel '{rec.kernel_name}' {info.label} block "
+                f"{block} is not ({unit}, 128)-tileable "
+                f"({'; '.join(bad)}) — Mosaic pads the block to the "
+                "native tile, wasting the padded lanes/sublanes every "
+                "grid step",
+            ))
+        if case.mxu:
+            mxu_bad = [
+                d for d in block[-2:] if d % 128 != 0
+            ] if len(block) >= 2 else [lane]
+            if mxu_bad:
+                findings.append(make_finding(
+                    "PAL002",
+                    f"mxu:{rec.kernel_name}:{info.label}",
+                    f"kernel '{rec.kernel_name}' {info.label} block "
+                    f"{block} does not tile the 128x128 MXU (dims "
+                    f"{mxu_bad}) — the spec declares this an MXU "
+                    "kernel (the expansion-arm contract)",
+                ))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# PAL003 — grid write-aliasing: output blocks must partition the output.
+# --------------------------------------------------------------------------
+
+def check_grid_aliasing(rec, case, make_finding):
+    findings = []
+    try:
+        steps = grid_steps(rec.grid)
+    except ValueError as exc:
+        return [make_finding(
+            "PAL003", f"grid:{rec.kernel_name}", str(exc)
+        )]
+    for info in rec.out_specs:
+        if info.block_shape is None:
+            continue
+        written: dict = {}
+        raced = set()
+        for step in steps:
+            bi = block_index(info, step)
+            in_range = all(
+                i >= 0 and (i + 1) * b <= d
+                for i, b, d in zip(bi, info.block_shape, info.array_shape)
+            )
+            if not in_range:
+                # Out-of-range writes are check_block_bounds' overrun
+                # finding; they must NOT count toward coverage here, or
+                # a shifted index map (block 0 unwritten, a phantom
+                # block past the end "written") passes the partition
+                # check with garbage output.
+                continue
+            if bi in written and written[bi] != step:
+                raced.add(bi)
+            else:
+                written[bi] = step
+        if raced and not case.accumulates:
+            findings.append(make_finding(
+                "PAL003", f"race:{rec.kernel_name}:{info.label}",
+                f"kernel '{rec.kernel_name}' output {info.label}: "
+                f"{len(raced)} block(s) (e.g. {sorted(raced)[0]}) are "
+                f"written by more than one grid step — grid steps may "
+                "execute in any order and revisions are not "
+                "synchronized, so this is a data race unless the spec "
+                "declares accumulation (accumulates=True)",
+            ))
+        # Coverage: the written blocks must tile the whole output.
+        nblocks = tuple(
+            -(-d // b) for d, b in zip(info.array_shape, info.block_shape)
+        )
+        expected = int(math.prod(nblocks))
+        if len(written) < expected:
+            findings.append(make_finding(
+                "PAL003", f"uncovered:{rec.kernel_name}:{info.label}",
+                f"kernel '{rec.kernel_name}' output {info.label}: only "
+                f"{len(written)} of {expected} output blocks are written "
+                f"by the {len(steps)}-step grid — the rest of the "
+                f"{info.array_shape} output is garbage",
+            ))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# PAL004 — dynamic-slice bounds: auto (blocked inputs) + manual windows.
+# --------------------------------------------------------------------------
+
+def check_block_bounds(rec, make_finding):
+    """Every grid-blocked block (input AND output) must lie inside its
+    array, and the grid must read the whole input: a ``tile_rows`` that
+    does not divide the row count silently drops the tail rows (the
+    ADVICE r4 wrong-permutation class) with interpret mode still green.
+    (Unwritten OUTPUT blocks are PAL003's coverage check.)"""
+    findings = []
+    try:
+        steps = grid_steps(rec.grid)
+    except ValueError:
+        return []  # reported once by check_grid_aliasing
+    for kind, info in (
+        [("input", i) for i in rec.in_specs]
+        + [("output", o) for o in rec.out_specs]
+    ):
+        if info.block_shape is None:
+            continue
+        read: set = set()
+        overrun = None
+        for step in steps:
+            bi = block_index(info, step)
+            in_range = all(
+                i >= 0 and (i + 1) * b <= d
+                for i, b, d in zip(bi, info.block_shape, info.array_shape)
+            )
+            if in_range:
+                read.add(bi)
+            else:
+                overrun = (step, bi)
+        if overrun is not None:
+            findings.append(make_finding(
+                "PAL004", f"block-overrun:{rec.kernel_name}:{info.label}",
+                f"kernel '{rec.kernel_name}' {kind} {info.label}: grid "
+                f"step {overrun[0]} maps block {overrun[1]} of shape "
+                f"{info.block_shape} past the {info.array_shape} array "
+                "— an out-of-bounds access the pipeline pads silently",
+            ))
+        if kind != "input":
+            continue  # unwritten OUTPUT blocks are PAL003's coverage
+        # Exact block-set coverage, not a high-watermark: an INTERIOR
+        # block skipped by a warped index map (review finding) is just
+        # as wrong as a dropped tail.
+        expected = int(math.prod(
+            -(-d // b) for d, b in zip(info.array_shape, info.block_shape)
+        ))
+        if len(read) < expected:
+            findings.append(make_finding(
+                "PAL004", f"unread-blocks:{rec.kernel_name}:{info.label}",
+                f"kernel '{rec.kernel_name}' input {info.label}: only "
+                f"{len(read)} of {expected} input blocks of the "
+                f"{info.array_shape} operand ever enter the kernel — "
+                "the unread rows never reach compute and the result is "
+                "silently wrong (a non-dividing tile size or an "
+                "index-map hole)",
+            ))
+    return findings
+
+
+def check_windows(case, make_finding):
+    """The manual-DMA half: every declared ``pl.ds`` window (computed
+    from the static stage tables the kernels consume) must fit its mask
+    array."""
+    findings = []
+    for w in case.windows:
+        if w.start < 0 or w.start + w.size > w.limit:
+            findings.append(make_finding(
+                "PAL004", f"window:{w.label}",
+                f"manual DMA window '{w.label}' reads rows "
+                f"[{w.start}, {w.start + w.size}) of a {w.limit}-row "
+                "ref — the static stage table points past its prepared "
+                "mask array (stale offsets or a padded tail the "
+                "relayout dropped)",
+            ))
+    return findings

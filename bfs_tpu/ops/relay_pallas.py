@@ -413,6 +413,7 @@ def _run_local_tile_major(x, arr2d, tr, specs, n, interpret, vma=None):
         w0 = pid * rows * LANES
         return (w0 < st.hi) & (w0 + rows * LANES > st.lo)
 
+    # bfs_tpu: hot
     def kernel(x_ref, m_hbm, o_ref, buf, sem):
         pid = pl.program_id(0)
 
@@ -545,6 +546,7 @@ def _run_pass(x, arr2d, mode, tr, tt, specs, n, interpret, vma=None,
     depth = DMA_DEPTH
 
     def make_kernel(nrefs):
+        # bfs_tpu: hot
         def kernel(x_ref, *rest):
             refs = rest[:nrefs]
             o_ref = rest[nrefs]
@@ -837,6 +839,7 @@ def _run_elem_pass(x, arr2d, mode, tr, tt, specs, n, interpret):
 
     depth = DMA_DEPTH
 
+    # bfs_tpu: hot
     def kernel(x_ref, m_hbm, o_ref, mbuf, sem):
         pid = pl.program_id(0)
         xv = x_ref[...]

@@ -531,7 +531,8 @@ def test_repo_self_lint_clean_modulo_baseline():
     stale_ast = [
         fp for fp in baseline.stale()
         if not (baseline.entries[fp][0].startswith("IR")
-                or baseline.entries[fp][0].startswith("HLO"))
+                or baseline.entries[fp][0].startswith("HLO")
+                or baseline.entries[fp][0].startswith("PAL"))
     ]
     assert stale_ast == [], (
         "stale baseline entries (fixed or edited — prune them): "
@@ -607,6 +608,18 @@ def test_repo_has_expected_hot_coverage():
     bench = SourceFile(os.path.join(REPO, "bfs_tpu/bench.py"), REPO)
     spans = [r for r in hot_regions(bench) if r.name.startswith("span@")]
     assert len(spans) >= 2, "bench timed-repeat hot spans went missing"
+    # EVERY Pallas kernel body is hot (ISSUE 13 satellite: the Beneš
+    # route kernels — tile-major local, per-stage local/outer, elem —
+    # lagged the tournament/packed-update pair; all five inner bodies
+    # are named `kernel`, so the pin is a count, not a name).
+    rp = SourceFile(
+        os.path.join(REPO, "bfs_tpu/ops/relay_pallas.py"), REPO
+    )
+    kernel_bodies = [r for r in hot_regions(rp) if r.name == "kernel"]
+    assert len(kernel_bodies) >= 5, (
+        "a Pallas kernel body lost its hot pragma",
+        sorted(r.start for r in kernel_bodies),
+    )
 
 
 # ---------------------------------------------------------------------------
@@ -676,7 +689,7 @@ def test_cli_rules_catalog():
     assert proc.returncode == 0
     for rule in ("TRC001", "TRC006", "RCD001", "RCD005", "LCK001", "LCK002",
                  "OBS001", "IR001", "IR004", "IR006", "HLO001", "HLO003",
-                 "HLO005"):
+                 "HLO005", "PAL001", "PAL003", "PAL005"):
         assert rule in proc.stdout
 
 
@@ -704,8 +717,8 @@ def test_cli_stale_baseline_fails_default_run(tmp_path):
 
 def test_cli_write_baseline_carries_ir_and_hlo_entries_over(tmp_path):
     """The AST --write-baseline regenerates its own section but must not
-    drop the hand-curated IR *or* HLO entries sharing the file (ISSUE 12
-    satellite: PR 8 special-cased IR only)."""
+    drop the hand-curated IR, HLO *or* Pallas entries sharing the file
+    (ISSUE 12/13 satellites: PR 8 special-cased IR only)."""
     bl = tmp_path / "baseline.txt"
     shipped = open(
         os.path.join(REPO, "bfs_tpu", "analysis", "baseline.txt"),
@@ -713,14 +726,17 @@ def test_cli_write_baseline_carries_ir_and_hlo_entries_over(tmp_path):
     ).read()
     bl.write_text(shipped
                   + "IR001  cafecafe0000  fixture: justified\n"
-                  + "HLO003  beefbeef0000  fixture: also justified\n")
+                  + "HLO003  beefbeef0000  fixture: also justified\n"
+                  + "PAL002  feedfeed0000  fixture: pal justified\n")
     proc = _run_cli(["--write-baseline", "--baseline", str(bl)])
     assert proc.returncode == 0, proc.stdout + proc.stderr
     rewritten = bl.read_text()
     assert "IR001  cafecafe0000  fixture: justified" in rewritten
     assert "HLO003  beefbeef0000  fixture: also justified" in rewritten
-    # The shipped HLO section's real entries survive too.
+    assert "PAL002  feedfeed0000  fixture: pal justified" in rewritten
+    # The shipped HLO and Pallas sections' real entries survive too.
     assert "HLO003  15602bda2246" in rewritten
+    assert "PAL002  32cd6b364883" in rewritten
     assert "carried over" in proc.stdout
 
 

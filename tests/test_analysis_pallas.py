@@ -1,0 +1,702 @@
+"""Tests for bfs_tpu.analysis.pallas — the kernel-grade pass (ISSUE 13):
+every PAL rule must trip on a fixture kernel and stay quiet on its
+near-miss, the repo's own kernel registry must run clean modulo the
+baseline with every ``pl.pallas_call`` site covered (the set-equality
+pin), the content-addressed result cache must hit on an unchanged tree,
+the CLI must exit non-zero on each rule fixture and reject scoping, the
+``--all`` composite surface must merge every pass under one exit code,
+and PAL005 needs its runtime proof: a deliberately broken twin of a
+shipping kernel trips the parity oracle while the shipped registry's
+twins all match bit-identically.
+
+The repo-wide registry runs carry the ``lint_pallas`` marker so a quick
+``-m 'not lint_pallas'`` selection can skip the (cached, but cold ~20 s)
+interpret-mode work; plain tier-1 runs them.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bfs_tpu.analysis import Baseline, default_baseline_path
+from bfs_tpu.analysis import pallas as pal_mod
+from bfs_tpu.analysis.pallas import (
+    KERNEL_SPECS,
+    KernelCase,
+    KernelSpec,
+    Window,
+    analyze_kernel,
+    analyze_pallas,
+    capture_pallas_calls,
+    discover_pallas_sites,
+    registered_sites,
+    registry_findings,
+    tree_bit_identical,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+def _spec(name, build):
+    return KernelSpec(name=name, path="fx.py", sites=(), build=build)
+
+
+def _pallas_double(
+    rows=16,
+    lanes=128,
+    block=(8, 128),
+    grid=None,
+    in_map=None,
+    out_map=None,
+    scratch=None,
+):
+    """Run a trivial doubling kernel through pl.pallas_call with the
+    given blocking — the knob set every fixture below turns."""
+    from jax.experimental import pallas as pl
+
+    x = jnp.arange(rows * lanes, dtype=jnp.uint32).reshape(rows, lanes)
+    grid = grid if grid is not None else rows // block[0]
+    in_map = in_map or (lambda i: (i, 0))
+    out_map = out_map or in_map
+
+    def kernel(x_ref, o_ref, *_scratch):
+        o_ref[...] = x_ref[...] * 2
+
+    return pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[pl.BlockSpec(block, in_map)],
+        out_specs=pl.BlockSpec(block, out_map),
+        out_shape=jax.ShapeDtypeStruct((rows, lanes), jnp.uint32),
+        scratch_shapes=list(scratch or ()),
+        interpret=True,
+    )(x)
+
+
+# ---------------------------------------------------------------------------
+# The capture spy itself.
+# ---------------------------------------------------------------------------
+
+def test_capture_records_real_call_parameters():
+    from jax.experimental.pallas import tpu as pltpu
+
+    def run():
+        return _pallas_double(
+            scratch=[pltpu.VMEM((2, 8, 128), jnp.uint32),
+                     pltpu.SemaphoreType.DMA((2,))],
+        )
+
+    result, records = capture_pallas_calls(run)
+    assert len(records) == 1
+    rec = records[0]
+    assert rec.grid == (2,)
+    assert rec.in_specs[0].block_shape == (8, 128)
+    assert rec.out_specs[0].block_shape == (8, 128)
+    # semaphores are not VMEM; the 2x8x128 u32 buffer is.
+    assert rec.scratch_bytes == 2 * 8 * 128 * 4
+    assert rec.interpret
+    assert int(np.asarray(result)[1, 1]) == 2 * 129
+
+
+def test_tree_bit_identical_catches_shape_dtype_value():
+    a = {"x": jnp.zeros(4, jnp.uint32), "y": jnp.int32(3)}
+    ok, _ = tree_bit_identical(a, {"x": jnp.zeros(4, jnp.uint32),
+                                   "y": jnp.int32(3)})
+    assert ok
+    ok, d = tree_bit_identical(a, {"x": jnp.zeros(4, jnp.int32),
+                                   "y": jnp.int32(3)})
+    assert not ok and "dtype" in d
+    ok, d = tree_bit_identical(a, {"x": jnp.zeros(5, jnp.uint32),
+                                   "y": jnp.int32(3)})
+    assert not ok and "shape" in d
+    ok, d = tree_bit_identical(a, {"x": jnp.ones(4, jnp.uint32),
+                                   "y": jnp.int32(3)})
+    assert not ok and "differ" in d
+
+
+# ---------------------------------------------------------------------------
+# PAL001 — VMEM residency proof.
+# ---------------------------------------------------------------------------
+
+def _scratch_hog_case():
+    from jax.experimental.pallas import tpu as pltpu
+
+    # 32 MB of declared VMEM scratch blows the 16 MB default budget.
+    return KernelCase(run=lambda: _pallas_double(
+        scratch=[pltpu.VMEM((1 << 23,), jnp.uint32)]
+    ))
+
+
+def test_pal001_vmem_over_budget_trips_and_small_passes(monkeypatch):
+    fs = analyze_kernel(_spec("fx.hog", _scratch_hog_case))
+    assert rules_of(fs) == ["PAL001"]
+    assert "scratch" in fs[0].message and "BFS_TPU_PAL_VMEM_MB" in fs[0].message
+    # A raised budget accepts the same kernel.
+    monkeypatch.setenv("BFS_TPU_PAL_VMEM_MB", "64")
+    assert analyze_kernel(_spec("fx.hog2", _scratch_hog_case)) == []
+    monkeypatch.delenv("BFS_TPU_PAL_VMEM_MB")
+    # The clean fixture is far under budget.
+    fs = analyze_kernel(_spec(
+        "fx.small", lambda: KernelCase(run=lambda: _pallas_double())
+    ))
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# PAL002 — (8, 128) tiling + MXU readiness.
+# ---------------------------------------------------------------------------
+
+def test_pal002_misaligned_block_trips_aligned_passes():
+    fs = analyze_kernel(_spec("fx.misaligned", lambda: KernelCase(
+        run=lambda: _pallas_double(rows=4, lanes=64, block=(4, 64),
+                                   grid=1, in_map=lambda i: (0, 0)),
+    )))
+    # in and out blocks both misaligned (sublane 4 % 8, lane 64 % 128).
+    assert rules_of(fs) == ["PAL002"]
+    assert len(fs) == 2
+    assert "4x64" in fs[0].snippet
+    assert analyze_kernel(_spec(
+        "fx.aligned", lambda: KernelCase(run=lambda: _pallas_double())
+    )) == []
+
+
+def test_pal002_mxu_contract():
+    # (8, 128) satisfies the VPU tiling but NOT the declared-MXU 128x128.
+    fs = analyze_kernel(_spec("fx.mxu", lambda: KernelCase(
+        run=lambda: _pallas_double(), mxu=True,
+    )))
+    assert rules_of(fs) == ["PAL002"]
+    assert all("mxu" in f.snippet for f in fs)
+    fs = analyze_kernel(_spec("fx.mxu_ok", lambda: KernelCase(
+        run=lambda: _pallas_double(rows=256, block=(128, 128), grid=2),
+        mxu=True,
+    )))
+    assert fs == []
+
+
+# ---------------------------------------------------------------------------
+# PAL003 — grid write-aliasing.
+# ---------------------------------------------------------------------------
+
+def test_pal003_output_race_trips_accumulate_declared_passes():
+    def racing(accumulates):
+        # Two grid steps both map output block (0, 0) of an 8-row out.
+        return KernelCase(
+            run=lambda: _pallas_double(
+                rows=8, grid=2,
+                in_map=lambda i: (0, 0), out_map=lambda i: (0, 0),
+            ),
+            accumulates=accumulates,
+        )
+
+    fs = analyze_kernel(_spec("fx.race", lambda: racing(False)))
+    assert rules_of(fs) == ["PAL003"]
+    assert "race" in fs[0].snippet and "data race" in fs[0].message
+    assert analyze_kernel(_spec("fx.accum", lambda: racing(True))) == []
+
+
+def test_pal003_shifted_output_map_trips_overrun_and_uncovered():
+    """An off-by-one OUTPUT index map writes a phantom block past the
+    array and leaves block 0 unwritten: the phantom must not count as
+    coverage (review finding) — PAL003 reports the garbage block and
+    PAL004 the out-of-bounds write."""
+    fs = analyze_kernel(_spec("fx.shifted", lambda: KernelCase(
+        run=lambda: _pallas_double(
+            rows=16, grid=2, out_map=lambda i: (i + 1, 0),
+        ),
+    )))
+    assert rules_of(fs) == ["PAL003", "PAL004"], [f.snippet for f in fs]
+    assert any("uncovered" in f.snippet for f in fs)
+    assert any("block-overrun" in f.snippet for f in fs)
+
+
+def test_pal003_uncovered_output_blocks_trip():
+    # Grid of 1 writes only the first of two output blocks; the input
+    # tail is equally dropped — both halves of the bug are reported.
+    fs = analyze_kernel(_spec("fx.uncovered", lambda: KernelCase(
+        run=lambda: _pallas_double(rows=16, grid=1),
+    )))
+    assert rules_of(fs) == ["PAL003", "PAL004"]
+    assert any("uncovered" in f.snippet for f in fs)
+    assert any("unread-blocks" in f.snippet for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# PAL004 — dynamic-slice bounds.
+# ---------------------------------------------------------------------------
+
+def test_pal004_interior_unread_input_block_trips():
+    """Coverage is an exact block-set count, not a high-watermark
+    (review finding): an index map that reads block 1 twice and skips
+    block 2 reaches the array end yet misses interior rows."""
+    fs = analyze_kernel(_spec("fx.hole", lambda: KernelCase(
+        run=lambda: _pallas_double(
+            rows=32, grid=4,
+            in_map=lambda i: (i - (i == 2), 0),
+            out_map=lambda i: (i, 0),
+        ),
+    )))
+    assert rules_of(fs) == ["PAL004"], [f.snippet for f in fs]
+    assert "unread-blocks" in fs[0].snippet
+    assert "3 of 4" in fs[0].message
+
+
+def test_tree_bit_identical_is_bitwise_not_value_equality():
+    """-0.0 == 0.0 by value but not by bits; NaN != NaN by value but a
+    bit-identical NaN is parity (review finding) — the oracle compares
+    raw bytes."""
+    ok, d = tree_bit_identical(jnp.float32(-0.0), jnp.float32(0.0))
+    assert not ok and "bit-wise" in d
+    nan = jnp.asarray([np.nan, 1.0], jnp.float32)
+    ok, _ = tree_bit_identical(nan, jnp.asarray([np.nan, 1.0], jnp.float32))
+    assert ok
+
+
+def test_pal004_manual_window_overrun_trips_fitting_passes():
+    def with_window(limit):
+        return KernelCase(
+            run=lambda: _pallas_double(),
+            windows=[Window("fx:stage0", 4, 8, limit)],
+        )
+
+    fs = analyze_kernel(_spec("fx.window", lambda: with_window(10)))
+    assert rules_of(fs) == ["PAL004"]
+    assert "window" in fs[0].snippet and "[4, 12)" in fs[0].message
+    assert analyze_kernel(_spec("fx.winok", lambda: with_window(12))) == []
+
+
+def test_pal004_benes_window_helper_catches_corrupt_stage_table():
+    """The windows helper mirrors the kernels' pl.ds arithmetic: a stage
+    offset pointing past the prepared mask array must produce an
+    out-of-bounds window."""
+    from bfs_tpu.analysis.pallas import benes_word_windows
+    from bfs_tpu.graph.relay import StageSpec
+
+    # One local_tm pass, 2 tiles of 8 rows, one full stage: 16 rows of
+    # masks needed; claim only 12 exist.
+    st = StageSpec(d=1, offset=0, nwords=8 * 128, compact=False,
+                   lo=0, hi=8 * 128)
+    ps = (("local_tm", 8, 8, (st,)),)
+    windows = benes_word_windows(ps, [12], 16 * 32 * 128)
+    assert any(w.start + w.size > w.limit for w in windows)
+    ok = benes_word_windows(ps, [16], 16 * 32 * 128)
+    assert all(w.start + w.size <= w.limit for w in ok)
+
+
+# ---------------------------------------------------------------------------
+# PAL005 — the interpret-vs-XLA parity oracle.
+# ---------------------------------------------------------------------------
+
+def test_pal005_broken_twin_trips_matching_passes():
+    def broken():
+        return KernelCase(
+            run=lambda: _pallas_double(),
+            twin=lambda: _pallas_double() + jnp.uint32(1),
+        )
+
+    fs = analyze_kernel(_spec("fx.skew", broken))
+    assert rules_of(fs) == ["PAL005"]
+    assert "bit-identical" in fs[0].message
+
+    def matching():
+        return KernelCase(
+            run=lambda: _pallas_double(),
+            twin=lambda: _pallas_double(),
+        )
+
+    assert analyze_kernel(_spec("fx.match", matching)) == []
+
+
+@pytest.mark.lint_pallas
+def test_pal005_runtime_proof_on_shipping_kernel():
+    """The acceptance proof: a deliberately broken twin of the SHIPPING
+    packed-update kernel trips the parity oracle; the shipped spec's own
+    twin matches bit-identically (asserted for every registered kernel
+    by the self-lint below)."""
+    real = KERNEL_SPECS["update.packed_words"]()
+
+    def broken_build():
+        case = real.build()
+        orig_twin = case.twin
+
+        def twin():
+            r = orig_twin()
+            return r._replace(packed=r.packed ^ jnp.uint32(1))
+
+        return KernelCase(run=case.run, twin=twin)
+
+    fs = analyze_kernel(KernelSpec(
+        name="fx.broken_update_twin", path=real.path, sites=(),
+        build=broken_build,
+    ))
+    assert any(f.rule == "PAL005" for f in fs), rules_of(fs)
+    # The shipped spec's twin matches (its only finding is the
+    # baselined PAL002 tile note — never a parity break).
+    assert not any(f.rule == "PAL005" for f in analyze_kernel(real))
+
+
+def test_pal005_can_never_be_baselined(monkeypatch, tmp_path, capsys):
+    """The documented contract, ENFORCED (review finding): a justified
+    baseline entry for a PAL005 parity break is ignored — the run stays
+    red and the dead entry reports stale."""
+    from bfs_tpu.analysis import __main__ as cli
+
+    spec_build = _fixture_specs()["PAL005"]
+    monkeypatch.setattr(pal_mod, "KERNEL_SPECS", {"PAL005": spec_build})
+    monkeypatch.setattr(pal_mod, "registry_findings",
+                        lambda *a, **k: [])
+    [finding] = [f for f in analyze_kernel(spec_build())
+                 if f.rule == "PAL005"]
+    bl = tmp_path / "baseline.txt"
+    bl.write_text(
+        f"PAL005  {finding.fingerprint()}  trying to silence parity\n"
+    )
+    rc = cli.main(["--pallas", "--no-cache", "--baseline", str(bl)])
+    out = capsys.readouterr()
+    assert rc == 1, out.out + out.err
+    assert "PAL005" in out.out  # still reported, not accepted
+
+
+def test_pal000_undecodable_grid_spec_call_fails_loudly():
+    """A kernel passing grid_spec= (the PrefetchScalarGridSpec shape)
+    gives the spy empty spec lists — every static rule would pass
+    vacuously, so the capture itself must be a PAL000 (review
+    finding)."""
+    from jax.experimental import pallas as pl
+
+    def run():
+        bs = pl.BlockSpec((8, 128), lambda i: (i, 0))
+        gs = pl.GridSpec(grid=(2,), in_specs=[bs], out_specs=bs)
+
+        def kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...] * 2
+
+        return pl.pallas_call(
+            kernel, grid_spec=gs,
+            out_shape=jax.ShapeDtypeStruct((16, 128), jnp.uint32),
+            interpret=True,
+        )(jnp.ones((16, 128), jnp.uint32))
+
+    fs = analyze_kernel(_spec("fx.gridspec", lambda: KernelCase(run=run)))
+    assert any(f.snippet == "pal:fx.gridspec:undecoded:kernel"
+               for f in fs), [f.snippet for f in fs]
+
+
+def test_pal000_no_pallas_call_and_builder_failure():
+    fs = analyze_kernel(_spec(
+        "fx.nocall", lambda: KernelCase(run=lambda: jnp.zeros(4))
+    ))
+    assert [f.snippet for f in fs] == ["pal:fx.nocall:no-pallas-call"]
+
+    def boom():
+        raise TypeError("deliberately broken case")
+
+    fs = analyze_kernel(_spec("fx.boom", boom))
+    assert [f.snippet for f in fs] == ["pal:fx.boom:build"]
+
+
+# ---------------------------------------------------------------------------
+# The registry <-> pallas_call-site set-equality pin.
+# ---------------------------------------------------------------------------
+
+def test_registry_covers_every_pallas_call_site():
+    """Tier-1 pin: every pl.pallas_call site in bfs_tpu/ has a
+    KERNEL_SPECS entry and every spec site exists — deleting a spec OR
+    adding an unregistered kernel fails here."""
+    discovered = discover_pallas_sites(REPO)
+    assert discovered == registered_sites(), (
+        sorted(discovered), sorted(registered_sites())
+    )
+    # The five shipped sites, by name — a rename must update the specs.
+    assert {s.split("::")[1] for s in discovered} == {
+        "_run_local_tile_major", "_run_pass", "_run_elem_pass",
+        "_class_tournament_call", "apply_relay_candidates_packed_pallas",
+    }
+    assert registry_findings(KERNEL_SPECS, REPO) == []
+
+
+def test_registry_findings_flag_both_directions():
+    pruned = dict(KERNEL_SPECS)
+    del pruned["rowmin.tournament"]
+    fs = registry_findings(pruned, REPO)
+    assert any("unregistered" in f.snippet
+               and "_class_tournament_call" in f.snippet for f in fs)
+
+    def ghost_build():  # never called — coverage is read statically
+        raise AssertionError
+
+    ghost_build.sites = ("bfs_tpu/ops/relay_pallas.py::_gone_kernel",)
+    fs = registry_findings({**KERNEL_SPECS, "fx.ghost": ghost_build}, REPO)
+    assert any("missing" in f.snippet and "_gone_kernel" in f.snippet
+               for f in fs)
+
+
+# ---------------------------------------------------------------------------
+# The repo registry: self-lint + cache.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.lint_pallas
+def test_repo_pallas_self_lint_clean_modulo_baseline():
+    """Every shipped kernel runs, every pallas_call site is covered, and
+    the findings are clean modulo the committed baseline.  PAL005 parity
+    is asserted bit-identical for EVERY registered kernel: a parity
+    break can never be baselined into silence here."""
+    findings, meta = analyze_pallas(use_cache=True)
+    assert len(meta["kernels"]) + len(meta["skipped"]) >= 5, meta
+    assert meta["skipped"] == {}, meta["skipped"]  # native router in-image
+    baseline = Baseline.load(default_baseline_path())
+    fresh = [f for f in findings if not baseline.accepts(f)]
+    assert fresh == [], "\n".join(f.render() for f in fresh)
+    assert not any(f.rule == "PAL005" for f in findings)
+    assert not any(f.rule == "PAL000" for f in findings)
+    # Every kernel reports its VMEM proof input (the meta the docs cite).
+    assert set(meta["vmem_bytes"]) == set(meta["kernels"])
+
+
+def _small_registry():
+    def a():
+        return _spec("fx.small_a", lambda: KernelCase(
+            run=lambda: _pallas_double()
+        ))
+
+    def b():
+        return _spec("fx.small_b", lambda: KernelCase(
+            run=lambda: _pallas_double(rows=8, grid=1)
+        ))
+
+    a.sites = ()
+    b.sites = ()
+    return {"fx.small_a": a, "fx.small_b": b}
+
+
+def test_pallas_result_cache_hits_on_unchanged_tree(tmp_path, monkeypatch):
+    monkeypatch.setattr(pal_mod, "KERNEL_SPECS", _small_registry())
+    monkeypatch.setattr(pal_mod, "registry_findings",
+                        lambda *a, **k: [])
+    f1, m1 = analyze_pallas(use_cache=True, cache_dir=str(tmp_path))
+    assert m1["cache"] == "miss"
+    f2, m2 = analyze_pallas(use_cache=True, cache_dir=str(tmp_path))
+    assert m2["cache"] == "hit"
+    assert [f.fingerprint() for f in f2] == [f.fingerprint() for f in f1]
+    assert m2["vmem_bytes"] == m1["vmem_bytes"]
+    assert any(name.startswith("pal_") for name in os.listdir(tmp_path))
+
+
+def test_pallas_skip_records_kernel():
+    from bfs_tpu.analysis.ir import SkipProgram
+
+    def skipper():
+        raise SkipProgram("no native router")
+
+    findings, meta = analyze_pallas({"fx.skipped": skipper})
+    assert findings == []
+    assert meta["skipped"] == {"fx.skipped": "no native router"}
+    assert meta["cache"] == "off"  # custom specs are never cached
+
+
+# ---------------------------------------------------------------------------
+# CLI: the --pallas path.
+# ---------------------------------------------------------------------------
+
+def _fixture_specs():
+    return {
+        "PAL001": lambda: _spec("fx.hog", _scratch_hog_case),
+        "PAL002": lambda: _spec("fx.misaligned", lambda: KernelCase(
+            run=lambda: _pallas_double(rows=4, lanes=64, block=(4, 64),
+                                       grid=1, in_map=lambda i: (0, 0)),
+        )),
+        "PAL003": lambda: _spec("fx.race", lambda: KernelCase(
+            run=lambda: _pallas_double(
+                rows=8, grid=2,
+                in_map=lambda i: (0, 0), out_map=lambda i: (0, 0),
+            ),
+        )),
+        "PAL004": lambda: _spec("fx.window", lambda: KernelCase(
+            run=lambda: _pallas_double(),
+            windows=[Window("fx:stage0", 4, 8, 10)],
+        )),
+        "PAL005": lambda: _spec("fx.skew", lambda: KernelCase(
+            run=lambda: _pallas_double(),
+            twin=lambda: _pallas_double() + jnp.uint32(1),
+        )),
+    }
+
+
+@pytest.mark.parametrize("rule", ["PAL001", "PAL002", "PAL003", "PAL004",
+                                  "PAL005"])
+def test_cli_exits_nonzero_on_rule_fixture(rule, monkeypatch, capsys):
+    from bfs_tpu.analysis import __main__ as cli
+
+    monkeypatch.setattr(pal_mod, "KERNEL_SPECS",
+                        {rule: _fixture_specs()[rule]})
+    monkeypatch.setattr(pal_mod, "registry_findings",
+                        lambda *a, **k: [])
+    rc = cli.main(["--pallas", "--no-cache", "--no-baseline"])
+    out = capsys.readouterr()
+    assert rc == 1, out.out + out.err
+    assert rule in out.out
+
+
+def test_cli_pallas_subcommand_and_baseline_accept(monkeypatch, tmp_path,
+                                                   capsys):
+    """`python -m bfs_tpu.analysis pallas` == `--pallas`; a justified
+    baseline entry turns the same fixture run green."""
+    from bfs_tpu.analysis import __main__ as cli
+
+    spec_build = _fixture_specs()["PAL002"]
+    monkeypatch.setattr(pal_mod, "KERNEL_SPECS", {"PAL002": spec_build})
+    monkeypatch.setattr(pal_mod, "registry_findings",
+                        lambda *a, **k: [])
+    findings = analyze_kernel(spec_build())
+    bl = tmp_path / "baseline.txt"
+    bl.write_text("".join(
+        f"{f.rule}  {f.fingerprint()}  fixture: accepted\n"
+        for f in findings
+    ))
+    rc = cli.main(["pallas", "--no-cache", "--baseline", str(bl)])
+    out = capsys.readouterr()
+    assert rc == 0, out.out + out.err
+
+
+def test_cli_pallas_rejects_scoping_flags(capsys):
+    from bfs_tpu.analysis import __main__ as cli
+
+    for argv in (["--pallas", "--changed"], ["--pallas", "some/file.py"]):
+        rc = cli.main(argv)
+        out = capsys.readouterr()
+        assert rc == 2, (argv, out.out, out.err)
+        assert "cannot be scoped" in out.err
+    for argv in (["--ir", "--pallas"], ["--hlo", "--pallas"]):
+        rc = cli.main(argv)
+        out = capsys.readouterr()
+        assert rc == 2
+        assert "one at a time" in out.err
+
+
+def test_cli_stale_pal_entry_fails_default_surface(monkeypatch, tmp_path,
+                                                   capsys):
+    """A stale `pal:` fingerprint fails a default-surface --pallas run
+    exactly like `ir:`/`hlo:` ones — and other families' entries are
+    not this pass's business."""
+    from bfs_tpu.analysis import __main__ as cli
+
+    monkeypatch.setattr(pal_mod, "KERNEL_SPECS", _small_registry())
+    monkeypatch.setattr(pal_mod, "registry_findings",
+                        lambda *a, **k: [])
+    bl = tmp_path / "baseline.txt"
+    bl.write_text("PAL002  deadbeef0000  a dead pal entry\n")
+    rc = cli.main(["--pallas", "--no-cache", "--baseline", str(bl)])
+    out = capsys.readouterr()
+    assert rc == 1, out.out + out.err
+    assert "STALE" in out.err
+    bl.write_text("HLO003  deadbeef0000  another family's entry\n")
+    rc = cli.main(["--pallas", "--no-cache", "--baseline", str(bl)])
+    out = capsys.readouterr()
+    assert rc == 0, out.out + out.err
+
+
+def test_cli_pallas_write_baseline_prints_never_clobbers(monkeypatch,
+                                                         tmp_path, capsys):
+    from bfs_tpu.analysis import __main__ as cli
+
+    monkeypatch.setattr(pal_mod, "KERNEL_SPECS",
+                        {"PAL002": _fixture_specs()["PAL002"]})
+    monkeypatch.setattr(pal_mod, "registry_findings",
+                        lambda *a, **k: [])
+    bl = tmp_path / "baseline.txt"
+    bl.write_text("TRC001  cafecafe0000  keep me\n")
+    rc = cli.main(["--pallas", "--no-cache", "--write-baseline",
+                   "--baseline", str(bl)])
+    out = capsys.readouterr()
+    assert rc == 0
+    assert "PAL002" in out.out  # candidate line printed
+    assert "PAL section" in out.err
+    assert bl.read_text() == "TRC001  cafecafe0000  keep me\n"  # untouched
+
+
+# ---------------------------------------------------------------------------
+# CLI: the --all composite surface.
+# ---------------------------------------------------------------------------
+
+def test_cli_all_rejects_scoping_and_combinations(capsys):
+    from bfs_tpu.analysis import __main__ as cli
+
+    for argv in (["--all", "--changed"], ["--all", "some/file.py"]):
+        rc = cli.main(argv)
+        out = capsys.readouterr()
+        assert rc == 2, (argv, out.out, out.err)
+        assert "cannot be scoped" in out.err
+    for argv in (["--all", "--ir"], ["--all", "--hlo"],
+                 ["--all", "--pallas"]):
+        rc = cli.main(argv)
+        out = capsys.readouterr()
+        assert rc == 2, argv
+        assert "one at a time" in out.err
+    rc = cli.main(["--all", "--write-baseline"])
+    out = capsys.readouterr()
+    assert rc == 2
+    assert "--write-baseline" in out.err
+
+
+@pytest.mark.lint_pallas
+@pytest.mark.lint_hlo
+@pytest.mark.lint_ir
+def test_cli_all_green_on_repo(capsys):
+    """The pre-merge gate surface: AST + IR + HLO + Pallas in one run,
+    clean modulo the committed baseline, exit 0.  Reuses the same
+    content-addressed caches the single-pass self-lints populate."""
+    from bfs_tpu.analysis import __main__ as cli
+
+    rc = cli.main(["--all"])
+    out = capsys.readouterr()
+    assert rc == 0, out.out + out.err
+    assert "analysis[--all]" in out.err
+    assert "pal: 5" in out.err
+
+
+@pytest.mark.lint_pallas
+@pytest.mark.lint_hlo
+@pytest.mark.lint_ir
+def test_cli_all_merges_exit_code_and_skip_exempts_family(monkeypatch,
+                                                          tmp_path,
+                                                          capsys):
+    """One tripping Pallas fixture makes the whole composite non-zero;
+    a registry whose kernels all SKIP exempts the PAL family from stale
+    enforcement (its baseline entries prove nothing) and the composite
+    goes green on the other three passes."""
+    from bfs_tpu.analysis import __main__ as cli
+    from bfs_tpu.analysis.ir import SkipProgram
+
+    monkeypatch.setattr(pal_mod, "KERNEL_SPECS",
+                        {"PAL005": _fixture_specs()["PAL005"]})
+    monkeypatch.setattr(pal_mod, "registry_findings",
+                        lambda *a, **k: [])
+    # Fixture-registry results must not land in the repo's real
+    # .bench_cache/pal/ (IR/HLO stay on their real caches — that is
+    # the point of the composite being cheap).
+    monkeypatch.setattr(pal_mod, "default_cache_dir",
+                        lambda root=None: str(tmp_path))
+    rc = cli.main(["--all"])
+    out = capsys.readouterr()
+    assert rc == 1, out.out + out.err
+    assert "PAL005" in out.out
+
+    def skipper():
+        raise SkipProgram("no router in this fixture")
+
+    skipper.sites = ()
+    monkeypatch.setattr(pal_mod, "KERNEL_SPECS", {"fx.skip": skipper})
+    rc = cli.main(["--all"])
+    out = capsys.readouterr()
+    assert rc == 0, out.out + out.err
+    assert "skipped" in out.err

@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# The pre-merge gate: tier-1 tests + the full cached lint surface.
+#
+#   tools/ci_gate.sh            # run everything, non-zero on any failure
+#   tools/ci_gate.sh --no-tests # lint surface only (tier-1 ran elsewhere)
+#
+# Two stages, fail-fast:
+#   1. tier-1: the full CPU test suite on the 8-device virtual platform
+#      (tests/conftest.py forces it), -m 'not slow' — exactly the
+#      ROADMAP.md verify command minus the log plumbing.
+#   2. bfs-tpu-lint --all: AST + IR + HLO + Pallas with merged baseline
+#      handling — one exit code over every analyzer rung.  The jax
+#      passes are content-address-cached (.bench_cache/{ir,hlo,pal}),
+#      so a tree tier-1 just ran on lints in seconds.
+#
+# Exit 0 = mergeable.  Any test failure, any unbaselined finding, or any
+# STALE baseline entry is non-zero.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+RUN_TESTS=1
+if [[ "${1:-}" == "--no-tests" ]]; then
+    RUN_TESTS=0
+fi
+
+if [[ "$RUN_TESTS" == "1" ]]; then
+    echo "== ci gate 1/2: tier-1 tests =="
+    JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
+        -p no:cacheprovider
+fi
+
+if [[ "$RUN_TESTS" == "1" ]]; then
+    echo "== ci gate 2/2: lint --all (AST + IR + HLO + Pallas) =="
+else
+    echo "== ci gate: lint --all (AST + IR + HLO + Pallas) =="
+fi
+JAX_PLATFORMS=cpu python -m bfs_tpu.analysis --all
+
+echo "== ci gate: all green =="

@@ -6,8 +6,9 @@ execute the parent ``bfs_tpu/__init__`` first, which imports the engine
 stack (~1.5 s of jax).  This wrapper installs a stub parent package so
 ``bfs_tpu.analysis`` loads alone — the lint stays sub-100ms, which is
 what makes it cheap enough to run on every commit.  All flags pass
-through, including ``--ir`` and ``--hlo`` (those passes import jax on
-purpose — the stub only keeps the DEFAULT AST path light).
+through, including ``--ir``/``--hlo``/``--pallas``/``--all`` (those
+passes import jax on purpose — the stub only keeps the DEFAULT AST
+path light).
 """
 
 from __future__ import annotations
