@@ -540,6 +540,123 @@ def _spec_relay_step(kind: str):
     )
 
 
+def _spec_relay_segment():
+    """The bounded-segment relay program (ISSUE 14): the checkpointable
+    twin of relay.fused — its carry is consumed per segment (callers
+    reassign), so the whole carry dict is a declared donation (IR001)."""
+    import jax.numpy as jnp
+
+    from ..models.bfs import _relay_segment_program
+
+    eng = _relay_engine()
+    prog = _relay_segment_program(
+        eng._static, eng.sparse_hybrid, eng._use_pallas(), eng.packed,
+        True, eng.direction.key(), eng._phase_sel(),
+        eng.relay_graph.num_vertices,
+    )
+    carry = eng.segment_carry(0, telemetry=True)
+    return Program(
+        name="relay.segment", path="bfs_tpu/models/bfs.py",
+        fn=prog,
+        args=(
+            carry, jnp.int32(8), *eng._tensors,
+            *eng._sparse_tensors_for(eng.packed),
+        ),
+        static_kwargs=dict(max_levels=16),
+        v_elements=eng.relay_graph.vr, packed=eng.packed,
+        donate={0: "carry"}, budget_bytes=_hbm_envelope(),
+    )
+
+
+def _spec_multi_segment(engine: str):
+    """The bounded-segment batched multi-source programs (ISSUE 14) —
+    what the serve checkpointing batch runner executes per segment."""
+    import jax.numpy as jnp
+
+    from ..models.multisource import multi_segment_init
+
+    if engine == "pull":
+        from ..graph.ell import build_pull_graph, device_ell
+        from ..models.multisource import _bfs_multi_pull_segment
+
+        pg = _memo("pg", lambda: build_pull_graph(_tiny_graph()))
+        ell0, folds = _memo("ell", lambda: device_ell(pg))
+        v = pg.num_vertices
+        state = multi_segment_init(v, [0, 1, 2, 3], True)
+        args = (ell0, folds, state, jnp.int32(8))
+        fn = _bfs_multi_pull_segment
+    else:
+        from ..graph.csr import build_device_graph
+        from ..models.multisource import _bfs_multi_segment
+
+        dg = _memo("dg", lambda: build_device_graph(_tiny_graph()))
+        v = dg.num_vertices
+        state = multi_segment_init(v, [0, 1, 2, 3], True)
+        args = (
+            jnp.asarray(dg.src), jnp.asarray(dg.dst), state, jnp.int32(8)
+        )
+        fn = _bfs_multi_segment
+    return Program(
+        name=f"multisource.segment_{engine}",
+        path="bfs_tpu/models/multisource.py",
+        fn=fn, args=args,
+        static_kwargs=dict(num_vertices=v, max_levels=v, packed=True),
+        v_elements=v, packed=True, donate={2: "state"},
+        budget_bytes=_hbm_envelope(),
+    )
+
+
+def _spec_sharded_relay_segment():
+    """The bounded-segment sharded relay program (ISSUE 14): per-shard
+    checkpoint shards cut at the exchange boundary — same collective
+    contract as sharded.relay_push (IR005/IR006 police the exchange)."""
+    from ..parallel.sharded import make_mesh
+
+    _need_devices(2)
+    import jax.numpy as jnp
+
+    from ..ops.packed import packed_rank_fits, resolve_packed
+    from ..parallel.sharded import (
+        _bfs_sharded_relay_segment,
+        _own_word_table_dev,
+        _prepare_relay,
+        _relay_valid_words,
+        _sharded_adj_dev,
+        _sharded_relay_mask_args,
+        _sharded_relay_static,
+        sharded_segment_carry,
+    )
+
+    mesh = _memo("mesh2", lambda: make_mesh(graph=2, batch=1))
+    srg = _memo("srg2", lambda: _prepare_relay(_tiny_graph(), mesh))
+    packed = resolve_packed(packed_rank_fits(srg.in_classes))
+    vperm_arg, net_arg = _sharded_relay_mask_args(srg, False)
+    static = _sharded_relay_static(srg, 2, False, packed)
+    adj = _sharded_adj_dev(srg, packed)
+    outdeg = jnp.asarray(srg.outdeg)
+    direction = ("auto", 14.0, 24.0, srg.num_vertices, srg.num_edges)
+    carry = sharded_segment_carry(
+        srg, 2, int(srg.old2new[0]), packed, True, True, outdeg
+    )
+    return Program(
+        name="sharded.relay_segment", path="bfs_tpu/parallel/sharded.py",
+        fn=_bfs_sharded_relay_segment,
+        args=(
+            carry, jnp.int32(8), vperm_arg, net_arg,
+            _relay_valid_words(srg), _own_word_table_dev(srg), *adj,
+            outdeg,
+        ),
+        static_kwargs=dict(
+            mesh=mesh, static=static, max_levels=16, telemetry=True,
+            direction=direction, exchange=("auto", 8), sparse=True,
+        ),
+        v_elements=srg.num_vertices, packed=packed,
+        budget_bytes=_hbm_envelope(),
+        mesh_axes=frozenset({"graph", "batch"}),
+        required_axes=frozenset({"graph"}),
+    )
+
+
 def _spec_superstep(engine: str):
     def build():
         from ..models.bfs import SuperstepRunner
@@ -706,6 +823,10 @@ PROGRAM_SPECS = {
     "relay.multi_fused": _spec_relay_multi_fused,
     "relay.step_dense": lambda: _spec_relay_step("dense"),
     "relay.step_sparse": lambda: _spec_relay_step("sparse"),
+    "relay.segment": _spec_relay_segment,
+    "multisource.segment_push": lambda: _spec_multi_segment("push"),
+    "multisource.segment_pull": lambda: _spec_multi_segment("pull"),
+    "sharded.relay_segment": _spec_sharded_relay_segment,
     "superstep.push_step": lambda: _spec_superstep("push"),
     "superstep.pull_step": lambda: _spec_superstep("pull"),
     "sharded.push_fused": _spec_sharded_push,

@@ -2107,6 +2107,75 @@ def main():
                 "superstep_phases": layout_detail["superstep_phases"],
             })
 
+    # Superstep-granular checkpoint overhead (ISSUE 14): with BFS_TPU_CKPT
+    # enabled, one UNTIMED segmented-with-checkpoints run is measured next
+    # to one fused run and the manager's report ships as
+    # details.superstep_ckpt — the capture carries the checkpoint cost
+    # (snapshot seconds/bytes, resolved interval, overhead ratio) next to
+    # the headline, so no capture hides it.  Epochs land in the journal's
+    # sidecar directory, content-keyed by the bench config like every
+    # other capture.  Off (the default) leaves the capture and every
+    # timed program byte-identical to the pre-ISSUE-14 bench.
+    if engine == "relay":
+        from .resilience.superstep_ckpt import resolve_ckpt
+
+        _ckpt_cfg = resolve_ckpt()
+        if _ckpt_cfg.enabled:
+            ck_rec = jr.get("superstep_ckpt") if jr is not None else None
+            if ck_rec is not None:
+                layout_detail["superstep_ckpt"] = ck_rec["superstep_ckpt"]
+                _stamp("journal: superstep checkpoint overhead restored")
+            else:
+                from .resilience.superstep_ckpt import SuperstepCheckpointer
+
+                _stamp(
+                    "superstep checkpoint overhead "
+                    f"(segmented run, {_ckpt_cfg.mode})..."
+                )
+                mgr = SuperstepCheckpointer(
+                    os.path.dirname(jr.path) if jr is not None else _CACHE_DIR,
+                    {
+                        "bench": graph_key, "engine": engine,
+                        "source": int(source),
+                        "direction": eng.direction.key(),
+                    },
+                    cfg=_ckpt_cfg,
+                )
+                with obs_span("bench.superstep_ckpt"):
+                    t0 = time.perf_counter()
+                    # eng.run, not run_one: the single-root path carries
+                    # the packed-truncation detect-and-rerun fallback,
+                    # so on a >62-level graph both arms compare FULL
+                    # traversals (run_many_device returns the truncated
+                    # packed state by contract).
+                    off_res = eng.run(source)
+                    fused_s = time.perf_counter() - t0
+                    t0 = time.perf_counter()
+                    seg_res = eng.run_segmented(source, ckpt=mgr)
+                    seg_s = time.perf_counter() - t0
+                detail = {
+                    **mgr.report(),
+                    "fused_seconds": fused_s,
+                    "segmented_seconds": seg_s,
+                    "overhead_ratio": (
+                        seg_s / fused_s if fused_s > 0 else None
+                    ),
+                    # The segment contract, checked in-capture: the
+                    # segmented run's result is bit-identical to the
+                    # fused program's.
+                    "bit_identical": bool(
+                        np.array_equal(seg_res.dist, off_res.dist)
+                        and np.array_equal(seg_res.parent, off_res.parent)
+                    ),
+                }
+                layout_detail["superstep_ckpt"] = detail
+                ratio = detail["overhead_ratio"]
+                _stamp(
+                    "superstep checkpoint overhead done "
+                    + (f"(x{ratio:.2f} vs fused)" if ratio else "")
+                )
+                _boundary(jr, "superstep_ckpt", {"superstep_ckpt": detail})
+
     # Device level curve (ISSUE 6 tentpole b): one UNTIMED fused search
     # carrying the obs/telemetry accumulator as extra while_loop state —
     # per-level frontier occupancy (+ out-edges on relay), pulled once at

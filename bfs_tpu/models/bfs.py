@@ -758,6 +758,151 @@ def _relay_fused_program(static, sparse: bool, use_pallas: bool,
     return fused
 
 
+@functools.lru_cache(maxsize=16)
+def _relay_segment_program(static, sparse: bool, use_pallas: bool,
+                           packed: bool = False, telemetry: bool = False,
+                           direction: tuple | None = None,
+                           phase_sel: tuple | None = None,
+                           num_real: int | None = None):
+    """ONE bounded segment of the relay loop (ISSUE 14) — the
+    checkpointable twin of :func:`_relay_fused_program`.
+
+    The carry is a dict of every loop-state leaf: the packed state word
+    (or dist/parent), the frontier words, the direction hysteresis pair
+    ``(mu, prev)`` in auto mode, and the telemetry accumulators — so a
+    snapshot of the carry at a segment boundary IS a complete resume
+    point, and a resumed run replays the direction schedule
+    bit-identically (the hysteresis state travels with the checkpoint).
+    ``seg_end`` is a TRACED operand: advancing it costs no retrace.
+
+    Body dispatch is per-superstep (one ``lax.cond`` on the same
+    predicates the fused program's nested-while / auto structures
+    evaluate), so a sequence of segments runs EXACTLY the superstep
+    bodies the fused program would, in the same order — results, the
+    schedule and the telemetry curves are bit-identical for any
+    segmentation (tests/test_superstep_ckpt.py pins this against the
+    fused program).  The input carry is DONATED (consumed per segment;
+    callers reassign), halving the segment call's peak state HBM
+    (IR001).  This is a NEW lint-registered program; the fused off-arm
+    programs are untouched (``BFS_TPU_CKPT=off`` byte-identity)."""
+    (vr, vperm_size, vperm_table, out_classes, out_space, net_table,
+     net_size, in_classes) = static
+    from ..ops import relay as R
+    from ..ops.packed import packed_cap
+
+    superstep = _superstep_fn(static, use_pallas, packed, phase_sel)
+    mode = direction[0] if direction is not None else None
+    dir_alpha = float(direction[1]) if direction is not None else 0.0
+    dir_beta = float(direction[2]) if direction is not None else 0.0
+    if mode == "pull" or (mode in ("auto", "push") and not sparse):
+        # Same normalization as the fused program: no sparse operands
+        # means the dense relay is the only body.
+        sparse = False
+        mode = "pull"
+    v_thresh = vr if num_real is None else num_real
+
+    @functools.partial(
+        jax.jit, static_argnames=("max_levels",), donate_argnums=(0,)
+    )
+    @traced("bfs.relay_segment")
+    def segment(carry, seg_end, vperm_masks, net_masks, valid_words,
+                adj_indptr, adj_dst, adj_slot, outdeg, max_levels):
+        cap = packed_cap(max_levels) if packed else max_levels
+        if telemetry:
+            from ..obs import telemetry as T
+
+        def live(c):
+            return (
+                c["changed"] & (c["level"] < cap) & (c["level"] < seg_end)
+            )
+
+        def mk_state(c):
+            if packed:
+                return R.PackedRelayState(
+                    c["pk"], c["fw"], c["level"], c["changed"]
+                )
+            return R.RelayState(
+                c["dist"], c["parent"], c["fw"], c["level"], c["changed"]
+            )
+
+        def dense(st):
+            return superstep(st, vperm_masks, net_masks, valid_words)
+
+        def sparse_step(st):
+            return _sparse_superstep(
+                st, adj_indptr, adj_dst, adj_slot, vr=vr, packed=packed
+            )
+
+        def body(c):
+            st = mk_state(c)
+            use_pull = None
+            if mode == "auto":
+                from .direction import take_pull
+
+                fsize, fe = _frontier_masses_words(st, outdeg, vr)
+                m_u = jnp.maximum(c["mu"] - fe, 0.0)
+                bv, be = sparse_budgets(vr, adj_dst.shape[0])
+                budget_ok = (fsize <= bv) & (fe <= jnp.float32(be))
+                use_pull = (
+                    take_pull(
+                        c["prev"], fsize, fe, m_u, v_thresh, dir_alpha,
+                        dir_beta,
+                    )
+                    | ~budget_ok
+                )
+            elif sparse:
+                # The legacy hybrid's dispatch, per superstep: sparse
+                # exactly when the fused nested-while's ``small()``
+                # predicate holds — identical body sequence.
+                use_pull = ~_take_sparse(st, outdeg, vr, adj_dst.shape[0])
+            if use_pull is None:
+                st2 = dense(st)
+            else:
+                st2 = jax.lax.cond(use_pull, dense, sparse_step, st)
+            out = dict(c)
+            if packed:
+                out["pk"] = st2.packed
+            else:
+                out["dist"], out["parent"] = st2.dist, st2.parent
+            out["fw"] = st2.fwords
+            out["level"] = st2.level
+            out["changed"] = st2.changed
+            if mode == "auto":
+                out["mu"] = m_u
+                out["prev"] = use_pull
+            if telemetry:
+                out["occ"] = T.record_frontier_words(
+                    c["occ"], st2.fwords, st2.level
+                )
+                if use_pull is None:
+                    code = jnp.int32(T.DIR_PULL)
+                else:
+                    code = jnp.where(
+                        use_pull, jnp.int32(T.DIR_PULL),
+                        jnp.int32(T.DIR_PUSH),
+                    )
+                out["dirs"] = T.record_direction(c["dirs"], st2.level, code)
+            return out
+
+        return jax.lax.while_loop(live, body, carry)
+
+    return segment
+
+
+@functools.lru_cache(maxsize=16)
+def _relay_segment_finish_program(in_classes: tuple, vr: int):
+    """Jitted once-per-run unpack for the segmented runner's TRUE loop
+    exit (module-level cache — a per-call jit would retrace, RCD001)."""
+    from ..ops import relay as R
+
+    @jax.jit
+    def fin(pk, fw, lv, ch):
+        dist, parent = R.unpack_relay_packed(pk, in_classes, vr)
+        return R.RelayState(dist, parent, fw, lv, ch)
+
+    return fin
+
+
 @functools.lru_cache(maxsize=8)
 def _relay_elem_program(static, pt: int, groups: int, use_pallas: bool):
     """Element-major batched multi-source loop: 32 trees per uint32 element,
@@ -1975,6 +2120,201 @@ class RelayEngine:
             beta=self.direction.beta,
         )
         return curve
+
+    def segment_keys(self, packed: bool, telemetry: bool) -> list[str]:
+        """The segment carry's key set for one flavor — the ONE
+        definition :meth:`segment_carry` builds from and the restore
+        gate validates against (an epoch lacking any of these cannot
+        resume this flavor)."""
+        keys = (["pk"] if packed else ["dist", "parent"]) + [
+            "fw", "level", "changed",
+        ]
+        if self.direction.mode == "auto" and self.sparse_hybrid:
+            keys += ["mu", "prev"]
+        if telemetry:
+            keys += ["occ", "dirs"]
+        return keys
+
+    def segment_carry(self, source: int, *, packed: bool | None = None,
+                      telemetry: bool = False,
+                      restore: dict | None = None) -> dict:
+        """Initial (or checkpoint-restored) carry for the segment program
+        (:func:`_relay_segment_program`): every loop-state leaf, incl.
+        the direction hysteresis pair in auto mode and the telemetry
+        accumulators — the carry IS the checkpoint.  ``restore`` maps
+        carry keys to host arrays from an epoch; metadata keys are
+        ignored."""
+        from ..ops import relay as Rops
+
+        if packed is None:
+            packed = self.packed
+        rg = self.relay_graph
+        auto = self.direction.mode == "auto" and self.sparse_hybrid
+        keys = self.segment_keys(packed, telemetry)
+        if restore is not None:
+            return {k: jnp.asarray(restore[k]) for k in keys}
+        check_sources(rg.num_vertices, source)
+        sn = jnp.int32(int(rg.old2new[source]))
+        if packed:
+            st = Rops.init_packed_relay_state(rg.vr, sn)
+            carry = {"pk": st.packed}
+        else:
+            st = Rops.init_relay_state(rg.vr, sn)
+            carry = {"dist": st.dist, "parent": st.parent}
+        carry.update(fw=st.fwords, level=st.level, changed=st.changed)
+        if auto:
+            # Same unexplored-mass seed the fused auto program computes
+            # (float32 sum of per-vertex integer out-degrees — exact
+            # below 2^24 edges, the mass-parity contract of
+            # models/direction.frontier_masses_words).
+            carry["mu"] = self._sparse_tensors[3].astype(jnp.float32).sum()
+            carry["prev"] = jnp.bool_(False)
+        if telemetry:
+            from ..obs import telemetry as T
+
+            carry["occ"] = T.init_level_acc()
+            carry["dirs"] = T.init_dir_acc()
+        return carry
+
+    def _segment_call(self, prog, carry, seg_end, tensors, max_levels):
+        """One segment-program call, AOT-compiled with the scoped-vmem
+        options on the pallas path (mirrors :meth:`_fused`)."""
+        if not self._use_pallas():
+            return prog(carry, seg_end, *tensors, max_levels=max_levels)
+        key = ("segment", max_levels, tuple(sorted(carry)))
+        compiled = self._compiled.get(key)
+        if compiled is None:
+            compiled = self._compile_maybe_cached(
+                prog.lower(carry, seg_end, *tensors, max_levels=max_levels)
+            )
+            self._compiled[key] = compiled
+        return compiled(carry, seg_end, *tensors)
+
+    def _run_segmented_flavor(self, source: int, ckpt, max_levels: int,
+                              packed: bool, telemetry: bool):
+        """Drive one carry flavor through bounded segments with per-epoch
+        checkpoints; returns ``(host RelayState, curve|None)``."""
+        import time as _time
+
+        from ..ops import relay as Rops
+        from ..ops.packed import PACKED_MAX_LEVELS, packed_cap
+
+        rg = self.relay_graph
+        prog = _relay_segment_program(
+            self._static, self.sparse_hybrid, self._use_pallas(), packed,
+            telemetry, self.direction.key(), self._phase_sel(),
+            rg.num_vertices,
+        )
+        tensors = (*self._tensors, *self._sparse_tensors_for(packed))
+        cap = packed_cap(max_levels) if packed else max_levels
+        from ..resilience.superstep_ckpt import restore_arrays
+
+        arrays, _shards = restore_arrays(
+            ckpt, packed, require=tuple(self.segment_keys(packed, telemetry))
+        )
+        carry = self.segment_carry(
+            source, packed=packed, telemetry=telemetry,
+            restore=arrays,
+        )
+        level, changed = jax.device_get((carry["level"], carry["changed"]))
+        while bool(changed) and int(level) < cap:
+            seg_end = jax.device_put(
+                np.int32(min(int(level) + ckpt.interval(), cap))
+            )
+            t0 = _time.perf_counter()
+            carry = self._segment_call(
+                prog, carry, seg_end, tensors, max_levels
+            )
+            new_level, changed = jax.device_get(
+                (carry["level"], carry["changed"])
+            )
+            seg_s = _time.perf_counter() - t0
+            # A disabled store still marks the fault boundary but must
+            # not pay the O(V) device->host carry pull per segment.
+            snap = {}
+            if ckpt.enabled:
+                snap = {k: np.asarray(v) for k, v in
+                        jax.device_get(carry).items()}
+                snap["packed_flag"] = np.int32(packed)
+            ckpt.save_epoch(int(new_level), snap)
+            ckpt.note_segment(int(new_level) - int(level), seg_s)
+            level = new_level
+        # The ONCE-PER-RUN unpack, at the TRUE end — intermediate epochs
+        # stay the raw packed carry (V/2 state bytes per snapshot).
+        if packed:
+            state_dev = _relay_segment_finish_program(
+                tuple(rg.in_classes), rg.vr
+            )(carry["pk"], carry["fw"], carry["level"], carry["changed"])
+        else:
+            state_dev = Rops.RelayState(
+                carry["dist"], carry["parent"], carry["fw"],
+                carry["level"], carry["changed"],
+            )
+        curve = None
+        if telemetry:
+            from ..obs.telemetry import (
+                direction_schedule,
+                edge_curve_from_levels,
+                level_curve,
+                read_telemetry,
+            )
+
+            fe_key = ("segment_edge_curve",)
+            fe_fn = self._compiled.get(fe_key)
+            if fe_fn is None:
+                fe_fn = jax.jit(edge_curve_from_levels)
+                self._compiled[fe_key] = fe_fn
+            fe_dev = fe_fn(
+                state_dev.dist, self._sparse_tensors[3],
+                state_dev.dist == INT32_MAX,
+            )
+            fv, fe, dirs = read_telemetry(
+                (carry["occ"], fe_dev, carry["dirs"])
+            )
+            curve_cap = (
+                min(PACKED_MAX_LEVELS, max_levels) if packed else max_levels
+            )
+            curve = level_curve(fv, fe, cap=curve_cap)
+            curve["direction_schedule"] = direction_schedule(
+                dirs, mode=self.direction.mode, alpha=self.direction.alpha,
+                beta=self.direction.beta,
+            )
+        return jax.device_get(state_dev), curve
+
+    def run_segmented(self, source: int = 0, *, ckpt,
+                      max_levels: int | None = None,
+                      telemetry: bool = False):
+        """Segmented-with-checkpoints single-source BFS (ISSUE 14): the
+        resumable twin of :meth:`run` — bit-identical dist/parent and
+        (with ``telemetry``) direction schedule for any segmentation,
+        resumable mid-traversal from ``ckpt``'s newest valid epoch.
+        Returns a BfsResult, or ``(BfsResult, curve)`` with telemetry.
+        Epochs are cleared on completion (a finished traversal's
+        checkpoints are dead weight; resume is for killed runs)."""
+        from ..ops.packed import packed_truncated
+
+        rg = self.relay_graph
+        check_sources(rg.num_vertices, source)
+        max_levels = int(max_levels) if max_levels is not None else rg.vr
+        packed = self.packed
+        state, curve = self._run_segmented_flavor(
+            source, ckpt, max_levels, packed, telemetry
+        )
+        if packed and packed_truncated(
+            state.changed, state.level, max_levels
+        ):
+            # Deeper than the packed level field: same detect-and-rerun
+            # contract as run(); packed epochs cannot feed the unpacked
+            # re-run, so the store is cleared first.
+            ckpt.clear()
+            state, curve = self._run_segmented_flavor(
+                source, ckpt, max_levels, False, telemetry
+            )
+        ckpt.clear()
+        result = self._to_result(state, source)
+        if telemetry:
+            return result, curve
+        return result
 
     def run_many_device(self, sources, *, max_levels: int | None = None):
         """Graph500-style batched timing path: dispatch one fused BFS per

@@ -142,6 +142,110 @@ def _bfs_multi_pull_fused(
     return jax.lax.while_loop(cond, body, state)
 
 
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_vertices", "max_levels", "packed"),
+    donate_argnums=(2,),
+)
+@traced("multisource._bfs_multi_segment")
+def _bfs_multi_segment(
+    src, dst, state, seg_end, num_vertices: int, max_levels: int,
+    packed: bool = False,
+):
+    """ONE bounded segment of the batched push loop (ISSUE 14): the same
+    superstep body as :func:`_bfs_multi_fused`, stopped at ``seg_end``
+    supersteps (a traced operand — no retrace per segment) so the caller
+    can snapshot the carry at the boundary and resume bit-identically.
+    The carry is donated: a stepped segment consumes its input state
+    (callers reassign), so XLA reuses the buffers instead of doubling
+    the [S, V] state HBM per segment (IR001).  Unlike the fused program
+    this returns the RAW carry — the once-per-run unpack happens at the
+    true end (:func:`multi_segment_finish`), never at a segment
+    boundary."""
+    from ..ops.packed import packed_cap
+    from ..ops.relax import relax_superstep_batched_packed
+
+    cap = packed_cap(max_levels) if packed else max_levels
+
+    def cond(s):
+        return s.changed & (s.level < cap) & (s.level < seg_end)
+
+    if packed:
+        return jax.lax.while_loop(
+            cond, lambda s: relax_superstep_batched_packed(s, src, dst),
+            state,
+        )
+    return jax.lax.while_loop(
+        cond, lambda s: relax_superstep_batched(s, src, dst), state
+    )
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_vertices", "max_levels", "packed"),
+    donate_argnums=(2,),
+)
+@traced("multisource._bfs_multi_pull_segment")
+def _bfs_multi_pull_segment(
+    ell0, folds, state, seg_end, num_vertices: int, max_levels: int,
+    packed: bool = False,
+):
+    """Pull-engine twin of :func:`_bfs_multi_segment` (the serve batch
+    path's segment program)."""
+    from ..ops.packed import packed_cap
+    from ..ops.pull import relax_pull_superstep_packed
+
+    cap = packed_cap(max_levels) if packed else max_levels
+
+    def cond(s):
+        return s.changed & (s.level < cap) & (s.level < seg_end)
+
+    if packed:
+        return jax.lax.while_loop(
+            cond, lambda s: relax_pull_superstep_packed(s, ell0, folds),
+            state,
+        )
+    return jax.lax.while_loop(
+        cond, lambda s: relax_pull_superstep(s, ell0, folds), state
+    )
+
+
+def multi_segment_init(
+    num_vertices: int, sources, packed: bool, restore: dict | None = None,
+):
+    """The segment loop's initial carry: a fresh batched state, or one
+    rebuilt from a checkpoint epoch's host arrays (``restore`` maps state
+    field names to np arrays; extra keys — checkpoint metadata — are
+    ignored)."""
+    from ..ops.relax import (
+        PackedBfsState,
+        init_packed_batched_state,
+    )
+
+    if restore is not None:
+        cls = PackedBfsState if packed else BfsState
+        return cls(**{
+            f: jnp.asarray(restore[f]) for f in cls._fields
+        })
+    if packed:
+        return init_packed_batched_state(
+            num_vertices, jnp.asarray(np.asarray(sources, np.int32))
+        )
+    return init_batched_state(
+        num_vertices, jnp.asarray(np.asarray(sources, np.int32))
+    )
+
+
+def multi_segment_finish(state, packed: bool) -> BfsState:
+    """The ONCE-PER-RUN unpack at true loop exit (the fused programs do
+    this inside the loop program; the segmented path defers it past the
+    last segment so every intermediate snapshot stays the raw packed
+    carry — V/2 state bytes per epoch)."""
+    from ..ops.relax import unpack_bfs_state
+
+    return unpack_bfs_state(state) if packed else state
+
+
 @dataclass
 class MultiBfsResult:
     """Per-source BFS trees: ``dist``/``parent`` are int32[S, V]."""

@@ -43,6 +43,9 @@ __all__ = [
     "unpack_relay_packed",
     "relay_superstep_words",
     "relay_superstep_words_packed",
+    "segment_live",
+    "relay_segment_words",
+    "relay_segment_words_packed",
 ]
 
 
@@ -475,6 +478,43 @@ def relay_superstep_words(
     l1 = apply_benes_std(l2, net_masks, net_table, net_size)
     cand = rowmin_candidates(l1, valid_words, in_classes, vr)
     return apply_relay_candidates(state, cand)
+
+
+def segment_live(state, cap, seg_end):
+    """THE segment-loop predicate (ISSUE 14): the fused predicate
+    ``changed & level < cap`` plus the segment bound — a TRACED operand,
+    so advancing ``seg_end`` never retraces.  Shared by the reference
+    segment runners below and (structurally) by every segment program in
+    models/ and parallel/: a segment boundary changes where the loop
+    pauses, never what it computes."""
+    return state.changed & (state.level < cap) & (state.level < seg_end)
+
+
+# bfs_tpu: hot traced
+def relay_segment_words(state: RelayState, seg_end, *, cap: int, **layout):
+    """ONE bounded segment of unpacked relay supersteps — the XLA
+    reference segment runner: :func:`relay_superstep_words` iterated
+    until convergence, the level cap, or ``seg_end``, whichever first.
+    Running segments of any size back-to-back is bit-identical to one
+    fused loop (the parity proof the segmented engine programs lean on;
+    tests/test_superstep_ckpt.py pins it)."""
+    return jax.lax.while_loop(
+        lambda s: segment_live(s, cap, seg_end),
+        lambda s: relay_superstep_words(s, **layout),
+        state,
+    )
+
+
+# bfs_tpu: hot traced
+def relay_segment_words_packed(
+    state: PackedRelayState, seg_end, *, cap: int, **layout
+):
+    """Packed twin of :func:`relay_segment_words`."""
+    return jax.lax.while_loop(
+        lambda s: segment_live(s, cap, seg_end),
+        lambda s: relay_superstep_words_packed(s, **layout),
+        state,
+    )
 
 
 # bfs_tpu: hot traced

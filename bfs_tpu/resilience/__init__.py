@@ -20,8 +20,17 @@ reproduction's equivalent, split by failure mode:
     with jitter and a transient/permanent error classifier; the serving
     layer retries transient device errors before degrading to the
     sequential oracle, and the bench retries engine init/compile.
+  * :mod:`~bfs_tpu.resilience.superstep_ckpt` — superstep-granular
+    checkpoint/restore (ISSUE 14): fused traversals run as bounded
+    segments whose full loop carry is snapshotted per epoch, so a kill
+    40 supersteps into a deep search resumes mid-traversal
+    bit-identically instead of restarting (``BFS_TPU_CKPT``).
 """
 
+# superstep_ckpt is NOT re-exported here: this package must stay
+# importable under the no-jax lint stub (obs tooling reads journals
+# through it), and the checkpoint store pulls in utils.checkpoint.
+# Import bfs_tpu.resilience.superstep_ckpt directly.
 from .faults import FaultInjected, corrupt_file, fault_point, fault_spec
 from .journal import RunJournal, config_key
 from .retry import (

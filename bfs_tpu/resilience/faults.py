@@ -36,6 +36,16 @@ wedges the tick and ``raise:serve.batch`` fails it permanently), and
 ``raise`` is interpreted as a FAILED verdict — the injected-corruption
 shape that exercises executable quarantine).
 
+The SUPERSTEP family (ISSUE 14): segmented traversals
+(resilience/superstep_ckpt.py) mark ``superstep:<level>`` right after
+each segment's checkpoint epoch lands durably, so
+``kill:superstep:<n>`` / ``raise:superstep:<n>`` dies at the n-th
+segment boundary of a mid-flight traversal — the chaos-traversal
+driver's kill point (``tools/chaos_run.py --mode traversal``).  The
+serve twin is ``serve.segment``, fired between segments of a
+checkpointing batch tick (``delay:serve.segment:s`` is a wedged
+mid-traversal dispatch the hung-call resume loop must survive).
+
 The corruption injectors simulate the non-crash failure modes the journal
 and checkpoint layers must reject: truncation (a torn write) and byte
 flips (bit rot / a torn page).  They are plain file edits so tests and

@@ -305,13 +305,29 @@ class RunJournal:
 
     # ---------------------------------------------------------------- reads --
     def get(self, phase: str) -> Any | None:
-        """Payload of a completed phase, or None.  A record whose sidecar
-        arrays are missing/corrupt reads as NOT completed (the phase
-        re-runs — corruption costs time, never correctness)."""
+        """Payload of a completed phase, or None.
+
+        Sidecar integrity (ISSUE 14 satellite): a record whose sidecar
+        ``.npz`` is DAMAGED (present but truncated/bit-flipped —
+        ``load_npz_strict`` rejects it) ROTATES the whole journal aside
+        and starts fresh: the index row is intact but the payload it
+        vouches for is gone, and later phases that consumed those arrays
+        (timed repeats measured against the reference mask, resume
+        carries) can no longer be proven consistent — replaying them
+        against a re-derived sidecar could blend two runs into one
+        capture.  A MISSING sidecar file keeps the old semantics (the
+        phase alone reads as not-completed and re-runs): absence is an
+        incomplete write, not corruption.  Either way: corruption costs
+        time, never correctness."""
         rec = self._records.get(phase)
         if rec is None:
             return None
         if rec.get("arrays") and self.load_arrays(phase) is None:
+            sidecar = os.path.join(
+                os.path.dirname(self.path), rec["arrays"]
+            )
+            if os.path.exists(sidecar):
+                self.restart(f"corrupt sidecar for phase {phase!r}")
             return None
         return rec["payload"]
 

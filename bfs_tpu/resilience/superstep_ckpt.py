@@ -1,0 +1,709 @@
+"""Superstep-granular checkpoint/restore: mid-traversal resume (ISSUE 14).
+
+The bench journal (resilience/journal.py) resumes at *phase* granularity:
+a kill 40 supersteps into a deep traversal loses every superstep already
+executed, because the whole loop is one fused XLA program whose carry
+never leaves the device.  This module cuts the traversal at the natural
+consistency point distributed BFS already synchronizes on — the
+per-superstep frontier exchange (Compression-and-Sieve, arXiv 1208.5542;
+the same boundary PR 11's exchange protocol rides) — by running the fused
+programs as **bounded segments of K supersteps**:
+
+    carry = init(source)                      # or restore(epoch N)
+    while carry.changed and carry.level < cap:
+        carry = segment_program(carry, seg_end=level + K, ...)
+        snapshot(carry)                       # atomic .npz epoch
+        fault_point(f"superstep:{level}")     # the chaos boundary
+
+Segment programs are NEW compiled artifacts (lint-registered next to the
+fused ones); with ``BFS_TPU_CKPT=off`` (the default) every caller runs
+today's single-segment fused programs byte-for-byte — the off arm's
+IR/HLO fingerprints are unchanged.
+
+Bit-identity contract: a segment boundary changes WHERE the loop pauses,
+never what it computes — each superstep body is the same compiled math
+as the fused program's, dispatched in the same order (the direction
+hysteresis state ``(mu, prev)``, the telemetry accumulators and the
+exchange-arm history all RIDE THE CARRY and therefore the checkpoint),
+so a resumed run reproduces the killed run's final dist/parent, its
+``details.direction_schedule`` and its exchange-arm sequence exactly.
+``tools/chaos_run.py --mode traversal`` is the acceptance harness.
+
+Checkpoint interval: ``BFS_TPU_CKPT=every:<k>`` forces K supersteps per
+segment; ``auto`` sizes it Young/Daly-style from the measured superstep
+seconds and snapshot seconds (:func:`daly_interval` — the classic
+``T_opt = sqrt(2 * delta * MTBF)`` with ``BFS_TPU_CKPT_MTBF_S`` as the
+failure-rate prior), re-derived after every segment.  The measured
+overhead ships in every capture as ``details.superstep_ckpt``.
+
+Durability: epochs are written through
+:func:`bfs_tpu.utils.checkpoint.save_npz_atomic` into the journal's
+sidecar directory, content-keyed by the run config exactly like every
+other capture (``ckpt_<blake2b(config)>.epoch<N>.npz``); loads go
+through ``load_npz_strict`` — a truncated or bit-flipped epoch is
+SKIPPED (counted, warned) and the loader falls back to the previous
+epoch, and a run with every epoch damaged falls back to a clean fresh
+traversal (counters name the fallback; corruption costs time, never
+correctness).  Sharded runs write per-shard epoch shards at the exchange
+boundary plus one meta file; an epoch is complete only when the meta AND
+every shard validate, so losing one shard's file falls back to the last
+complete epoch — and because epochs are host arrays, the surviving epoch
+re-admits onto a freshly built mesh (the shard-loss recovery path the
+chaos driver exercises by corrupting a single shard file).
+"""
+
+from __future__ import annotations
+
+import glob
+import logging
+import math
+import os
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+# utils.checkpoint (and with it jax) is imported lazily inside the store
+# methods — journal.py's idiom — so resolve_ckpt()/CkptConfig stay
+# importable in no-jax contexts (the lint stub, config-only callers).
+from .faults import fault_point
+from .journal import config_key
+
+logger = logging.getLogger(__name__)
+
+#: The fault-family name of the segment boundary (resilience/faults.py):
+#: boundaries are ``superstep:<level>``, so ``BFS_TPU_FAULT=
+#: kill:superstep:<n>`` kills at the n-th segment boundary and
+#: ``raise:superstep:<n>`` raises there (family matching — the caller
+#: never needs to know which level the n-th boundary lands on).
+TRAVERSAL_BOUNDARY = "superstep"
+
+CKPT_MODES = ("off", "every", "auto")
+
+#: Default segment length the auto arm starts from (before any
+#: measurement exists) and the forced arm falls back to on a bare
+#: ``every:``.
+DEFAULT_K0 = 8
+
+#: Young/Daly failure-rate prior (seconds).  There is no failure
+#: telemetry to estimate a real MTBF from inside one process; this knob
+#: is the operator's statement of how often the environment kills runs
+#: (driver timeouts, preemptions).
+DEFAULT_MTBF_S = 600.0
+
+
+@dataclass(frozen=True)
+class CkptConfig:
+    """Resolved checkpoint policy — hashable, like DirectionConfig /
+    ExchangeConfig, so it can sit in journal configs and cache keys."""
+
+    mode: str = "off"
+    k: int = DEFAULT_K0
+
+    @property
+    def enabled(self) -> bool:
+        return self.mode != "off"
+
+    def key(self) -> tuple:
+        return (self.mode, int(self.k))
+
+
+def resolve_ckpt(spec: str | None = None) -> CkptConfig:
+    """Parse ``BFS_TPU_CKPT`` (or an explicit ``spec``, which wins):
+    ``off`` | ``every:<k>`` | ``auto``.  Unknown modes and non-positive
+    intervals raise — silently clamping a typo'd knob would change what a
+    capture measured (the resolve_direction contract)."""
+    if spec is None:
+        spec = os.environ.get("BFS_TPU_CKPT", "off") or "off"
+    spec = spec.strip()
+    mode, _, arg = spec.partition(":")
+    if mode not in CKPT_MODES:
+        raise ValueError(
+            f"unknown BFS_TPU_CKPT {spec!r}; use off | every:<k> | auto"
+        )
+    if mode == "every":
+        k = int(arg) if arg else DEFAULT_K0
+        if k < 1:
+            raise ValueError(
+                f"BFS_TPU_CKPT=every:<k> needs k >= 1 (got {k})"
+            )
+        return CkptConfig(mode="every", k=k)
+    if arg:
+        raise ValueError(
+            f"BFS_TPU_CKPT {spec!r}: only 'every' takes an argument"
+        )
+    return CkptConfig(mode=mode)
+
+
+def daly_interval(
+    superstep_s: float, snapshot_s: float, mtbf_s: float = DEFAULT_MTBF_S
+) -> int:
+    """Young/Daly checkpoint interval in SUPERSTEPS:
+    ``T_opt = sqrt(2 * delta * M)`` seconds between checkpoints (delta =
+    one snapshot's cost, M = mean time between failures), divided by the
+    measured per-superstep seconds and clamped to [1, 4096].  Monotone in
+    the ratio snapshot-cost : superstep-cost — cheap snapshots or slow
+    supersteps checkpoint often, the reverse rarely."""
+    superstep_s = max(float(superstep_s), 1e-9)
+    t_opt = math.sqrt(2.0 * max(float(snapshot_s), 1e-6) * float(mtbf_s))
+    return max(1, min(4096, int(round(t_opt / superstep_s))))
+
+
+class SuperstepCheckpointer:
+    """Epoch store + interval policy for one segmented traversal.
+
+    ``config`` is the run identity (graph hash / engine statics /
+    direction / packed / source ...): the file stem is
+    ``ckpt_<blake2b(config)>`` so two different run configurations can
+    never feed each other's epochs — content-keying, the way the journal
+    and the layout cache key everything else.  ``shards`` > 1 switches to
+    per-shard epoch files (meta + one file per shard; an epoch is
+    complete only when all validate).
+
+    A disabled checkpointer (mode ``off``) is a no-op store: callers may
+    still drive the segmented loop (tests do), nothing touches disk.
+    """
+
+    def __init__(
+        self,
+        directory: str | os.PathLike,
+        config: dict,
+        *,
+        cfg: CkptConfig | None = None,
+        shards: int = 1,
+        retain: int = 2,
+        mtbf_s: float | None = None,
+    ):
+        self.cfg = cfg if cfg is not None else resolve_ckpt()
+        self.directory = os.fspath(directory)
+        self.config = dict(config)
+        self.key = config_key(self.config)
+        self.stem = os.path.join(self.directory, f"ckpt_{self.key}")
+        self.shards = int(shards)
+        self.retain = max(2, int(retain))
+        self.mtbf_s = (
+            float(mtbf_s)
+            if mtbf_s is not None
+            else float(os.environ.get("BFS_TPU_CKPT_MTBF_S", DEFAULT_MTBF_S))
+        )
+        self._k = self.cfg.k if self.cfg.mode == "every" else DEFAULT_K0
+        # Measured economics (medians are overkill: both costs are
+        # smoothed with a simple running mean — the interval only needs
+        # the right order of magnitude).
+        self._superstep_s: float | None = None
+        self._snapshot_s: float | None = None
+        self.counters = {
+            "epochs_written": 0,
+            "segments": 0,
+            "epochs_corrupt_skipped": 0,
+            "fresh_fallbacks": 0,
+        }
+        self.snapshot_bytes = 0
+        self.snapshot_seconds = 0.0
+        self.resumed_from_epoch: int | None = None
+        if self.cfg.enabled:
+            os.makedirs(self.directory, exist_ok=True)
+
+    # ------------------------------------------------------------ interval --
+    @property
+    def enabled(self) -> bool:
+        return self.cfg.enabled
+
+    def interval(self) -> int:
+        """Current segment length in supersteps."""
+        return self._k
+
+    def note_segment(self, supersteps: int, seg_seconds: float) -> None:
+        """Feed one segment's measurement; in ``auto`` mode re-derive the
+        Young/Daly interval from the running means."""
+        self.counters["segments"] += 1
+        if supersteps > 0 and seg_seconds > 0:
+            per = seg_seconds / supersteps
+            self._superstep_s = (
+                per
+                if self._superstep_s is None
+                else 0.5 * (self._superstep_s + per)
+            )
+        if (
+            self.cfg.mode == "auto"
+            and self._superstep_s is not None
+            and self._snapshot_s is not None
+        ):
+            self._k = daly_interval(
+                self._superstep_s, self._snapshot_s, self.mtbf_s
+            )
+
+    # -------------------------------------------------------------- naming --
+    def _epoch_path(self, superstep: int, shard: int | None = None) -> str:
+        base = f"{self.stem}.epoch{int(superstep):06d}"
+        if shard is None:
+            return f"{base}.npz"
+        return f"{base}.shard{int(shard)}.npz"
+
+    def _meta_path(self, superstep: int) -> str:
+        return f"{self.stem}.epoch{int(superstep):06d}.meta.npz"
+
+    def epochs(self) -> list[int]:
+        """Superstep numbers of every epoch with at least one file on
+        disk, ascending."""
+        found = set()
+        for path in glob.glob(f"{self.stem}.epoch*.npz"):
+            tail = os.path.basename(path).split(".epoch", 1)[1]
+            digits = tail.split(".", 1)[0]
+            if digits.isdigit():
+                found.add(int(digits))
+        return sorted(found)
+
+    # --------------------------------------------------------------- writes --
+    def save_epoch(
+        self,
+        superstep: int,
+        arrays: dict[str, np.ndarray],
+        shard_arrays: list[dict[str, np.ndarray]] | None = None,
+    ) -> None:
+        """Write one durable epoch (atomic per file), prune past the
+        retention window, then mark the ``superstep:<n>`` fault boundary
+        — the kill point lands AFTER the epoch is durable, which is what
+        "boundary" means for resume semantics (same contract as the
+        bench's journal boundaries)."""
+        if not self.cfg.enabled:
+            fault_point(f"{TRAVERSAL_BOUNDARY}:{int(superstep)}")
+            return
+        from ..utils.checkpoint import save_npz_atomic
+
+        t0 = time.perf_counter()
+        meta = {
+            f"meta_{k}": np.asarray(v)
+            for k, v in (
+                ("config", self.key),
+                ("superstep", int(superstep)),
+                ("shards", self.shards),
+            )
+        }
+        nbytes = 0
+        if shard_arrays is None:
+            payload = {**arrays, **meta}
+            save_npz_atomic(self._epoch_path(superstep), **payload)
+            nbytes += sum(int(np.asarray(a).nbytes) for a in arrays.values())
+        else:
+            if len(shard_arrays) != self.shards:
+                raise ValueError(
+                    f"expected {self.shards} shard payloads, got "
+                    f"{len(shard_arrays)}"
+                )
+            # Meta file LAST: its presence marks "every shard landed", so
+            # a kill mid-epoch can never leave a meta pointing at missing
+            # shards (shard files without a meta are an incomplete epoch
+            # the loader skips).
+            for s, sa in enumerate(shard_arrays):
+                save_npz_atomic(self._epoch_path(superstep, s), **sa, **meta)
+                nbytes += sum(
+                    int(np.asarray(a).nbytes) for a in sa.values()
+                )
+            save_npz_atomic(self._meta_path(superstep), **arrays, **meta)
+            nbytes += sum(int(np.asarray(a).nbytes) for a in arrays.values())
+        dt = time.perf_counter() - t0
+        self.counters["epochs_written"] += 1
+        self.snapshot_bytes = nbytes
+        self.snapshot_seconds += dt
+        self._snapshot_s = (
+            dt if self._snapshot_s is None else 0.5 * (self._snapshot_s + dt)
+        )
+        self._prune()
+        fault_point(f"{TRAVERSAL_BOUNDARY}:{int(superstep)}")
+
+    def _epoch_files(self, ep: int) -> list[str]:
+        """Every file a given epoch may own.  Exact names, not a bare
+        ``epoch<N>*`` glob — on a >999999-level traversal the 6-digit
+        padding widens and a prefix glob for epoch 100000 would also
+        match epoch 1000000's files."""
+        return [
+            self._epoch_path(ep),
+            self._meta_path(ep),
+            *glob.glob(f"{self.stem}.epoch{int(ep):06d}.shard*.npz"),
+        ]
+
+    def _prune(self) -> None:
+        for ep in self.epochs()[: -self.retain]:
+            for path in self._epoch_files(ep):
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+
+    def clear(self) -> None:
+        """Delete every epoch (the traversal finished — its checkpoints
+        are dead weight, and a later run of the same config must start
+        fresh, not resume a finished carry)."""
+        for path in glob.glob(f"{self.stem}.epoch*.npz"):
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+    # ---------------------------------------------------------------- reads --
+    def _load_one(self, path: str) -> dict | None:
+        from ..utils.checkpoint import CheckpointError, load_npz_strict
+
+        try:
+            z = load_npz_strict(path)
+        except (CheckpointError, FileNotFoundError, OSError) as exc:
+            logger.warning("skipping damaged checkpoint %s (%r)", path, exc)
+            self.counters["epochs_corrupt_skipped"] += 1
+            return None
+        cfg = z.get("meta_config")
+        if cfg is None or str(cfg) != self.key:
+            logger.warning(
+                "skipping %s: written by a different run config", path
+            )
+            self.counters["epochs_corrupt_skipped"] += 1
+            return None
+        return z
+
+    def load_latest(self):
+        """``(superstep, arrays, shard_arrays)`` from the newest COMPLETE
+        valid epoch, or None (fresh traversal).  Damaged / foreign /
+        incomplete epochs are skipped newest-first — the corruption
+        matrix contract: a flipped byte in the newest epoch falls back to
+        the previous one, all epochs damaged falls back to a clean fresh
+        run (``fresh_fallbacks`` counts it — corruption is visible,
+        never silent)."""
+        if not self.cfg.enabled:
+            return None
+        had_any = False
+        for ep in reversed(self.epochs()):
+            had_any = True
+            if self.shards == 1:
+                z = self._load_one(self._epoch_path(ep))
+                if z is None:
+                    continue
+                arrays = {
+                    k: v for k, v in z.items() if not k.startswith("meta_")
+                }
+                self.resumed_from_epoch = ep
+                return ep, arrays, None
+            meta_path = self._meta_path(ep)
+            if not os.path.exists(meta_path):
+                # The NORMAL mid-epoch kill shape: meta is written LAST,
+                # so shard files without one are an incomplete epoch —
+                # expected wreckage, not corruption (no counter).
+                logger.info(
+                    "skipping incomplete epoch %d (no meta file)", ep
+                )
+                continue
+            meta = self._load_one(meta_path)
+            if meta is None:
+                continue
+            if int(meta.get("meta_shards", -1)) != self.shards:
+                logger.warning(
+                    "skipping epoch %d: shard count mismatch", ep
+                )
+                self.counters["epochs_corrupt_skipped"] += 1
+                continue
+            shard_arrays = []
+            ok = True
+            for s in range(self.shards):
+                z = self._load_one(self._epoch_path(ep, s))
+                if z is None:
+                    ok = False  # shard loss: this epoch is incomplete
+                    break
+                shard_arrays.append({
+                    k: v for k, v in z.items() if not k.startswith("meta_")
+                })
+            if not ok:
+                continue
+            arrays = {
+                k: v for k, v in meta.items() if not k.startswith("meta_")
+            }
+            self.resumed_from_epoch = ep
+            return ep, arrays, shard_arrays
+        if had_any:
+            self.counters["fresh_fallbacks"] += 1
+        return None
+
+    # --------------------------------------------------------------- report --
+    def report(self) -> dict:
+        """JSON-ready ``details.superstep_ckpt``: the policy, the
+        measured economics, and the fallback counters — every capture
+        carries the cost, none hides it."""
+        return {
+            "mode": self.cfg.mode,
+            "interval": int(self._k),
+            "shards": self.shards,
+            "superstep_seconds": self._superstep_s,
+            "snapshot_seconds_mean": self._snapshot_s,
+            "snapshot_seconds_total": self.snapshot_seconds,
+            "snapshot_bytes": int(self.snapshot_bytes),
+            "mtbf_s": self.mtbf_s,
+            "resumed_from_epoch": self.resumed_from_epoch,
+            **self.counters,
+        }
+
+
+# ---------------------------------------------------------------------------
+# Host drivers: the segmented loop over each engine family's segment
+# program.  Each drives DEVICE state through bounded segments, snapshots
+# the full carry per segment, and restores it on resume.  The engine- and
+# mesh-specific segment programs live next to their fused twins
+# (models/bfs.py, models/multisource.py, parallel/sharded.py).
+# ---------------------------------------------------------------------------
+
+def restore_arrays(ckpt: SuperstepCheckpointer, packed: bool,
+                   require: tuple = (), require_shards: tuple = ()):
+    """THE shared restore gate every disk-backed segmented driver uses:
+    ``(meta/carry arrays, shard arrays)`` of the newest valid epoch iff
+    it matches the requested carry flavor AND carries every key in
+    ``require``, else ``(None, None)`` — the flavor/key checks live in
+    ONE place so the relay / multisource / sharded drivers cannot
+    diverge on them.  The key check matters because the config key does
+    not encode every carry-shaping flag (telemetry on/off): an epoch
+    from a plainer drive of the same config must fall back to a fresh
+    traversal, never KeyError mid-restore (the "corruption costs time,
+    never correctness" contract).  ``resumed_from_epoch`` is reset on
+    ENTRY and only re-set by a successful load, so the report always
+    describes the flavor that actually produced the result — the
+    packed-truncation fallback (clear + fresh unpacked re-run) must not
+    keep advertising the packed arm's resume (the honesty signal the
+    chaos driver's silent-fresh-restart check relies on)."""
+    ckpt.resumed_from_epoch = None
+    found = ckpt.load_latest()
+    if found is None:
+        return None, None
+    _ep, arrays, shard_arrays = found
+    missing = [k for k in require if k not in arrays]
+    for sa in shard_arrays or ():
+        missing += [k for k in require_shards if k not in sa]
+    if (
+        int(np.asarray(arrays.get("packed_flag", -1))) != int(packed)
+        or missing
+    ):
+        if missing:
+            logger.warning(
+                "checkpoint epoch lacks carry keys %s; fresh traversal",
+                missing,
+            )
+        ckpt.resumed_from_epoch = None
+        return None, None
+    return arrays, shard_arrays
+
+def run_multi_segmented(
+    graph,
+    sources,
+    *,
+    ckpt: SuperstepCheckpointer,
+    engine: str = "push",
+    max_levels: int | None = None,
+    block: int = 1024,
+):
+    """Segmented batched multi-source BFS (push/pull engines): the
+    checkpointed twin of :func:`bfs_tpu.models.multisource.bfs_multi`,
+    bit-identical results for any segmentation.  Returns a
+    MultiBfsResult."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..graph.csr import build_device_graph
+    from ..graph.ell import build_pull_graph, device_ell
+    from ..models.bfs import check_sources
+    from ..models.multisource import (
+        MultiBfsResult,
+        _bfs_multi_pull_segment,
+        _bfs_multi_segment,
+        multi_segment_finish,
+        multi_segment_init,
+    )
+    from ..ops.packed import (
+        packed_cap,
+        packed_parent_fits,
+        packed_truncated,
+        resolve_packed,
+    )
+
+    sources = np.atleast_1d(np.asarray(sources, dtype=np.int32))
+    if engine == "pull":
+        pg = build_pull_graph(graph)
+        v = pg.num_vertices
+        ell0, folds = device_ell(pg)
+
+        def seg(state, seg_end, packed):
+            return _bfs_multi_pull_segment(
+                ell0, folds, state, seg_end, v, limit, packed
+            )
+    elif engine == "push":
+        dg = build_device_graph(graph, block=block)
+        v = dg.num_vertices
+        src_t, dst_t = jnp.asarray(dg.src), jnp.asarray(dg.dst)
+
+        def seg(state, seg_end, packed):
+            return _bfs_multi_segment(
+                src_t, dst_t, state, seg_end, v, limit, packed
+            )
+    else:
+        raise ValueError(f"unknown engine {engine!r}; use 'push' or 'pull'")
+    check_sources(v, sources)
+    limit = int(max_levels) if max_levels is not None else v
+
+    def run_flavor(packed: bool):
+        from ..ops.relax import PackedBfsState
+        from ..ops.relax import BfsState as _BfsState
+
+        cap = packed_cap(limit) if packed else limit
+        cls = PackedBfsState if packed else _BfsState
+        arrays, _shards = restore_arrays(ckpt, packed, require=cls._fields)
+        state = multi_segment_init(v, sources, packed, restore=arrays)
+        level, changed = jax.device_get((state.level, state.changed))
+        while bool(changed) and int(level) < cap:
+            k = ckpt.interval()
+            seg_end = jnp.int32(min(int(level) + k, cap))
+            t0 = time.perf_counter()
+            state = seg(state, seg_end, packed)
+            new_level, changed = jax.device_get(
+                (state.level, state.changed)
+            )
+            seg_s = time.perf_counter() - t0
+            # Disabled store: mark the boundary, skip the O(S*V) pull.
+            snap = {}
+            if ckpt.enabled:
+                snap = {
+                    k2: np.asarray(val)
+                    for k2, val in jax.device_get(state)._asdict().items()
+                }
+                snap["packed_flag"] = np.int32(packed)
+            ckpt.save_epoch(int(new_level), snap)
+            ckpt.note_segment(int(new_level) - int(level), seg_s)
+            level = new_level
+        return multi_segment_finish(state, packed), int(level), bool(changed)
+
+    packed = resolve_packed(packed_parent_fits(v))
+    state, level, changed = run_flavor(packed)
+    if packed and packed_truncated(changed, level, limit):
+        ckpt.clear()  # packed epochs cannot feed the unpacked re-run
+        state, level, changed = run_flavor(False)
+    ckpt.clear()
+    return MultiBfsResult(
+        sources=sources,
+        dist=np.asarray(state.dist[:, :v]),
+        parent=np.asarray(state.parent[:, :v]),
+        num_levels=int(level),
+    )
+
+
+# ---------------------------------------------------------------------------
+# CLI runner: the chaos-traversal subject process.
+#
+#   python -m bfs_tpu.resilience.superstep_ckpt \
+#       --config relay|multi|sharded --ckpt-dir D --out result.json
+#
+# Runs one traversal segmented-with-checkpoints and writes a result
+# document with dist/parent content hashes, the direction schedule, the
+# exchange-arm sequence (sharded) and the checkpoint report.  Under
+# ``BFS_TPU_FAULT=kill:superstep:<n>`` it dies at the n-th segment
+# boundary; re-invoking with the same --ckpt-dir resumes from the newest
+# valid epoch.  tools/chaos_run.py --mode traversal drives this and
+# diffs resumed vs golden.
+# ---------------------------------------------------------------------------
+
+def _hash(a: np.ndarray) -> str:
+    import hashlib
+
+    return hashlib.blake2b(
+        np.ascontiguousarray(a).tobytes(), digest_size=16
+    ).hexdigest()
+
+
+def _runner_main(argv=None) -> int:
+    import argparse
+    import json
+    import sys
+
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--config", required=True,
+                    choices=("relay", "multi", "sharded"))
+    ap.add_argument("--ckpt-dir", required=True)
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--scale", type=int, default=8)
+    ap.add_argument("--edge-factor", type=int, default=4)
+    ap.add_argument("--seed", type=int, default=3)
+    # Default 3, not 0: R-MAT leaves many low-id vertices in tiny
+    # components at toy scale, and a 1-level traversal has no interior
+    # boundary to chaos.
+    ap.add_argument("--source", type=int, default=3)
+    ap.add_argument("--interval", type=int, default=2,
+                    help="forced supersteps per segment (every:<k>)")
+    ap.add_argument("--shards", type=int, default=8,
+                    help="sharded config: mesh size over the graph axis")
+    args = ap.parse_args(argv)
+
+    # Virtual multi-device CPU platform for the sharded config, set
+    # before jax initializes (same contract as tests/conftest.py and the
+    # analysis CLI).
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+    from ..graph.generators import rmat_graph
+
+    graph = rmat_graph(args.scale, args.edge_factor, seed=args.seed)
+    cfg = CkptConfig(mode="every", k=args.interval)
+    base_config = {
+        "runner": args.config, "scale": args.scale,
+        "edge_factor": args.edge_factor, "seed": args.seed,
+        "source": args.source, "interval": args.interval,
+    }
+    doc: dict = {"config": args.config}
+
+    if args.config == "relay":
+        from ..models.bfs import RelayEngine
+
+        eng = RelayEngine(graph, sparse_hybrid=True, direction="auto")
+        ckpt = SuperstepCheckpointer(args.ckpt_dir, base_config, cfg=cfg)
+        result, curve = eng.run_segmented(
+            args.source, ckpt=ckpt, telemetry=True
+        )
+        doc.update(
+            dist_hash=_hash(result.dist), parent_hash=_hash(result.parent),
+            num_levels=result.num_levels,
+            direction_schedule=curve["direction_schedule"],
+        )
+    elif args.config == "multi":
+        ckpt = SuperstepCheckpointer(args.ckpt_dir, base_config, cfg=cfg)
+        v = graph.num_vertices
+        sources = [(args.source + 7 * i) % v for i in range(4)]
+        result = run_multi_segmented(
+            graph, sources, ckpt=ckpt, engine="push"
+        )
+        doc.update(
+            dist_hash=_hash(result.dist), parent_hash=_hash(result.parent),
+            num_levels=result.num_levels,
+        )
+    else:  # sharded
+        from ..parallel.sharded import bfs_sharded_segmented, make_mesh
+
+        mesh = make_mesh(graph=args.shards, batch=1)
+        ckpt = SuperstepCheckpointer(
+            args.ckpt_dir, base_config, cfg=cfg, shards=args.shards
+        )
+        result, curve = bfs_sharded_segmented(
+            graph, args.source, mesh=mesh, ckpt=ckpt,
+            direction="auto", exchange="auto", telemetry=True,
+        )
+        doc.update(
+            dist_hash=_hash(result.dist), parent_hash=_hash(result.parent),
+            num_levels=result.num_levels,
+            direction_schedule=curve["direction_schedule"],
+            exchange_schedule=curve["exchange"]["schedule"],
+            exchange_bytes=curve["exchange"]["bytes_per_level"],
+        )
+    doc["superstep_ckpt"] = ckpt.report()
+    with open(args.out, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+    print(json.dumps({"ok": True, **{k: doc[k] for k in ("config",)}}),
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover - subprocess entry
+    raise SystemExit(_runner_main())

@@ -103,6 +103,14 @@ class ExecutableCache:
         with self._lock:
             return key in self._cache
 
+    def peek(self, key: tuple):
+        """The cached runner (or None) WITHOUT LRU/counter side effects —
+        the hung-call resume path inspects the runner's checkpoint
+        progress after a watchdog timeout (ISSUE 14) without recording a
+        phantom hit."""
+        with self._lock:
+            return self._cache.get(key)
+
     def __len__(self) -> int:
         with self._lock:
             return len(self._cache)
@@ -144,6 +152,163 @@ def _state_to_result(state, sources: np.ndarray, num_vertices: int) -> MultiBfsR
         parent=parent,
         num_levels=int(levels),  # bfs_tpu: ok TRC002 levels is host-side after the pull above
     )
+
+
+class _Abandoned(RuntimeError):
+    """Raised inside a watchdog-abandoned attempt thread when a NEWER
+    attempt has taken over the traversal: the zombie must stop burning
+    device time, and its late snapshots must never clobber the live
+    attempt's progress."""
+
+
+class SegmentedBatchRunner:
+    """Resumable segmented batch runner (ISSUE 14 serve integration).
+
+    With ``BFS_TPU_CKPT`` enabled, the pull/push batch programs run as
+    bounded segments (models/multisource.py ``_bfs_multi_*_segment``)
+    with the full carry snapshotted to HOST arrays after every segment —
+    in-process checkpoint epochs.  A hung device call (watchdog
+    ``HungCallError``) abandons only the attempt THREAD, not the
+    process, so the next attempt for the same padded source batch — the
+    server's hung-call resume loop, or the breaker's half-open canary
+    re-submitting the same query — RESUMES from the newest epoch instead
+    of recomputing from the roots.  Results are bit-identical to the
+    fused runner for any segmentation (the segment programs' contract).
+
+    Thread safety: each attempt bumps a generation under the lock; a
+    watchdog-abandoned thread that wakes up later sees the stale
+    generation, aborts (``_Abandoned``) and never overwrites the live
+    attempt's progress.
+    """
+
+    resumable = True
+
+    def __init__(self, registry, name: str, engine: str, batch: int,
+                 epoch: int, num_vertices: int, want_packed: bool,
+                 interval: int, metrics=None):
+        self.registry = registry
+        self.name = name
+        self.engine = engine
+        self.batch = batch
+        self.epoch = epoch
+        self.v = num_vertices
+        self.want_packed = want_packed
+        self.interval = max(1, int(interval))
+        self.metrics = metrics
+        self._lock = make_lock("executor.SegmentedBatchRunner._lock")
+        self._gen = 0  # guarded-by: _lock
+        #: (batch key, packed flavor, host snapshot, level) — guarded-by: _lock
+        self._progress = None
+
+    def ckpt_progress(self):
+        """The resumable superstep (or None) — what the server's
+        hung-call loop checks to decide whether another attempt would
+        make progress rather than re-wedge from the same point."""
+        with self._lock:
+            return None if self._progress is None else self._progress[3]
+
+    def _bump(self, counter: str) -> None:
+        if self.metrics is not None:
+            self.metrics.bump(counter)
+
+    def _segment(self, state, seg_end, packed):
+        import jax.numpy as jnp
+
+        from ..models.multisource import (
+            _bfs_multi_pull_segment,
+            _bfs_multi_segment,
+        )
+
+        operands = self.registry.acquire_epoch(
+            self.name, self.epoch, self.engine
+        )
+        if self.engine == "pull":
+            ell0, folds = operands
+            return _bfs_multi_pull_segment(
+                ell0, folds, state, jnp.int32(seg_end), self.v, self.v,
+                packed,
+            )
+        src, dst = operands
+        return _bfs_multi_segment(
+            src, dst, state, jnp.int32(seg_end), self.v, self.v, packed
+        )
+
+    def _run_flavor(self, sources: np.ndarray, key: bytes, my_gen: int,
+                    packed: bool):
+        import jax
+
+        from ..models.multisource import (
+            multi_segment_finish,
+            multi_segment_init,
+        )
+        from ..ops.packed import packed_cap
+        from ..resilience.faults import fault_point
+
+        cap = packed_cap(self.v) if packed else self.v
+        state = None
+        with self._lock:
+            if (
+                self._progress is not None
+                and self._progress[0] == key
+                and self._progress[1] == packed
+            ):
+                state = multi_segment_init(
+                    self.v, sources, packed, restore=self._progress[2]
+                )
+                self._bump("ckpt_resumes")
+        if state is None:
+            state = multi_segment_init(self.v, sources, packed)
+        level, changed = jax.device_get((state.level, state.changed))
+        while bool(changed) and int(level) < cap:
+            seg_end = min(int(level) + self.interval, cap)
+            state = self._segment(state, seg_end, packed)
+            level, changed = jax.device_get((state.level, state.changed))
+            snap = {
+                k: np.asarray(v)
+                for k, v in jax.device_get(state)._asdict().items()
+            }
+            with self._lock:
+                if self._gen != my_gen:
+                    raise _Abandoned(
+                        "a newer attempt owns this traversal"
+                    )
+                self._progress = (key, packed, snap, int(level))
+            self._bump("ckpt_segments")
+            if bool(changed) and int(level) < cap:
+                # The segment boundary the chaos/hung-call tests target
+                # (a delay here is a wedged mid-traversal dispatch).
+                fault_point("serve.segment")
+        return multi_segment_finish(state, packed), int(level), bool(changed)
+
+    # bfs_tpu: hot
+    def __call__(self, sources: np.ndarray) -> MultiBfsResult:
+        from ..analysis.runtime import guarded_region
+        from ..ops.packed import PACKED_MAX_LEVELS, packed_truncated
+
+        key = np.ascontiguousarray(sources).tobytes()
+        with self._lock:
+            self._gen += 1
+            my_gen = self._gen
+        with guarded_region(
+            f"serve.device_batch/{self.name}/{self.engine}-segmented"
+        ):
+            packed = self.want_packed
+            state, level, changed = self._run_flavor(
+                sources, key, my_gen, packed
+            )
+            if packed and packed_truncated(changed, level, self.v):
+                # Deeper than the packed cap: re-run unpacked (the
+                # packed progress cannot feed it).
+                with self._lock:
+                    if self._gen == my_gen:
+                        self._progress = None
+                state, level, changed = self._run_flavor(
+                    sources, key, my_gen, False
+                )
+        with self._lock:
+            if self._gen == my_gen:
+                self._progress = None  # finished: epochs are dead weight
+        return _state_to_result(state, sources, self.v)
 
 
 def build_batch_runner(registry, name: str, engine: str, batch: int,
@@ -188,6 +353,24 @@ def build_batch_runner(registry, name: str, engine: str, batch: int,
     )
 
     want_packed = resolve_packed(packed_parent_fits(v))
+
+    # ISSUE 14: with BFS_TPU_CKPT enabled the pull/push batch programs
+    # run as bounded segments with in-process checkpoint epochs, so a
+    # hung-call retry or a breaker half-open canary on a deep-graph tick
+    # RESUMES mid-traversal instead of recomputing from the roots
+    # (server._execute_batch's hung-call resume loop reads
+    # ``ckpt_progress``).  Off (the default) keeps the fused AOT runners
+    # below byte-for-byte.
+    from ..resilience.superstep_ckpt import resolve_ckpt
+
+    ckpt_cfg = resolve_ckpt()
+    if ckpt_cfg.enabled and engine in ("pull", "push"):
+        return SegmentedBatchRunner(
+            registry, name, engine, batch, epoch, v, want_packed,
+            interval=ckpt_cfg.k,
+            metrics=getattr(registry, "metrics", None),
+        )
+
     # A graph shallower than the cap can never truncate — skip the
     # per-tick flag pull entirely (the common case; v-vertex BFS depth
     # is bounded by v).

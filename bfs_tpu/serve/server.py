@@ -60,7 +60,7 @@ from .executor import (
     build_batch_runner,
     run_oracle_batch,
 )
-from .health import ServeHealth
+from .health import HungCallError, ServeHealth
 from .registry import ENGINES, GraphRegistry
 
 #: Default device-path retry shape: short delays (a serving tick is
@@ -587,15 +587,47 @@ class BfsServer:
                 # the batch's earliest deadline: a tick with 50 ms left
                 # must not sleep 500 ms to find out.
                 device_attempted = True
-                result = retry_call(
-                    _device_tick,
-                    policy=self.retry_policy,
-                    deadline_s=(
-                        min(deadlines) - time.monotonic() if deadlines else None
-                    ),
-                    on_retry=_on_retry,
-                    describe=f"device batch ({first.graph}/{first.engine})",
-                )
+                # ISSUE 14 hung-call resume: a watchdog timeout abandons
+                # only the attempt THREAD; when the runner is a
+                # checkpointing SegmentedBatchRunner its completed
+                # segments survive as in-process epochs, so another
+                # attempt RESUMES mid-traversal instead of recomputing
+                # from the roots.  Re-attempt only while progress
+                # actually advances (a wedge at the same superstep twice
+                # means the device path is dead — degrade) and the batch
+                # deadline has not passed.
+                resume_progress = None
+                while True:
+                    try:
+                        result = retry_call(
+                            _device_tick,
+                            policy=self.retry_policy,
+                            deadline_s=(
+                                min(deadlines) - time.monotonic()
+                                if deadlines else None
+                            ),
+                            on_retry=_on_retry,
+                            describe=(
+                                f"device batch ({first.graph}/"
+                                f"{first.engine})"
+                            ),
+                        )
+                        break
+                    except HungCallError:
+                        runner0 = self.exe_cache.peek(exe_key)
+                        prog_fn = getattr(runner0, "ckpt_progress", None)
+                        progress = prog_fn() if callable(prog_fn) else None
+                        past_deadline = bool(deadlines) and (
+                            time.monotonic() >= min(deadlines)
+                        )
+                        if (
+                            progress is None
+                            or progress == resume_progress
+                            or past_deadline
+                        ):
+                            raise
+                        resume_progress = progress
+                        self.metrics.bump("ckpt_hung_resumes")
                 if retried["n"]:
                     self.metrics.bump("device_retry_successes")
                 self._health.record_success(circuit_key)

@@ -562,6 +562,10 @@ def test_repo_has_expected_hot_coverage():
             "rowmin_ranks",
             "apply_relay_candidates_packed",
             "relay_superstep_words_packed",
+            # the bounded-segment reference runners (ISSUE 14) iterate
+            # the same hot bodies — they must stay transfer-policed
+            "relay_segment_words",
+            "relay_segment_words_packed",
         ),
         # the per-phase Pallas kernels (ISSUE 7) run inside the fused
         # hot loop when selected — they must keep static hot coverage,
@@ -1039,7 +1043,8 @@ def test_hlo_fingerprints_pin_program_specs_coverage():
         doc = json.load(fh)
     committed = set(doc["programs"])
     registry = set(PROGRAM_SPECS)
-    assert len(registry) >= 25
+    # ISSUE 11 pinned 25; ISSUE 14 adds the four segment programs.
+    assert len(registry) >= 29
     assert registry - committed == set(), (
         "programs missing HLO fingerprint coverage — run "
         "`bfs-tpu-lint --hlo --update-fingerprints`"

@@ -199,6 +199,60 @@ def test_journal_sidecar_arrays_roundtrip_and_corruption(tmp_path):
     jr3.close()
 
 
+@pytest.mark.parametrize("mode", ["truncate", "flip"])
+def test_journal_damaged_sidecar_rotates_whole_journal(tmp_path, mode):
+    """ISSUE 14 satellite: a sidecar referenced by an INTACT index row
+    that fails strict validation (torn write, bit rot) rotates the whole
+    journal aside — later phases that consumed those arrays can no
+    longer be proven consistent, so nothing of the tainted run may
+    resume — and the rotated file survives as evidence."""
+    jr = RunJournal.open_for(str(tmp_path), CFG)
+    mask = np.packbits(np.arange(64) % 3 == 0)
+    jr.put("reference", {"n": 64}, arrays={"mask_packed": mask})
+    jr.put("repeat:0", {"seconds": 1.25})  # downstream of the sidecar
+    jr.close()
+    sidecar = [
+        p for p in os.listdir(tmp_path)
+        if p.endswith(".npz") and "reference" in p
+    ][0]
+    corrupt_file(str(tmp_path / sidecar), mode=mode)
+    jr2 = RunJournal.open_for(str(tmp_path), CFG)
+    assert jr2.get("reference") is None
+    assert jr2.invalidated is not None and "sidecar" in jr2.invalidated
+    # The WHOLE journal rotated: the downstream phase is gone too, and
+    # the old file was moved aside, never deleted.
+    assert jr2.get("repeat:0") is None
+    assert any(
+        p.startswith(os.path.basename(jr2.path)) and ".stale." in p
+        for p in os.listdir(tmp_path)
+    )
+    # The fresh journal is writable and resumable as usual.
+    jr2.put("reference", {"n": 64}, arrays={"mask_packed": mask})
+    assert jr2.get("reference") == {"n": 64}
+    jr2.close()
+
+
+def test_journal_missing_sidecar_only_fails_that_phase(tmp_path):
+    """A MISSING sidecar file is an incomplete write, not corruption:
+    the owning phase re-runs, every other phase stays restored and the
+    journal is NOT rotated."""
+    jr = RunJournal.open_for(str(tmp_path), CFG)
+    mask = np.packbits(np.arange(64) % 3 == 0)
+    jr.put("reference", {"n": 64}, arrays={"mask_packed": mask})
+    jr.put("repeat:0", {"seconds": 1.25})
+    jr.close()
+    sidecar = [
+        p for p in os.listdir(tmp_path)
+        if p.endswith(".npz") and "reference" in p
+    ][0]
+    os.remove(tmp_path / sidecar)
+    jr2 = RunJournal.open_for(str(tmp_path), CFG)
+    assert jr2.get("reference") is None
+    assert jr2.get("repeat:0") == {"seconds": 1.25}
+    assert jr2.invalidated is None
+    jr2.close()
+
+
 # ------------------------------------------------------------------- faults --
 def test_fault_spec_parsing():
     assert fault_spec("") is None

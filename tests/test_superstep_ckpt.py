@@ -1,0 +1,571 @@
+"""Superstep-granular checkpoint/restore (ISSUE 14).
+
+Covers: BFS_TPU_CKPT resolution and the Young/Daly interval, the ops-level
+reference segment runners, fused-vs-segmented bit-identity for the relay /
+multisource / x8 sharded programs (dist, parent, direction schedule,
+exchange-arm sequence), mid-traversal kill/resume (the ``chaos``-marked
+smoke ci_gate runs), the checkpoint corruption matrix (newest epoch
+damaged -> previous; all damaged -> clean fresh run), per-shard epoch
+shard loss, the ``superstep:<n>`` fault family, and the serve hung-call
+resume path.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from conftest import REPO_ROOT
+
+from bfs_tpu.graph.generators import rmat_graph
+from bfs_tpu.resilience import faults
+from bfs_tpu.resilience.faults import FaultInjected, corrupt_file, fault_spec
+from bfs_tpu.resilience.superstep_ckpt import (
+    CkptConfig,
+    SuperstepCheckpointer,
+    daly_interval,
+    resolve_ckpt,
+    run_multi_segmented,
+)
+
+SOURCE = 3
+
+
+@pytest.fixture(scope="module")
+def graph():
+    return rmat_graph(8, 4, seed=3)
+
+
+@pytest.fixture(scope="module")
+def eng(graph):
+    from bfs_tpu.models.bfs import RelayEngine
+
+    # Auto direction + sparse hybrid: the carry holds the hysteresis
+    # pair, so resume must restore it — the hardest single-chip flavor.
+    return RelayEngine(graph, sparse_hybrid=True, direction="auto")
+
+
+@pytest.fixture(scope="module")
+def golden(eng):
+    result = eng.run(SOURCE)
+    curve = eng.run_level_curve(SOURCE)
+    return result, curve
+
+
+def _mgr(tmp_path, k=2, config=None, **kw):
+    return SuperstepCheckpointer(
+        tmp_path, config if config is not None else {"t": 1},
+        cfg=CkptConfig("every", k), **kw,
+    )
+
+
+def _assert_identical(res, curve, golden):
+    gres, gcurve = golden
+    np.testing.assert_array_equal(res.dist, gres.dist)
+    np.testing.assert_array_equal(res.parent, gres.parent)
+    assert res.num_levels == gres.num_levels
+    if curve is not None:
+        # The ISSUE 14 assertion: the resumed run reproduces the killed
+        # run's direction schedule exactly — it is a pure function of
+        # graph + thresholds and the hysteresis state rides the carry.
+        assert (
+            curve["direction_schedule"]["schedule"]
+            == gcurve["direction_schedule"]["schedule"]
+        )
+        assert curve["occupancy"] == gcurve["occupancy"]
+
+
+# ------------------------------------------------------------ knob parsing --
+def test_resolve_ckpt_default_off(monkeypatch):
+    monkeypatch.delenv("BFS_TPU_CKPT", raising=False)
+    cfg = resolve_ckpt()
+    assert cfg.mode == "off" and not cfg.enabled
+
+
+def test_resolve_ckpt_spellings():
+    assert resolve_ckpt("every:5") == CkptConfig("every", 5)
+    assert resolve_ckpt("every").k >= 1
+    assert resolve_ckpt("auto").mode == "auto"
+    assert resolve_ckpt("off").enabled is False
+    with pytest.raises(ValueError):
+        resolve_ckpt("always")
+    with pytest.raises(ValueError):
+        resolve_ckpt("every:0")
+    with pytest.raises(ValueError):
+        resolve_ckpt("auto:3")
+
+
+def test_daly_interval_shape():
+    # Cheaper snapshots (or a flakier environment) checkpoint more often.
+    assert daly_interval(0.1, 1e-4, 600) < daly_interval(0.1, 1.0, 600)
+    assert daly_interval(0.1, 0.01, 60) < daly_interval(0.1, 0.01, 6000)
+    # Slower supersteps need fewer of them per segment.
+    assert daly_interval(10.0, 0.01, 600) <= daly_interval(0.01, 0.01, 600)
+    # Clamps.
+    assert daly_interval(1e9, 1e-6, 1) == 1
+    assert daly_interval(1e-9, 10, 1e9) == 4096
+
+
+def test_auto_interval_rederived_from_measurements(tmp_path):
+    mgr = SuperstepCheckpointer(
+        tmp_path, {"t": 1}, cfg=CkptConfig("auto"), mtbf_s=600
+    )
+    k0 = mgr.interval()
+    mgr.save_epoch(1, {"x": np.zeros(4, np.int32)})
+    mgr.note_segment(1, 0.5)
+    assert mgr.interval() == daly_interval(
+        mgr._superstep_s, mgr._snapshot_s, 600
+    )
+    assert mgr.report()["mode"] == "auto"
+    assert isinstance(k0, int)
+
+
+# ------------------------------------------------- ops reference segments --
+def test_ops_segment_runner_parity(eng):
+    """Segments of any size composed back-to-back equal one full loop
+    (ops/relay.relay_segment_words — the XLA reference segment runner).
+    ``seg_end`` is a traced operand, so ONE compiled program serves the
+    full run and every partial segment."""
+    import functools
+
+    import jax
+    import jax.numpy as jnp
+
+    from bfs_tpu.graph.relay import valid_slot_words
+    from bfs_tpu.ops import relay as R
+
+    rg = eng.relay_graph
+    layout = dict(
+        vperm_masks=jnp.asarray(rg.vperm_masks),
+        vperm_table=rg.vperm_table, vperm_size=rg.vperm_size,
+        out_classes=tuple(rg.out_classes), out_space=rg.out_space,
+        net_masks=jnp.asarray(rg.net_masks), net_table=rg.net_table,
+        net_size=rg.net_size, in_classes=tuple(rg.in_classes),
+        valid_words=jnp.asarray(valid_slot_words(rg.src_l1, rg.net_size)),
+        vr=rg.vr,
+    )
+    seg = jax.jit(
+        functools.partial(R.relay_segment_words, cap=rg.vr, **layout)
+    )
+    sn = int(rg.old2new[SOURCE])
+    full = seg(R.init_relay_state(rg.vr, sn), jnp.int32(rg.vr))
+    st = R.init_relay_state(rg.vr, sn)
+    while bool(st.changed) and int(st.level) < rg.vr:
+        st = seg(st, jnp.int32(int(st.level) + 2))
+    full, st = jax.device_get((full, st))
+    np.testing.assert_array_equal(st.dist, full.dist)
+    np.testing.assert_array_equal(st.parent, full.parent)
+    assert int(st.level) == int(full.level)
+
+
+# ------------------------------------------------------ relay segmentation --
+def test_relay_segmented_parity_and_epoch_cleanup(eng, golden, tmp_path):
+    mgr = _mgr(tmp_path, k=2)
+    res, curve = eng.run_segmented(SOURCE, ckpt=mgr, telemetry=True)
+    _assert_identical(res, curve, golden)
+    assert mgr.report()["epochs_written"] >= 2
+    # A finished traversal clears its epochs — a later same-config run
+    # starts fresh instead of resuming a finished carry.
+    assert mgr.epochs() == []
+
+
+def test_relay_segmented_with_disabled_store(eng, golden, tmp_path):
+    mgr = SuperstepCheckpointer(tmp_path, {"t": 1}, cfg=CkptConfig("off"))
+    res = eng.run_segmented(SOURCE, ckpt=mgr)
+    _assert_identical(res, None, golden)
+    assert list(tmp_path.iterdir()) == []  # nothing touched disk
+
+
+def _interrupt(eng, tmp_path, boundary: int, k: int = 1, config=None):
+    """Run segmented until a raise at the nth superstep boundary; leaves
+    epochs on disk."""
+    os.environ["BFS_TPU_FAULT"] = f"raise:superstep:{boundary}"
+    faults.reset()
+    try:
+        with pytest.raises(FaultInjected):
+            eng.run_segmented(
+                SOURCE, ckpt=_mgr(tmp_path, k=k, config=config),
+                telemetry=True,
+            )
+    finally:
+        os.environ.pop("BFS_TPU_FAULT", None)
+        faults.reset()
+
+
+@pytest.mark.chaos
+def test_relay_kill_resume_bit_identical(eng, golden, tmp_path):
+    """THE in-process traversal-chaos smoke (ci_gate stage): kill one
+    mid-traversal segment, resume, assert bit-identity incl. the
+    direction schedule."""
+    _interrupt(eng, tmp_path, boundary=2)
+    mgr = _mgr(tmp_path, k=1)
+    res, curve = eng.run_segmented(SOURCE, ckpt=mgr, telemetry=True)
+    assert mgr.report()["resumed_from_epoch"] == 2
+    _assert_identical(res, curve, golden)
+
+
+@pytest.mark.parametrize("mode", ["truncate", "flip"])
+def test_corruption_newest_epoch_falls_back_to_previous(
+    eng, golden, tmp_path, mode
+):
+    _interrupt(eng, tmp_path, boundary=3)
+    mgr = _mgr(tmp_path, k=1)
+    eps = mgr.epochs()
+    assert len(eps) == 2  # retention window
+    corrupt_file(mgr._epoch_path(eps[-1]), mode=mode)
+    res, curve = eng.run_segmented(SOURCE, ckpt=mgr, telemetry=True)
+    rep = mgr.report()
+    assert rep["resumed_from_epoch"] == eps[-2]
+    assert rep["epochs_corrupt_skipped"] >= 1
+    _assert_identical(res, curve, golden)
+
+
+def test_corruption_all_epochs_falls_back_to_fresh(eng, golden, tmp_path):
+    _interrupt(eng, tmp_path, boundary=3)
+    mgr = _mgr(tmp_path, k=1)
+    for ep in mgr.epochs():
+        corrupt_file(mgr._epoch_path(ep), mode="flip")
+    res, curve = eng.run_segmented(SOURCE, ckpt=mgr, telemetry=True)
+    rep = mgr.report()
+    # No wrong answers, and the counters NAME the fallback.
+    assert rep["resumed_from_epoch"] is None
+    assert rep["fresh_fallbacks"] == 1
+    assert rep["epochs_corrupt_skipped"] >= 2
+    _assert_identical(res, curve, golden)
+
+
+def test_epoch_missing_carry_keys_falls_back_fresh(eng, golden, tmp_path):
+    """The config key does not encode telemetry: an epoch written by a
+    telemetry-OFF drive of the same config must make a telemetry-ON
+    resume fall back to a fresh traversal (restore gate key check) —
+    never KeyError mid-restore."""
+    os.environ["BFS_TPU_FAULT"] = "raise:superstep:2"
+    faults.reset()
+    try:
+        with pytest.raises(FaultInjected):
+            eng.run_segmented(SOURCE, ckpt=_mgr(tmp_path, k=1))  # no telem
+    finally:
+        os.environ.pop("BFS_TPU_FAULT", None)
+        faults.reset()
+    mgr = _mgr(tmp_path, k=1)
+    res, curve = eng.run_segmented(SOURCE, ckpt=mgr, telemetry=True)
+    assert mgr.resumed_from_epoch is None  # did NOT resume
+    _assert_identical(res, curve, golden)
+
+
+def test_foreign_config_epoch_is_skipped(eng, golden, tmp_path):
+    """An epoch written by a DIFFERENT run config must never feed a
+    resume, even if a file lands under this config's stem."""
+    _interrupt(eng, tmp_path, boundary=2, config={"other": "run"})
+    other = _mgr(tmp_path, k=1, config={"other": "run"})
+    mine = _mgr(tmp_path, k=1, config={"mine": "run"})
+    for ep in other.epochs():
+        os.rename(other._epoch_path(ep), mine._epoch_path(ep))
+    assert mine.load_latest() is None
+    assert mine.counters["epochs_corrupt_skipped"] >= 1
+    res, curve = eng.run_segmented(
+        SOURCE, ckpt=_mgr(tmp_path, k=2, config={"mine": "run"}),
+        telemetry=True,
+    )
+    _assert_identical(res, curve, golden)
+
+
+# ------------------------------------------------------------- multisource --
+def test_multi_segmented_parity_and_resume(graph, tmp_path):
+    from bfs_tpu.models.multisource import bfs_multi
+
+    sources = [3, 10, 17, 24]
+    ref = bfs_multi(graph, sources, engine="push")
+    res = run_multi_segmented(
+        graph, sources, ckpt=_mgr(tmp_path / "a", k=2), engine="push"
+    )
+    np.testing.assert_array_equal(res.dist, ref.dist)
+    np.testing.assert_array_equal(res.parent, ref.parent)
+    assert res.num_levels == ref.num_levels
+
+    os.environ["BFS_TPU_FAULT"] = "raise:superstep:2"
+    faults.reset()
+    try:
+        with pytest.raises(FaultInjected):
+            run_multi_segmented(
+                graph, sources, ckpt=_mgr(tmp_path / "b", k=1),
+                engine="push",
+            )
+    finally:
+        os.environ.pop("BFS_TPU_FAULT", None)
+        faults.reset()
+    mgr = _mgr(tmp_path / "b", k=1)
+    res2 = run_multi_segmented(graph, sources, ckpt=mgr, engine="push")
+    assert mgr.report()["resumed_from_epoch"] is not None
+    np.testing.assert_array_equal(res2.dist, ref.dist)
+    np.testing.assert_array_equal(res2.parent, ref.parent)
+
+
+def test_multi_segmented_pull_parity(graph, tmp_path):
+    from bfs_tpu.models.multisource import bfs_multi
+
+    sources = [3, 10]
+    ref = bfs_multi(graph, sources, engine="pull")
+    res = run_multi_segmented(
+        graph, sources, ckpt=_mgr(tmp_path, k=3), engine="pull"
+    )
+    np.testing.assert_array_equal(res.dist, ref.dist)
+    np.testing.assert_array_equal(res.parent, ref.parent)
+
+
+# ----------------------------------------------------------------- sharded --
+@pytest.fixture(scope="module")
+def sharded_setup():
+    import jax
+
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual platform")
+    from bfs_tpu.parallel.sharded import bfs_sharded, make_mesh
+
+    g = rmat_graph(7, 4, seed=3)
+    mesh = make_mesh(graph=8, batch=1)
+    ref, refc = bfs_sharded(
+        g, SOURCE, mesh=mesh, engine="relay", telemetry=True,
+        direction="auto", exchange="auto",
+    )
+    return g, mesh, ref, refc
+
+
+def _run_sharded_seg(setup, tmp_path, k=2):
+    from bfs_tpu.parallel.sharded import bfs_sharded_segmented
+
+    g, mesh, _ref, _refc = setup
+    mgr = SuperstepCheckpointer(
+        tmp_path, {"t": 1}, cfg=CkptConfig("every", k), shards=8
+    )
+    res, curve = bfs_sharded_segmented(
+        g, SOURCE, mesh=mesh, ckpt=mgr, telemetry=True,
+        direction="auto", exchange="auto",
+    )
+    return mgr, res, curve
+
+
+def _assert_sharded_identical(res, curve, setup):
+    _g, _mesh, ref, refc = setup
+    np.testing.assert_array_equal(res.dist, ref.dist)
+    np.testing.assert_array_equal(res.parent, ref.parent)
+    assert (
+        curve["direction_schedule"]["schedule"]
+        == refc["direction_schedule"]["schedule"]
+    )
+    # The exchange-arm sequence AND the per-level wire bytes are part of
+    # the bit-identity contract — the resumed run re-runs the SAME
+    # exchange it would have run uninterrupted.
+    assert curve["exchange"]["schedule"] == refc["exchange"]["schedule"]
+    assert (
+        curve["exchange"]["bytes_per_level"]
+        == refc["exchange"]["bytes_per_level"]
+    )
+
+
+def test_sharded_segmented_parity(sharded_setup, tmp_path):
+    mgr, res, curve = _run_sharded_seg(sharded_setup, tmp_path, k=2)
+    _assert_sharded_identical(res, curve, sharded_setup)
+    assert mgr.report()["shards"] == 8
+
+
+@pytest.mark.chaos
+def test_sharded_kill_resume_and_shard_loss(sharded_setup, tmp_path):
+    """Kill mid-traversal, then LOSE one shard's file of the newest
+    epoch: the loader must fall back to the last COMPLETE epoch and the
+    resumed run (on a freshly built mesh) must still be bit-identical —
+    the shard-loss recovery state machine."""
+    from bfs_tpu.parallel.sharded import bfs_sharded_segmented, make_mesh
+
+    g, _mesh, _ref, _refc = sharded_setup
+    os.environ["BFS_TPU_FAULT"] = "raise:superstep:3"
+    faults.reset()
+    try:
+        with pytest.raises(FaultInjected):
+            _run_sharded_seg(sharded_setup, tmp_path, k=1)
+    finally:
+        os.environ.pop("BFS_TPU_FAULT", None)
+        faults.reset()
+    mgr = SuperstepCheckpointer(
+        tmp_path, {"t": 1}, cfg=CkptConfig("every", 1), shards=8
+    )
+    eps = mgr.epochs()
+    assert len(eps) == 2
+    # Shard loss: damage one shard of the NEWEST epoch only.
+    corrupt_file(mgr._epoch_path(eps[-1], shard=5), mode="truncate")
+    res, curve = bfs_sharded_segmented(
+        g, SOURCE, mesh=make_mesh(graph=8, batch=1), ckpt=mgr,
+        telemetry=True, direction="auto", exchange="auto",
+    )
+    rep = mgr.report()
+    assert rep["resumed_from_epoch"] == eps[-2]
+    assert rep["epochs_corrupt_skipped"] >= 1
+    _assert_sharded_identical(res, curve, sharded_setup)
+
+
+def test_sharded_rejects_wrong_shard_count(sharded_setup, tmp_path):
+    from bfs_tpu.parallel.sharded import bfs_sharded_segmented
+
+    g, mesh, _ref, _refc = sharded_setup
+    with pytest.raises(ValueError, match="shards"):
+        bfs_sharded_segmented(
+            g, SOURCE, mesh=mesh,
+            ckpt=SuperstepCheckpointer(
+                tmp_path, {"t": 1}, cfg=CkptConfig("every", 1), shards=2
+            ),
+        )
+
+
+# ------------------------------------------------------------ fault family --
+def test_superstep_fault_spec_parsing():
+    assert fault_spec("kill:superstep:3") == ("kill", "superstep", 3)
+    assert fault_spec("raise:superstep") == ("raise", "superstep", 1)
+    # Exact-boundary spelling still works through the generic machinery.
+    assert fault_spec("raise:superstep:0") == ("raise", "superstep:0", 1)
+
+
+def test_superstep_fault_fires_at_nth_boundary(monkeypatch):
+    monkeypatch.setenv("BFS_TPU_FAULT", "raise:superstep:3")
+    faults.reset()
+    faults.fault_point("superstep:4")   # arrival 1 (family match)
+    faults.fault_point("superstep:8")   # arrival 2
+    faults.fault_point("unrelated")     # no match, no count
+    with pytest.raises(FaultInjected):
+        faults.fault_point("superstep:12")  # arrival 3 fires
+    faults.reset()
+
+
+def test_save_epoch_marks_boundary_even_when_disabled(
+    tmp_path, monkeypatch
+):
+    """The fault boundary exists on the off arm too (a segmented test
+    run without a store still has killable boundaries)."""
+    monkeypatch.setenv("BFS_TPU_FAULT", "raise:superstep")
+    faults.reset()
+    mgr = SuperstepCheckpointer(tmp_path, {"t": 1}, cfg=CkptConfig("off"))
+    with pytest.raises(FaultInjected):
+        mgr.save_epoch(1, {})
+    faults.reset()
+
+
+# ------------------------------------------------------------------- serve --
+def test_serve_runner_is_segmented_only_when_enabled(graph, monkeypatch):
+    from bfs_tpu.serve.executor import SegmentedBatchRunner, build_batch_runner
+    from bfs_tpu.serve.registry import GraphRegistry
+
+    reg = GraphRegistry()
+    reg.register("g", graph)
+    monkeypatch.delenv("BFS_TPU_CKPT", raising=False)
+    off = build_batch_runner(reg, "g", "pull", 4)
+    assert not isinstance(off, SegmentedBatchRunner)
+    monkeypatch.setenv("BFS_TPU_CKPT", "every:2")
+    on = build_batch_runner(reg, "g", "pull", 4)
+    assert isinstance(on, SegmentedBatchRunner)
+    # Parity: the segmented runner's replies are bit-identical.
+    sources = np.asarray([3, 10, 17, 24], np.int32)
+    a = off(sources)
+    b = on(sources)
+    np.testing.assert_array_equal(a.dist, b.dist)
+    np.testing.assert_array_equal(a.parent, b.parent)
+    assert on.ckpt_progress() is None  # finished: epochs cleared
+
+
+@pytest.mark.chaos
+def test_serve_hung_call_resumes_from_checkpoint(monkeypatch):
+    """A wedged mid-traversal device tick (watchdog HungCallError) must
+    RESUME from the newest in-process checkpoint epoch on each retry —
+    the tick completes device-side (status ok) even though every attempt
+    wedges, because each attempt advances at least one segment."""
+    import time
+
+    from bfs_tpu.graph.csr import Graph
+    from bfs_tpu.oracle.bfs import queue_bfs
+    from bfs_tpu.serve import BfsServer
+
+    monkeypatch.setenv("BFS_TPU_CKPT", "every:2")
+    v = 24
+    g = Graph.from_undirected_edges(
+        v, np.array([(i, i + 1) for i in range(v - 1)])
+    )
+    faults.reset()
+    try:
+        with BfsServer(
+            engine="pull", max_batch=4, tick_s=0.0,
+            watchdog_s=0.3, watchdog_min_s=0.2,
+            watchdog_compile_floor_s=120.0,
+        ) as server:
+            server.register("g", g)
+            warm = server.submit("g", [0]).result(timeout=120)
+            assert warm.record.status == "ok"
+            os.environ["BFS_TPU_FAULT"] = "delay:serve.segment:0.8"
+            t0 = time.monotonic()
+            reply = server.submit("g", [1]).result(timeout=120)
+            assert time.monotonic() - t0 < 100
+            os.environ.pop("BFS_TPU_FAULT", None)
+            assert reply.record.status == "ok"
+            np.testing.assert_array_equal(reply.dist, queue_bfs(g, 1)[0])
+            counters = server.report()["counters"]
+            assert counters.get("ckpt_hung_resumes", 0) >= 1
+            assert counters.get("watchdog_timeouts", 0) >= 1
+            assert counters.get("ckpt_resumes", 0) >= 1
+    finally:
+        os.environ.pop("BFS_TPU_FAULT", None)
+        faults.reset()
+
+
+# ------------------------------------------------------------------- bench --
+@pytest.mark.slow
+def test_bench_ships_superstep_ckpt_detail(tmp_path):
+    """A relay bench with BFS_TPU_CKPT enabled measures the checkpoint
+    arm and ships details.superstep_ckpt (overhead + bit-identity) in
+    the capture."""
+    env = dict(os.environ)
+    env.update(
+        JAX_PLATFORMS="cpu", BENCH_SCALE="8", BENCH_EDGE_FACTOR="4",
+        BENCH_ROOTS="2", BENCH_REPEATS="1", BENCH_ENGINE="relay",
+        BENCH_TIME_BUDGET="500", BENCH_STEP_PROFILE="0",
+        BENCH_PHASE_LEDGER="0", BENCH_LEVEL_CURVE="0",
+        BFS_TPU_CKPT="every:2",
+        BFS_TPU_JOURNAL_DIR=str(tmp_path / "journal"),
+        BFS_TPU_CACHE_DIR=str(tmp_path / "cache"),
+    )
+    env.pop("BFS_TPU_FAULT", None)
+    proc = subprocess.run(
+        [sys.executable, "-m", "bfs_tpu.bench"],
+        capture_output=True, text=True, env=env, cwd=REPO_ROOT,
+        timeout=500,
+    )
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    lines = [
+        json.loads(l) for l in proc.stdout.splitlines()
+        if l.startswith("{")
+    ]
+    detail = lines[-1]["details"]["superstep_ckpt"]
+    assert detail["mode"] == "every" and detail["interval"] == 2
+    assert detail["bit_identical"] is True
+    assert detail["epochs_written"] >= 1
+    assert detail["overhead_ratio"] > 0
+    # Epoch sidecars land next to the journal, content-keyed.
+    assert not list((tmp_path / "journal").glob("ckpt_*.epoch*.npz")), (
+        "finished traversal must clear its epochs"
+    )
+
+
+# -------------------------------------------------------------- chaos CLI --
+@pytest.mark.chaos
+@pytest.mark.slow
+def test_traversal_chaos_cli_relay():
+    """One real SIGKILL-at-superstep-boundary iteration through the
+    subprocess driver (the full matrix runs in tools/chaos_run.py)."""
+    proc = subprocess.run(
+        [
+            sys.executable, os.path.join(REPO_ROOT, "tools", "chaos_run.py"),
+            "--mode", "traversal", "--iterations", "1", "--seed", "1",
+            "--traversal-configs", "relay", "--timeout", "400",
+        ],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=560,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

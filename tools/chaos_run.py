@@ -33,10 +33,25 @@ exits non-zero on any wrong answer, any frozen tick (a future that never
 resolves inside ``--serve-tick-timeout``), or any missing breaker /
 watchdog / integrity / epoch transition in the final metrics snapshot.
 
+The traversal mode (ISSUE 14) is the superstep-checkpoint acceptance
+harness: each subject process runs ONE traversal as bounded segments
+with per-epoch checkpoints (``python -m
+bfs_tpu.resilience.superstep_ckpt``), gets SIGKILLed at a randomized
+SUPERSTEP boundary (``BFS_TPU_FAULT=kill:superstep:<n>`` — mid-
+traversal, not mid-phase), and is re-invoked against the same
+checkpoint directory until it completes.  The resumed result must be
+bit-identical to an un-killed golden run on dist/parent content hashes,
+the direction schedule AND the exchange-arm sequence, and must provably
+have resumed from a checkpoint epoch rather than silently restarting.
+Covers the single-chip relay (packed + sparse hybrid, auto direction),
+batched multi-source, and the x8 sharded relay (whose per-shard epoch
+files also exercise the shard-loss fallback in tests).
+
 Usage (CPU, tiny config — the tier-1-adjacent shape):
     python tools/chaos_run.py --iterations 5 --seed 1
     python tools/chaos_run.py --mode loadgen --iterations 3
     python tools/chaos_run.py --mode serve --scale 8
+    python tools/chaos_run.py --mode traversal --iterations 2 --seed 1
 
 Heavier configs pass through the usual BENCH_* env knobs.
 """
@@ -553,10 +568,146 @@ def chaos_serve(args, rng: random.Random) -> int:
     return 1 if failures else 0
 
 
+#: Traversal-chaos subject configs (ISSUE 14): the superstep_ckpt CLI
+#: runner's --config values — relay = single-chip packed + sparse-hybrid
+#: auto-direction, multi = batched multi-source push, sharded = the x8
+#: sharded relay with auto direction + auto exchange.
+TRAVERSAL_CONFIGS = ("relay", "multi", "sharded")
+
+#: Result-document fields that must be BIT-IDENTICAL between a resumed
+#: run and the un-killed golden run (dist/parent content hashes, the
+#: direction schedule, the exchange-arm sequence and its per-level
+#: bytes).  Fields a config does not produce are absent on both sides.
+TRAVERSAL_DETERMINISTIC = (
+    "dist_hash", "parent_hash", "num_levels", "direction_schedule",
+    "exchange_schedule", "exchange_bytes",
+)
+
+
+def run_traversal(args, cfg: str, ckpt_dir: str, out: str,
+                  fault: str | None = None):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.pop("BFS_TPU_FAULT", None)
+    if fault is not None:
+        env["BFS_TPU_FAULT"] = fault
+    if cfg == "sharded":
+        flags = env.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            env["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "bfs_tpu.resilience.superstep_ckpt",
+            "--config", cfg, "--ckpt-dir", ckpt_dir, "--out", out,
+            "--scale", str(args.scale), "--edge-factor",
+            str(args.edge_factor),
+            "--seed", str(args.seed if args.seed is not None else 3),
+            "--interval", str(args.ckpt_interval),
+        ],
+        capture_output=True, text=True, env=env, cwd=REPO_ROOT,
+        timeout=args.timeout,
+    )
+    doc = None
+    if proc.returncode == 0 and os.path.exists(out):
+        with open(out, encoding="utf-8") as fh:
+            doc = json.load(fh)
+    return proc, doc
+
+
+def chaos_traversal(args, rng: random.Random) -> int:
+    """Kill-at-superstep-boundary chaos (ISSUE 14 acceptance): for each
+    traversal config, one un-killed golden run pins the reference result
+    document, then every iteration SIGKILLs a fresh run at a RANDOM
+    segment boundary (``BFS_TPU_FAULT=kill:superstep:<n>`` — the
+    boundary fires right after that epoch is durable), re-invokes with
+    the same --ckpt-dir until a run completes, and diffs the resumed
+    document against the golden: dist/parent content hashes, the
+    direction schedule and the exchange-arm sequence must all be
+    BIT-IDENTICAL, and the resumed run must actually have resumed from a
+    checkpoint epoch (a silent fresh-restart would also pass the value
+    diff — the ``resumed_from_epoch`` check keeps the proof honest)."""
+    failures = 0
+    configs = [c for c in args.traversal_configs.split(",") if c]
+    for cfg in configs:
+        with tempfile.TemporaryDirectory(prefix=f"chaos_tg_{cfg}_") as gd:
+            gout = os.path.join(gd, "golden.json")
+            log(f"[{cfg}] golden run (uninterrupted)...")
+            proc, golden = run_traversal(args, cfg, gd, gout)
+            if golden is None:
+                log(f"[{cfg}] golden run failed rc={proc.returncode}")
+                sys.stderr.write(proc.stderr[-4000:])
+                return 2
+            segments = int(golden["superstep_ckpt"]["segments"])
+            log(f"[{cfg}] golden: levels={golden['num_levels']} "
+                f"segments={segments}")
+            for it in range(args.iterations):
+                with tempfile.TemporaryDirectory(
+                    prefix=f"chaos_t_{cfg}_"
+                ) as cd:
+                    rout = os.path.join(cd, "resumed.json")
+                    kills = 0
+                    while True:
+                        n = rng.randint(1, max(1, segments))
+                        fault = (
+                            f"kill:superstep:{n}"
+                            if kills < args.max_kills_per_iteration
+                            else None
+                        )
+                        proc, doc = run_traversal(
+                            args, cfg, cd, rout, fault=fault
+                        )
+                        if proc.returncode == 0:
+                            break
+                        if proc.returncode != -signal.SIGKILL:
+                            log(f"[{cfg}] iter {it}: unexpected "
+                                f"rc={proc.returncode} (fault={fault})")
+                            sys.stderr.write(proc.stderr[-4000:])
+                            return 2
+                        kills += 1
+                        log(f"[{cfg}] iter {it}: killed at boundary "
+                            f"{n} (kill #{kills}); resuming...")
+                    bad = []
+                    if doc is None:
+                        bad.append("completed run wrote no result doc")
+                    else:
+                        for k in TRAVERSAL_DETERMINISTIC:
+                            if doc.get(k) != golden.get(k):
+                                bad.append(
+                                    f"{k}: resumed {doc.get(k)!r} != "
+                                    f"golden {golden.get(k)!r}"
+                                )
+                        if (
+                            kills
+                            and doc["superstep_ckpt"]["resumed_from_epoch"]
+                            is None
+                        ):
+                            bad.append(
+                                "killed run's successor never resumed "
+                                "from a checkpoint epoch (silent fresh "
+                                "restart)"
+                            )
+                    if bad:
+                        log(f"[{cfg}] iter {it}: FAIL after {kills} "
+                            "kill(s):")
+                        for b in bad:
+                            log(f"  - {b}")
+                        failures += 1
+                    else:
+                        log(f"[{cfg}] iter {it}: ok after {kills} "
+                            "kill(s) — dist/parent, schedule and "
+                            "exchange arms bit-identical")
+    log(f"traversal chaos: "
+        f"{len(configs) * args.iterations - failures}/"
+        f"{len(configs) * args.iterations} ok")
+    return 1 if failures else 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--mode", default="bench",
-                    choices=("bench", "loadgen", "serve"))
+                    choices=("bench", "loadgen", "serve", "traversal"))
     ap.add_argument("--iterations", type=int, default=5)
     ap.add_argument("--seed", type=int, default=None,
                     help="RNG seed for the kill schedule (default: time)")
@@ -580,6 +731,15 @@ def main(argv=None) -> int:
     # Loadgen shape.
     ap.add_argument("--requests", type=int, default=200)
     ap.add_argument("--loadgen-kill-max-s", type=float, default=20.0)
+    # Traversal (superstep-checkpoint) schedule shape (ISSUE 14).
+    ap.add_argument("--traversal-configs", default=",".join(TRAVERSAL_CONFIGS),
+                    help="comma list of superstep_ckpt runner configs to "
+                    "chaos (relay = packed + sparse-hybrid single chip, "
+                    "multi = batched multi-source push, sharded = x8 "
+                    "sharded relay with auto direction/exchange)")
+    ap.add_argument("--ckpt-interval", type=int, default=2,
+                    help="traversal mode: supersteps per checkpoint "
+                    "segment (every:<k>)")
     # Serve (self-healing) schedule shape.
     ap.add_argument("--serve-engine", default="pull",
                     choices=("pull", "push", "relay"))
@@ -600,6 +760,7 @@ def main(argv=None) -> int:
     rng = random.Random(seed)
     rc = {
         "bench": chaos_bench, "loadgen": chaos_loadgen, "serve": chaos_serve,
+        "traversal": chaos_traversal,
     }[args.mode](args, rng)
     # Unified metrics snapshot (bfs_tpu.obs.MetricsRegistry — replaces the
     # bespoke retrace table): the driver itself runs no traced programs, so

@@ -4,11 +4,16 @@
 #   tools/ci_gate.sh            # run everything, non-zero on any failure
 #   tools/ci_gate.sh --no-tests # lint surface only (tier-1 ran elsewhere)
 #
-# Two stages, fail-fast:
+# Three stages, fail-fast:
 #   1. tier-1: the full CPU test suite on the 8-device virtual platform
 #      (tests/conftest.py forces it), -m 'not slow' — exactly the
 #      ROADMAP.md verify command minus the log plumbing.
-#   2. bfs-tpu-lint --all: AST + IR + HLO + Pallas with merged baseline
+#   2. traversal-chaos smoke (ISSUE 14): the in-process chaos-marker
+#      tests of tests/test_superstep_ckpt.py — kill one mid-traversal
+#      segment, resume, assert bit-identity (~seconds).  Runs even with
+#      --no-tests: a checkpoint/resume divergence must fail the gate
+#      independently of where tier-1 ran.
+#   3. bfs-tpu-lint --all: AST + IR + HLO + Pallas with merged baseline
 #      handling — one exit code over every analyzer rung.  The jax
 #      passes are content-address-cached (.bench_cache/{ir,hlo,pal}),
 #      so a tree tier-1 just ran on lints in seconds.
@@ -24,13 +29,17 @@ if [[ "${1:-}" == "--no-tests" ]]; then
 fi
 
 if [[ "$RUN_TESTS" == "1" ]]; then
-    echo "== ci gate 1/2: tier-1 tests =="
+    echo "== ci gate 1/3: tier-1 tests =="
     JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
         -p no:cacheprovider
 fi
 
+echo "== ci gate: traversal-chaos smoke (kill/resume one segment) =="
+JAX_PLATFORMS=cpu python -m pytest tests/test_superstep_ckpt.py -q \
+    -m 'chaos and not slow' -p no:cacheprovider
+
 if [[ "$RUN_TESTS" == "1" ]]; then
-    echo "== ci gate 2/2: lint --all (AST + IR + HLO + Pallas) =="
+    echo "== ci gate 3/3: lint --all (AST + IR + HLO + Pallas) =="
 else
     echo "== ci gate: lint --all (AST + IR + HLO + Pallas) =="
 fi
