@@ -59,6 +59,7 @@ _FLAVOR_ENV = (
     "BFS_TPU_PACKED", "BFS_TPU_PALLAS", "BFS_TPU_ROWMIN",
     "BFS_TPU_STATE_UPDATE", "BFS_TPU_IR_HBM_GB",
     "BFS_TPU_EXCHANGE", "BFS_TPU_EXCHANGE_DIV",
+    "BFS_TPU_EXPANSION", "BFS_TPU_MXU_KERNEL", "BFS_TPU_TILES_BUILD",
 )
 
 #: Primitives whose presence in a loop body is a host round-trip (IR002).
@@ -503,6 +504,117 @@ def _spec_relay_fused():
     )
 
 
+def _relay_engine_mxu():
+    def build():
+        from ..models.bfs import RelayEngine
+
+        return RelayEngine(_tiny_graph(), expansion="mxu")
+
+    return _memo("relay_engine_mxu", build)
+
+
+def _spec_relay_fused_mxu():
+    """The MXU expansion arm's fused program (ISSUE 15): the same loop
+    scaffolding as relay.fused with the tiled masked-matmul dense body
+    and key-flavor sparse adjacency — donation/transfer/dtype/footprint
+    rules must hold for the new arm exactly as for the gather one."""
+    import jax.numpy as jnp
+
+    from ..models.bfs import _relay_fused_program
+
+    eng = _relay_engine_mxu()
+    fused = _relay_fused_program(
+        eng._static, eng.sparse_hybrid, eng._use_pallas(), eng.packed,
+        False, eng.direction.key(), eng._phase_sel(),
+        eng.relay_graph.num_vertices, eng._expansion_key(),
+    )
+    return Program(
+        name="relay.fused_mxu", path="bfs_tpu/models/bfs.py",
+        fn=fused,
+        args=(
+            jnp.int32(0), *eng._mxu_mask_args(),
+            *eng._sparse_tensors_for(eng.packed),
+        ),
+        static_kwargs=dict(max_levels=16),
+        v_elements=eng.relay_graph.vr, packed=eng.packed,
+        budget_bytes=_hbm_envelope(),
+    )
+
+
+def _spec_relay_segment_mxu():
+    """The mxu arm's checkpointable segment twin (ISSUE 15): carry
+    donated per segment like relay.segment."""
+    import jax.numpy as jnp
+
+    from ..models.bfs import _relay_segment_program
+
+    eng = _relay_engine_mxu()
+    prog = _relay_segment_program(
+        eng._static, eng.sparse_hybrid, eng._use_pallas(), eng.packed,
+        True, eng.direction.key(), eng._phase_sel(),
+        eng.relay_graph.num_vertices, eng._expansion_key(),
+    )
+    carry = eng.segment_carry(0, telemetry=True)
+    return Program(
+        name="relay.segment_mxu", path="bfs_tpu/models/bfs.py",
+        fn=prog,
+        args=(
+            carry, jnp.int32(8), *eng._mxu_mask_args(),
+            *eng._sparse_tensors_for(eng.packed),
+        ),
+        static_kwargs=dict(max_levels=16),
+        v_elements=eng.relay_graph.vr, packed=eng.packed,
+        donate={0: "carry"}, budget_bytes=_hbm_envelope(),
+    )
+
+
+def _spec_sharded_relay_mxu():
+    """The sharded mxu arm (ISSUE 15): per-shard tiles against the
+    all-gathered global frontier — the exchange contract (IR005/IR006)
+    must hold unchanged since the superstep tail is body-agnostic."""
+    from ..parallel.sharded import make_mesh
+
+    _need_devices(2)
+    import jax.numpy as jnp
+
+    from ..ops.packed import packed_rank_fits, resolve_packed
+    from ..parallel.sharded import (
+        _bfs_sharded_relay_fused,
+        _own_word_table_dev,
+        _prepare_relay,
+        _resolve_sharded_expansion,
+        _sharded_adj_dev,
+        _sharded_relay_static,
+        _sharded_tiles_dev,
+    )
+
+    mesh = _memo("mesh2", lambda: make_mesh(graph=2, batch=1))
+    srg = _memo("srg2", lambda: _prepare_relay(_tiny_graph(), mesh))
+    packed = resolve_packed(packed_rank_fits(srg.in_classes))
+    exp_static, packed = _resolve_sharded_expansion("mxu", srg, packed)
+    static = _sharded_relay_static(srg, 2, False, packed, exp_static)
+    tiles_arg = _sharded_tiles_dev(srg)[0]
+    dummy = jnp.zeros((2, 1), jnp.uint32)
+    adj = _sharded_adj_dev(srg, packed, True)
+    direction = ("auto", 14.0, 24.0, srg.num_vertices, srg.num_edges)
+    return Program(
+        name="sharded.relay_mxu", path="bfs_tpu/parallel/sharded.py",
+        fn=_bfs_sharded_relay_fused,
+        args=(
+            tiles_arg, dummy, dummy, _own_word_table_dev(srg), *adj,
+            jnp.asarray(srg.outdeg), jnp.int32(0),
+        ),
+        static_kwargs=dict(
+            mesh=mesh, static=static, max_levels=16, telemetry=True,
+            direction=direction, exchange=("auto", 8), sparse=True,
+        ),
+        v_elements=srg.num_vertices, packed=packed,
+        budget_bytes=_hbm_envelope(),
+        mesh_axes=frozenset({"graph", "batch"}),
+        required_axes=frozenset({"graph"}),
+    )
+
+
 def _spec_relay_multi_fused():
     import jax.numpy as jnp
 
@@ -820,10 +932,12 @@ PROGRAM_SPECS = {
     "serve.batch_pull": lambda: _spec_serve_batch("pull"),
     "direction.fused_auto": _spec_direction_fused,
     "relay.fused": _spec_relay_fused,
+    "relay.fused_mxu": _spec_relay_fused_mxu,
     "relay.multi_fused": _spec_relay_multi_fused,
     "relay.step_dense": lambda: _spec_relay_step("dense"),
     "relay.step_sparse": lambda: _spec_relay_step("sparse"),
     "relay.segment": _spec_relay_segment,
+    "relay.segment_mxu": _spec_relay_segment_mxu,
     "multisource.segment_push": lambda: _spec_multi_segment("push"),
     "multisource.segment_pull": lambda: _spec_multi_segment("pull"),
     "sharded.relay_segment": _spec_sharded_relay_segment,
@@ -836,6 +950,7 @@ PROGRAM_SPECS = {
         "exchange_auto"
     ),
     "sharded.relay_push": lambda: _spec_sharded_relay("push"),
+    "sharded.relay_mxu": _spec_sharded_relay_mxu,
     "layout.device_hist": lambda: _spec_layout_device("layout.device_hist"),
     "layout.device_relabel": lambda: _spec_layout_device(
         "layout.device_relabel"

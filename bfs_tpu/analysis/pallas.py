@@ -561,6 +561,7 @@ def registered_sites(specs: dict | None = None) -> set:
 # --------------------------------------------------------------------------
 
 _PAL_PATH = "bfs_tpu/ops/relay_pallas.py"
+_MXU_PATH = "bfs_tpu/ops/relay_mxu.py"
 _BUILD_CACHE: dict = {}
 
 
@@ -824,8 +825,70 @@ def _spec_update_packed() -> KernelCase:
     )
 
 
-def _make_spec(name, sites, build):
-    spec = KernelSpec(name=name, path=_PAL_PATH, sites=sites, build=build)
+def _mxu_case():
+    """Deterministic lint-scale MXU expansion fixture: a small scrambled-
+    key tile layout (host oracle builder) whose geometry is deliberately
+    unaligned — rows not a multiple of 128, cols under one superblock —
+    so the padding conventions (inert tiles, zero frontier pad block,
+    sentinel key rows) all execute."""
+    def build():
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        from ..graph.adj_tiles import build_adj_tiles_host, keys_from_new2old
+
+        rng = np.random.default_rng(41)
+        rows, cols, e = 1376, 800, 4000
+        src = rng.integers(0, rows, e)
+        dst = rng.integers(0, cols, e)
+        keys2d = keys_from_new2old(
+            rng.permutation(rows).astype(np.int64), rows
+        )
+        at = build_adj_tiles_host(
+            src, dst, rows=rows, cols=cols, keys2d=keys2d
+        )
+        fw = rng.integers(0, 2**32, at.rtp // 32, dtype=np.uint32)
+        # One guaranteed-empty frontier row block: the early-out branch
+        # must execute (and the twin must agree it contributes nothing).
+        fw[0:4] = 0
+        return at, jnp.asarray(fw[: rows // 32 + (1 if rows % 32 else 0)])
+
+    return _memo("mxu_case", build)
+
+
+def _spec_expand_mxu() -> KernelCase:
+    from ..graph.adj_tiles import TILE
+    from ..ops import relay_mxu as RM
+
+    at, fw = _mxu_case()
+    ops = RM.mxu_device_operands(at)
+    windows = []
+    ntp = at.ntp
+    rb_limit = at.keys2d.shape[0]
+    for t in range(ntp):
+        windows.append(Window(f"mxu:tile{t}", t, 1, ntp))
+        windows.append(Window(f"mxu:fblk{t}", t, 1, ntp))
+        windows.append(Window(
+            f"mxu:keys{t}", int(at.row_idx[t]), 1, rb_limit
+        ))
+    return KernelCase(
+        run=lambda: RM.expand_frontier_mxu(
+            fw, ops, rows=at.rows, cols=at.cols, rtp=at.rtp, vtp=at.vtp,
+            interpret=True,
+        ),
+        twin=lambda: RM.expand_frontier_mxu_xla(
+            fw, ops, rows=at.rows, cols=at.cols, rtp=at.rtp, vtp=at.vtp
+        ),
+        windows=windows,
+        mxu=True,  # the PAL002 128x128 contract — first real consumer
+    )
+
+
+def _make_spec(name, sites, build, path=None):
+    spec = KernelSpec(
+        name=name, path=path or _PAL_PATH, sites=sites, build=build
+    )
 
     def builder():
         return spec
@@ -863,6 +926,16 @@ KERNEL_SPECS = {
         "update.packed_words",
         (f"{_PAL_PATH}::apply_relay_candidates_packed_pallas",),
         _spec_update_packed,
+    ),
+    # The MXU expansion arm (ISSUE 15): mxu=True — the first real
+    # consumer of the PAL002 128x128 MXU block contract; PAL005 pins the
+    # kernel byte-identical to its XLA twin (the raw-bytes oracle was
+    # built for exactly this arm).
+    "expand.frontier_mxu": _make_spec(
+        "expand.frontier_mxu",
+        (_MXU_PATH + "::expand_frontier_mxu",),
+        _spec_expand_mxu,
+        path=_MXU_PATH,
     ),
 }
 

@@ -543,6 +543,45 @@ def _relay_cache_detail() -> dict:
         if k not in ("builder", "build_stages", "build_seconds")
     }
 
+
+def _expansion_detail(eng) -> dict:
+    """``details.expansion`` (ISSUE 15): which expansion arm the timed
+    repeats ran, why (forced / measured / static gate), the probe's arm
+    seconds when one ran, and the tile-layout density evidence.  The
+    per-level arm schedule is joined in by :func:`_expansion_per_level`
+    once the direction schedule is known."""
+    detail = {
+        "arm": getattr(eng, "expansion", "gather"),
+        "requested": getattr(eng, "expansion_requested", "auto"),
+        "selection_basis": getattr(eng, "expansion_basis", None),
+    }
+    probe = getattr(eng, "expansion_probe", None)
+    if probe is not None:
+        detail["probe"] = probe
+    at = getattr(eng, "adj_tiles", None)
+    if at is not None:
+        from .graph.adj_tiles import tile_occupancy_hist
+
+        detail["tile_occupancy"] = tile_occupancy_hist(at)
+        detail.update(getattr(eng, "tiles_info", {}) or {})
+    return detail
+
+
+def _expansion_per_level(layout_detail: dict) -> None:
+    """Join the per-level ARM schedule into ``details.expansion``: a pull
+    level ran this engine's expansion arm (gather's Beneš pipeline or the
+    mxu matmul), a push level the sparse gather body — derived from the
+    SAME direction schedule the capture already pins, so the two views
+    can never disagree."""
+    exp = layout_detail.get("expansion")
+    sched = layout_detail.get("direction_schedule")
+    if not isinstance(exp, dict) or not isinstance(sched, dict):
+        return
+    arm = exp.get("arm", "gather")
+    exp["per_level"] = [
+        arm if s == "pull" else "sparse" for s in sched.get("schedule", [])
+    ]
+
 @jax.jit
 def _pack_dist_words(d):
     """Reached-bit words from a dist vector, padded to a multiple of 32.
@@ -1499,6 +1538,11 @@ def main():
         "rowmin_kernel": os.environ.get("BFS_TPU_ROWMIN", "auto") or "auto",
         "state_update_kernel": os.environ.get("BFS_TPU_STATE_UPDATE", "auto")
         or "auto",
+        # The expansion arm (ISSUE 15): gather- and mxu-timed repeats
+        # must never blend into one median, same contract as the applier
+        # and direction knobs.
+        "expansion": os.environ.get("BFS_TPU_EXPANSION", "auto") or "auto",
+        "mxu_kernel": os.environ.get("BFS_TPU_MXU_KERNEL", "auto") or "auto",
     })
     _install_signal_handlers(jr)
 
@@ -1741,6 +1785,11 @@ def main():
         layout_detail["phase_kernel_selection"] = eng.phase_selection
         if eng.phase_probe is not None:
             layout_detail["phase_kernel_probe"] = eng.phase_probe
+        # details.expansion (ISSUE 15): which expansion arm the timed
+        # repeats ran and WHY, plus the tile-layout density evidence; the
+        # per-level arm schedule joins once the direction schedule is
+        # known (the level-curve phase below).
+        layout_detail["expansion"] = _expansion_detail(eng)
         if (
             isinstance(eng.applier_probe, dict)
             and "selected" in eng.applier_probe
@@ -2191,6 +2240,7 @@ def main():
                 sched = curve_rec["level_curve"].get("direction_schedule")
                 if sched is not None:
                     layout_detail["direction_schedule"] = sched
+                    _expansion_per_level(layout_detail)
             _stamp("journal: level curve restored (direction schedule rides it)")
         elif _behind(0.80):
             _stamp("behind budget: skipping level curve")
@@ -2240,6 +2290,7 @@ def main():
                 # telemetry pull, journaled with the curve so a resumed
                 # bench replays it bit-identically.
                 layout_detail["direction_schedule"] = sched
+                _expansion_per_level(layout_detail)
                 _stamp(
                     "direction schedule: "
                     + "".join(

@@ -333,8 +333,9 @@ def _load_or_build(graph, *, cache, tag, kind, key_fn, build_fn, to_arrays,
             {
                 "kind": kind,
                 "build_seconds": build_seconds,
-                "num_vertices": int(obj.num_vertices),
-                "num_edges": int(obj.num_edges),
+                # getattr: sidecar artifacts (adj tiles) size differently
+                "num_vertices": int(getattr(obj, "num_vertices", -1)),
+                "num_edges": int(getattr(obj, "num_edges", -1)),
                 **build_meta,
             },
             tag=tag,
@@ -415,6 +416,155 @@ def load_or_build_relay(graph, *, cache: LayoutCache | None = None,
         build_meta=build_meta,
         prepare_build=prepare,
     )
+
+
+def tiles_key(rg) -> str:
+    """Content key for the MXU adjacency-tile SIDECAR bundle (ISSUE 15):
+    blake2b over the relay layout's relabeled edge structure + relabel
+    table — everything the tile builder consumes.  A SIDECAR next to —
+    never inside — the relay bundle, so the relay schema (and every
+    existing bundle) stays byte-identical."""
+    from ..graph.adj_tiles import TILES_VERSION
+
+    h = hashlib.blake2b(digest_size=16)
+    for arr in (rg.adj_indptr, rg.adj_dst, rg.new2old):
+        a = np.ascontiguousarray(np.asarray(arr))
+        h.update(str(a.dtype).encode())
+        h.update(memoryview(a))
+    h.update(np.int64(rg.vr).tobytes())
+    return f"adjtiles_v{TILES_VERSION}_s{STORE_VERSION}_{h.hexdigest()}"
+
+
+def load_or_build_tiles(rg, *, cache: LayoutCache | None = None,
+                        builder: str | None = None,
+                        budget_bytes: int | None = None):
+    """``(AdjTiles, info)`` — the MXU arm's tiled adjacency, disk-cached
+    as a sidecar bundle (info contract: :func:`_load_or_build`).  The
+    host builder is the pinned oracle; the device arm
+    (``BFS_TPU_TILES_BUILD``, default device) is bit-identical and falls
+    back to host on failure.  ``BFS_TPU_TILES_CACHE=1`` enables the
+    default on-disk cache when the caller passes none (engine inits stay
+    build-only by default — fixture-scale tiles build in milliseconds)."""
+    from ..graph.adj_tiles import (
+        build_adj_tiles_from_relay,
+        resolve_tiles_builder,
+        tiles_from_arrays,
+        tiles_to_arrays,
+    )
+
+    if cache is None and os.environ.get("BFS_TPU_TILES_CACHE", "") == "1":
+        cache = LayoutCache()
+    builder = resolve_tiles_builder(builder)
+    at, info = _load_or_build(
+        rg,
+        cache=cache,
+        tag=None,
+        kind="adj_tiles",
+        key_fn=lambda: tiles_key(rg),
+        build_fn=lambda: build_adj_tiles_from_relay(
+            rg, builder, budget_bytes
+        ),
+        to_arrays=tiles_to_arrays,
+        from_arrays=tiles_from_arrays,
+        build_meta={"builder": builder},
+    )
+    # The budget must gate WARM HITS too: the key does not include the
+    # budget knob, so a bundle built under a looser BFS_TPU_MXU_TILE_GB
+    # would otherwise ship right past a tightened one.
+    if budget_bytes is not None and at.nbytes > budget_bytes:
+        raise ValueError(
+            f"cached adjacency tile layout is {at.nbytes >> 20} MB, over "
+            f"the {budget_bytes >> 20} MB budget (BFS_TPU_MXU_TILE_GB)"
+        )
+    return at, info
+
+
+# ---------------------------------------------------------------------------
+# Phase-probe verdict memo (ISSUE 15 satellite): probe_phase_kernels is a
+# pure function of (layout shapes, kernel/probe sources, backend, knobs) —
+# serve cold-start used to re-pay its K-loops per registered graph even when
+# the layout bundle itself warm-hit.  Verdicts are tiny JSON files stored
+# content-keyed next to the layout bundles.
+# ---------------------------------------------------------------------------
+
+#: Source files whose bytes key the probe verdict: the kernels and the
+#: probe itself — an arm implementation change must re-probe.
+_PROBE_SOURCES = (
+    "ops/relay.py", "ops/relay_pallas.py", "ops/relay_mxu.py",
+    "profiling.py",
+)
+
+
+def probe_verdict_key(eng) -> str:
+    """Content key of one engine's probe verdict: layout geometry (the
+    probe's operand shapes), expansion-arm geometry when tiles exist,
+    kernel sources, jax version + backend + device kind, and the knob
+    env."""
+    import jax
+
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    h = hashlib.blake2b(digest_size=16)
+    for rel in _PROBE_SOURCES:
+        try:
+            with open(os.path.join(pkg, rel), "rb") as f:
+                h.update(f.read())
+        except OSError:
+            h.update(b"missing:" + rel.encode())
+    rg = eng.relay_graph
+    geo = (
+        rg.vr, rg.net_size, rg.vperm_size,
+        tuple((c.width, c.va, c.vb, c.sa, c.sb, c.vertex_major)
+              for c in rg.in_classes),
+        bool(eng.packed),
+    )
+    if eng.adj_tiles is not None:
+        geo = geo + (eng.adj_tiles.nt, eng.adj_tiles.vtp, eng.adj_tiles.rtp)
+    h.update(repr(geo).encode())
+    dev = jax.devices()[0]
+    h.update(
+        f"{jax.__version__}|{jax.default_backend()}|"
+        f"{getattr(dev, 'device_kind', '?')}".encode()
+    )
+    for knob in ("BFS_TPU_PAL_VMEM_MB", "BFS_TPU_MXU_KERNEL"):
+        h.update(f"{knob}={os.environ.get(knob, '')}".encode())
+    return f"probe_{h.hexdigest()}"
+
+
+def _probe_dir(root: str | None = None) -> str:
+    return os.path.join(root or default_root(), "probe")
+
+
+def load_probe_verdict(key: str, root: str | None = None) -> dict | None:
+    path = os.path.join(_probe_dir(root), f"{key}.json")
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+        if doc.get("key") != key:
+            raise ValueError("probe verdict key mismatch")
+        bump_artifact("phase_probe_memo_hits")
+        return doc["verdict"]
+    except OSError:
+        return None
+    except Exception as exc:
+        logger.warning("dropping corrupt probe verdict %s: %s", key, exc)
+        try:
+            os.remove(path)
+        except OSError:
+            pass
+        return None
+
+
+def save_probe_verdict(key: str, verdict: dict,
+                       root: str | None = None) -> None:
+    d = _probe_dir(root)
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, f"{key}.json")
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump({"key": key, "created": time.time(), "verdict": verdict},
+                  f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+    bump_artifact("phase_probe_memo_writes")
 
 
 def load_or_build_pull(graph, *, k: int | None = None, row_multiple: int = 64,

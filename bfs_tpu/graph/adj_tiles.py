@@ -1,0 +1,447 @@
+"""Bit-packed tiled adjacency for the MXU expansion arm (ISSUE 15).
+
+The relay pipeline expands the frontier through Beneš bit routing — dense,
+gather-free, but every bit moves on the VPU while the MXU sits idle.  BLEST
+(arxiv 2512.21967) and "Graph Traversal on Tensor Cores" (arxiv 2606.05081)
+both reformulate dense-frontier expansion as tiled boolean matrix products
+over bit-packed adjacency tiles; this module is the LAYOUT half of that
+arm (ops/relay_mxu.py is the kernel half).
+
+Geometry (all in the RELAY relabeled id space — the frontier words the
+fused programs already carry feed the tiles directly, no repacking):
+
+  * a **tile** is a 128 (src rows) x 128 (dst bits) block of the adjacency
+    matrix, stored bit-packed as ``uint32[128, 4]`` — tile ``t``, row
+    ``i``, word ``j``, bit ``b`` set iff edge
+    ``(u = row_idx[t]*128 + i,  v = col_id[t]*128 + 32*j + b)`` exists.
+    2 KB per stored tile; EMPTY tiles are never stored (CSR-of-tiles), so
+    the layout costs ``nt * 2 KB`` where ``nt`` is the number of nonempty
+    128x128 blocks — dense/community graphs sit near the bitmap floor,
+    scale-free tails degrade toward one tile per edge (the budget gate in
+    ops/relay_mxu.resolve_expansion is what keeps a hostile graph from
+    OOMing the arm into existence).
+  * tiles are sorted by ``(col_id, row_idx)`` and grouped into **column
+    superblocks** of 128 column-tiles (= 16384 destinations = one 128x128
+    uint32 output block, the MXU-aligned unit the kernel's grid walks);
+    ``sb_indptr[g]`` bounds superblock ``g``'s tile span.
+  * ``keys2d[rb, i]`` is the ORIGINAL id of src row ``u = rb*128 + i`` as
+    uint32 (``PACKED_SENTINEL`` at relabel dummies and padding) — the
+    candidate VALUE the expansion emits per destination is the MINIMUM
+    original id over contributing frontier sources, i.e. exactly the
+    canonical min-parent every engine and the oracle share.  One extra
+    all-sentinel row block (and one all-zero frontier pad block) backs the
+    ``row_idx = row_blocks`` padding convention.
+
+The host builder is the PINNED ORACLE; the device builder runs the heavy
+per-edge stages (tile coding, the (col, row, bit) sort, dedup flags) as
+jitted XLA programs and is bit-identical to it (tests/test_expansion_mxu).
+Bundles are stored as a SIDECAR next to the relay layout bundle
+(cache/layout.load_or_build_tiles) with the same byte-stable conventions —
+the relay bundle schema itself is untouched.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+#: Tile geometry: 128 src rows x 128 dst bits (4 uint32 words per row).
+TILE = 128
+TILE_WORDS = TILE // 32
+#: Column-superblock: 128 column-tiles = one (128, 128) uint32 output
+#: block per kernel grid step — the MXU-aligned unit (PAL002 mxu=True).
+SB_TILES = 128
+SB_VERTS = SB_TILES * TILE  # 16384 destinations
+
+#: Unreached/min-identity sentinel — the packed-state lattice top
+#: (ops/packed.PACKED_SENTINEL), redeclared as a plain numpy scalar so this
+#: module never imports jax at layout-build time.
+KEY_SENTINEL = np.uint32(0xFFFFFFFF)
+
+TILES_VERSION = 1
+
+
+def round_up(x: int, m: int) -> int:
+    return -(-int(x) // m) * m
+
+
+@dataclass(frozen=True)
+class AdjTiles:
+    """CSR-of-tiles adjacency for one expansion target.
+
+    ``cols`` is the destination id space (single-chip: the relay ``vr``;
+    sharded: the shard's owned ``block``); ``rows`` the source id space
+    (single-chip ``vr``, sharded the GLOBAL ``n*block``).  ``vtp``/``rtp``
+    are their 16384-/128-padded extents; ``nt`` the real tile count
+    (arrays are padded to ``ntp >= 1`` with inert tiles whose ``row_idx``
+    points at the guaranteed-zero frontier pad block and whose ``col_id``
+    is the dropped overflow segment)."""
+
+    rows: int
+    cols: int
+    rtp: int
+    vtp: int
+    nt: int
+    tiles: np.ndarray  # uint32[ntp, TILE, TILE_WORDS]
+    row_idx: np.ndarray  # int32[ntp]; pad = rtp // TILE
+    col_id: np.ndarray  # int32[ntp]; pad = vtp // TILE
+    sb_indptr: np.ndarray  # int32[vtp // SB_VERTS + 1]
+    keys2d: np.ndarray  # uint32[rtp // TILE + 1, TILE]
+
+    @property
+    def ntp(self) -> int:
+        return int(self.tiles.shape[0])
+
+    @property
+    def nbytes(self) -> int:
+        return int(
+            self.tiles.nbytes + self.row_idx.nbytes + self.col_id.nbytes
+            + self.sb_indptr.nbytes + self.keys2d.nbytes
+        )
+
+
+def keys_from_new2old(new2old: np.ndarray, rows: int) -> np.ndarray:
+    """uint32[rtp//TILE + 1, TILE] original-id key table: ``new2old``
+    where real, ``KEY_SENTINEL`` at dummies/padding, one extra sentinel
+    pad block (the ``row_idx`` padding target)."""
+    rtp = round_up(rows, TILE)
+    n2o = np.asarray(new2old)
+    keys = np.full(rtp + TILE, KEY_SENTINEL, dtype=np.uint32)
+    real = n2o >= 0
+    keys[: n2o.shape[0]][real] = n2o[real].astype(np.uint32)
+    return keys.reshape(-1, TILE)
+
+
+def _finalize(
+    rows: int, cols: int, nt: int,
+    tiles: np.ndarray, row_idx: np.ndarray, col_id: np.ndarray,
+    keys2d: np.ndarray,
+) -> AdjTiles:
+    """Shared tail of both builders: pad to ``ntp >= 1`` with inert tiles
+    and derive the superblock index.  Everything here is a deterministic
+    function of the sorted tile list, so host and device arms converge to
+    byte-identical arrays."""
+    rtp = round_up(rows, TILE)
+    vtp = round_up(max(cols, 1), SB_VERTS)
+    if nt == 0:
+        tiles = np.zeros((1, TILE, TILE_WORDS), dtype=np.uint32)
+        row_idx = np.array([rtp // TILE], dtype=np.int32)
+        col_id = np.array([vtp // TILE], dtype=np.int32)
+    sb = np.searchsorted(
+        np.asarray(col_id[:max(nt, 0)]) // SB_TILES,
+        np.arange(vtp // SB_VERTS + 1),
+        side="left",
+    ).astype(np.int32)
+    return AdjTiles(
+        rows=int(rows), cols=int(cols), rtp=rtp, vtp=vtp, nt=int(nt),
+        tiles=np.ascontiguousarray(tiles, dtype=np.uint32),
+        row_idx=np.ascontiguousarray(row_idx, dtype=np.int32),
+        col_id=np.ascontiguousarray(col_id, dtype=np.int32),
+        sb_indptr=sb,
+        keys2d=np.ascontiguousarray(keys2d, dtype=np.uint32),
+    )
+
+
+def _check_budget(nt: int, budget_bytes: int | None) -> None:
+    need = int(nt) * TILE * TILE_WORDS * 4
+    if budget_bytes is not None and need > budget_bytes:
+        raise ValueError(
+            f"adjacency tile layout needs {need >> 20} MB "
+            f"({nt} tiles x 2 KB), over the {budget_bytes >> 20} MB "
+            "budget (BFS_TPU_MXU_TILE_GB) — a scale-free tail this "
+            "sparse belongs on the gather arm"
+        )
+
+
+def build_adj_tiles_host(
+    src: np.ndarray, dst: np.ndarray, *, rows: int, cols: int,
+    keys2d: np.ndarray, budget_bytes: int | None = None,
+) -> AdjTiles:
+    """THE pinned oracle builder: (src, dst) edge lists (relay-space ids,
+    ``src < rows``, ``dst < cols``) -> the tiled layout.  Duplicate edges
+    OR onto the same bit, so multigraph inputs are handled identically to
+    the device arm's dedup pass.  ``budget_bytes`` rejects (before the
+    tile allocation) layouts whose nonempty-tile count would exceed it."""
+    src = np.asarray(src, dtype=np.int64)
+    dst = np.asarray(dst, dtype=np.int64)
+    if src.shape[0] == 0:
+        return _finalize(rows, cols, 0, None, None, None, keys2d)
+    cb = dst >> 7
+    rb = src >> 7
+    code = cb * (round_up(rows, TILE) // TILE + 1) + rb
+    order = np.argsort(code, kind="stable")
+    cs = code[order]
+    first = np.concatenate([[True], cs[1:] != cs[:-1]])
+    tile_of = np.cumsum(first) - 1
+    nt = int(tile_of[-1]) + 1
+    _check_budget(nt, budget_bytes)
+    row_idx = rb[order][first].astype(np.int32)
+    col_id = cb[order][first].astype(np.int32)
+    tiles = np.zeros(nt * TILE * TILE_WORDS, dtype=np.uint32)
+    i = src[order] & (TILE - 1)
+    vloc = dst[order] & (TILE - 1)
+    flat = tile_of * (TILE * TILE_WORDS) + i * TILE_WORDS + (vloc >> 5)
+    np.bitwise_or.at(tiles, flat, np.uint32(1) << (vloc & 31).astype(np.uint32))
+    return _finalize(
+        rows, cols, nt, tiles.reshape(nt, TILE, TILE_WORDS), row_idx,
+        col_id, keys2d,
+    )
+
+
+def build_adj_tiles_device(
+    src: np.ndarray, dst: np.ndarray, *, rows: int, cols: int,
+    keys2d: np.ndarray, budget_bytes: int | None = None,
+) -> AdjTiles:
+    """Device arm: the per-edge heavy stages — tile coding, the
+    (col_tile, row_tile, in-tile bit) sort, the first-of-tile and
+    duplicate-edge flags, and the bit scatter — run as jitted XLA
+    programs (PR 10 builder-pipeline style: one trace per shape via the
+    module jit cache); only the data-dependent ``nt`` is read back
+    between the two programs.  Bit-identical to the host oracle."""
+    import jax.numpy as jnp
+
+    src = np.asarray(src, dtype=np.int32)
+    dst = np.asarray(dst, dtype=np.int32)
+    if src.shape[0] == 0:
+        return _finalize(rows, cols, 0, None, None, None, keys2d)
+    cb_s, rb_s, lb_s, first, dup = [
+        np.asarray(a) for a in _dev_sort(jnp.asarray(src), jnp.asarray(dst))
+    ]
+    nt = int(first.sum())
+    _check_budget(nt, budget_bytes)
+    tile_of = np.cumsum(first) - 1
+    row_idx = rb_s[first.astype(bool)].astype(np.int32)
+    col_id = cb_s[first.astype(bool)].astype(np.int32)
+    tiles = np.asarray(
+        _dev_scatter(
+            jnp.asarray(tile_of.astype(np.int32)), jnp.asarray(lb_s),
+            jnp.asarray(dup), nt,
+        )
+    )
+    return _finalize(
+        rows, cols, nt, tiles.reshape(nt, TILE, TILE_WORDS), row_idx,
+        col_id, keys2d,
+    )
+
+
+_DEV_CACHE: dict = {}
+
+
+def _dev_sort(src, dst):
+    """Jitted sort stage: (col_tile, row_tile, in-tile bit id) three-key
+    sort + first-of-tile and exact-duplicate flags.  int32 keys only —
+    the flat tile code overflows int32 at scale, which is exactly why
+    this is a multi-key ``lax.sort`` and not a coded argsort."""
+    import jax
+    import jax.numpy as jnp
+
+    fn = _DEV_CACHE.get("sort")
+    if fn is None:
+
+        def _sort(src, dst):
+            cb = dst >> 7
+            rb = src >> 7
+            lb = (src & (TILE - 1)) * TILE + (dst & (TILE - 1))
+            cb_s, rb_s, lb_s = jax.lax.sort((cb, rb, lb), num_keys=3)
+            newt = jnp.concatenate(
+                [
+                    jnp.ones(1, jnp.int32),
+                    (
+                        (cb_s[1:] != cb_s[:-1]) | (rb_s[1:] != rb_s[:-1])
+                    ).astype(jnp.int32),
+                ]
+            )
+            dup = jnp.concatenate(
+                [
+                    jnp.zeros(1, jnp.int32),
+                    (
+                        (cb_s[1:] == cb_s[:-1])
+                        & (rb_s[1:] == rb_s[:-1])
+                        & (lb_s[1:] == lb_s[:-1])
+                    ).astype(jnp.int32),
+                ]
+            )
+            return cb_s, rb_s, lb_s, newt, dup
+
+        fn = jax.jit(_sort)
+        _DEV_CACHE["sort"] = fn
+    return fn(src, dst)
+
+
+def _dev_scatter(tile_of, lb_s, dup, nt: int):
+    """Jitted bit-scatter stage: every first-occurrence edge contributes
+    ``1 << bit`` to its word — after dedup the bits are unique, so a sum
+    scatter IS the bitwise OR the oracle computes."""
+    import jax
+    import jax.numpy as jnp
+
+    fn = _DEV_CACHE.get("scatter")
+    if fn is None:
+
+        def _scatter(tile_of, lb_s, dup, nt):
+            word = tile_of * (TILE * TILE_WORDS) + (
+                (lb_s // TILE) * TILE_WORDS + ((lb_s % TILE) >> 5)
+            )
+            bit = jnp.uint32(1) << (lb_s % TILE & 31).astype(jnp.uint32)
+            word = jnp.where(dup == 0, word, nt * TILE * TILE_WORDS)
+            return (
+                jnp.zeros(nt * TILE * TILE_WORDS, jnp.uint32)
+                .at[word]
+                .add(jnp.where(dup == 0, bit, 0), mode="drop")
+            )
+
+        fn = jax.jit(_scatter, static_argnums=(3,))
+        _DEV_CACHE["scatter"] = fn
+    return fn(tile_of, lb_s, dup, nt)
+
+
+def resolve_tiles_builder(builder: str | None = None) -> str:
+    """``BFS_TPU_TILES_BUILD=device|host`` (default device — the PR 10
+    convention; host is the pinned oracle)."""
+    import os
+
+    builder = builder or os.environ.get("BFS_TPU_TILES_BUILD", "device")
+    if builder not in ("device", "host"):
+        raise ValueError(
+            f"unknown tiles builder {builder!r}; use device|host"
+        )
+    return builder
+
+
+def _relay_edges(rg):
+    """(src, dst) relay-relabeled edge arrays from a RelayGraph's sparse
+    CSR (adj_indptr rows ascend with relabeled src; adj_dst is the
+    relabeled destination)."""
+    deg = np.diff(np.asarray(rg.adj_indptr[: rg.vr + 1], dtype=np.int64))
+    src = np.repeat(np.arange(rg.vr, dtype=np.int64), deg)
+    return src, np.asarray(rg.adj_dst, dtype=np.int64)
+
+
+def build_adj_tiles_from_relay(
+    rg, builder: str | None = None, budget_bytes: int | None = None,
+) -> AdjTiles:
+    """The single-chip layout: rows == cols == the relay ``vr``; keys are
+    ``new2old`` (the candidate the expansion emits is the min ORIGINAL
+    id over contributing frontier sources — the canonical parent)."""
+    src, dst = _relay_edges(rg)
+    keys2d = keys_from_new2old(rg.new2old, rg.vr)
+    build = (
+        build_adj_tiles_device
+        if resolve_tiles_builder(builder) == "device"
+        else build_adj_tiles_host
+    )
+    try:
+        return build(
+            src, dst, rows=rg.vr, cols=rg.vr, keys2d=keys2d,
+            budget_bytes=budget_bytes,
+        )
+    except ValueError:
+        raise  # over-budget is a decision, not an availability failure
+    except Exception:
+        if build is build_adj_tiles_host:
+            raise
+        # Same availability contract as the relay device builder: a
+        # device-arm failure falls back to the oracle, never to "no arm".
+        return build_adj_tiles_host(
+            src, dst, rows=rg.vr, cols=rg.vr, keys2d=keys2d,
+            budget_bytes=budget_bytes,
+        )
+
+
+def build_adj_tiles_sharded(
+    srg, builder: str | None = None, budget_bytes: int | None = None,
+) -> list:
+    """Per-shard tile layouts for the sharded relay: shard ``s`` owns the
+    LOCAL destination block, sources span the GLOBAL relabeled space (the
+    all-gathered frontier words are the kernel's input, exactly as for
+    the dense Beneš body).  Keys are the global ``new2old``."""
+    n = srg.num_shards
+    gtot = n * srg.block
+    keys2d = keys_from_new2old(srg.new2old, gtot)
+    build = (
+        build_adj_tiles_device
+        if resolve_tiles_builder(builder) == "device"
+        else build_adj_tiles_host
+    )
+    out = []
+    for s in range(n):
+        indptr = np.asarray(srg.adj_indptr[s], dtype=np.int64)
+        deg = np.diff(indptr[: gtot + 1])
+        src = np.repeat(np.arange(gtot, dtype=np.int64), deg)
+        dst = np.asarray(srg.adj_dst[s], dtype=np.int64)[: src.shape[0]]
+        try:
+            at = build(
+                src, dst, rows=gtot, cols=srg.block, keys2d=keys2d,
+                budget_bytes=budget_bytes,
+            )
+        except ValueError:
+            raise
+        except Exception:
+            if build is build_adj_tiles_host:
+                raise
+            at = build_adj_tiles_host(
+                src, dst, rows=gtot, cols=srg.block, keys2d=keys2d,
+                budget_bytes=budget_bytes,
+            )
+        out.append(at)
+    return out
+
+
+def tile_occupancy_hist(at: AdjTiles) -> dict:
+    """Per-tile set-bit histogram over power-of-two buckets — the density
+    evidence the bench ships in ``details.expansion`` (a layout living in
+    the 1-16 bucket is one-edge-per-tile scale-free tail; 4096+ is the
+    dense-community regime the MXU arm exists for)."""
+    pops = np.array(
+        [
+            int(np.unpackbits(t.view(np.uint8)).sum())
+            for t in np.asarray(at.tiles[: max(at.nt, 0)])
+        ],
+        dtype=np.int64,
+    )
+    edges = [1, 16, 64, 256, 1024, 4096, TILE * TILE + 1]
+    hist = {}
+    for lo, hi in zip(edges[:-1], edges[1:]):
+        hist[f"{lo}-{hi - 1}"] = int(((pops >= lo) & (pops < hi)).sum())
+    return {
+        "tiles": int(at.nt),
+        "tile_bytes": int(at.nt) * TILE * TILE_WORDS * 4,
+        "edge_bits": int(pops.sum()) if pops.size else 0,
+        "mean_fill": float(pops.mean() / (TILE * TILE)) if pops.size else 0.0,
+        "buckets": hist,
+    }
+
+
+# --------------------------------------------------------------------------
+# Byte-stable sidecar schema (cache/layout.load_or_build_tiles stores these
+# next to — never inside — the relay layout bundle).
+# --------------------------------------------------------------------------
+
+def tiles_to_arrays(at: AdjTiles) -> dict[str, np.ndarray]:
+    return {
+        "dims": np.array(
+            [TILES_VERSION, at.rows, at.cols, at.rtp, at.vtp, at.nt],
+            dtype=np.int64,
+        ),
+        "tiles": at.tiles,
+        "row_idx": at.row_idx,
+        "col_id": at.col_id,
+        "sb_indptr": at.sb_indptr,
+        "keys2d": at.keys2d,
+    }
+
+
+def tiles_from_arrays(z) -> AdjTiles:
+    dims = np.asarray(z["dims"])
+    if int(dims[0]) != TILES_VERSION:
+        raise ValueError(f"adj-tiles schema version {int(dims[0])}")
+    return AdjTiles(
+        rows=int(dims[1]), cols=int(dims[2]), rtp=int(dims[3]),
+        vtp=int(dims[4]), nt=int(dims[5]),
+        tiles=np.asarray(z["tiles"], dtype=np.uint32),
+        row_idx=np.asarray(z["row_idx"], dtype=np.int32),
+        col_id=np.asarray(z["col_id"], dtype=np.int32),
+        sb_indptr=np.asarray(z["sb_indptr"], dtype=np.int32),
+        keys2d=np.asarray(z["keys2d"], dtype=np.uint32),
+    )

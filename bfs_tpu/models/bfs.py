@@ -237,6 +237,24 @@ def _adj_ranks(rg) -> np.ndarray:
     ).astype(np.int32)
 
 
+def _adj_keys(rg) -> np.ndarray:
+    """Per-edge ORIGINAL src ids (the MXU arm's sparse-path payload):
+    ``src_l1[adj_slot]`` — the same table the gather arm's once-per-run
+    host map reads, gathered per edge instead.  Sorting (dst, key) IS the
+    canonical min-parent tie-break, so the sparse superstep needs no
+    changes to emit key candidates."""
+    return np.asarray(rg.src_l1)[np.asarray(rg.adj_slot)].astype(np.int32)
+
+
+def _sparse_third(rg, packed: bool, mxu: bool) -> np.ndarray:
+    """The sparse adjacency's third array per carry/arm flavor: keys for
+    the mxu arm (either carry), ranks for the packed gather carry, L1
+    slots for the unpacked gather carry."""
+    if mxu:
+        return _adj_keys(rg)
+    return _adj_ranks(rg) if packed else rg.adj_slot
+
+
 def slots_to_parent(parent_slots: np.ndarray, src_l1: np.ndarray) -> np.ndarray:
     """Map relay-engine parent values (L1 slot indices; -1 unreached; the
     source's self-entry is fixed up by callers) to ORIGINAL src ids — the
@@ -484,12 +502,44 @@ def _frontier_masses_words(st, outdeg, vr: int):
     return frontier_masses_words(st.fwords, outdeg, vr)
 
 
+def _mxu_body_fn(expansion: tuple, packed: bool):
+    """The mxu dense-superstep closure for the fused/segment programs:
+    ``expansion = ('mxu', geo, use_kernel)`` (ops/relay_mxu.mxu_static
+    geometry + the kernel-vs-twin choice, both static so they live in the
+    program cache key).  The closure takes the TILE-OPERAND tuple in the
+    slot the gather body reads its vperm masks from — one program
+    signature, two arms, byte-identical gather traces."""
+    from ..ops import relay_mxu as RM
+
+    _, geo, use_kernel = expansion
+    step = RM.mxu_superstep_packed if packed else RM.mxu_superstep
+
+    def superstep(st, tile_ops, net_m, valid_words):
+        return step(st, tile_ops, geo, use_kernel)
+
+    return superstep
+
+
+def _mxu_finish(out):
+    """The mxu once-per-run decode: the packed parent field IS the
+    original source id (the expansion's candidate value), so the finish
+    is two field extracts — no rank->slot reconstruction."""
+    from ..ops import relay as R
+    from ..ops.packed import packed_dist, packed_parent
+
+    return R.RelayState(
+        packed_dist(out.packed), packed_parent(out.packed), out.fwords,
+        out.level, out.changed,
+    )
+
+
 @functools.lru_cache(maxsize=16)
 def _relay_fused_program(static, sparse: bool, use_pallas: bool,
                          packed: bool = False, telemetry: bool = False,
                          direction: tuple | None = None,
                          phase_sel: tuple | None = None,
-                         num_real: int | None = None):
+                         num_real: int | None = None,
+                         expansion: tuple = ("gather",)):
     """Jitted relay BFS loop (v4), cached per static layout shape.
 
     With ``sparse``, small frontiers (under the SPARSE_BV/BE budgets) take
@@ -545,7 +595,18 @@ def _relay_fused_program(static, sparse: bool, use_pallas: bool,
     from ..ops import relay as R
     from ..ops.packed import packed_cap
 
-    superstep = _superstep_fn(static, use_pallas, packed, phase_sel)
+    mxu = expansion[0] == "mxu"
+    if mxu:
+        # The MXU expansion arm (ISSUE 15): the dense (pull) body is the
+        # tiled masked matmul of ops/relay_mxu.py; parent VALUES through
+        # the whole carry are ORIGINAL source ids (the sparse push body
+        # ships the key-flavor adjacency), so the finish decodes fields
+        # instead of reconstructing slots.  Everything else — predicates,
+        # budgets, telemetry, caps — is the gather program verbatim, so
+        # the two arms' schedules are bit-identical by construction.
+        superstep = _mxu_body_fn(expansion, packed)
+    else:
+        superstep = _superstep_fn(static, use_pallas, packed, phase_sel)
     mode = direction[0] if direction is not None else None
     # Static Python floats, hoisted OUT of the jitted program body (the
     # float() casts below run at trace-build time on config values, never
@@ -581,9 +642,12 @@ def _relay_fused_program(static, sparse: bool, use_pallas: bool,
         def finish(out):
             # The ONCE-PER-RUN unpack (tentpole contract): the returned
             # state is the same RelayState (slot parents) either way, so
-            # every downstream consumer is unchanged.
+            # every downstream consumer is unchanged.  The mxu arm's
+            # parent field is the ORIGINAL id (key), decoded directly.
             if not packed:
                 return out
+            if mxu:
+                return _mxu_finish(out)
             dist, parent = R.unpack_relay_packed(out.packed, in_classes, vr)
             return R.RelayState(
                 dist, parent, out.fwords, out.level, out.changed
@@ -763,7 +827,8 @@ def _relay_segment_program(static, sparse: bool, use_pallas: bool,
                            packed: bool = False, telemetry: bool = False,
                            direction: tuple | None = None,
                            phase_sel: tuple | None = None,
-                           num_real: int | None = None):
+                           num_real: int | None = None,
+                           expansion: tuple = ("gather",)):
     """ONE bounded segment of the relay loop (ISSUE 14) — the
     checkpointable twin of :func:`_relay_fused_program`.
 
@@ -790,7 +855,13 @@ def _relay_segment_program(static, sparse: bool, use_pallas: bool,
     from ..ops import relay as R
     from ..ops.packed import packed_cap
 
-    superstep = _superstep_fn(static, use_pallas, packed, phase_sel)
+    if expansion[0] == "mxu":
+        # Same arm substitution as the fused program: mxu pull body,
+        # key-flavor candidates — the segment boundary semantics are
+        # untouched, so kill/resume bit-identity carries to the new arm.
+        superstep = _mxu_body_fn(expansion, packed)
+    else:
+        superstep = _superstep_fn(static, use_pallas, packed, phase_sel)
     mode = direction[0] if direction is not None else None
     dir_alpha = float(direction[1]) if direction is not None else 0.0
     dir_beta = float(direction[2]) if direction is not None else 0.0
@@ -890,13 +961,21 @@ def _relay_segment_program(static, sparse: bool, use_pallas: bool,
 
 
 @functools.lru_cache(maxsize=16)
-def _relay_segment_finish_program(in_classes: tuple, vr: int):
+def _relay_segment_finish_program(in_classes: tuple, vr: int,
+                                  mxu: bool = False):
     """Jitted once-per-run unpack for the segmented runner's TRUE loop
-    exit (module-level cache — a per-call jit would retrace, RCD001)."""
+    exit (module-level cache — a per-call jit would retrace, RCD001).
+    The mxu flavor decodes original-id parents instead of slots."""
     from ..ops import relay as R
 
     @jax.jit
     def fin(pk, fw, lv, ch):
+        if mxu:
+            from ..ops.packed import packed_dist, packed_parent
+
+            return R.RelayState(
+                packed_dist(pk), packed_parent(pk), fw, lv, ch
+            )
         dist, parent = R.unpack_relay_packed(pk, in_classes, vr)
         return R.RelayState(dist, parent, fw, lv, ch)
 
@@ -949,7 +1028,8 @@ def _relay_elem_program(static, pt: int, groups: int, use_pallas: bool):
 @functools.lru_cache(maxsize=8)
 def _relay_multi_fused_program(static, use_pallas: bool,
                                packed: bool = False,
-                               phase_sel: tuple | None = None):
+                               phase_sel: tuple | None = None,
+                               expansion: tuple = ("gather",)):
     """Batched (multi-source) relay loop: ``vmap`` lifts the dense superstep
     over a leading sources axis while all trees share one lock-step
     ``while_loop`` (BASELINE.json config 5 semantics).  ``packed`` as in
@@ -960,7 +1040,14 @@ def _relay_multi_fused_program(static, use_pallas: bool,
     from ..ops import relay as R
     from ..ops.packed import packed_cap
 
-    superstep = _superstep_fn(static, use_pallas, packed, phase_sel)
+    mxu = expansion[0] == "mxu"
+    if mxu:
+        # Batched arm: the XLA twin always (kernel-under-vmap is not a
+        # shape Mosaic supports; the twin is bit-identical by the PAL005
+        # contract, so the batch path can never diverge from it).
+        superstep = _mxu_body_fn((expansion[0], expansion[1], False), packed)
+    else:
+        superstep = _superstep_fn(static, use_pallas, packed, phase_sel)
 
     @functools.partial(jax.jit, static_argnames=("max_levels",))
     @traced("bfs.relay_multi_fused")
@@ -988,9 +1075,16 @@ def _relay_multi_fused_program(static, use_pallas: bool,
             out = jax.lax.while_loop(
                 lambda st: st.changed & (st.level < cap), body, state
             )
-            dist, parent = jax.vmap(
-                lambda pk: R.unpack_relay_packed(pk, in_classes, vr)
-            )(out.packed)
+            if mxu:
+                from ..ops.packed import packed_dist, packed_parent
+
+                dist, parent = packed_dist(out.packed), packed_parent(
+                    out.packed
+                )
+            else:
+                dist, parent = jax.vmap(
+                    lambda pk: R.unpack_relay_packed(pk, in_classes, vr)
+                )(out.packed)
             return R.RelayState(
                 dist, parent, out.fwords, out.level, out.changed
             )
@@ -1448,7 +1542,8 @@ class RelayEngine:
     """
 
     def __init__(self, graph, *, sparse_hybrid: bool = True,
-                 applier: str = "auto", direction: str | None = None):
+                 applier: str = "auto", direction: str | None = None,
+                 expansion: str | None = None):
         from ..graph.relay import RelayGraph, build_relay_graph, valid_slot_words
 
         rg = graph if isinstance(graph, RelayGraph) else build_relay_graph(graph)
@@ -1487,6 +1582,17 @@ class RelayEngine:
                 "BFS_TPU_PACKED=1 forced but a degree-class width exceeds "
                 "the 26-bit parent-rank field"
             )
+        # Expansion arm (ISSUE 15): gather (the Beneš relay pipeline) vs
+        # mxu (the tiled masked matmul of ops/relay_mxu.py), selected like
+        # every other arm here — forced by knob or picked by measurement
+        # (probe_phase_kernels' expansion phase on TPU backends), never by
+        # a static default.  Forced 'mxu' resolves NOW (it constrains the
+        # packed carry: the parent field must hold ORIGINAL ids); 'auto'
+        # on a TPU backend defers to the phase probe below.
+        self.adj_tiles = None
+        self._mxu_dev = None
+        self.expansion_probe = None
+        self._resolve_expansion_static(expansion)
         self.applier_probe = None
         self._probe_net_arg = None
 
@@ -1553,17 +1659,20 @@ class RelayEngine:
         if sparse_hybrid:
             # The packed sparse superstep consumes per-edge RANKS (the
             # parent field of the fused word); the unpacked one consumes
-            # L1 slots.  The rank flavor is derived host-side once per
-            # engine (slot = base + rank*stride inverted) so the on-disk
+            # L1 slots; the MXU arm consumes per-edge KEYS (original src
+            # ids — the sort key IS the canonical tie-break, and the
+            # payload matches the mxu pull body's candidates).  Each
+            # flavor is derived host-side once per engine so the on-disk
             # layout bundles stay slot-based and cache-compatible.
-            # _sparse_packed_flavor records which flavor SHIPPED —
-            # distinct from self.packed, which callers may downgrade
-            # (bench's warm-phase truncation guard).
-            self._sparse_packed_flavor = self.packed
+            # _sparse_flavor records which flavor SHIPPED — distinct from
+            # self.packed, which callers may downgrade (bench's
+            # warm-phase truncation guard), and from self.expansion,
+            # which the TPU phase probe may still flip to mxu.
+            self._sparse_flavor = (self.packed, self.expansion == "mxu")
             self._sparse_tensors = (
                 jnp.asarray(rg.adj_indptr),
                 jnp.asarray(rg.adj_dst),
-                jnp.asarray(_adj_ranks(rg) if self.packed else rg.adj_slot),
+                jnp.asarray(_sparse_third(rg, *self._sparse_flavor)),
                 jnp.asarray(outdeg),
             )
         else:
@@ -1612,15 +1721,41 @@ class RelayEngine:
                 )
             forced[phase] = v
         need_auto = [p for p, v in forced.items() if v == "auto"]
-        if need_auto and self.packed and jax.default_backend() == "tpu":
+        # The expansion arm's measured half rides the SAME probe (ISSUE
+        # 15): 'auto' that survived the static gates builds the tile
+        # layout (budget-gated) and lets probe_phase_kernels time the
+        # gather-vs-mxu dense supersteps next to the rowmin/state-update
+        # arms.  BFS_TPU_PHASE_PROBE=force runs the probe on any backend
+        # (the interpret-arm measurement the ledger also takes).
+        probe_exp = self.expansion == "auto-probe"
+        force_probe = os.environ.get("BFS_TPU_PHASE_PROBE", "") == "force"
+        on_tpu = jax.default_backend() == "tpu" or force_probe
+        if probe_exp:
+            if not on_tpu:
+                self.expansion = "gather"
+                self.expansion_basis = (
+                    "auto -> gather: non-tpu backend (mxu arm is "
+                    "interpret-only; force BFS_TPU_EXPANSION=mxu to run "
+                    "it anyway)"
+                )
+                probe_exp = False
+            elif not self._build_tiles(require=False):
+                self.expansion = "gather"
+                probe_exp = False
+        if ((need_auto and self.packed) or probe_exp) and on_tpu:
             from ..profiling import probe_phase_kernels
 
-            try:
-                probe = probe_phase_kernels(self)
-            except Exception as exc:  # pragma: no cover - TPU-only path
-                logger.warning("phase-kernel probe failed: %r", exc)
-                probe = None
+            probe = self._probe_memoized(probe_phase_kernels)
             self.phase_probe = probe
+            if probe_exp:
+                rec = probe.get("expansion") if probe else None
+                if rec is not None and "selected" in rec:
+                    self.expansion = rec["selected"]
+                    self.expansion_basis = rec["selection_basis"]
+                    self.expansion_probe = rec
+                else:
+                    self.expansion = "gather"
+                    self.expansion_basis = "fallback (probe failed)"
             for p in forced:
                 if forced[p] != "auto":
                     sel[p], basis[p] = forced[p], "forced (env)"
@@ -1629,6 +1764,16 @@ class RelayEngine:
                     basis[p] = probe[p]["selection_basis"]
                 else:
                     sel[p], basis[p] = "xla", "fallback (probe failed)"
+            if not (need_auto and self.packed):
+                # Expansion-only probe: the rowmin/state-update phases
+                # keep their static resolution below.
+                for p in forced:
+                    if forced[p] != "auto":
+                        sel[p], basis[p] = forced[p], "forced (env)"
+                    elif not self.packed:
+                        sel[p], basis[p] = (
+                            "xla", "unpacked carry (no fused arm)"
+                        )
         else:
             for p in forced:
                 if forced[p] != "auto":
@@ -1653,6 +1798,141 @@ class RelayEngine:
             self.phase_selection["rowmin"],
             self.phase_selection["state_update"],
         )
+
+    def _probe_memoized(self, probe_fn):
+        """The K-loop phase probe, MEMOIZED content-keyed next to the
+        layout bundle (ISSUE 15 satellite): a bundle-cache warm hit used
+        to re-pay the probe on every engine init — serve registered N
+        graphs, paid N probes per process start.  The verdict is a pure
+        function of (layout shapes, kernel sources, backend, probe
+        knobs), which is exactly the memo key (cache/layout.py)."""
+        from ..cache.layout import load_probe_verdict, save_probe_verdict
+
+        key = None
+        try:
+            from ..cache.layout import probe_verdict_key
+
+            key = probe_verdict_key(self)
+            cached = load_probe_verdict(key)
+            if cached is not None:
+                cached["memo"] = "hit"
+                return cached
+        except Exception as exc:
+            logger.warning("probe memo unavailable: %r", exc)
+        try:
+            probe = probe_fn(self)
+        except Exception as exc:  # pragma: no cover - TPU-only path
+            logger.warning("phase-kernel probe failed: %r", exc)
+            return None
+        if key is not None and probe is not None:
+            probe["memo"] = "miss"
+            try:
+                save_probe_verdict(key, probe)
+            except Exception as exc:
+                logger.warning("probe memo write failed: %r", exc)
+        return probe
+
+    # ---------------------------------------------------------- expansion --
+    def _resolve_expansion_static(self, requested: str | None) -> None:
+        """The static half of the expansion-arm choice (ISSUE 15): forced
+        modes resolve here (and constrain the packed carry — the mxu
+        parent field holds ORIGINAL ids, so ``V`` must fit 26 bits);
+        'auto' applies its static gates and defers the measured half to
+        the phase probe (``expansion == 'auto-probe'`` until then)."""
+        import os
+
+        from ..ops.packed import packed_parent_fits
+        from ..ops.relay_mxu import resolve_expansion
+
+        req = resolve_expansion(requested)
+        self.expansion_requested = req
+        self.expansion = "gather"
+        self.expansion_basis = "default"
+        if req == "gather":
+            self.expansion_basis = "forced (env/arg)"
+            return
+        fits = packed_parent_fits(self.relay_graph.num_vertices)
+        if req == "mxu":
+            if self.packed and not fits:
+                if os.environ.get("BFS_TPU_PACKED", "") == "1":
+                    raise ValueError(
+                        "BFS_TPU_EXPANSION=mxu with BFS_TPU_PACKED=1 "
+                        "needs V <= 2^26: the mxu arm's packed parent "
+                        "field carries ORIGINAL ids"
+                    )
+                self.packed = False
+            self._build_tiles(require=True)
+            self.expansion = "mxu"
+            self.expansion_basis = "forced (env/arg)"
+            return
+        if self.packed and not fits:
+            self.expansion_basis = (
+                "auto -> gather: V exceeds the 26-bit packed parent "
+                "field for original-id candidates"
+            )
+            return
+        # Measured half rides the phase probe (needs the shipped engine
+        # tensors) — _resolve_phase_selection finishes this.
+        self.expansion = "auto-probe"
+
+    def _build_tiles(self, require: bool) -> bool:
+        """Build/load the tiled adjacency (graph/adj_tiles.py) under the
+        BFS_TPU_MXU_TILE_GB budget; ``require`` raises instead of
+        degrading to gather (the forced-mxu contract: a capture must
+        never silently measure the other arm)."""
+        if self.adj_tiles is not None:
+            return True
+        from ..cache.layout import load_or_build_tiles
+        from ..ops.relay_mxu import tiles_budget_bytes
+
+        try:
+            at, info = load_or_build_tiles(
+                self.relay_graph, budget_bytes=tiles_budget_bytes()
+            )
+        except Exception as exc:
+            if require:
+                raise
+            logger.warning("mxu tile build rejected: %r", exc)
+            self.expansion_basis = f"auto -> gather: tiles build ({exc!r})"
+            return False
+        self.adj_tiles = at
+        self.tiles_info = info
+        return True
+
+    def _mxu_ops(self) -> tuple:
+        """Device-resident tile operands, shipped once per engine."""
+        cached = self._mxu_dev
+        if cached is None:
+            from ..ops.relay_mxu import mxu_device_operands
+
+            at = self.adj_tiles
+            self._istamp(
+                f"shipping adjacency tiles ({at.nbytes >> 20} MB, "
+                f"{at.nt} tiles)..."
+            )
+            cached = mxu_device_operands(at)
+            self._mxu_dev = cached
+        return cached
+
+    def _mxu_mask_args(self) -> tuple:
+        """The mxu arm's substitution for the (vperm, net, valid) mask
+        operand slots: the tile tuple plus two 1-element dummies (XLA
+        drops unused operands, same trick as the hybrid-off adjacency
+        dummies)."""
+        dummy = getattr(self, "_mxu_dummy", None)
+        if dummy is None:
+            dummy = self._mxu_dummy = jnp.zeros(1, jnp.uint32)
+        return (self._mxu_ops(), dummy, dummy)
+
+    def _expansion_key(self, kernel_ok: bool = True) -> tuple:
+        """Hashable expansion-arm element for program/executable keys:
+        ``('gather',)`` or ``('mxu', geometry, use_kernel)``."""
+        if self.expansion != "mxu":
+            return ("gather",)
+        from ..ops.relay_mxu import mxu_static, resolve_mxu_kernel
+
+        use_kernel = kernel_ok and resolve_mxu_kernel() == "pallas"
+        return ("mxu", mxu_static(self.adj_tiles), use_kernel)
 
     def _resolve_applier(self, applier: str) -> str:
         """Forced env/arg choice, or the measured probe on TPU 'auto'."""
@@ -1704,44 +1984,52 @@ class RelayEngine:
         return compile_exe_cached(lowered, self._COMPILER_OPTIONS)
 
     def _sparse_tensors_for(self, packed: bool):
-        """Device sparse-adjacency operands matching the carry flavor:
-        ranks for packed, slots for unpacked.  The engine ships its
-        default flavor at init; the other (only ever needed by the
-        deep-graph fallback) is built lazily and memoized."""
-        if not self.sparse_hybrid or packed == getattr(
-            self, "_sparse_packed_flavor", self.packed
+        """Device sparse-adjacency operands matching the carry/arm
+        flavor: keys for the mxu arm, ranks for the packed gather carry,
+        slots for the unpacked one.  The engine ships its default flavor
+        at init; others (the deep-graph fallback, or an expansion arm the
+        TPU probe flipped after shipping) are built lazily and
+        memoized."""
+        flavor = (packed, self.expansion == "mxu")
+        if not self.sparse_hybrid or flavor == getattr(
+            self, "_sparse_flavor", (self.packed, False)
         ):
             return self._sparse_tensors
-        alt = getattr(self, "_sparse_alt", None)
+        memo = getattr(self, "_sparse_alt_memo", None)
+        if memo is None:
+            memo = self._sparse_alt_memo = {}
+        alt = memo.get(flavor)
         if alt is None:
-            rg = self.relay_graph
-            third = rg.adj_slot if not packed else _adj_ranks(rg)
             alt = (
                 self._sparse_tensors[0],
                 self._sparse_tensors[1],
-                jnp.asarray(third),
+                jnp.asarray(_sparse_third(self.relay_graph, *flavor)),
                 self._sparse_tensors[3],
             )
-            self._sparse_alt = alt
+            memo[flavor] = alt
         return alt
 
     def _fused(self, source_new, max_levels, packed: bool | None = None,
                telemetry: bool = False):
         if packed is None:
             packed = self.packed
+        expansion = self._expansion_key()
         fused = _relay_fused_program(
             self._static, self.sparse_hybrid, self._use_pallas(), packed,
             telemetry, self.direction.key(), self._phase_sel(),
-            self.relay_graph.num_vertices,
+            self.relay_graph.num_vertices, expansion,
         )
-        args = (
-            source_new, *self._tensors, *self._sparse_tensors_for(packed)
+        masks = (
+            self._mxu_mask_args()
+            if self.expansion == "mxu"
+            else self._tensors
         )
+        args = (source_new, *masks, *self._sparse_tensors_for(packed))
         if not self._use_pallas():
             return fused(*args, max_levels=max_levels)
         key = (
             "fused", max_levels, packed, telemetry, self.direction.key(),
-            self._phase_sel(),
+            self._phase_sel(), expansion,
         )
         compiled = self._compiled.get(key)
         if compiled is None:
@@ -1813,6 +2101,14 @@ class RelayEngine:
                 return _sparse_superstep(
                     st, indptr, adst, aslot, vr=vr, packed=packed
                 )
+        elif self.expansion == "mxu":
+            from ..ops import relay_mxu as RM
+
+            _, geo, use_kernel = self._expansion_key()
+            step = RM.mxu_superstep_packed if packed else RM.mxu_superstep
+
+            def fn(st, *tile_ops):
+                return step(st, tile_ops, geo, use_kernel)
         else:
             fn = _superstep_fn(
                 self._static, self._use_pallas(), packed,
@@ -1830,13 +2126,13 @@ class RelayEngine:
         from ..ops.relay import PackedRelayState
 
         packed = isinstance(state, PackedRelayState)
-        key = (kind + "_step", packed)
+        key = (kind + "_step", packed, self.expansion)
         compiled = self._compiled.get(key)
         if compiled is None:
             if kind == "sparse":
                 args = (state, *self._sparse_tensors_for(packed)[:3])
             else:
-                args = (state, *self._tensors)
+                args = (state, *self._dense_step_operands())
             opts = (
                 self._COMPILER_OPTIONS
                 if jax.default_backend() == "tpu"
@@ -1883,7 +2179,15 @@ class RelayEngine:
             )
             return body(state, *tensors[:3]), "sparse"
         body = self._step_body("dense", state)
-        return body(state, *self._tensors), "dense"
+        return body(state, *self._dense_step_operands()), "dense"
+
+    def _dense_step_operands(self) -> tuple:
+        """The dense superstep body's non-state operands for this
+        engine's expansion arm (masks for gather, the tile tuple for
+        mxu)."""
+        if self.expansion == "mxu":
+            return self._mxu_ops()
+        return self._tensors
 
     def frontier_stats(self, state):
         """(frontier vertices, frontier out-edges) for a RelayState — the
@@ -1910,14 +2214,22 @@ class RelayEngine:
         dense body as :meth:`step_dispatch` — the tile-major local pass's
         ~73 MB VMEM scratch needs the raised scoped-vmem compile budget,
         which plain ``jax.jit`` would not apply."""
-        return self._step_body("dense", state)(state, *self._tensors)
+        return self._step_body("dense", state)(
+            state, *self._dense_step_operands()
+        )
 
     def _to_result(self, state, source: int) -> BfsResult:
         rg = self.relay_graph
         dist = np.asarray(state.dist)[rg.old2new]
-        parent = slots_to_parent(np.asarray(state.parent), rg.src_l1)[
-            rg.old2new
-        ]
+        if self.expansion == "mxu":
+            # The mxu arm's parent VALUES are already ORIGINAL ids (the
+            # expansion's min-key candidates) — only the index space
+            # needs the relabel gather.
+            parent = np.asarray(state.parent)[rg.old2new].copy()
+        else:
+            parent = slots_to_parent(np.asarray(state.parent), rg.src_l1)[
+                rg.old2new
+            ]
         parent[source] = source  # init wrote the relabeled id at the source
         return BfsResult(dist=dist, parent=parent, num_levels=int(state.level))
 
@@ -1937,20 +2249,28 @@ class RelayEngine:
             self._orig_dev = cached
         return cached
 
-    def _map_original_device(self, dist_new, parent_slots, source: int):
-        """Relabeled-space device (dist, parent-slots) -> ORIGINAL id
-        space, on device (the device twin of :meth:`_to_result`)."""
+    def _map_original_device(self, dist_new, parent_slots, source: int,
+                             flavor: str | None = None):
+        """Relabeled-space device (dist, parent) -> ORIGINAL id space, on
+        device (the device twin of :meth:`_to_result`).  ``flavor``
+        overrides the engine's expansion arm for callers whose parent
+        values are ALWAYS slots (the elem-tree extraction)."""
+        flavor = self.expansion if flavor is None else flavor
         o2n, s1 = self._orig_tables_device()
-        key = ("to_original",)
+        key = ("to_original", flavor)
         fn = self._compiled.get(key)
         if fn is None:
             m1 = int(self.relay_graph.src_l1.shape[0])
+            mxu = flavor == "mxu"
 
             def _map(dist, parent, o2n, s1, src):
-                slots = parent
-                par = jnp.where(
-                    slots >= 0, s1[jnp.clip(slots, 0, m1 - 1)], slots
-                )
+                if mxu:
+                    par = parent  # values are original ids already
+                else:
+                    par = jnp.where(
+                        parent >= 0, s1[jnp.clip(parent, 0, m1 - 1)],
+                        parent,
+                    )
                 # init wrote a non-sentinel word at the source's
                 # self-entry; fix it up exactly like the host path does.
                 return dist[o2n], par[o2n].at[src].set(src)
@@ -2044,7 +2364,11 @@ class RelayEngine:
             state.visited, state.dist_planes, state.rank_planes,
             jnp.int32(i // 32), jnp.uint32(i % 32), base1, stride1,
         )
-        return self._map_original_device(dist_new, parent_slots, source)
+        # Elem-mode parents are ALWAYS slots regardless of the engine's
+        # expansion arm (the elem pipeline is the gather formulation).
+        return self._map_original_device(
+            dist_new, parent_slots, source, flavor="gather"
+        )
 
     def run(self, source: int = 0, *, max_levels: int | None = None) -> BfsResult:
         from ..ops.packed import packed_truncated
@@ -2181,7 +2505,7 @@ class RelayEngine:
         options on the pallas path (mirrors :meth:`_fused`)."""
         if not self._use_pallas():
             return prog(carry, seg_end, *tensors, max_levels=max_levels)
-        key = ("segment", max_levels, tuple(sorted(carry)))
+        key = ("segment", max_levels, tuple(sorted(carry)), self.expansion)
         compiled = self._compiled.get(key)
         if compiled is None:
             compiled = self._compile_maybe_cached(
@@ -2203,9 +2527,14 @@ class RelayEngine:
         prog = _relay_segment_program(
             self._static, self.sparse_hybrid, self._use_pallas(), packed,
             telemetry, self.direction.key(), self._phase_sel(),
-            rg.num_vertices,
+            rg.num_vertices, self._expansion_key(),
         )
-        tensors = (*self._tensors, *self._sparse_tensors_for(packed))
+        masks = (
+            self._mxu_mask_args()
+            if self.expansion == "mxu"
+            else self._tensors
+        )
+        tensors = (*masks, *self._sparse_tensors_for(packed))
         cap = packed_cap(max_levels) if packed else max_levels
         from ..resilience.superstep_ckpt import restore_arrays
 
@@ -2243,7 +2572,7 @@ class RelayEngine:
         # stay the raw packed carry (V/2 state bytes per snapshot).
         if packed:
             state_dev = _relay_segment_finish_program(
-                tuple(rg.in_classes), rg.vr
+                tuple(rg.in_classes), rg.vr, self.expansion == "mxu"
             )(carry["pk"], carry["fw"], carry["level"], carry["changed"])
         else:
             state_dev = Rops.RelayState(
@@ -2357,16 +2686,23 @@ class RelayEngine:
         max_levels = int(max_levels) if max_levels is not None else rg.vr
         if packed is None:
             packed = self.packed
+        expansion = self._expansion_key(kernel_ok=False)
         fused = _relay_multi_fused_program(
-            self._static, self._use_pallas(), packed, self._phase_sel()
+            self._static, self._use_pallas(), packed, self._phase_sel(),
+            expansion,
         )
         sources_new = jax.device_put(rg.old2new[sources])  # explicit: guard-clean in timed repeats
-        args = (sources_new, *self._tensors)
+        masks = (
+            self._mxu_mask_args()
+            if self.expansion == "mxu"
+            else self._tensors
+        )
+        args = (sources_new, *masks)
         if not self._use_pallas():
             return fused(*args, max_levels=max_levels)
         key = (
             "multi", sources_new.shape[0], max_levels, packed,
-            self._phase_sel(),
+            self._phase_sel(), expansion,
         )
         compiled = self._compiled.get(key)
         if compiled is None:
@@ -2514,9 +2850,12 @@ class RelayEngine:
                 )
             )
         dist = np.asarray(state.dist)[:, rg.old2new]
-        parent = slots_to_parent(np.asarray(state.parent), rg.src_l1)[
-            :, rg.old2new
-        ]
+        if self.expansion == "mxu":
+            parent = np.asarray(state.parent)[:, rg.old2new].copy()
+        else:
+            parent = slots_to_parent(np.asarray(state.parent), rg.src_l1)[
+                :, rg.old2new
+            ]
         rows = np.arange(sources.shape[0])
         parent[rows, sources] = sources  # init wrote relabeled ids at sources
         return MultiBfsResult(
@@ -2790,9 +3129,15 @@ class SuperstepRunner:
 
             rg = self._relay.relay_graph
             dist = np.asarray(state.dist)[self._old2new]
-            parent = slots_to_parent(np.asarray(state.parent), rg.src_l1)[
-                self._old2new
-            ]
+            if self._relay.expansion == "mxu":
+                # mxu-arm parent VALUES are already original ids (the
+                # expansion's min-key candidates) — slot-mapping them
+                # would gather nonsense through src_l1.
+                parent = np.asarray(state.parent)[self._old2new].copy()
+            else:
+                parent = slots_to_parent(
+                    np.asarray(state.parent), rg.src_l1
+                )[self._old2new]
             fbits = np.asarray(
                 unpack_std(jnp.asarray(state.fwords), rg.vr)
             ).astype(bool)[self._old2new]

@@ -1,0 +1,390 @@
+"""MXU-native frontier expansion: BFS as bit-packed masked matmul (ISSUE 15).
+
+The third expansion arm next to the sparse gather (push) and the Beneš
+relay pipeline (the gather-free pull): dense frontier levels expand as
+tiled products of the frontier bitmap against the bit-packed 128x128
+adjacency tiles of :mod:`bfs_tpu.graph.adj_tiles` — the BLEST /
+graph-traversal-on-tensor-cores formulation, shaped for the TPU MXU.
+
+Per (frontier row-block, adjacency tile) the kernel computes the
+CONTRIBUTION MASKS as one matmul:
+
+    FW[g, u]  = frontier_bit(u) * 2^(u mod 16)   for u // 16 == g   (8x128)
+    M = FW @ A_tile                                                 (8x128)
+
+``A_tile`` is the tile unpacked to 0/1 f32.  Each group sums at most 16
+distinct powers of two < 2^16, so the f32 accumulation is EXACT and
+``M[g, v]`` is literally the 16-bit bitmask of group-``g`` sources that
+reach destination ``v`` — the matmul does the whole neighborhood
+intersection.  The epilogue reduces each mask to the minimum ORIGINAL
+source id (``keys2d``) and min-accumulates across the column's tiles:
+
+    cand[v] = min over contributing frontier sources u of orig_id(u)
+
+which is the canonical min-parent candidate every engine shares, emitted
+as ``uint32`` with ``PACKED_SENTINEL`` where no source contributes — the
+exact operand :func:`bfs_tpu.ops.relay.apply_relay_candidates_packed`
+merges (the parent field carries the ORIGINAL id; models/bfs.py's mxu
+finish decodes it without the rank->slot reconstruction).
+
+Early-out: a tile whose 128-bit frontier block is all zero is SKIPPED
+before its 2 KB DMA is even issued (``pl.when`` on the 4 preloaded
+frontier words), so sparse-frontier supersteps touch no adjacency bytes —
+though the direction optimizer routes those levels to the push arm anyway.
+
+:func:`expand_frontier_mxu_xla` is the bit-identical XLA twin (the PAL005
+parity oracle diffs raw bytes against it; it is also the shipping arm on
+CPU backends and under ``vmap`` in the batched multi-source program —
+min over uint32 keys is associative/commutative and exact, so any
+evaluation order produces identical bytes).
+
+Knobs::
+
+    BFS_TPU_EXPANSION    auto | gather | mxu   (default auto)
+    BFS_TPU_MXU_KERNEL   auto | pallas | xla   (default auto: pallas on
+                         TPU backends, the XLA twin elsewhere)
+    BFS_TPU_MXU_TILE_GB  float tile-storage budget for auto/mxu (default 4)
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..graph.adj_tiles import SB_TILES, SB_VERTS, TILE, TILE_WORDS
+
+__all__ = [
+    "EXPANSION_MODES",
+    "resolve_expansion",
+    "resolve_mxu_kernel",
+    "tiles_budget_bytes",
+    "expand_frontier_mxu",
+    "expand_frontier_mxu_xla",
+    "mxu_device_operands",
+    "mxu_superstep_packed",
+    "mxu_superstep",
+]
+
+SENT = np.uint32(0xFFFFFFFF)  # == ops.packed.PACKED_SENTINEL
+GROUPS = TILE // 16  # 8 weight groups of 16 rows; 2^0..2^15 exact in f32
+
+EXPANSION_MODES = ("auto", "gather", "mxu")
+
+
+def resolve_expansion(mode: str | None = None) -> str:
+    """``BFS_TPU_EXPANSION`` (an explicit argument wins).  Raises on
+    unknown modes — a typo'd knob must never silently change what a
+    capture measured."""
+    if mode is None:
+        mode = os.environ.get("BFS_TPU_EXPANSION", "auto") or "auto"
+    if mode not in EXPANSION_MODES:
+        raise ValueError(
+            f"unknown expansion {mode!r}; use 'auto', 'gather' or 'mxu'"
+        )
+    return mode
+
+
+def resolve_mxu_kernel(kernel: str | None = None) -> str:
+    """Which implementation the mxu arm's DENSE superstep compiles:
+    ``pallas`` (the fused kernel; interpret-mode off-TPU — parity tests
+    only, never a shipping loop) or ``xla`` (the twin).  ``auto`` follows
+    the backend like every other per-phase kernel here."""
+    if kernel is None:
+        kernel = os.environ.get("BFS_TPU_MXU_KERNEL", "auto") or "auto"
+    if kernel not in ("auto", "pallas", "xla"):
+        raise ValueError(
+            f"unknown mxu kernel {kernel!r}; use 'auto', 'pallas' or 'xla'"
+        )
+    if kernel == "auto":
+        try:
+            return "pallas" if jax.default_backend() == "tpu" else "xla"
+        except Exception:  # pragma: no cover - backend init failure
+            return "xla"
+    return kernel
+
+
+def tiles_budget_bytes() -> int:
+    """Tile-storage ceiling for building the mxu layout
+    (``BFS_TPU_MXU_TILE_GB``, default 4 GB): a scale-free tail can
+    degrade toward one 2 KB tile per edge, and the arm must never OOM a
+    host just by being probed."""
+    return int(
+        float(os.environ.get("BFS_TPU_MXU_TILE_GB", "4")) * (1 << 30)
+    )
+
+
+def mxu_device_operands(at) -> tuple:
+    """Ship an :class:`~bfs_tpu.graph.adj_tiles.AdjTiles` layout as the
+    fused programs' tile-operand tuple ``(tiles, row_idx, col_id,
+    sb_indptr, keys2d)`` — the one pytree both the kernel and the twin
+    consume (static geometry travels separately via
+    :func:`mxu_static`)."""
+    return (
+        jnp.asarray(at.tiles),
+        jnp.asarray(at.row_idx),
+        jnp.asarray(at.col_id),
+        jnp.asarray(at.sb_indptr),
+        jnp.asarray(at.keys2d),
+    )
+
+
+def mxu_static(at) -> tuple:
+    """Hashable geometry for program cache keys: (rows, cols, rtp, vtp,
+    ntp)."""
+    return (int(at.rows), int(at.cols), int(at.rtp), int(at.vtp),
+            int(at.ntp))
+
+
+def _pad_frontier_words(fwords: jax.Array, rows: int, rtp: int) -> jax.Array:
+    """Frontier words padded to the row space + ONE zero pad block (the
+    ``row_idx = rtp // TILE`` padding target reads guaranteed zeros)."""
+    have = fwords.shape[-1]
+    want = rtp // 32 + TILE // 32
+    pad = jnp.zeros((*fwords.shape[:-1], want - have), jnp.uint32)
+    return jnp.concatenate([fwords, pad], axis=-1)
+
+
+# bfs_tpu: hot traced
+def expand_frontier_mxu_xla(
+    fwords: jax.Array, tile_ops: tuple, *, rows: int, cols: int, rtp: int,
+    vtp: int, chunk: int = 256,
+) -> jax.Array:
+    """Bit-identical XLA twin of :func:`expand_frontier_mxu`:
+    ``uint32[cols]`` min-original-id candidate per destination
+    (``SENT`` where no frontier in-neighbor).  Tiles stream in
+    ``chunk``-sized slabs through ``lax.map`` so the unpacked
+    (chunk, 128, 128) contribution tensor never scales with the graph;
+    uint32 min is exact and order-free, so chunking cannot perturb a
+    bit."""
+    tiles, row_idx, col_id, _sb, keys2d = tile_ops
+    ntp = tiles.shape[0]
+    nc = -(-ntp // chunk)
+    npad = nc * chunk - ntp
+    if npad:
+        # Inert padding: the zero frontier pad block + the dropped
+        # overflow column segment (graph/adj_tiles padding convention).
+        tiles = jnp.concatenate(
+            [tiles, jnp.zeros((npad, TILE, TILE_WORDS), jnp.uint32)]
+        )
+        row_idx = jnp.concatenate(
+            [row_idx, jnp.full(npad, rtp // TILE, jnp.int32)]
+        )
+        col_id = jnp.concatenate(
+            [col_id, jnp.full(npad, vtp // TILE, jnp.int32)]
+        )
+    fwp = _pad_frontier_words(fwords, rows, rtp)
+    fblk = fwp.reshape(-1, TILE_WORDS)[row_idx]  # [ntp, 4]
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+
+    def per_chunk(args):
+        tk, fb, rk = args
+        lane = jnp.arange(TILE, dtype=jnp.int32)
+        fbits = (fb[:, lane >> 5] >> (lane & 31).astype(jnp.uint32)) & 1
+        rowmask = jnp.uint32(0) - fbits  # 0 / ~0 per (tile, u)
+        contrib = tk & rowmask[:, :, None]  # [chunk, 128, 4]
+        bits = (contrib[:, :, :, None] >> shifts) & 1  # [chunk,128,4,32]
+        keyrow = keys2d[rk]  # [chunk, 128]
+        cand = jnp.min(
+            jnp.where(
+                bits != 0,
+                keyrow[:, :, None, None],
+                SENT,
+            ),
+            axis=1,
+        )  # [chunk, 4, 32]
+        return cand.reshape(-1, TILE)
+
+    cands = jax.lax.map(
+        per_chunk,
+        (
+            tiles.reshape(nc, chunk, TILE, TILE_WORDS),
+            fblk.reshape(nc, chunk, TILE_WORDS),
+            row_idx.reshape(nc, chunk),
+        ),
+    ).reshape(-1, TILE)
+    out = jax.ops.segment_min(
+        cands, col_id, num_segments=vtp // TILE + 1,
+        indices_are_sorted=False,
+    )[: vtp // TILE]
+    return out.reshape(-1)[:cols]
+
+
+def _mxu_kernel_factory():
+    """One column-superblock per grid step; the per-tile inner loop DMAs
+    the frontier block first and early-outs (no tile DMA, no matmul) when
+    it is zero."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu  # noqa: F401
+
+    # bfs_tpu: hot
+    def kernel(sb_ref, cl_ref, row_ref, tiles_hbm, fblk_hbm, keys_hbm,
+               o_ref, tbuf, fbuf, kbuf, sem):
+        from jax.experimental.pallas import tpu as pltpu
+
+        pid = pl.program_id(0)
+        o_ref[...] = jnp.full((SB_TILES, TILE), SENT, jnp.uint32)
+        t0 = sb_ref[pid]
+        t1 = sb_ref[pid + 1]
+
+        def body(t, carry):
+            cp_f = pltpu.make_async_copy(
+                fblk_hbm.at[t], fbuf.at[0], sem.at[0]
+            )
+            cp_f.start()
+            cp_f.wait()
+            nz = (fbuf[0] != 0).any()
+
+            @pl.when(nz)
+            def _():
+                r = row_ref[t]
+                cl = cl_ref[t]
+                cp_t = pltpu.make_async_copy(
+                    tiles_hbm.at[t], tbuf.at[0], sem.at[1]
+                )
+                cp_k = pltpu.make_async_copy(
+                    keys_hbm.at[r], kbuf.at[0], sem.at[2]
+                )
+                cp_t.start()
+                cp_k.start()
+                cp_t.wait()
+                cp_k.wait()
+                tile = tbuf[0]  # [128, 4] uint32
+                keys = kbuf[0]  # [128] uint32
+                # Frontier bit + group weight per source row, as the
+                # [GROUPS, 128] weighted LHS.  The word select unrolls
+                # over the 4 static frontier words (no in-kernel gather).
+                u = jax.lax.broadcasted_iota(jnp.int32, (GROUPS, TILE), 1)
+                g = jax.lax.broadcasted_iota(jnp.int32, (GROUPS, TILE), 0)
+                fbit = jnp.zeros((GROUPS, TILE), jnp.uint32)
+                for j in range(TILE_WORDS):
+                    fbit = jnp.where(
+                        (u >> 5) == j,
+                        (fbuf[0, j] >> (u & 31).astype(jnp.uint32)) & 1,
+                        fbit,
+                    )
+                member = (u >> 4) == g
+                fw = jnp.where(
+                    member & (fbit == 1),
+                    (jnp.uint32(1) << (u & 15).astype(jnp.uint32)),
+                    jnp.uint32(0),
+                ).astype(jnp.float32)
+                # Tile unpack: [128, 128] 0/1 — word select unrolled over
+                # the 4 static v-words, shifts per lane.
+                vv = jax.lax.broadcasted_iota(jnp.int32, (TILE, TILE), 1)
+                aw = jnp.zeros((TILE, TILE), jnp.uint32)
+                for j in range(TILE_WORDS):
+                    aw = jnp.where((vv >> 5) == j, tile[:, j][:, None], aw)
+                a = ((aw >> (vv & 31).astype(jnp.uint32)) & 1).astype(
+                    jnp.float32
+                )
+                # THE masked matmul: 16-bit contribution masks per group,
+                # exact in f32 (sums of distinct powers of two < 2^16).
+                m = jnp.dot(fw, a, preferred_element_type=jnp.float32)
+                masks = m.astype(jnp.uint32)  # [GROUPS, 128]
+                # Reduce each mask to the min ORIGINAL id; accumulate the
+                # column minimum into this tile's output row.
+                ii = jax.lax.broadcasted_iota(jnp.int32, (16, TILE), 0)
+                cand = jnp.full((TILE,), SENT, jnp.uint32)
+                for gi in range(GROUPS):
+                    bits = (masks[gi][None, :] >> ii.astype(jnp.uint32)) & 1
+                    kg = jax.lax.dynamic_slice_in_dim(keys, gi * 16, 16)
+                    cand = jnp.minimum(
+                        cand,
+                        jnp.min(
+                            jnp.where(bits == 1, kg[:, None], SENT), axis=0
+                        ),
+                    )
+                cur = o_ref[pl.ds(cl, 1), :]
+                o_ref[pl.ds(cl, 1), :] = jnp.minimum(cur, cand[None, :])
+
+            return carry
+
+        jax.lax.fori_loop(t0, t1, body, jnp.int32(0))
+
+    return kernel
+
+
+# bfs_tpu: hot traced
+def expand_frontier_mxu(
+    fwords: jax.Array, tile_ops: tuple, *, rows: int, cols: int, rtp: int,
+    vtp: int, interpret: bool | None = None,
+) -> jax.Array:
+    """The fused Pallas expansion: ``uint32[cols]`` min-original-id
+    candidates, bit-identical to :func:`expand_frontier_mxu_xla` (the
+    PAL005 oracle pins raw bytes).  Grid = one 16384-destination column
+    superblock per step (a (128, 128) uint32 output block — the PAL002
+    ``mxu=True`` contract); tiles, frontier blocks and key rows stream
+    via per-tile DMA with the empty-frontier early-out."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        from .relay_pallas import pallas_interpret
+
+        interpret = pallas_interpret()
+    tiles, row_idx, col_id, sb_indptr, keys2d = tile_ops
+    col_local = (col_id % SB_TILES).astype(jnp.int32)
+    fwp = _pad_frontier_words(fwords, rows, rtp)
+    fblk = fwp.reshape(-1, TILE_WORDS)[row_idx]  # [ntp, 4]
+    grid = vtp // SB_VERTS
+    out = pl.pallas_call(
+        _mxu_kernel_factory(),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # sb_indptr
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # col_local
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # row_idx
+            pl.BlockSpec(memory_space=pl.ANY),  # tiles
+            pl.BlockSpec(memory_space=pl.ANY),  # fblk
+            pl.BlockSpec(memory_space=pl.ANY),  # keys2d
+        ],
+        out_specs=pl.BlockSpec((SB_TILES, TILE), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((vtp // TILE, TILE), jnp.uint32),
+        scratch_shapes=[
+            pltpu.VMEM((1, TILE, TILE_WORDS), jnp.uint32),  # tile buf
+            pltpu.VMEM((1, TILE_WORDS), jnp.uint32),  # frontier block
+            pltpu.VMEM((1, TILE), jnp.uint32),  # key row
+            pltpu.SemaphoreType.DMA((3,)),
+        ],
+        interpret=interpret,
+    )(sb_indptr, col_local, row_idx, tiles, fblk, keys2d)
+    return out.reshape(-1)[:cols]
+
+
+def _expand(st_fwords, tile_ops, geo: tuple, use_kernel: bool):
+    rows, cols, rtp, vtp, _ntp = geo
+    if use_kernel:
+        return expand_frontier_mxu(
+            st_fwords, tile_ops, rows=rows, cols=cols, rtp=rtp, vtp=vtp
+        )
+    return expand_frontier_mxu_xla(
+        st_fwords, tile_ops, rows=rows, cols=cols, rtp=rtp, vtp=vtp
+    )
+
+
+# bfs_tpu: hot traced
+def mxu_superstep_packed(st, tile_ops, geo: tuple, use_kernel: bool):
+    """One mxu pull superstep on the packed carry: expand -> one
+    lexicographic min (the candidate's parent field is the ORIGINAL id —
+    the mxu finish decodes it directly, no rank->slot pass)."""
+    from . import relay as R
+
+    cand = _expand(st.fwords, tile_ops, geo, use_kernel)
+    return R.apply_relay_candidates_packed(st, cand)
+
+
+# bfs_tpu: hot traced
+def mxu_superstep(st, tile_ops, geo: tuple, use_kernel: bool):
+    """Unpacked twin (the >62-level fallback carry): parent VALUES are
+    original ids (INT32_MAX convention at the apply boundary)."""
+    from . import relay as R
+    from .relax import INT32_MAX
+
+    cand = _expand(st.fwords, tile_ops, geo, use_kernel)
+    cand_i = jnp.where(
+        cand == SENT, jnp.int32(INT32_MAX), cand.astype(jnp.int32)
+    )
+    return R.apply_relay_candidates(st, cand_i)

@@ -236,7 +236,7 @@ def _relay_candidates_shard(
     from ..ops import relay as R
 
     (block, vperm_size, vperm_table, out_classes, out_space, net_table,
-     net_size, in_classes, n, use_pallas, packed) = static
+     net_size, in_classes, n, use_pallas, packed, _expansion) = static
     if use_pallas:
         from ..ops import relay_pallas as RP
     nw = block // 32
@@ -263,11 +263,50 @@ def _relay_candidates_shard(
 
 
 def _sharded_relay_static(srg, n: int, use_pallas: bool = False,
-                          packed: bool = False):
+                          packed: bool = False,
+                          expansion: tuple = ("gather",)):
+    """The sharded program's hashable static tuple.  ``expansion`` is the
+    arm element (ISSUE 15): ``('gather',)`` or ``('mxu', geometry,
+    use_kernel)`` — appended last, read back via :func:`_static_parts`."""
     return (
         srg.block, srg.vperm_size, srg.vperm_table, tuple(srg.out_classes),
         srg.out_space, srg.net_table, srg.net_size, tuple(srg.in_classes), n,
-        use_pallas, packed,
+        use_pallas, packed, expansion,
+    )
+
+
+def _static_parts(static) -> tuple:
+    """(block, in_classes, packed, expansion) from the static tuple."""
+    return static[0], static[7], static[10], static[11]
+
+
+def _mxu_candidates_shard(fw_global, tile_blk, *, expansion, packed):
+    """One shard's MXU candidate pipeline (ISSUE 15): the all-gathered
+    global frontier words against this shard's (global src x local dst)
+    adjacency tiles — min ORIGINAL source id per owned destination, in
+    the shared candidate format (``uint32 | PACKED_SENTINEL`` packed,
+    ``int32 | INT32_MAX`` unpacked) so the body-agnostic superstep tail
+    (sieve, exchange, state update) is untouched."""
+    from ..ops import relay_mxu as RM
+
+    _, geo, use_kernel = expansion
+    rows, cols, rtp, vtp, _ntp = geo
+    tiles, row_idx, col_id, sb_indptr, keys2d = tile_blk
+    if use_kernel:
+        cand = RM.expand_frontier_mxu(
+            fw_global, (tiles, row_idx, col_id, sb_indptr, keys2d),
+            rows=rows, cols=cols, rtp=rtp, vtp=vtp,
+        )
+    else:
+        cand = RM.expand_frontier_mxu_xla(
+            fw_global, (tiles, row_idx, col_id, sb_indptr, keys2d),
+            rows=rows, cols=cols, rtp=rtp, vtp=vtp,
+        )
+    if packed:
+        return cand
+    return jnp.where(
+        cand == jnp.uint32(0xFFFFFFFF),
+        jnp.int32(INT32_MAX), cand.astype(jnp.int32),
     )
 
 
@@ -460,9 +499,8 @@ def _bfs_sharded_relay_fused(
     from .exchange import ExchangeConfig, make_exchange
 
     n = mesh.shape[GRAPH_AXIS]
-    block = static[0]
-    in_classes = static[7]
-    packed = static[-1]
+    block, in_classes, packed, expansion = _static_parts(static)
+    mxu = expansion[0] == "mxu"
     nw = block // 32
     gtot = n * block
     cap = packed_cap(max_levels) if packed else max_levels
@@ -504,10 +542,19 @@ def _bfs_sharded_relay_fused(
         def cond(c):
             return c["changed"] & (c["level"] < cap)
 
-        def dense_cand(fw):
-            return _relay_candidates_shard(
-                fw, vperm_blk, net_blk, valid_blk, static=static
-            )
+        if mxu:
+
+            def dense_cand(fw):
+                return _mxu_candidates_shard(
+                    fw, vperm_blk, expansion=expansion, packed=packed
+                )
+
+        else:
+
+            def dense_cand(fw):
+                return _relay_candidates_shard(
+                    fw, vperm_blk, net_blk, valid_blk, static=static
+                )
 
         def push_cand(fw, unreached):
             return _sharded_push_candidates(
@@ -643,9 +690,16 @@ def _bfs_sharded_relay_fused(
 
         out = jax.lax.while_loop(cond, body, carry)
         if packed:
-            dist, parent = unpack_relay_packed(
-                out["pk"], in_classes, block
-            )
+            if mxu:
+                from ..ops.packed import packed_dist, packed_parent
+
+                dist, parent = packed_dist(out["pk"]), packed_parent(
+                    out["pk"]
+                )
+            else:
+                dist, parent = unpack_relay_packed(
+                    out["pk"], in_classes, block
+                )
         else:
             dist, parent = out["dist"], out["parent"]
         if telemetry:
@@ -720,8 +774,8 @@ def _bfs_sharded_relay_segment(
     from .exchange import ExchangeConfig, make_exchange
 
     n = mesh.shape[GRAPH_AXIS]
-    block = static[0]
-    packed = static[-1]
+    block, _in_classes, packed, expansion = _static_parts(static)
+    mxu = expansion[0] == "mxu"
     nw = block // 32
     gtot = n * block
     cap = packed_cap(max_levels) if packed else max_levels
@@ -773,10 +827,19 @@ def _bfs_sharded_relay_segment(
                 & (c["level"] < seg_end)
             )
 
-        def dense_cand(fw):
-            return _relay_candidates_shard(
-                fw, vperm_blk, net_blk, valid_blk, static=static
-            )
+        if mxu:
+
+            def dense_cand(fw):
+                return _mxu_candidates_shard(
+                    fw, vperm_blk, expansion=expansion, packed=packed
+                )
+
+        else:
+
+            def dense_cand(fw):
+                return _relay_candidates_shard(
+                    fw, vperm_blk, net_blk, valid_blk, static=static
+                )
 
         def push_cand(fw, unreached):
             return _sharded_push_candidates(
@@ -905,13 +968,20 @@ def _bfs_sharded_relay_segment(
 
 
 @functools.lru_cache(maxsize=8)
-def _sharded_segment_unpack_program(in_classes: tuple, block: int, n: int):
+def _sharded_segment_unpack_program(in_classes: tuple, block: int, n: int,
+                                    mxu: bool = False):
     """Jitted per-shard unpack for the segmented runner's TRUE loop exit
-    (cached at module level — a per-call jit would retrace, RCD001)."""
+    (cached at module level — a per-call jit would retrace, RCD001).
+    The mxu flavor decodes original-id parents (no slot pass)."""
     from ..ops.relay import unpack_relay_packed
 
     @jax.jit
     def unpack(pk):
+        if mxu:
+            from ..ops.packed import packed_dist, packed_parent
+
+            p2 = pk.reshape(n, block)
+            return packed_dist(p2), packed_parent(p2)
         return jax.vmap(
             lambda p: unpack_relay_packed(p, in_classes, block)
         )(pk.reshape(n, block))
@@ -990,6 +1060,7 @@ def bfs_sharded_segmented(
     telemetry: bool = False,
     direction: str | None = None,
     exchange: str | None = None,
+    expansion: str | None = None,
 ):
     """Segmented-with-checkpoints sharded relay BFS (ISSUE 14): the
     resumable twin of :func:`bfs_sharded` ``engine='relay'`` —
@@ -1033,7 +1104,6 @@ def bfs_sharded_segmented(
     )
     source_new = int(srg.old2new[source])
     use_pallas = _resolve_sharded_applier(applier)
-    vperm_arg, net_arg = _sharded_relay_mask_args(srg, use_pallas)
     block = srg.block
     has_adj = srg.adj_dst is not None and srg.outdeg is not None
     if dir_cfg.mode == "push" and not has_adj:
@@ -1055,13 +1125,22 @@ def bfs_sharded_segmented(
     # segment would both waste an O(n*net_size) host pass + upload per
     # superstep and inflate the measured superstep seconds the Young/Daly
     # interval is derived from).
-    valid_dev = _relay_valid_words(srg)
+    packed0 = resolve_packed(packed_rank_fits(srg.in_classes))
+    exp_static, packed0 = _resolve_sharded_expansion(expansion, srg, packed0)
+    mxu = exp_static[0] == "mxu"
+    if mxu:
+        vperm_arg = _sharded_tiles_dev(srg)[0]
+        net_arg = jnp.zeros((n, 1), jnp.uint32)
+        valid_dev = jnp.zeros((n, 1), jnp.uint32)
+    else:
+        vperm_arg, net_arg = _sharded_relay_mask_args(srg, use_pallas)
+        valid_dev = _relay_valid_words(srg)
     own_dev = _own_word_table_dev(srg)
 
     def run_flavor(packed: bool):
-        static = _sharded_relay_static(srg, n, use_pallas, packed)
+        static = _sharded_relay_static(srg, n, use_pallas, packed, exp_static)
         adj = (
-            _sharded_adj_dev(srg, packed) if sparse
+            _sharded_adj_dev(srg, packed, mxu) if sparse
             else _sharded_adj_dummies(n)
         )
         cap = packed_cap(max_levels) if packed else max_levels
@@ -1125,7 +1204,7 @@ def bfs_sharded_segmented(
         # same per-shard math the fused program runs at its loop exit).
         if packed:
             dist, parent = _sharded_segment_unpack_program(
-                tuple(srg.in_classes), block, n
+                tuple(srg.in_classes), block, n, mxu
             )(carry["pk"])
             dist = jax.device_get(dist).reshape(-1)
             parent = jax.device_get(parent).reshape(-1)
@@ -1134,13 +1213,15 @@ def bfs_sharded_segmented(
             parent = np.asarray(jax.device_get(carry["parent"]))
         return carry, dist, parent, int(level), bool(changed)
 
-    packed = resolve_packed(packed_rank_fits(srg.in_classes))
+    packed = packed0
     carry, dist, parent, level, changed = run_flavor(packed)
     if packed and packed_truncated(changed, level, max_levels):
         ckpt.clear()
         carry, dist, parent, level, changed = run_flavor(False)
         packed = False
-    dist, parent = _relay_map_back(srg, dist, parent, source)
+    dist, parent = _relay_map_back(
+        srg, dist, parent, source, "mxu" if mxu else "gather"
+    )
     result = BfsResult(dist=dist, parent=parent, num_levels=level)
     ckpt.clear()
     if not telemetry:
@@ -1185,9 +1266,9 @@ def _bfs_sharded_relay_multi_fused(
     from ..ops.relay import pack_std, unpack_relay_packed
 
     n = mesh.shape[GRAPH_AXIS]
-    block = static[0]
-    in_classes = static[7]
-    packed = static[-1]
+    # The batched sharded program is gather-only (the multi twin of the
+    # single-chip rule: batch paths run the XLA formulation).
+    block, in_classes, packed, _expansion = _static_parts(static)
     nw = block // 32
     cap = packed_cap(max_levels) if packed else max_levels
 
@@ -1393,10 +1474,10 @@ def _sharded_adj_ranks(srg) -> np.ndarray:
     ).astype(np.int32)
 
 
-def _sharded_adj_dev(srg, packed: bool):
+def _sharded_adj_dev(srg, packed: bool, mxu: bool = False):
     """Device-resident per-shard adjacency operands ``(indptr, dst,
-    slot-or-rank)``, memoized per flavor on the layout object (layout
-    data, like the masks — must not land inside a caller's timed
+    slot-or-rank-or-key)``, memoized per flavor on the layout object
+    (layout data, like the masks — must not land inside a caller's timed
     repeats).  Raises if this layout predates per-shard adjacency."""
     if srg.adj_dst is None:
         raise ValueError(
@@ -1404,9 +1485,14 @@ def _sharded_adj_dev(srg, packed: bool):
             "(pre-exchange layout); rebuild with build_sharded_relay_graph"
         )
     key = "_adj_dev_ranks" if packed else "_adj_dev_slots"
+    if mxu:
+        key = "_adj_dev_keys"
     cached = getattr(srg, key, None)
     if cached is None:
-        third = _sharded_adj_ranks(srg) if packed else srg.adj_slot
+        if mxu:
+            third = _sharded_adj_keys(srg)
+        else:
+            third = _sharded_adj_ranks(srg) if packed else srg.adj_slot
         cached = (
             jnp.asarray(srg.adj_indptr),
             jnp.asarray(srg.adj_dst),
@@ -1427,17 +1513,110 @@ def _sharded_adj_dummies(n: int):
     )
 
 
-def _relay_map_back(srg, dist, parent, source_or_sources):
+def _sharded_adj_keys(srg) -> np.ndarray:
+    """Per-edge ORIGINAL src ids (the mxu arm's sparse-path payload):
+    ``src_l1[shard][slot]`` per shard — sorting (dst, key) is the
+    canonical tie-break, the single-chip ``_adj_keys`` contract."""
+    slots = np.clip(srg.adj_slot, 0, srg.src_l1.shape[1] - 1)
+    shard = np.arange(srg.adj_slot.shape[0])[:, None]
+    return np.where(
+        srg.adj_slot >= 0, srg.src_l1[shard, slots], srg.adj_slot
+    ).astype(np.int32)
+
+
+def _sharded_tiles_dev(srg):
+    """Stacked per-shard MXU tile operands ``(tiles, row_idx, col_id,
+    sb_indptr, keys2d)`` (leading shard axis, per-shard tile counts
+    padded to the max with inert tiles) + the shared static geometry —
+    memoized on the layout object like the adjacency flavors."""
+    cached = getattr(srg, "_mxu_tiles_dev", None)
+    if cached is not None:
+        return cached
+    from ..graph.adj_tiles import TILE, TILE_WORDS, build_adj_tiles_sharded
+    from ..ops.relay_mxu import tiles_budget_bytes
+
+    per = build_adj_tiles_sharded(srg, budget_bytes=tiles_budget_bytes())
+    ntp = max(at.ntp for at in per)
+
+    def pad(at):
+        k = ntp - at.ntp
+        if not k:
+            return at.tiles, at.row_idx, at.col_id
+        return (
+            np.concatenate(
+                [at.tiles, np.zeros((k, TILE, TILE_WORDS), np.uint32)]
+            ),
+            np.concatenate(
+                [at.row_idx, np.full(k, at.rtp // TILE, np.int32)]
+            ),
+            np.concatenate(
+                [at.col_id, np.full(k, at.vtp // TILE, np.int32)]
+            ),
+        )
+
+    padded = [pad(at) for at in per]
+    ops = (
+        jnp.asarray(np.stack([p[0] for p in padded])),
+        jnp.asarray(np.stack([p[1] for p in padded])),
+        jnp.asarray(np.stack([p[2] for p in padded])),
+        jnp.asarray(np.stack([at.sb_indptr for at in per])),
+        jnp.asarray(np.stack([at.keys2d for at in per])),
+    )
+    geo = (per[0].rows, per[0].cols, per[0].rtp, per[0].vtp, ntp)
+    cached = (ops, geo)
+    object.__setattr__(srg, "_mxu_tiles_dev", cached)
+    return cached
+
+
+def _resolve_sharded_expansion(expansion, srg, packed: bool):
+    """The sharded expansion-arm resolution: forced modes only — 'auto'
+    runs gather (the mesh program is AOT-compiled once; the single-chip
+    probe's verdict is the measured signal, and the first TPU window
+    re-probes).  Returns ``(expansion_static, packed)``; forcing mxu with
+    a forced packed carry that cannot hold original ids is an error."""
+    import os
+
+    from ..ops.packed import packed_parent_fits
+    from ..ops.relay_mxu import resolve_expansion, resolve_mxu_kernel
+
+    req = resolve_expansion(expansion)
+    if req != "mxu":
+        return ("gather",), packed
+    if srg.adj_dst is None:
+        raise ValueError(
+            "BFS_TPU_EXPANSION=mxu needs the per-shard adjacency this "
+            "ShardedRelayGraph predates (the tile builder reads it); "
+            "rebuild with build_sharded_relay_graph"
+        )
+    if packed and not packed_parent_fits(srg.num_vertices):
+        if os.environ.get("BFS_TPU_PACKED", "") == "1":
+            raise ValueError(
+                "BFS_TPU_EXPANSION=mxu with BFS_TPU_PACKED=1 needs "
+                "V <= 2^26: the mxu packed parent field carries "
+                "ORIGINAL ids"
+            )
+        packed = False
+    _, geo = _sharded_tiles_dev(srg)
+    use_kernel = resolve_mxu_kernel() == "pallas"
+    return ("mxu", geo, use_kernel), packed
+
+
+def _relay_map_back(srg, dist, parent, source_or_sources,
+                    expansion: str = "gather"):
     """Global-relabeled sharded state -> original-id arrays.  Parent values
     are per-shard L1 slot indices; vertex at global new id g is owned by
-    shard g // block with src table src_l1[shard]."""
+    shard g // block with src table src_l1[shard].  On the mxu arm parent
+    VALUES are already original ids — only the index space remaps."""
     dist = np.asarray(dist)
     parent = np.asarray(parent)
-    shard_of = np.arange(parent.shape[-1]) // srg.block
-    slots = np.clip(parent, 0, srg.src_l1.shape[1] - 1)
-    parent = np.where(
-        parent >= 0, srg.src_l1[shard_of, slots], parent
-    ).astype(np.int32)
+    if expansion == "mxu":
+        parent = parent.astype(np.int32).copy()
+    else:
+        shard_of = np.arange(parent.shape[-1]) // srg.block
+        slots = np.clip(parent, 0, srg.src_l1.shape[1] - 1)
+        parent = np.where(
+            parent >= 0, srg.src_l1[shard_of, slots], parent
+        ).astype(np.int32)
     dist = dist[..., srg.old2new]
     parent = parent[..., srg.old2new]
     if np.ndim(source_or_sources) == 0:
@@ -1479,6 +1658,7 @@ def bfs_sharded(
     telemetry: bool = False,
     direction: str | None = None,
     exchange: str | None = None,
+    expansion: str | None = None,
 ):
     """Single-source BFS sharded over the mesh's ``graph`` axis.
 
@@ -1536,7 +1716,6 @@ def bfs_sharded(
         max_levels = int(max_levels) if max_levels is not None else srg.num_vertices
         source_new = jnp.int32(int(srg.old2new[source]))
         use_pallas = _resolve_sharded_applier(applier)
-        vperm_arg, net_arg = _sharded_relay_mask_args(srg, use_pallas)
         n = _graph_shards(mesh)
         has_adj = srg.adj_dst is not None
         if dir_cfg.mode == "push" and not has_adj:
@@ -1557,16 +1736,37 @@ def bfs_sharded(
             else jnp.zeros((1,), jnp.int32)
         )
         sparse = sparse and srg.outdeg is not None
+        packed0 = resolve_packed(packed_rank_fits(srg.in_classes))
+        exp_static, packed0 = _resolve_sharded_expansion(
+            expansion, srg, packed0
+        )
+        mxu = exp_static[0] == "mxu"
+        if mxu:
+            # The tile tuple rides the vperm mask-operand slot (the
+            # single-chip trick: one program signature, two arms); the
+            # Beneš masks — multi-GB at bench scale — are never even
+            # built on this arm, and the valid words become dummies.
+            vperm_arg = _sharded_tiles_dev(srg)[0]
+            net_arg = jnp.zeros((n, 1), jnp.uint32)
+        else:
+            vperm_arg, net_arg = _sharded_relay_mask_args(srg, use_pallas)
 
         def run_prog(packed: bool):
-            static = _sharded_relay_static(srg, n, use_pallas, packed)
+            static = _sharded_relay_static(
+                srg, n, use_pallas, packed, exp_static
+            )
             adj = (
-                _sharded_adj_dev(srg, packed)
+                _sharded_adj_dev(srg, packed, mxu)
                 if sparse
                 else _sharded_adj_dummies(n)
             )
+            valid_arg = (
+                jnp.zeros((n, 1), jnp.uint32)
+                if mxu
+                else _relay_valid_words(srg)
+            )
             args = (
-                vperm_arg, net_arg, _relay_valid_words(srg),
+                vperm_arg, net_arg, valid_arg,
                 _own_word_table_dev(srg), *adj, outdeg_dev, source_new,
             )
             kwargs = dict(
@@ -1593,7 +1793,7 @@ def bfs_sharded(
                 return compiled(*args)
             return _bfs_sharded_relay_fused(*args, **kwargs)
 
-        packed = resolve_packed(packed_rank_fits(srg.in_classes))
+        packed = packed0
         out = run_prog(packed)
         dist, parent, level, changed = out[:4]
         if packed and packed_truncated(
@@ -1605,7 +1805,8 @@ def bfs_sharded(
             dist, parent, level, changed = out[:4]
             packed = False
         dist, parent = _relay_map_back(
-            srg, jax.device_get(dist), jax.device_get(parent), source
+            srg, jax.device_get(dist), jax.device_get(parent), source,
+            "mxu" if mxu else "gather",
         )
         result = BfsResult(dist=dist, parent=parent, num_levels=int(level))
         if not telemetry:

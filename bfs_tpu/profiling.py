@@ -344,6 +344,26 @@ def superstep_phase_ledger(eng, *, loops: int = 4, repeats: int = 2) -> dict:
         ),
     }
 
+    # ---- expansion arms (ISSUE 15) -----------------------------------------
+    # Present whenever the engine carries a tile layout: the gather
+    # (Beneš) dense superstep vs the mxu tiled masked matmul, measured on
+    # a pinned fully-dense frontier (the regime the direction optimizer
+    # hands to the pull/expansion body).  ``seconds`` reports the arm the
+    # engine actually runs, keeping the ledger comparable with the timed
+    # repeats (the _effective contract above).
+    if getattr(eng, "adj_tiles", None) is not None:
+        try:
+            exp = _expansion_arms(eng, mb)
+        except Exception as exc:
+            exp = {"probe_error": repr(exc), "arms": {}}
+        eng_arm = getattr(eng, "expansion", "gather")
+        if eng_arm in exp.get("arms", {}):
+            exp["seconds"] = exp["arms"][eng_arm]
+        exp["selected"] = eng_arm
+        exp["selection_basis"] = getattr(eng, "expansion_basis", None)
+        exp["interpret_arm"] = interp
+        phases["expansion"] = exp
+
     # ---- full dense superstep (cross-check) --------------------------------
     from .models.bfs import _superstep_fn
 
@@ -464,6 +484,115 @@ def superstep_phase_ledger(eng, *, loops: int = 4, repeats: int = 2) -> dict:
     }
 
 
+def _expansion_arms(eng, mb) -> dict:
+    """K-loop both EXPANSION arms — the gather (Beneš relay) dense
+    superstep vs the mxu tiled masked matmul — on the engine's real
+    operands with a PINNED fully-dense frontier (the regime the arm
+    targets; an evolving state would empty the frontier after one
+    superstep and time the mxu early-out instead of the expand).  The
+    packed-word feedback keeps XLA from hoisting either body."""
+    import jax
+
+    from .models.bfs import _superstep_fn
+    from .ops import relay as R
+    from .ops import relay_mxu as RM
+    from .ops.packed import PACKED_SENTINEL
+
+    packed = bool(getattr(eng, "packed", False))
+    static = eng._static
+    vr = static[0]
+    superstep = _superstep_fn(
+        static, eng._use_pallas(), packed,
+        eng._phase_sel() if hasattr(eng, "_phase_sel") else None,
+    )
+    vperm_m, net_m, valid = eng._tensors
+    geo = RM.mxu_static(eng.adj_tiles)
+    use_kernel = RM.resolve_mxu_kernel() == "pallas"
+    tile_ops = eng._mxu_ops()
+    mxu_step = RM.mxu_superstep_packed if packed else RM.mxu_superstep
+
+    nw = vr // 32
+    fw_dense = jnp.full(nw, 0xFFFFFFFF, jnp.uint32)
+    pk0 = jnp.full(vr, PACKED_SENTINEL, jnp.uint32)
+    d0 = jnp.full(vr, np.int32(2**31 - 1), jnp.int32)
+    p0 = jnp.full(vr, -1, jnp.int32)
+
+    def feedback(st):
+        word = st.packed if packed else st.dist.astype(jnp.uint32)
+        return fw_dense ^ (jax.lax.slice_in_dim(word, 0, nw) & 1)
+
+    def mk(st_words, fw):
+        if packed:
+            return R.PackedRelayState(
+                st_words, fw, jnp.int32(0), jnp.bool_(True)
+            )
+        return R.RelayState(
+            st_words, p0, fw, jnp.int32(0), jnp.bool_(True)
+        )
+
+    def k_arm(run_body):
+        # Operands arrive as ARGS (pytrees), never closed over — a
+        # closed-over mask/tile array bakes into the program as a
+        # constant (GBs at bench scale; the RelayEngine._tensors rule).
+        def fn(k, st_words, fw, *ops):
+            st0 = mk(st_words, fw)
+
+            def body(i, st):
+                s2 = run_body(
+                    mk(st.packed if packed else st.dist, feedback(st)),
+                    *ops,
+                )
+                if packed:
+                    return R.PackedRelayState(
+                        s2.packed, st.fwords, jnp.int32(0), st.changed
+                    )
+                return R.RelayState(
+                    s2.dist, p0, st.fwords, jnp.int32(0), st.changed
+                )
+
+            out = jax.lax.fori_loop(0, k, body, st0)
+            return out.packed if packed else out.dist
+
+        return fn
+
+    def gather_body(st, vm, nm, vw):
+        return superstep(st, vm, nm, vw)
+
+    def mxu_body(st, ops):
+        return mxu_step(st, ops, geo, use_kernel)
+
+    init = pk0 if packed else d0
+    arms = {
+        "gather": mb(
+            k_arm(gather_body), (init, fw_dense, vperm_m, net_m, valid)
+        )
+    }
+    try:
+        arms["mxu"] = mb(k_arm(mxu_body), (init, fw_dense, tile_ops))
+    except Exception as exc:
+        arms["mxu_error"] = repr(exc)
+    from .ops.relay_pallas import pallas_interpret
+
+    interp = pallas_interpret()
+    rec = {
+        "arms": arms,
+        "gather_seconds": arms["gather"],
+        "tiles": int(eng.adj_tiles.nt),
+        "mxu_kernel": "pallas" if use_kernel else "xla",
+        "frontier": "pinned dense (all bits set)",
+    }
+    if "mxu" in arms:
+        rec["mxu_seconds"] = arms["mxu"]
+        rec["selected"] = "mxu" if arms["mxu"] <= arms["gather"] else "gather"
+        rec["selection_basis"] = (
+            "measured (interpret arm)" if interp else "measured"
+        )
+    else:
+        rec["selected"] = "gather"
+        rec["selection_basis"] = "measured (mxu arm failed)"
+    return rec
+
+
 def probe_phase_kernels(eng, *, loops: int = 4, repeats: int = 2) -> dict:
     """Measure the pallas-vs-XLA arms of the packed row-min and packed
     state-update on a RelayEngine's real shapes and pick per phase — the
@@ -560,6 +689,16 @@ def probe_phase_kernels(eng, *, loops: int = 4, repeats: int = 2) -> dict:
             rec["selected"] = "xla"
             rec["selection_basis"] = "measured (pallas arm failed)"
         out[phase] = rec
+    # The EXPANSION arm (ISSUE 15): measured whenever the engine carries
+    # a tile layout (auto-probe built it, or the arm was forced) — the
+    # gather-vs-mxu verdict rides the same memoized probe document.
+    if getattr(eng, "adj_tiles", None) is not None:
+        try:
+            out["expansion"] = _expansion_arms(eng, mb)
+        except Exception as exc:
+            # No "selected" entry: the engine falls back to gather with
+            # the failure on record, never a silent default.
+            out["expansion"] = {"probe_error": repr(exc)}
     return out
 
 

@@ -587,6 +587,16 @@ def test_repo_has_expected_hot_coverage():
             "_bfs_direction_fused",
         ),
         "bfs_tpu/models/bfs.py": ("_frontier_masses_words",),
+        # the MXU expansion arm (ISSUE 15): the kernel, its XLA twin and
+        # the superstep wrappers all run inside the fused hot loop when
+        # the arm is selected — they must keep static hot coverage
+        "bfs_tpu/ops/relay_mxu.py": (
+            "expand_frontier_mxu",
+            "expand_frontier_mxu_xla",
+            "mxu_superstep_packed",
+            "mxu_superstep",
+            "kernel",
+        ),
         "bfs_tpu/obs/telemetry.py": ("record_direction",),
         "bfs_tpu/serve/executor.py": ("_state_to_result",),
         # the device layout-builder programs (ISSUE 10 tentpole) are the
@@ -1044,7 +1054,7 @@ def test_hlo_fingerprints_pin_program_specs_coverage():
     committed = set(doc["programs"])
     registry = set(PROGRAM_SPECS)
     # ISSUE 11 pinned 25; ISSUE 14 adds the four segment programs.
-    assert len(registry) >= 29
+    assert len(registry) >= 32
     assert registry - committed == set(), (
         "programs missing HLO fingerprint coverage — run "
         "`bfs-tpu-lint --hlo --update-fingerprints`"

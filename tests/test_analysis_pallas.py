@@ -417,10 +417,11 @@ def test_registry_covers_every_pallas_call_site():
     assert discovered == registered_sites(), (
         sorted(discovered), sorted(registered_sites())
     )
-    # The five shipped sites, by name — a rename must update the specs.
+    # The six shipped sites, by name — a rename must update the specs.
     assert {s.split("::")[1] for s in discovered} == {
         "_run_local_tile_major", "_run_pass", "_run_elem_pass",
         "_class_tournament_call", "apply_relay_candidates_packed_pallas",
+        "expand_frontier_mxu",
     }
     assert registry_findings(KERNEL_SPECS, REPO) == []
 
@@ -452,7 +453,7 @@ def test_repo_pallas_self_lint_clean_modulo_baseline():
     is asserted bit-identical for EVERY registered kernel: a parity
     break can never be baselined into silence here."""
     findings, meta = analyze_pallas(use_cache=True)
-    assert len(meta["kernels"]) + len(meta["skipped"]) >= 5, meta
+    assert len(meta["kernels"]) + len(meta["skipped"]) >= 6, meta
     assert meta["skipped"] == {}, meta["skipped"]  # native router in-image
     baseline = Baseline.load(default_baseline_path())
     fresh = [f for f in findings if not baseline.accepts(f)]
@@ -661,7 +662,7 @@ def test_cli_all_green_on_repo(capsys):
     out = capsys.readouterr()
     assert rc == 0, out.out + out.err
     assert "analysis[--all]" in out.err
-    assert "pal: 5" in out.err
+    assert "pal: 6" in out.err
 
 
 @pytest.mark.lint_pallas

@@ -4,7 +4,7 @@
 #   tools/ci_gate.sh            # run everything, non-zero on any failure
 #   tools/ci_gate.sh --no-tests # lint surface only (tier-1 ran elsewhere)
 #
-# Three stages, fail-fast:
+# Stages, fail-fast:
 #   1. tier-1: the full CPU test suite on the 8-device virtual platform
 #      (tests/conftest.py forces it), -m 'not slow' — exactly the
 #      ROADMAP.md verify command minus the log plumbing.
@@ -37,6 +37,15 @@ fi
 echo "== ci gate: traversal-chaos smoke (kill/resume one segment) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_superstep_ckpt.py -q \
     -m 'chaos and not slow' -p no:cacheprovider
+
+echo "== ci gate: MXU-arm parity smoke (ISSUE 15) =="
+# The gather-vs-mxu bit-identity core: kernel/twin raw-byte parity,
+# forced-mxu end-to-end vs the gather arm, and the x8 sharded parity —
+# a divergence between the expansion arms must fail the gate on its own
+# stage, independent of where tier-1 ran (~seconds; the full matrix runs
+# in tier-1's tests/test_expansion_mxu.py).
+JAX_PLATFORMS=cpu python -m pytest tests/test_expansion_mxu.py -q \
+    -m 'mxu_smoke' -p no:cacheprovider
 
 if [[ "$RUN_TESTS" == "1" ]]; then
     echo "== ci gate 3/3: lint --all (AST + IR + HLO + Pallas) =="

@@ -27,7 +27,7 @@ import sys
 
 #: Phases in ledger order (unknown extras are appended as found).
 PHASE_ORDER = ["vperm", "broadcast", "net_apply", "rowmin", "state_update",
-               "full_superstep", "full_superstep_telemetry"]
+               "expansion", "full_superstep", "full_superstep_telemetry"]
 
 
 def load_doc(path: str) -> dict:
@@ -63,7 +63,10 @@ def extract(doc: dict, path: str):
     (``details.superstep_phases``) and sharded MULTICHIP headlines
     (``details.sharded_phases`` — per-shard rows + the exchange-bytes
     column riding each phase record, plus ``details.exchange.schedule``,
-    the per-level arm record)."""
+    the per-level arm record).  The last element is the EXPANSION-arm
+    record (ISSUE 15): ``details.expansion``'s selected arm + per-level
+    arm schedule, diffed under ``--exact`` like the direction and
+    exchange schedules."""
     ledger = doc
     details = doc.get("details")
     if isinstance(details, dict):
@@ -96,7 +99,15 @@ def extract(doc: dict, path: str):
         ex = details.get("exchange")
         if isinstance(ex, dict):
             xsched = ex.get("schedule")
-    return phases, ledger, sched, xbytes, per_shard, xsched
+    esched = None
+    if isinstance(details, dict):
+        exp = details.get("expansion")
+        if isinstance(exp, dict):
+            esched = {
+                "arm": exp.get("arm"),
+                "per_level": exp.get("per_level"),
+            }
+    return phases, ledger, sched, xbytes, per_shard, xsched, esched
 
 
 def fmt_s(s: float) -> str:
@@ -120,8 +131,8 @@ def main() -> int:
     )
     args = ap.parse_args()
 
-    pb, lb, sb, xb, shb, xsb = extract(load_doc(args.before), args.before)
-    pa, la, sa, xa, sha, xsa = extract(load_doc(args.after), args.after)
+    pb, lb, sb, xb, shb, xsb, esb = extract(load_doc(args.before), args.before)
+    pa, la, sa, xa, sha, xsa, esa = extract(load_doc(args.after), args.after)
 
     names = [p for p in PHASE_ORDER if p in pb or p in pa]
     names += [p for p in sorted(set(pb) | set(pa)) if p not in names]
@@ -192,11 +203,17 @@ def main() -> int:
 
     if args.exact and xsb != xsa:
         mismatched.append("exchange_schedule")
+    if args.exact and esb != esa:
+        # The expansion-arm record (selected arm + per-level arm
+        # schedule): a resumed run flipping gather<->mxu, or replaying a
+        # different per-level arm sequence, recomputed what it should
+        # have restored.
+        mismatched.append("expansion_arm_schedule")
 
     for side, led in (("before", lb), ("after", la)):
         sel = {
             p: led["phases"][p].get("selected")
-            for p in ("rowmin", "state_update")
+            for p in ("rowmin", "state_update", "expansion")
             if p in led.get("phases", {})
             and isinstance(led["phases"][p], dict)
             and led["phases"][p].get("selected")
