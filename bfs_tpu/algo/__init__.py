@@ -1,0 +1,37 @@
+"""Semiring-parameterized graph algorithms on the superstep machinery.
+
+One substrate (:mod:`bfs_tpu.algo.substrate` — the contribute/combine/
+identity/state contract), many algorithms: BFS (the original instance,
+:mod:`bfs_tpu.models.bfs`), weighted SSSP as min-plus supersteps with
+delta-stepping buckets (:mod:`bfs_tpu.algo.sssp`), connected components
+as label-min propagation (:mod:`bfs_tpu.algo.cc`), each riding the
+fused / segmented / sharded program families with oracle-exact results
+(docs/ARCHITECTURE.md §24).
+"""
+
+from .cc import CcResult, cc, cc_segmented
+from .sharded import cc_sharded, sssp_sharded
+from .sssp import SsspResult, sssp, sssp_segmented
+from .substrate import (
+    DEFAULT_MAX_WEIGHT,
+    SEMIRINGS,
+    Semiring,
+    edge_weights_np,
+    resolve_delta,
+)
+
+__all__ = [
+    "CcResult",
+    "DEFAULT_MAX_WEIGHT",
+    "SEMIRINGS",
+    "Semiring",
+    "SsspResult",
+    "cc",
+    "cc_segmented",
+    "cc_sharded",
+    "edge_weights_np",
+    "resolve_delta",
+    "sssp",
+    "sssp_segmented",
+    "sssp_sharded",
+]

@@ -1,0 +1,285 @@
+"""Connected components as label-min propagation on the superstep machine.
+
+The ``cc`` semiring row (:data:`bfs_tpu.algo.substrate.SEMIRINGS`): every
+vertex starts labeled with its own id, active vertices contribute their
+LABEL along out-edges, the combine is the same segmented min, and a vertex
+whose label improves joins the next frontier.  The fixpoint labels every
+vertex with the minimum id reachable over edges — on the repo's standard
+bi-directed graphs, exactly the minimum vertex id of its connected
+component, the canonical representative the union-find oracle
+(:func:`bfs_tpu.oracle.cc.union_find_labels`) computes.
+
+Rootless: the initial frontier is ALL vertices (every vertex is its own
+best-known label), there is no source argument, and isolated vertices
+terminate immediately — the per-algorithm analog of the per-tile
+empty-frontier early-out: a vertex whose label cannot improve never
+re-enters the frontier, and the traversal ends when the frontier drains
+globally.  Monotone label descent makes ANY superstep schedule converge
+to the same fixpoint, which is why the push arm, the ELL pull arm and the
+sharded arm are value-identical by construction (tests pin it).
+
+The pull arm reuses the BFS ELL machinery verbatim:
+:func:`bfs_tpu.ops.pull.pull_candidates` is already a value-agnostic
+gather + row-min — BFS feeds it the frontier-id table, CC feeds it
+``where(frontier, label, INF)`` — so the scatter-free superstep needs no
+new kernel, just a different table.
+
+No packed arm: the label IS the entire per-vertex state word already
+(``packable=False`` in the contract table).
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..analysis.runtime import traced
+from ..graph.csr import Graph, build_device_graph
+from ..ops.relax import INT32_MAX, combine_min
+
+
+class CcState(NamedTuple):
+    """Loop carry: ``label`` int32[V+1] (slot V inert, holds V);
+    ``frontier`` marks vertices whose label improved last superstep."""
+
+    label: jax.Array  # int32[V+1]
+    frontier: jax.Array  # bool[V+1]
+    rounds: jax.Array  # int32 scalar
+    changed: jax.Array  # bool scalar
+
+
+def init_cc_state(num_vertices: int) -> CcState:
+    n = num_vertices + 1
+    label = jnp.arange(n, dtype=jnp.int32)
+    frontier = jnp.ones((n,), dtype=bool).at[num_vertices].set(False)
+    return CcState(label, frontier, jnp.int32(0), jnp.bool_(True))
+
+
+# bfs_tpu: hot traced
+def cc_superstep(
+    state: CcState,
+    src: jax.Array,
+    dst: jax.Array,
+    *,
+    axis_name: str | None = None,
+) -> CcState:
+    """One label-min superstep (push): active vertices broadcast their
+    label along out-edges; per destination the minimum wins.  With
+    ``axis_name``, per-shard candidates merge with ``lax.pmin``."""
+    n = state.label.shape[0]
+    active = state.frontier[src]
+    cand = combine_min(
+        jnp.where(active, state.label[src], INT32_MAX), dst, n
+    )
+    if axis_name is not None:
+        cand = jax.lax.pmin(cand, axis_name)
+    return _apply_labels(state, cand)
+
+
+# bfs_tpu: hot traced
+def _apply_labels(state: CcState, cand: jax.Array) -> CcState:
+    """Shared apply tail of the push and pull arms: strict label descent,
+    improved set = next frontier, termination = nothing improved."""
+    improved = cand < state.label
+    label = jnp.where(improved, cand, state.label)
+    return CcState(label, improved, state.rounds + 1, improved.any())
+
+
+# bfs_tpu: hot traced
+def cc_superstep_pull(state: CcState, ell0, folds) -> CcState:
+    """Pull twin: gather + row-min over the ELL in-neighbour matrices
+    (:func:`bfs_tpu.ops.pull.pull_candidates` with the LABEL table in
+    place of BFS's frontier-id table — the op is value-agnostic)."""
+    from ..ops.pull import pull_candidates
+
+    tab = jnp.where(state.frontier, state.label, INT32_MAX)
+    cand = pull_candidates(tab, ell0, folds)
+    return _apply_labels(state, cand)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_vertices", "max_rounds")
+)
+@traced("algo.cc_fused")
+def _cc_fused(src, dst, num_vertices: int, max_rounds: int):
+    """Fused push CC: one ``while_loop`` to the label fixpoint."""
+    state = init_cc_state(num_vertices)
+
+    def cond(s):
+        return s.changed & (s.rounds < max_rounds)
+
+    def body(s):
+        return cc_superstep(s, src, dst)
+
+    return jax.lax.while_loop(cond, body, state)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_vertices", "max_rounds")
+)
+@traced("algo.cc_pull_fused")
+def _cc_pull_fused(ell0, folds, num_vertices: int, max_rounds: int):
+    """Fused pull CC over the ELL layout (same fixpoint, scatter-free)."""
+    state = init_cc_state(num_vertices)
+
+    def cond(s):
+        return s.changed & (s.rounds < max_rounds)
+
+    def body(s):
+        return cc_superstep_pull(s, ell0, folds)
+
+    return jax.lax.while_loop(cond, body, state)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("num_vertices",), donate_argnums=(0,)
+)
+@traced("algo.cc_segment")
+def _cc_segment(state, seg_end, src, dst, num_vertices: int):
+    """ONE bounded segment of the push loop (checkpointable twin;
+    ``seg_end`` traced — no retrace per segment advance)."""
+
+    def cond(s):
+        return s.changed & (s.rounds < seg_end)
+
+    def body(s):
+        return cc_superstep(s, src, dst)
+
+    return jax.lax.while_loop(cond, body, state)
+
+
+# ------------------------------------------------------------ host driver --
+
+@dataclass
+class CcResult:
+    """Host-side labels (int32[V], sentinel slot stripped): ``label[v]``
+    is the minimum vertex id of v's component.  ``rounds`` counts
+    executed supersteps including the final empty one that detects the
+    fixpoint."""
+
+    label: np.ndarray
+    rounds: int
+    engine: str
+
+    @property
+    def num_components(self) -> int:
+        return int(np.unique(self.label).size)
+
+    def same_component(self, u: int, v: int) -> bool:
+        return int(self.label[u]) == int(self.label[v])
+
+
+def _resolve_engine(engine: str, graph: Graph) -> str:
+    """``auto`` picks pull past the same density point the BFS engines
+    use as a rule of thumb (gather beats scatter on dense in-neighbour
+    rows); any choice is value-identical — monotone label descent has one
+    fixpoint — so this only shapes the superstep cost."""
+    if engine != "auto":
+        return engine
+    v = max(graph.num_vertices, 1)
+    return "pull" if graph.num_edges / v >= 8 else "push"
+
+
+def cc(
+    graph: Graph,
+    *,
+    engine: str = "push",
+    max_rounds: int | None = None,
+    block: int = 1024,
+) -> CcResult:
+    """Connected components (``engine`` = push | pull | auto).  On a
+    bi-directed graph the labels are exactly union-find's min-id
+    representatives; on a directed graph this computes the min REACHABLE
+    id fixpoint instead (pass the bi-directed form for components)."""
+    engine = _resolve_engine(engine, graph)
+    v = graph.num_vertices
+    if engine == "pull":
+        from ..graph.ell import build_pull_graph, device_ell
+
+        pg = build_pull_graph(graph)
+        ell0, folds = device_ell(pg)
+        return cc_device_pull(
+            ell0, folds, pg.num_vertices, max_rounds=max_rounds
+        )
+    if engine == "push":
+        dg = build_device_graph(graph, block=block)
+        return cc_device(
+            jnp.asarray(dg.src), jnp.asarray(dg.dst), v,
+            max_rounds=max_rounds,
+        )
+    raise ValueError(
+        f"unknown engine {engine!r}; use 'push', 'pull' or 'auto'"
+    )
+
+
+def cc_device(
+    src_dev, dst_dev, num_vertices: int, *, max_rounds: int | None = None
+) -> CcResult:
+    """The push arm against ALREADY-RESIDENT sentinel-padded device edge
+    arrays — the serve registry's residency form
+    (:func:`bfs_tpu.serve.algo.registry_cc`)."""
+    v = int(num_vertices)
+    cap = int(max_rounds) if max_rounds is not None else v + 1
+    state = _cc_fused(src_dev, dst_dev, num_vertices=v, max_rounds=cap)
+    label = np.asarray(jax.device_get(state.label))
+    return CcResult(
+        label=label[:v],
+        rounds=int(jax.device_get(state.rounds)),
+        engine="push",
+    )
+
+
+def cc_device_pull(
+    ell0, folds, num_vertices: int, *, max_rounds: int | None = None
+) -> CcResult:
+    """The pull arm against resident ELL operands (same fixpoint)."""
+    v = int(num_vertices)
+    cap = int(max_rounds) if max_rounds is not None else v + 1
+    state = _cc_pull_fused(ell0, folds, num_vertices=v, max_rounds=cap)
+    label = np.asarray(jax.device_get(state.label))
+    return CcResult(
+        label=label[:v],
+        rounds=int(jax.device_get(state.rounds)),
+        engine="pull",
+    )
+
+
+def cc_segmented(
+    graph: Graph,
+    *,
+    ckpt,
+    max_rounds: int | None = None,
+    block: int = 1024,
+) -> CcResult:
+    """Checkpointed twin of the push arm: bounded segments, a durable
+    epoch per boundary, bit-identical labels for any segmentation
+    (:func:`bfs_tpu.algo.substrate.drive_segments`)."""
+    from .substrate import drive_segments
+
+    dg = build_device_graph(graph, block=block)
+    v = dg.num_vertices
+    cap = int(max_rounds) if max_rounds is not None else v + 1
+    src_dev, dst_dev = jnp.asarray(dg.src), jnp.asarray(dg.dst)
+
+    def init(arrays):
+        if arrays is not None:
+            return CcState(**{
+                k: jnp.asarray(arrays[k]) for k in CcState._fields
+            })
+        return init_cc_state(v)
+
+    def seg(carry, seg_end):
+        return _cc_segment(carry, seg_end, src_dev, dst_dev, num_vertices=v)
+
+    state, rounds, _ = drive_segments(
+        ckpt, init=init, seg=seg, fields=CcState._fields,
+        packed=False, cap=cap,
+    )
+    label = np.asarray(jax.device_get(state.label))
+    ckpt.clear()
+    return CcResult(label=label[:v], rounds=rounds, engine="push")

@@ -1,0 +1,550 @@
+"""Weighted SSSP as min-plus supersteps with delta-stepping buckets.
+
+The BFS superstep machinery instantiated on the ``sssp`` semiring row
+(:data:`bfs_tpu.algo.substrate.SEMIRINGS`): per active edge the
+contribution is ``dist[src] + w(src, dst)`` instead of ``src``, the
+combine is the SAME segmented min (:func:`bfs_tpu.ops.relax.combine_min`),
+and the apply keeps the min per destination.  Because weights are a hash
+of the endpoints (:func:`bfs_tpu.algo.substrate.edge_weights`), every
+layout — dst-sorted, padded, round-robin sharded — recomputes its own
+weights from the edge arrays it already holds.
+
+**Delta-stepping.**  The loop carry includes a bucket ``threshold`` T:
+only dirty vertices with ``dist < T`` relax (the current bucket).  When
+the bucket drains with dirty work remaining, T jumps to
+``min(dist[dirty]) + delta`` — the classic bucket advance, here one
+``where`` on a carried scalar, no host round-trip.  ``delta=inf`` (env
+``BFS_TPU_SSSP_DELTA``) degenerates to one bucket = plain frontier
+Bellman-Ford; any delta yields the same fixpoint (tests pin this), it
+only reshapes the superstep schedule, trading rounds against wasted
+long-edge relaxations exactly as in the CPU algorithm.
+
+**Canonical parents.**  Parents are NOT carried through the loop: the
+unique shortest-distance fixpoint determines them after the fact.  One
+exit-time canonicalization pass (:func:`_sssp_parents`) takes, per
+reached vertex, the MINIMUM u among in-edges with
+``dist[u] + w(u, v) == dist[v]`` — the same ``combine_min`` — so every
+engine arm (fused, segmented, sharded, packed) produces bit-identical
+parents, and the host Dijkstra oracle applies the identical rule.
+
+**Packed arm.**  For ``V < 2^16 - 1`` the carry word fuses
+``dist:16 | parent:16`` (the BFS ``level:6|parent:26`` word widened for
+valued distances): candidates travel as packed words through ONE uint32
+``segment_min`` and the merge is gated on STRICT distance improvement, so
+the packed arm's frontier schedule and round count are bit-identical to
+the unpacked arm.  Distances are clamped to 0xFFFE in flight; a final
+distance hitting the clamp reports truncation and the caller re-runs
+unpacked — the same detect-and-fall-back contract as the >62-level BFS
+cap (``packed_truncated``).  The word's parent bits are a provisional
+last-improver (diagnostic only); the exit canonicalization pass is
+authoritative on both arms.
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..analysis.runtime import traced
+from ..graph.csr import Graph, NO_PARENT, build_device_graph
+from ..ops.relax import INT32_MAX, combine_min
+from .substrate import DEFAULT_MAX_WEIGHT, edge_weights, resolve_delta
+
+#: Host-int mirror of the unreached sentinel for static saturation
+#: arithmetic inside the supersteps (INT32_MAX itself is np.int32).
+_INT32_MAX_HOST = int(INT32_MAX)
+
+#: Packed-arm capacity: dist field holds [0, 0xFFFD]; 0xFFFE is the
+#: in-flight clamp (truncation canary), 0xFFFF the unreached sentinel.
+PACKED16_DIST_CLAMP = 0xFFFE
+PACKED16_UNREACHED = 0xFFFF
+#: Parent field capacity: ids in [0, V] with 0xFFFF = no parent, so the
+#: packed arm requires V < 0xFFFF.
+PACKED16_MAX_V = 0xFFFF
+
+
+def packed16_fits(num_vertices: int) -> bool:
+    """True when the dist:16|parent:16 carry can represent this graph."""
+    return int(num_vertices) < PACKED16_MAX_V
+
+
+class SsspState(NamedTuple):
+    """Unpacked loop carry.  ``dirty`` marks vertices whose dist improved
+    since they last relaxed their out-edges (the delta-stepping work
+    set); ``threshold`` is the current bucket's exclusive upper bound."""
+
+    dist: jax.Array  # int32[V+1]; INT32_MAX = unreached; slot V inert
+    dirty: jax.Array  # bool[V+1]
+    threshold: jax.Array  # int32 scalar
+    rounds: jax.Array  # int32 scalar: supersteps executed
+    changed: jax.Array  # bool scalar: dirty work remains
+
+
+class PackedSsspState(NamedTuple):
+    """Packed twin: ``packed`` is uint32[V+1] ``dist:16|parent:16``
+    (all-ones = unreached); other fields as in :class:`SsspState`."""
+
+    packed: jax.Array  # uint32[V+1]
+    dirty: jax.Array  # bool[V+1]
+    threshold: jax.Array  # int32 scalar
+    rounds: jax.Array
+    changed: jax.Array
+
+
+def init_sssp_state(num_vertices: int, source, delta: int) -> SsspState:
+    n = num_vertices + 1
+    source = jnp.asarray(source, dtype=jnp.int32)
+    dist = jnp.full((n,), INT32_MAX, dtype=jnp.int32).at[source].set(0)
+    dirty = jnp.zeros((n,), dtype=bool).at[source].set(True)
+    return SsspState(
+        dist, dirty, jnp.int32(delta), jnp.int32(0), jnp.bool_(True)
+    )
+
+
+def init_packed_sssp_state(
+    num_vertices: int, source, delta: int
+) -> PackedSsspState:
+    n = num_vertices + 1
+    source = jnp.asarray(source, dtype=jnp.int32)
+    # Source word: dist 0, parent = itself.
+    packed = (
+        jnp.full((n,), 0xFFFFFFFF, dtype=jnp.uint32)
+        .at[source]
+        .set(source.astype(jnp.uint32))
+    )
+    dirty = jnp.zeros((n,), dtype=bool).at[source].set(True)
+    return PackedSsspState(
+        packed, dirty, jnp.int32(delta), jnp.int32(0), jnp.bool_(True)
+    )
+
+
+def packed16_dist(packed: jax.Array) -> jax.Array:
+    """int32 distances from packed words (0xFFFF -> INT32_MAX)."""
+    d16 = (packed >> 16).astype(jnp.int32)
+    return jnp.where(d16 == PACKED16_UNREACHED, INT32_MAX, d16)
+
+
+# bfs_tpu: hot traced
+def sssp_superstep(
+    state: SsspState,
+    src: jax.Array,
+    dst: jax.Array,
+    w: jax.Array,
+    delta: int,
+    *,
+    axis_name: str | None = None,
+) -> SsspState:
+    """One min-plus superstep: relax the current bucket's dirty vertices,
+    then advance the bucket threshold iff it drained with work left.
+
+    With ``axis_name``, ``src``/``dst``/``w`` are this device's edge
+    shard and candidates merge across the mesh with ``lax.pmin`` — the
+    identical collective shape as the BFS sharded superstep, so the
+    min-plus arm inherits the replicated-state contract unchanged."""
+    n = state.dist.shape[0]
+    frontier = state.dirty & (state.dist < state.threshold)
+    active = frontier[src]
+    # The sum may wrap where inactive (dist = INT32_MAX); those lanes are
+    # masked to the identity before the combine ever sees them.
+    sums = state.dist[src] + w
+    cand = combine_min(jnp.where(active, sums, INT32_MAX), dst, n)
+    if axis_name is not None:
+        cand = jax.lax.pmin(cand, axis_name)
+    improved = cand < state.dist
+    dist = jnp.where(improved, cand, state.dist)
+    dirty = (state.dirty & ~frontier) | improved
+    # Bucket advance: only when the bucket drained (no frontier at all)
+    # and dirty work remains beyond the threshold.
+    min_dirty = jnp.min(jnp.where(dirty, dist, INT32_MAX))
+    # Saturating advance: min(.., MAX-delta)+delta keeps the final
+    # all-buckets threshold finite (delta=inf lands exactly on INT32_MAX).
+    threshold = jnp.where(
+        ~frontier.any() & (min_dirty != INT32_MAX),
+        jnp.minimum(min_dirty, jnp.int32(_INT32_MAX_HOST - delta))
+        + jnp.int32(delta),
+        state.threshold,
+    )
+    return SsspState(
+        dist, dirty, threshold, state.rounds + 1, dirty.any()
+    )
+
+
+# bfs_tpu: hot traced
+def sssp_superstep_packed(
+    state: PackedSsspState,
+    src: jax.Array,
+    dst: jax.Array,
+    w: jax.Array,
+    delta: int,
+    *,
+    axis_name: str | None = None,
+) -> PackedSsspState:
+    """Packed twin: candidates travel as ``dist:16|parent:16`` words
+    through one uint32 combine; the merge is strict on the DISTANCE field
+    so the frontier schedule is bit-identical to the unpacked arm."""
+    n = state.packed.shape[0]
+    d16 = state.packed >> 16  # uint32; 0xFFFF = unreached
+    frontier = state.dirty & (
+        d16.astype(jnp.int32) < state.threshold
+    ) & (d16 != PACKED16_UNREACHED)
+    active = frontier[src]
+    sums = jnp.minimum(
+        d16[src] + w.astype(jnp.uint32), jnp.uint32(PACKED16_DIST_CLAMP)
+    )
+    cand_word = (sums << 16) | src.astype(jnp.uint32)
+    cand = combine_min(
+        jnp.where(active, cand_word, jnp.uint32(0xFFFFFFFF)), dst, n
+    )
+    if axis_name is not None:
+        cand = jax.lax.pmin(cand, axis_name)
+    improved = (cand >> 16) < d16
+    packed = jnp.where(improved, cand, state.packed)
+    dirty = (state.dirty & ~frontier) | improved
+    new_d16 = packed >> 16
+    dirty_dist = jnp.where(
+        dirty & (new_d16 != PACKED16_UNREACHED),
+        new_d16.astype(jnp.int32),
+        INT32_MAX,
+    )
+    min_dirty = jnp.min(dirty_dist)
+    threshold = jnp.where(
+        ~frontier.any() & (min_dirty != INT32_MAX),
+        jnp.minimum(min_dirty, jnp.int32(_INT32_MAX_HOST - delta))
+        + jnp.int32(delta),
+        state.threshold,
+    )
+    return PackedSsspState(
+        packed, dirty, threshold, state.rounds + 1, dirty.any()
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("num_segments", "max_weight"))
+@traced("algo.sssp_parents")
+def _sssp_parents(dist, src, dst, source, num_segments: int, max_weight: int):
+    """Exit-time canonicalization: per reached non-source vertex, parent =
+    MIN u over in-edges with ``dist[u] + w(u, v) == dist[v]`` — the same
+    combine, one pass, identical on every arm.  Every optimal predecessor
+    qualifies (its dist is final), so this is the global canonical
+    tie-break, not a schedule artifact."""
+    w = edge_weights(src, dst, max_weight)
+    ds = dist[src]
+    ok = (ds != INT32_MAX) & (ds + w == dist[dst])
+    parent = combine_min(
+        jnp.where(ok, src, INT32_MAX), dst, num_segments
+    )
+    reached = dist != INT32_MAX
+    parent = jnp.where(
+        reached & (parent != INT32_MAX), parent, jnp.int32(NO_PARENT)
+    )
+    return parent.at[source].set(jnp.asarray(source, jnp.int32))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "num_vertices", "max_weight", "delta", "max_rounds", "packed",
+    ),
+)
+@traced("algo.sssp_fused")
+def _sssp_fused(
+    src,
+    dst,
+    source,
+    num_vertices: int,
+    max_weight: int,
+    delta: int,
+    max_rounds: int,
+    packed: bool = False,
+):
+    """The fused SSSP program: weights from the endpoint hash, then one
+    ``while_loop`` of min-plus supersteps (packed or unpacked carry)."""
+    w = edge_weights(src, dst, max_weight)
+    if packed:
+        pstate = init_packed_sssp_state(num_vertices, source, delta)
+
+        def pcond(s):
+            return s.changed & (s.rounds < max_rounds)
+
+        def pbody(s):
+            return sssp_superstep_packed(s, src, dst, w, delta)
+
+        return jax.lax.while_loop(pcond, pbody, pstate)
+    state = init_sssp_state(num_vertices, source, delta)
+
+    def cond(s):
+        return s.changed & (s.rounds < max_rounds)
+
+    def body(s):
+        return sssp_superstep(s, src, dst, w, delta)
+
+    return jax.lax.while_loop(cond, body, state)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_vertices", "max_weight", "delta", "packed"),
+    donate_argnums=(0,),
+)
+@traced("algo.sssp_segment")
+def _sssp_segment(
+    state,
+    seg_end,
+    src,
+    dst,
+    num_vertices: int,
+    max_weight: int,
+    delta: int,
+    packed: bool = False,
+):
+    """ONE bounded segment of the fused loop — the checkpointable twin.
+    ``seg_end`` is a TRACED round bound: advancing it costs no retrace,
+    and a sequence of segments runs exactly the supersteps the fused
+    program would (bit-identical carries at every boundary)."""
+    w = edge_weights(src, dst, max_weight)
+
+    def cond(s):
+        return s.changed & (s.rounds < seg_end)
+
+    if packed:
+
+        def pbody(s):
+            return sssp_superstep_packed(s, src, dst, w, delta)
+
+        return jax.lax.while_loop(cond, pbody, state)
+
+    def body(s):
+        return sssp_superstep(s, src, dst, w, delta)
+
+    return jax.lax.while_loop(cond, body, state)
+
+
+# ------------------------------------------------------------ host driver --
+
+@dataclass
+class SsspResult:
+    """Host-side result in the oracle's shapes: int32[V] ``dist``
+    (INT32_MAX = unreached) and canonical int32[V] ``parent`` (sentinel
+    slot stripped).  ``rounds`` counts executed supersteps including
+    bucket-advance rounds; ``packed`` reports the carry flavor that
+    PRODUCED the result (False after a truncation fallback)."""
+
+    dist: np.ndarray
+    parent: np.ndarray
+    rounds: int
+    max_weight: int
+    delta: int
+    packed: bool
+    truncated_fallbacks: int = 0
+
+    def dist_to(self, v: int) -> int:
+        return int(self.dist[v])
+
+    def has_path_to(self, v: int) -> bool:
+        return int(self.dist[v]) != int(INT32_MAX)
+
+
+def _rounds_cap(num_vertices: int, max_weight: int, max_rounds) -> int:
+    """Safety bound on supersteps: within a bucket each round extends the
+    settled distance prefix by >= 1 weight unit (integer weights >= 1),
+    and each advance covers >= 1 dirty vertex — so total rounds are
+    bounded by max finite distance + bucket count, <= (w_max + 1) * V.
+    The loop exits on convergence long before this on any real graph."""
+    if max_rounds is not None:
+        return int(max_rounds)
+    return (int(max_weight) + 1) * (int(num_vertices) + 1)
+
+
+def _finish(dist_dev, src_dev, dst_dev, source, n, max_weight):
+    dist = np.asarray(jax.device_get(dist_dev))
+    parent = np.asarray(
+        jax.device_get(
+            _sssp_parents(
+                dist_dev, src_dev, dst_dev, jnp.int32(source), n, max_weight
+            )
+        )
+    )
+    return dist, parent
+
+
+def resolve_packed16(num_vertices: int) -> bool:
+    """``BFS_TPU_PACKED=0/1`` forces the carry flavor (the same knob as
+    BFS); otherwise packed exactly when dist:16|parent:16 fits."""
+    from ..ops.packed import resolve_packed
+
+    return resolve_packed(packed16_fits(num_vertices))
+
+
+def sssp(
+    graph: Graph,
+    source: int = 0,
+    *,
+    max_weight: int = DEFAULT_MAX_WEIGHT,
+    delta: int | str | None = None,
+    max_rounds: int | None = None,
+    packed: bool | None = None,
+    block: int = 1024,
+) -> SsspResult:
+    """Single-source shortest paths on the fused push engine.
+
+    Weights are ``edge_weights(src, dst, max_weight)`` — pass the same
+    ``max_weight`` to :func:`bfs_tpu.oracle.sssp.dijkstra` (with
+    :func:`bfs_tpu.algo.substrate.edge_weights_np`) for oracle parity.
+    ``packed=None`` resolves the dist:16|parent:16 arm automatically and
+    falls back unpacked when a final distance hits the 16-bit clamp."""
+    dg = build_device_graph(graph, block=block)
+    return sssp_device(
+        jnp.asarray(dg.src), jnp.asarray(dg.dst), dg.num_vertices, source,
+        max_weight=max_weight, delta=delta, max_rounds=max_rounds,
+        packed=packed,
+    )
+
+
+def sssp_device(
+    src_dev,
+    dst_dev,
+    num_vertices: int,
+    source: int = 0,
+    *,
+    max_weight: int = DEFAULT_MAX_WEIGHT,
+    delta: int | str | None = None,
+    max_rounds: int | None = None,
+    packed: bool | None = None,
+) -> SsspResult:
+    """:func:`sssp` against ALREADY-RESIDENT sentinel-padded device edge
+    arrays — the form the serve registry's residency layer feeds
+    (:func:`bfs_tpu.serve.algo.registry_sssp`): operands upload once per
+    (graph, engine) epoch and every traversal reuses them."""
+    v = int(num_vertices)
+    n = v + 1
+    delta_i = resolve_delta(delta)
+    cap = _rounds_cap(v, max_weight, max_rounds)
+    use_packed = (
+        resolve_packed16(v) if packed is None else bool(packed)
+    )
+    fallbacks = 0
+    if use_packed and not packed16_fits(v):
+        raise ValueError(
+            f"packed16 carry needs V < {PACKED16_MAX_V}, got {v}"
+        )
+    if use_packed:
+        pstate = _sssp_fused(
+            src_dev, dst_dev, jnp.int32(source),
+            num_vertices=v, max_weight=max_weight, delta=delta_i,
+            max_rounds=cap, packed=True,
+        )
+        if not bool(jax.device_get(packed16_truncated(pstate.packed))):
+            dist_dev = packed16_dist(pstate.packed)
+            dist, parent = _finish(
+                dist_dev, src_dev, dst_dev, source, n, max_weight
+            )
+            return SsspResult(
+                dist=dist[:v], parent=parent[:v],
+                rounds=int(jax.device_get(pstate.rounds)),
+                max_weight=max_weight, delta=delta_i, packed=True,
+            )
+        fallbacks = 1  # clamp hit: the packed dists are not trustworthy
+    state = _sssp_fused(
+        src_dev, dst_dev, jnp.int32(source),
+        num_vertices=v, max_weight=max_weight, delta=delta_i,
+        max_rounds=cap, packed=False,
+    )
+    dist, parent = _finish(
+        state.dist, src_dev, dst_dev, source, n, max_weight
+    )
+    return SsspResult(
+        dist=dist[:v], parent=parent[:v],
+        rounds=int(jax.device_get(state.rounds)),
+        max_weight=max_weight, delta=delta_i, packed=False,
+        truncated_fallbacks=fallbacks,
+    )
+
+
+@functools.partial(jax.jit)
+@traced("algo.sssp_truncated")
+def packed16_truncated(packed) -> jax.Array:
+    """Did any final packed distance hit the in-flight clamp?  The clamp
+    value doubles as the truncation canary: a genuine distance of exactly
+    0xFFFE also reports truncation (conservative — the unpacked re-run is
+    correct either way)."""
+    return ((packed >> 16) == PACKED16_DIST_CLAMP).any()
+
+
+def sssp_segmented(
+    graph: Graph,
+    source: int = 0,
+    *,
+    ckpt,
+    max_weight: int = DEFAULT_MAX_WEIGHT,
+    delta: int | str | None = None,
+    max_rounds: int | None = None,
+    packed: bool | None = None,
+    block: int = 1024,
+) -> SsspResult:
+    """Checkpointed twin of :func:`sssp`: the fused loop cut into bounded
+    segments with a durable epoch per boundary
+    (:func:`bfs_tpu.algo.substrate.drive_segments`) — bit-identical
+    results for any segmentation, kill/resume included."""
+    from .substrate import drive_segments
+
+    dg = build_device_graph(graph, block=block)
+    v = dg.num_vertices
+    n = v + 1
+    delta_i = resolve_delta(delta)
+    cap = _rounds_cap(v, max_weight, max_rounds)
+    src_dev, dst_dev = jnp.asarray(dg.src), jnp.asarray(dg.dst)
+
+    def run_flavor(use_packed: bool):
+        cls = PackedSsspState if use_packed else SsspState
+
+        def init(arrays):
+            if arrays is not None:
+                return cls(**{
+                    k: jnp.asarray(arrays[k]) for k in cls._fields
+                })
+            if use_packed:
+                return init_packed_sssp_state(v, source, delta_i)
+            return init_sssp_state(v, source, delta_i)
+
+        def seg(carry, seg_end):
+            return _sssp_segment(
+                carry, seg_end, src_dev, dst_dev,
+                num_vertices=v, max_weight=max_weight, delta=delta_i,
+                packed=use_packed,
+            )
+
+        return drive_segments(
+            ckpt, init=init, seg=seg, fields=cls._fields,
+            packed=use_packed, cap=cap,
+        )
+
+    use_packed = resolve_packed16(v) if packed is None else bool(packed)
+    fallbacks = 0
+    if use_packed:
+        pstate, rounds, _ = run_flavor(True)
+        if not bool(jax.device_get(packed16_truncated(pstate.packed))):
+            dist, parent = _finish(
+                packed16_dist(pstate.packed), src_dev, dst_dev, source,
+                n, max_weight,
+            )
+            ckpt.clear()
+            return SsspResult(
+                dist=dist[:v], parent=parent[:v], rounds=rounds,
+                max_weight=max_weight, delta=delta_i, packed=True,
+            )
+        fallbacks = 1
+        ckpt.clear()  # packed epochs cannot feed the unpacked re-run
+    state, rounds, _ = run_flavor(False)
+    dist, parent = _finish(
+        state.dist, src_dev, dst_dev, source, n, max_weight
+    )
+    ckpt.clear()
+    return SsspResult(
+        dist=dist[:v], parent=parent[:v], rounds=rounds,
+        max_weight=max_weight, delta=delta_i, packed=False,
+        truncated_fallbacks=fallbacks,
+    )

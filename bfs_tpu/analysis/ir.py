@@ -924,6 +924,182 @@ def _spec_sharded_relay(flavor: str = "dense"):
     )
 
 
+def _spec_algo_sssp_fused(packed: bool):
+    """The semiring SSSP programs (ISSUE 16): min-plus supersteps over
+    hash-recomputed weights, unpacked int32 or packed dist:16|parent:16
+    carry — same HBM/donation rules as the BFS fused programs."""
+    import jax.numpy as jnp
+
+    from ..algo.sssp import _sssp_fused
+    from ..graph.csr import build_device_graph
+
+    dg = _memo("dg", lambda: build_device_graph(_tiny_graph()))
+    v = dg.num_vertices
+    return Program(
+        name=f"algo.sssp_fused{'_packed' if packed else ''}",
+        path="bfs_tpu/algo/sssp.py",
+        fn=_sssp_fused,
+        args=(jnp.asarray(dg.src), jnp.asarray(dg.dst), jnp.int32(0)),
+        static_kwargs=dict(
+            num_vertices=v, max_weight=31, delta=64, max_rounds=64,
+            packed=packed,
+        ),
+        v_elements=v, packed=packed, budget_bytes=_hbm_envelope(),
+    )
+
+
+def _spec_algo_sssp_segment():
+    import jax.numpy as jnp
+
+    from ..algo.sssp import _sssp_segment, init_sssp_state
+    from ..graph.csr import build_device_graph
+
+    dg = _memo("dg", lambda: build_device_graph(_tiny_graph()))
+    v = dg.num_vertices
+    return Program(
+        name="algo.sssp_segment", path="bfs_tpu/algo/sssp.py",
+        fn=_sssp_segment,
+        args=(
+            init_sssp_state(v, 0, 64), jnp.int32(8),
+            jnp.asarray(dg.src), jnp.asarray(dg.dst),
+        ),
+        static_kwargs=dict(
+            num_vertices=v, max_weight=31, delta=64, packed=False
+        ),
+        v_elements=v, donate={0: "state"}, budget_bytes=_hbm_envelope(),
+    )
+
+
+def _spec_algo_sssp_parents():
+    """The exit-time parent canonicalization pass — the one program every
+    SSSP arm shares, which is WHY parents are schedule-independent."""
+    import jax.numpy as jnp
+
+    from ..algo.sssp import _sssp_parents
+    from ..graph.csr import build_device_graph
+
+    dg = _memo("dg", lambda: build_device_graph(_tiny_graph()))
+    v = dg.num_vertices
+    dist = jnp.zeros((v + 1,), jnp.int32)
+    return Program(
+        name="algo.sssp_parents", path="bfs_tpu/algo/sssp.py",
+        fn=_sssp_parents,
+        args=(dist, jnp.asarray(dg.src), jnp.asarray(dg.dst), jnp.int32(0)),
+        static_kwargs=dict(num_segments=v + 1, max_weight=31),
+        v_elements=v, budget_bytes=_hbm_envelope(),
+    )
+
+
+def _spec_algo_cc_fused(engine: str):
+    from ..algo.cc import _cc_fused, _cc_pull_fused
+
+    if engine == "pull":
+        from ..graph.ell import build_pull_graph, device_ell
+
+        pg = _memo("pg", lambda: build_pull_graph(_tiny_graph()))
+        ell0, folds = _memo("ell", lambda: device_ell(pg))
+        v = pg.num_vertices
+        fn, args = _cc_pull_fused, (ell0, folds)
+    else:
+        import jax.numpy as jnp
+
+        from ..graph.csr import build_device_graph
+
+        dg = _memo("dg", lambda: build_device_graph(_tiny_graph()))
+        v = dg.num_vertices
+        fn, args = _cc_fused, (jnp.asarray(dg.src), jnp.asarray(dg.dst))
+    return Program(
+        name=f"algo.cc_fused_{engine}", path="bfs_tpu/algo/cc.py",
+        fn=fn, args=args,
+        static_kwargs=dict(num_vertices=v, max_rounds=v + 1),
+        v_elements=v, budget_bytes=_hbm_envelope(),
+    )
+
+
+def _spec_algo_cc_segment():
+    import jax.numpy as jnp
+
+    from ..algo.cc import _cc_segment, init_cc_state
+    from ..graph.csr import build_device_graph
+
+    dg = _memo("dg", lambda: build_device_graph(_tiny_graph()))
+    v = dg.num_vertices
+    return Program(
+        name="algo.cc_segment", path="bfs_tpu/algo/cc.py",
+        fn=_cc_segment,
+        args=(
+            init_cc_state(v), jnp.int32(8),
+            jnp.asarray(dg.src), jnp.asarray(dg.dst),
+        ),
+        static_kwargs=dict(num_vertices=v),
+        v_elements=v, donate={0: "state"}, budget_bytes=_hbm_envelope(),
+    )
+
+
+def _spec_algo_sssp_sharded():
+    import jax.numpy as jnp
+
+    from ..parallel.sharded import make_mesh
+
+    _need_devices(2)
+    from ..algo.sharded import _sssp_sharded_fused
+    from ..graph.csr import build_device_graph
+
+    mesh = _memo("mesh2", lambda: make_mesh(graph=2, batch=1))
+    dg = _memo(
+        "dg2", lambda: build_device_graph(_tiny_graph(), num_shards=2)
+    )
+    v = dg.num_vertices
+    return Program(
+        name="algo.sssp_sharded", path="bfs_tpu/algo/sharded.py",
+        fn=_sssp_sharded_fused,
+        args=(
+            jnp.asarray(dg.src).reshape(2, -1),
+            jnp.asarray(dg.dst).reshape(2, -1),
+            jnp.int32(0),
+        ),
+        static_kwargs=dict(
+            mesh=mesh, num_vertices=v, max_weight=31, delta=64,
+            max_rounds=64,
+        ),
+        v_elements=v, budget_bytes=_hbm_envelope(),
+        mesh_axes=frozenset({"graph"}),
+        required_axes=frozenset({"graph"}),
+        # SsspState(dist, dirty, threshold, rounds, changed) — replicated.
+        expected_out_names=(frozenset(),) * 5,
+    )
+
+
+def _spec_algo_cc_sharded():
+    import jax.numpy as jnp
+
+    from ..parallel.sharded import make_mesh
+
+    _need_devices(2)
+    from ..algo.sharded import _cc_sharded_fused
+    from ..graph.csr import build_device_graph
+
+    mesh = _memo("mesh2", lambda: make_mesh(graph=2, batch=1))
+    dg = _memo(
+        "dg2", lambda: build_device_graph(_tiny_graph(), num_shards=2)
+    )
+    v = dg.num_vertices
+    return Program(
+        name="algo.cc_sharded", path="bfs_tpu/algo/sharded.py",
+        fn=_cc_sharded_fused,
+        args=(
+            jnp.asarray(dg.src).reshape(2, -1),
+            jnp.asarray(dg.dst).reshape(2, -1),
+        ),
+        static_kwargs=dict(mesh=mesh, num_vertices=v, max_rounds=64),
+        v_elements=v, budget_bytes=_hbm_envelope(),
+        mesh_axes=frozenset({"graph"}),
+        required_axes=frozenset({"graph"}),
+        # CcState(label, frontier, rounds, changed) — replicated.
+        expected_out_names=(frozenset(),) * 4,
+    )
+
+
 #: name -> builder.  Order is the report order.
 PROGRAM_SPECS = {
     "bfs.push_fused": _spec_push_fused,
@@ -951,6 +1127,15 @@ PROGRAM_SPECS = {
     ),
     "sharded.relay_push": lambda: _spec_sharded_relay("push"),
     "sharded.relay_mxu": _spec_sharded_relay_mxu,
+    "algo.sssp_fused": lambda: _spec_algo_sssp_fused(False),
+    "algo.sssp_fused_packed": lambda: _spec_algo_sssp_fused(True),
+    "algo.sssp_segment": _spec_algo_sssp_segment,
+    "algo.sssp_parents": _spec_algo_sssp_parents,
+    "algo.cc_fused_push": lambda: _spec_algo_cc_fused("push"),
+    "algo.cc_fused_pull": lambda: _spec_algo_cc_fused("pull"),
+    "algo.cc_segment": _spec_algo_cc_segment,
+    "algo.sssp_sharded": _spec_algo_sssp_sharded,
+    "algo.cc_sharded": _spec_algo_cc_sharded,
     "layout.device_hist": lambda: _spec_layout_device("layout.device_hist"),
     "layout.device_relabel": lambda: _spec_layout_device(
         "layout.device_relabel"

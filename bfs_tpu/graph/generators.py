@@ -78,3 +78,17 @@ def path_graph(num_vertices: int) -> Graph:
     """A simple path 0-1-2-...-(V-1); worst-case diameter for level-sync BFS."""
     u = np.arange(num_vertices - 1, dtype=np.int32)
     return Graph.from_undirected_edges(num_vertices, np.stack([u, u + 1], axis=1))
+
+
+def star_graph(num_vertices: int, hub: int = 0) -> Graph:
+    """A star: ``hub`` joined to every other vertex.  Maximum fan-out in
+    one superstep — the combine's worst-case segment density, and the
+    semiring algorithms' canonical tie-break stressor (every leaf path
+    runs through the hub)."""
+    leaves = np.array(
+        [v for v in range(num_vertices) if v != hub], dtype=np.int32
+    )
+    hubs = np.full(leaves.shape, hub, dtype=np.int32)
+    return Graph.from_undirected_edges(
+        num_vertices, np.stack([hubs, leaves], axis=1)
+    )

@@ -112,15 +112,29 @@ def relax_superstep(
     return apply_candidates(state, cand_parent)
 
 
+# bfs_tpu: hot traced
+def combine_min(values, dst, num_segments: int) -> jax.Array:
+    """THE semiring combine: one segmented min of per-edge contribution
+    values over edge destinations (identity = the dtype's max sentinel).
+
+    Every algorithm on the superstep machinery reduces through this one
+    op — BFS contributes ``src`` ids (min-id parent), SSSP contributes
+    ``dist[src] + w`` min-plus sums, connected components contributes
+    ``label[src]`` (bfs_tpu/algo/substrate.py's semiring table).  Edges
+    must be dst-sorted with sentinel padding (csr.build_device_graph) so
+    ``indices_are_sorted=True`` holds and padded lanes are inert."""
+    return jax.ops.segment_min(
+        values, dst, num_segments=num_segments, indices_are_sorted=True
+    )
+
+
 def _push_candidates(frontier, src, dst, num_segments: int) -> jax.Array:
     """Min source id among active in-edges per destination; INT32_MAX where
-    none (the mapper + reducer monoid as one segmented min)."""
+    none (the mapper + reducer monoid as one segmented min) — BFS's
+    instance of :func:`combine_min`."""
     active = frontier[src]
-    return jax.ops.segment_min(
-        jnp.where(active, src, INT32_MAX),
-        dst,
-        num_segments=num_segments,
-        indices_are_sorted=True,
+    return combine_min(
+        jnp.where(active, src, INT32_MAX), dst, num_segments
     )
 
 

@@ -1,3 +1,12 @@
 from .bfs import queue_bfs, canonical_bfs, check, has_path_to, dist_to, path_to  # noqa: F401
-from .device import COUNT_FIELDS, DeviceChecker  # noqa: F401
+from .cc import check_cc, union_find_labels  # noqa: F401
+from .device import (  # noqa: F401
+    CC_COUNT_FIELDS,
+    COUNT_FIELDS,
+    SSSP_COUNT_FIELDS,
+    DeviceChecker,
+    cc_device_check,
+    sssp_device_check,
+)
 from .native import native_bfs, native_available  # noqa: F401
+from .sssp import check_sssp, dijkstra  # noqa: F401

@@ -119,6 +119,146 @@ def _coverage_mismatch(dist, ref_words, v: int):
     )
 
 
+# ------------------------------------------------------- algo verdicts --
+# The semiring algorithms' on-device invariant programs (ISSUE 16): the
+# same shape as the BFS verdict — data-parallel reductions over the edge
+# set, a handful of bytes down the tunnel.  The HOST oracles
+# (oracle/sssp.py, oracle/cc.py) stay the ground truth; these are the
+# per-run cheap checks the harness can afford per root.
+
+#: Names for the SSSP verdict vector, index-aligned.
+SSSP_COUNT_FIELDS = (
+    "source_dist_nonzero",
+    "edge_dst_unreached",
+    "edge_relaxable",
+    "reached_without_parent",
+    "tree_edge_not_tight",
+)
+
+#: Names for the CC verdict vector, index-aligned.
+CC_COUNT_FIELDS = (
+    "edge_label_mismatch",
+    "label_above_id",
+    "root_not_self_labeled",
+)
+
+
+@functools.partial(jax.jit, static_argnames=("v", "max_weight"))
+def _sssp_check_counts(srcv, dstv, dist, parent, source, v: int,
+                       max_weight: int):
+    """int32[5] SSSP violation counts (see :data:`SSSP_COUNT_FIELDS`).
+
+    Weights are recomputed from the endpoint hash
+    (:func:`bfs_tpu.algo.substrate.edge_weights`) — the same
+    zero-operand-plumbing trick the engines use.  Sentinel-padded edges
+    are inert; dist/parent may carry the engines' sentinel slot.
+    """
+    from ..algo.substrate import edge_weights
+
+    inf = jnp.int32(INF_DIST)
+    dist = jax.lax.slice_in_dim(dist, 0, v)
+    parent = jax.lax.slice_in_dim(parent, 0, v)
+    dist_p = jnp.concatenate([dist, jnp.full((1,), inf, jnp.int32)])
+    si = jnp.minimum(srcv, v)
+    di = jnp.minimum(dstv, v)
+    real = (srcv < v) & (dstv < v)
+    wv = edge_weights(srcv, dstv, max_weight)
+    ds, dd = dist_p[si], dist_p[di]
+
+    c_src = (dist_p[jnp.minimum(source, v)] != 0).sum(dtype=jnp.int32)
+
+    reach_s = real & (ds != inf)
+    reach_d = dd != inf
+    c_unreached = (reach_s & ~reach_d).sum(dtype=jnp.int32)
+    # A relaxable edge remaining means the fixpoint was not reached.
+    c_relaxable = (reach_s & reach_d & (dd > ds + wv)).sum(dtype=jnp.int32)
+
+    reached = dist != inf
+    non_src = reached & (jnp.arange(v, dtype=jnp.int32) != source)
+    c_noparent = (non_src & ((parent < 0) | (parent >= v))).sum(
+        dtype=jnp.int32
+    )
+    hasp = non_src & (parent >= 0) & (parent < v)
+    # Tree-edge tightness via the edge-side scatter: edge (u, w) covers w
+    # iff parent[w] == u AND dist[w] == dist[u] + weight(u, w).
+    par_p = jnp.concatenate(
+        [parent, jnp.full((1,), NO_PARENT, jnp.int32)]
+    )
+    tight = real & (par_p[di] == srcv) & (dd == ds + wv)
+    covered = (
+        jnp.zeros(v + 1, bool)
+        .at[jnp.where(tight, di, jnp.int32(v))]
+        .set(True)
+    )
+    c_loose = (hasp & ~covered[:v]).sum(dtype=jnp.int32)
+
+    return jnp.stack(
+        [c_src, c_unreached, c_relaxable, c_noparent, c_loose]
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("v",))
+def _cc_check_counts(srcv, dstv, label, v: int):
+    """int32[3] CC violation counts (see :data:`CC_COUNT_FIELDS`)."""
+    label = jax.lax.slice_in_dim(label, 0, v)
+    label_p = jnp.concatenate([label, jnp.full((1,), -1, jnp.int32)])
+    si = jnp.minimum(srcv, v)
+    di = jnp.minimum(dstv, v)
+    real = (srcv < v) & (dstv < v)
+    c_edge = (real & (label_p[si] != label_p[di])).sum(dtype=jnp.int32)
+    ids = jnp.arange(v, dtype=jnp.int32)
+    c_above = (label > ids).sum(dtype=jnp.int32)
+    inrange = (label >= 0) & (label < v)
+    roots = label_p[jnp.where(inrange, label, jnp.int32(v))]
+    c_root = (inrange & (roots != label)).sum(dtype=jnp.int32)
+    return jnp.stack([c_edge, c_above, c_root])
+
+
+def sssp_device_check(
+    src, dst, dist, parent, source, num_vertices: int, max_weight: int
+) -> dict[str, int]:
+    """Named nonzero SSSP violation counts (empty dict == all invariants
+    hold); only the counter vector crosses the tunnel."""
+    host = np.asarray(
+        jax.device_get(
+            _sssp_check_counts(
+                jnp.asarray(src).reshape(-1),
+                jnp.asarray(dst).reshape(-1),
+                jnp.asarray(dist),
+                jnp.asarray(parent),
+                jnp.int32(source),
+                int(num_vertices),
+                int(max_weight),
+            )
+        )
+    )
+    return {
+        name: int(n)
+        for name, n in zip(SSSP_COUNT_FIELDS, host.tolist())
+        if n
+    }
+
+
+def cc_device_check(src, dst, label, num_vertices: int) -> dict[str, int]:
+    """Named nonzero CC violation counts (empty dict == consistent,
+    self-rooted, id-dominated labels)."""
+    host = np.asarray(
+        jax.device_get(
+            _cc_check_counts(
+                jnp.asarray(src).reshape(-1),
+                jnp.asarray(dst).reshape(-1),
+                jnp.asarray(label),
+                int(num_vertices),
+            )
+        )
+    )
+    return {
+        name: int(n)
+        for name, n in zip(CC_COUNT_FIELDS, host.tolist())
+        if n
+    }
+
+
 class DeviceChecker:
     """Device-resident verifier bound to one graph's edge arrays.
 

@@ -25,6 +25,7 @@ breaker per executable, hung-call watchdog, sampled on-device integrity
 checks — the self-healing layer).
 """
 
+from .algo import registry_cc, registry_sssp
 from .registry import ENGINES, GraphRegistry, RegisteredGraph
 from .executor import ExecutableCache, build_batch_runner, run_oracle_batch
 from .health import HungCallError, ServeHealth, run_with_deadline
@@ -56,5 +57,7 @@ __all__ = [
     "ServeHealth",
     "ServeReply",
     "ServerClosed",
+    "registry_cc",
+    "registry_sssp",
     "run_with_deadline",
 ]

@@ -1,0 +1,137 @@
+"""Connected components on the semiring substrate (ISSUE 16).
+
+Covers: label-min propagation vs the union-find oracle (min-id canonical
+labels, bit-for-bit) on multi-component gnm / star / path / rmat; the
+push and pull arms' value identity plus the density-based ``auto``
+resolution; fused-vs-segmented bit-identity incl. the in-process
+kill/resume chaos smoke; x2/x8 edge-sharded parity; the on-device label
+invariant counters; and the result's component-query surface.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from bfs_tpu.algo import cc, cc_segmented, cc_sharded
+from bfs_tpu.graph.generators import (
+    gnm_graph,
+    path_graph,
+    rmat_graph,
+    star_graph,
+)
+from bfs_tpu.oracle import cc_device_check, check_cc, union_find_labels
+from bfs_tpu.resilience import faults
+from bfs_tpu.resilience.faults import FaultInjected
+from bfs_tpu.resilience.superstep_ckpt import CkptConfig, SuperstepCheckpointer
+
+GRAPHS = {
+    # Sparse G(n, m): isolated vertices + several components — the
+    # rootless semiring's reason to exist (BFS needs a root per island).
+    "gnm_multi": lambda: gnm_graph(200, 150, seed=7),
+    "star": lambda: star_graph(64),
+    "path": lambda: path_graph(200),
+    "rmat": lambda: rmat_graph(7, 8, seed=2),
+}
+
+_cache: dict[str, object] = {}
+
+
+@pytest.fixture(params=sorted(GRAPHS))
+def graph(request):
+    if request.param not in _cache:
+        _cache[request.param] = GRAPHS[request.param]()
+    return _cache[request.param]
+
+
+def _mgr(tmp_path, k=1):
+    return SuperstepCheckpointer(
+        tmp_path, {"algo": "cc"}, cfg=CkptConfig("every", k)
+    )
+
+
+# -------------------------------------------------------- oracle parity --
+@pytest.mark.algo_smoke
+@pytest.mark.parametrize("engine", ["push", "pull"])
+def test_cc_matches_union_find(graph, engine):
+    oracle = union_find_labels(graph)
+    res = cc(graph, engine=engine)
+    assert res.engine == engine
+    np.testing.assert_array_equal(res.label, oracle)
+    assert check_cc(graph, res.label) == []
+    assert res.num_components == int(np.unique(oracle).size)
+
+
+def test_cc_auto_engine_resolution():
+    dense = gnm_graph(64, 1024, seed=1)  # E/V >= 8 -> pull
+    sparse = path_graph(64)
+    assert cc(dense, engine="auto").engine == "pull"
+    assert cc(sparse, engine="auto").engine == "push"
+    np.testing.assert_array_equal(
+        cc(dense, engine="auto").label, union_find_labels(dense)
+    )
+
+
+def test_cc_component_queries():
+    g = GRAPHS["gnm_multi"]()
+    res = cc(g)
+    oracle = union_find_labels(g)
+    assert res.num_components > 1
+    same = np.flatnonzero(oracle == oracle[g.src[0]])
+    assert res.same_component(int(same[0]), int(same[-1]))
+    other = np.flatnonzero(oracle != oracle[g.src[0]])
+    assert not res.same_component(int(same[0]), int(other[0]))
+
+
+# ---------------------------------------------------------- device check --
+def test_cc_device_check(graph):
+    res = cc(graph)
+    assert cc_device_check(
+        graph.src, graph.dst, res.label, graph.num_vertices
+    ) == {}
+    bad = res.label.copy()
+    v = graph.num_vertices - 1
+    bad[v] = v  # detach the last vertex from its component's label
+    viol = cc_device_check(graph.src, graph.dst, bad, graph.num_vertices)
+    if int(res.label[v]) != v:  # was not already its own representative
+        assert viol
+
+
+# ------------------------------------------------- segmented / kill-resume --
+@pytest.mark.algo_smoke
+def test_cc_segmented_bit_identical(graph, tmp_path):
+    fused = cc(graph)
+    for k in (2, 3):
+        res = cc_segmented(graph, ckpt=_mgr(tmp_path / f"k{k}", k=k))
+        np.testing.assert_array_equal(res.label, fused.label)
+        assert res.rounds == fused.rounds
+
+
+@pytest.mark.chaos
+def test_cc_kill_resume_bit_identical(tmp_path):
+    g = GRAPHS["gnm_multi"]()
+    fused = cc(g)
+    os.environ["BFS_TPU_FAULT"] = "raise:superstep:2"
+    faults.reset()
+    try:
+        with pytest.raises(FaultInjected):
+            cc_segmented(g, ckpt=_mgr(tmp_path))
+    finally:
+        os.environ.pop("BFS_TPU_FAULT", None)
+        faults.reset()
+    mgr = _mgr(tmp_path)
+    res = cc_segmented(g, ckpt=mgr)
+    assert mgr.report()["resumed_from_epoch"] == 2
+    np.testing.assert_array_equal(res.label, fused.label)
+    assert res.rounds == fused.rounds
+
+
+# ----------------------------------------------------------------- sharded --
+@pytest.mark.algo_smoke
+@pytest.mark.parametrize("shards", [2, 8])
+def test_cc_sharded_parity(graph, shards):
+    base = cc(graph)
+    res = cc_sharded(graph, num_shards=shards)
+    assert res.engine == f"push_sharded_x{shards}"
+    np.testing.assert_array_equal(res.label, base.label)
+    assert res.rounds == base.rounds

@@ -1,0 +1,239 @@
+"""Weighted SSSP on the semiring substrate (ISSUE 16).
+
+Covers: min-plus supersteps vs the host Dijkstra oracle (dist AND
+canonical parents, bit-for-bit) on star/path/gnm/rmat; delta-stepping
+bucket invariance (delta in {1, 17, inf, default} -> one fixpoint); the
+packed16 (dist:16|parent:16) arm's schedule identity with the unpacked
+carry; the truncation canary -> unpacked fallback; fused-vs-segmented
+bit-identity incl. the in-process kill/resume chaos smoke; x2/x8
+edge-sharded parity; the on-device invariant counters; and the semiring
+registry / hash-weight / delta-knob contracts.
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bfs_tpu.algo import (
+    DEFAULT_MAX_WEIGHT,
+    SEMIRINGS,
+    edge_weights_np,
+    resolve_delta,
+    sssp,
+    sssp_segmented,
+    sssp_sharded,
+)
+from bfs_tpu.algo.sssp import PACKED16_MAX_V, packed16_fits
+from bfs_tpu.algo.substrate import edge_weights
+from bfs_tpu.graph.csr import INF_DIST
+from bfs_tpu.graph.generators import (
+    gnm_graph,
+    path_graph,
+    rmat_graph,
+    star_graph,
+)
+from bfs_tpu.oracle import check_sssp, dijkstra, sssp_device_check
+from bfs_tpu.resilience import faults
+from bfs_tpu.resilience.faults import FaultInjected
+from bfs_tpu.resilience.superstep_ckpt import CkptConfig, SuperstepCheckpointer
+
+MAXW = 31
+SOURCE = 3
+
+GRAPHS = {
+    "star": lambda: star_graph(64),
+    "path": lambda: path_graph(200),
+    "gnm": lambda: gnm_graph(300, 2100, seed=5),
+    "rmat": lambda: rmat_graph(7, 8, seed=2),
+}
+
+_cache: dict[str, object] = {}
+
+
+@pytest.fixture(params=sorted(GRAPHS))
+def graph(request):
+    if request.param not in _cache:
+        _cache[request.param] = GRAPHS[request.param]()
+    return _cache[request.param]
+
+
+def _oracle(graph, source=SOURCE, max_weight=MAXW):
+    w = edge_weights_np(graph.src, graph.dst, max_weight)
+    return dijkstra(graph, w, source)
+
+
+def _mgr(tmp_path, k=1, config=None):
+    return SuperstepCheckpointer(
+        tmp_path, config if config is not None else {"algo": "sssp"},
+        cfg=CkptConfig("every", k),
+    )
+
+
+# ------------------------------------------------------------- substrate --
+def test_semiring_registry():
+    assert set(SEMIRINGS) == {"bfs", "sssp", "cc"}
+    # Only valueless contributions ride the AND/popcount MXU tiles.
+    assert SEMIRINGS["bfs"].mxu_eligible
+    assert not SEMIRINGS["sssp"].mxu_eligible
+    assert not SEMIRINGS["cc"].mxu_eligible
+    assert SEMIRINGS["bfs"].packable and SEMIRINGS["sssp"].packable
+    assert not SEMIRINGS["cc"].packable
+
+
+def test_edge_weights_host_device_parity(graph):
+    w_np = edge_weights_np(graph.src, graph.dst, MAXW)
+    w_dev = np.asarray(
+        edge_weights(jnp.asarray(graph.src), jnp.asarray(graph.dst), MAXW)
+    )
+    np.testing.assert_array_equal(w_np, w_dev.astype(w_np.dtype))
+    assert int(w_np.min()) >= 1 and int(w_np.max()) <= MAXW
+
+
+def test_resolve_delta_knob(monkeypatch):
+    monkeypatch.delenv("BFS_TPU_SSSP_DELTA", raising=False)
+    assert resolve_delta() == 64
+    assert resolve_delta(17) == 17
+    assert resolve_delta("inf") == 2**31 - 1
+    assert resolve_delta(0) == 2**31 - 1
+    monkeypatch.setenv("BFS_TPU_SSSP_DELTA", "9")
+    assert resolve_delta() == 9
+    monkeypatch.setenv("BFS_TPU_SSSP_DELTA", "inf")
+    assert resolve_delta() == 2**31 - 1
+
+
+def test_packed16_gate():
+    assert packed16_fits(PACKED16_MAX_V - 1)
+    assert not packed16_fits(PACKED16_MAX_V)
+
+
+# -------------------------------------------------------- oracle parity --
+@pytest.mark.algo_smoke
+@pytest.mark.parametrize("packed", [False, True])
+def test_sssp_matches_dijkstra(graph, packed):
+    odist, opar = _oracle(graph)
+    res = sssp(graph, SOURCE, max_weight=MAXW, packed=packed)
+    np.testing.assert_array_equal(res.dist, odist)
+    np.testing.assert_array_equal(res.parent, opar)
+    assert res.packed is packed
+    assert res.truncated_fallbacks == 0
+    w = edge_weights_np(graph.src, graph.dst, MAXW)
+    assert check_sssp(graph, w, res.dist, res.parent, SOURCE) == []
+
+
+@pytest.mark.parametrize("delta", [1, 17, "inf"])
+def test_delta_bucket_invariance(graph, delta):
+    # Any bucket width reaches the same min-plus fixpoint; parents come
+    # from the exit-time canonicalization, so they match too.
+    odist, opar = _oracle(graph)
+    res = sssp(graph, SOURCE, max_weight=MAXW, delta=delta, packed=False)
+    np.testing.assert_array_equal(res.dist, odist)
+    np.testing.assert_array_equal(res.parent, opar)
+
+
+def test_packed_schedule_identity(graph):
+    # The packed merge is strict on the dist field, so the frontier
+    # schedule — hence the round count — is identical to unpacked.
+    r_p = sssp(graph, SOURCE, max_weight=MAXW, packed=True)
+    r_u = sssp(graph, SOURCE, max_weight=MAXW, packed=False)
+    assert r_p.rounds == r_u.rounds
+    np.testing.assert_array_equal(r_p.dist, r_u.dist)
+    np.testing.assert_array_equal(r_p.parent, r_u.parent)
+
+
+@pytest.mark.algo_smoke
+def test_packed_truncation_falls_back_unpacked():
+    # path(600) x max_weight 255: the true eccentricity overflows 16 bits
+    # (the oracle proves the scenario is real), the clamp canary fires,
+    # and the driver re-runs unpacked — exact, with the fallback counted.
+    g = path_graph(600)
+    w = edge_weights_np(g.src, g.dst, DEFAULT_MAX_WEIGHT)
+    odist, opar = dijkstra(g, w, 0)
+    assert int(odist[odist != INF_DIST].max()) > 0xFFFE
+    res = sssp(g, 0, packed=True)
+    assert res.packed is False
+    assert res.truncated_fallbacks == 1
+    np.testing.assert_array_equal(res.dist, odist)
+    np.testing.assert_array_equal(res.parent, opar)
+
+
+# ---------------------------------------------------------- device check --
+def test_sssp_device_check(graph):
+    res = sssp(graph, SOURCE, max_weight=MAXW, packed=False)
+    assert sssp_device_check(
+        graph.src, graph.dst, res.dist, res.parent, SOURCE,
+        graph.num_vertices, MAXW,
+    ) == {}
+    bad = res.dist.copy()
+    bad[SOURCE] = 1
+    viol = sssp_device_check(
+        graph.src, graph.dst, bad, res.parent, SOURCE,
+        graph.num_vertices, MAXW,
+    )
+    assert viol.get("source_dist_nonzero") == 1
+
+
+# ------------------------------------------------- segmented / kill-resume --
+@pytest.mark.algo_smoke
+@pytest.mark.parametrize("packed", [False, True])
+def test_segmented_bit_identical(graph, tmp_path, packed):
+    fused = sssp(graph, SOURCE, max_weight=MAXW, packed=packed)
+    for k in (2, 3):
+        res = sssp_segmented(
+            graph, SOURCE, ckpt=_mgr(tmp_path / f"k{k}", k=k),
+            max_weight=MAXW, packed=packed,
+        )
+        np.testing.assert_array_equal(res.dist, fused.dist)
+        np.testing.assert_array_equal(res.parent, fused.parent)
+        assert res.rounds == fused.rounds
+        assert res.packed is fused.packed
+
+
+def test_segmented_disabled_store_touches_nothing(graph, tmp_path):
+    off = SuperstepCheckpointer(
+        tmp_path, {"algo": "sssp"}, cfg=CkptConfig("off")
+    )
+    fused = sssp(graph, SOURCE, max_weight=MAXW, packed=False)
+    res = sssp_segmented(
+        graph, SOURCE, ckpt=off, max_weight=MAXW, packed=False
+    )
+    np.testing.assert_array_equal(res.dist, fused.dist)
+    assert list(tmp_path.iterdir()) == []
+
+
+@pytest.mark.chaos
+@pytest.mark.parametrize("packed", [False, True])
+def test_sssp_kill_resume_bit_identical(tmp_path, packed):
+    g = GRAPHS["gnm"]()
+    fused = sssp(g, SOURCE, max_weight=MAXW, packed=packed)
+    os.environ["BFS_TPU_FAULT"] = "raise:superstep:2"
+    faults.reset()
+    try:
+        with pytest.raises(FaultInjected):
+            sssp_segmented(
+                g, SOURCE, ckpt=_mgr(tmp_path), max_weight=MAXW,
+                packed=packed,
+            )
+    finally:
+        os.environ.pop("BFS_TPU_FAULT", None)
+        faults.reset()
+    mgr = _mgr(tmp_path)
+    res = sssp_segmented(
+        g, SOURCE, ckpt=mgr, max_weight=MAXW, packed=packed
+    )
+    assert mgr.report()["resumed_from_epoch"] == 2
+    np.testing.assert_array_equal(res.dist, fused.dist)
+    np.testing.assert_array_equal(res.parent, fused.parent)
+    assert res.rounds == fused.rounds
+
+
+# ----------------------------------------------------------------- sharded --
+@pytest.mark.algo_smoke
+@pytest.mark.parametrize("shards", [2, 8])
+def test_sssp_sharded_parity(graph, shards):
+    base = sssp(graph, SOURCE, max_weight=MAXW, packed=False)
+    res = sssp_sharded(graph, SOURCE, num_shards=shards, max_weight=MAXW)
+    np.testing.assert_array_equal(res.dist, base.dist)
+    np.testing.assert_array_equal(res.parent, base.parent)
+    assert res.rounds == base.rounds

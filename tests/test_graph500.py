@@ -1,0 +1,124 @@
+"""Graph500-style harness (ISSUE 16): ``tools/graph500_run.py``.
+
+Covers: the official per-kernel statistics block (quartiles, mean/stddev
+over time and nedge, TEPS quartiles, harmonic mean/stddev of TEPS) on
+hand-checkable inputs; degree-filtered deterministic root sampling; an
+end-to-end scale run whose output carries the official keys and whose
+capture lines are ledger-shaped JSONL; and journal resume (a re-run of a
+completed scale replays the journaled document instead of recomputing).
+"""
+
+import importlib.util
+import json
+import os
+
+import numpy as np
+import pytest
+
+from conftest import REPO_ROOT
+
+from bfs_tpu.graph.csr import Graph
+
+_spec = importlib.util.spec_from_file_location(
+    "graph500_run", os.path.join(REPO_ROOT, "tools", "graph500_run.py")
+)
+g5 = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(g5)
+
+
+# ------------------------------------------------------------- statistics --
+def test_kernel_stats_official_keys_and_harmonic_mean():
+    times = np.array([1.0, 2.0, 4.0, 8.0])
+    nedges = np.full(4, 100.0)
+    s = g5.kernel_stats(times, nedges)
+    for block in ("time", "nedge", "TEPS"):
+        for stat in ("min", "firstquartile", "median", "thirdquartile",
+                     "max"):
+            assert f"{stat}_{block}" in s
+    for block in ("time", "nedge"):
+        assert f"mean_{block}" in s and f"stddev_{block}" in s
+    # TEPS aggregates harmonically: 4 / sum(t/100) = 4 / 0.15.
+    assert s["harmonic_mean_TEPS"] == pytest.approx(4 / 0.15)
+    assert s["harmonic_stddev_TEPS"] > 0
+    assert s["min_time"] == 1.0 and s["max_time"] == 8.0
+    assert s["median_nedge"] == 100.0
+
+
+def test_kernel_stats_single_root():
+    s = g5.kernel_stats(np.array([2.0]), np.array([50.0]))
+    assert s["stddev_time"] == 0.0
+    assert s["harmonic_mean_TEPS"] == pytest.approx(25.0)
+    assert s["harmonic_stddev_TEPS"] == 0.0
+
+
+def test_format_output_official_lines():
+    s = g5.kernel_stats(np.array([1.0, 2.0]), np.array([10.0, 10.0]))
+    text = g5.format_output(5, 16, 2, 0.1, 0.2, {"bfs": s, "sssp": s})
+    assert "SCALE: 5" in text
+    assert "edgefactor: 16" in text
+    assert "NBFS: 2" in text
+    assert "construction_time: 0.2" in text
+    assert "bfs validation: PASSED" in text
+    assert "bfs  harmonic_mean_TEPS:" in text
+    assert "sssp  median_time:" in text
+
+
+# ----------------------------------------------------------------- roots --
+def test_sample_roots_degree_filtered_and_deterministic():
+    # Vertex 3 is isolated: it must never be sampled as a search key.
+    edges = np.array([[0, 1], [1, 2], [2, 0]], dtype=np.int32)
+    g = Graph.from_undirected_edges(4, edges)
+    roots = g5.sample_roots(g, nbfs=3, seed=7)
+    assert 3 not in roots.tolist()
+    assert len(set(roots.tolist())) == len(roots)
+    np.testing.assert_array_equal(roots, g5.sample_roots(g, nbfs=3, seed=7))
+
+
+# ------------------------------------------------------------ end to end --
+@pytest.mark.algo_smoke
+def test_main_end_to_end(tmp_path, monkeypatch, capsys):
+    monkeypatch.setenv("BFS_TPU_JOURNAL_DIR", str(tmp_path / "journal"))
+    out = tmp_path / "official.txt"
+    cap = tmp_path / "capture.json"
+    rc = g5.main([
+        "--scales", "5", "--roots", "3", "--seed", "2",
+        "--max-weight", "31", "--out", str(out), "--capture", str(cap),
+    ])
+    assert rc == 0
+    text = out.read_text()
+    assert "SCALE: 5" in text
+    assert "bfs validation: PASSED" in text
+    assert "sssp validation: PASSED" in text
+    assert "sssp  harmonic_mean_TEPS:" in text
+    lines = [json.loads(l) for l in cap.read_text().splitlines()]
+    assert {l["metric"] for l in lines} == {
+        "graph500_s5_bfs_harmonic_TEPS",
+        "graph500_s5_sssp_harmonic_TEPS",
+    }
+    for line in lines:
+        assert set(line) == {
+            "metric", "value", "unit", "vs_baseline", "details"
+        }
+        assert line["unit"] == "TEPS" and line["value"] > 0
+        assert line["details"]["validation"] == "PASSED"
+    capsys.readouterr()  # drain the official block printed to stdout
+
+
+def test_journal_resume_replays_completed_scale(tmp_path):
+    from bfs_tpu.resilience.journal import RunJournal
+
+    cfg = {"tool": "graph500_run", "scales": [5], "edgefactor": 8,
+           "roots": 2, "seed": 3, "max_weight": 31}
+    jr = RunJournal.open_for(str(tmp_path), cfg)
+    doc1 = g5.run_scale(5, edgefactor=8, nbfs=2, seed=3, max_weight=31,
+                        jr=jr)
+    doc2 = g5.run_scale(5, edgefactor=8, nbfs=2, seed=3, max_weight=31,
+                        jr=jr)
+    # Bit-identical wall-clock floats prove the journal replayed the
+    # document rather than re-running the kernels.
+    assert doc2 == doc1
+    jr.close()
+    jr2 = RunJournal.open_for(str(tmp_path), cfg)
+    assert g5.run_scale(5, edgefactor=8, nbfs=2, seed=3, max_weight=31,
+                        jr=jr2) == doc1
+    jr2.close()

@@ -47,6 +47,17 @@ echo "== ci gate: MXU-arm parity smoke (ISSUE 15) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_expansion_mxu.py -q \
     -m 'mxu_smoke' -p no:cacheprovider
 
+echo "== ci gate: algorithm-parity smoke (ISSUE 16) =="
+# The semiring substrate's oracle core: SSSP vs Dijkstra (dist + the
+# canonical parents), CC vs union-find, packed truncation fallback,
+# fused-vs-segmented identity, x2/x8 sharded parity, and the graph500
+# harness end-to-end — an algorithm diverging from its oracle must fail
+# the gate on its own stage (~seconds; the full matrix incl. chaos
+# kill/resume runs in tier-1's tests/test_algo_{sssp,cc}.py).
+JAX_PLATFORMS=cpu python -m pytest tests/test_algo_sssp.py \
+    tests/test_algo_cc.py tests/test_graph500.py -q \
+    -m 'algo_smoke' -p no:cacheprovider
+
 if [[ "$RUN_TESTS" == "1" ]]; then
     echo "== ci gate 3/3: lint --all (AST + IR + HLO + Pallas) =="
 else
