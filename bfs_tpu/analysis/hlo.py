@@ -142,6 +142,47 @@ def shape_dtypes(shape: str) -> list[str]:
     return [dt for dt, _ in _SHAPE_RE.findall(shape) if dt in _DTYPE_BYTES]
 
 
+def shape_max_elements(shape: str) -> int:
+    """Largest per-array element count in an HLO shape string (tuples:
+    the max over members).  The HLO004 payload criterion: a collective
+    whose every result is a scalar (<= 1 element) is control plane (the
+    ``changed`` reduce, the direction masses), whatever its byte size."""
+    best = 0
+    for dt, dims in _SHAPE_RE.findall(shape):
+        if dt not in _DTYPE_BYTES:
+            continue
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        best = max(best, n)
+    return best
+
+
+# `replica_groups={{0,1,...},{...}}` — explicit groups; the first
+# group's id list is enough (XLA emits uniform group sizes for the
+# mesh-axis collectives this repo compiles).
+_GROUPS_RE = re.compile(r"replica_groups=\{\{([\d,\s]*)\}")
+# `replica_groups=[G,S]<=[N]` — the iota v2 spelling: G groups of S.
+_GROUPS_IOTA_RE = re.compile(r"replica_groups=\[(\d+),(\d+)\]")
+
+
+def replica_group_size(text: str) -> int | None:
+    """Participants per replica group of a collective instruction line,
+    or None when the instruction carries no replica_groups attribute
+    (single-group collectives over all devices print ``{}`` on some XLA
+    versions — those return None too and the caller falls back to the
+    device count)."""
+    m = _GROUPS_RE.search(text)
+    if m:
+        ids = [x for x in m.group(1).split(",") if x.strip()]
+        return len(ids) or None
+    m = _GROUPS_IOTA_RE.search(text)
+    if m:
+        return int(m.group(2))
+    return None
+
+
 @dataclass
 class Instruction:
     opcode: str

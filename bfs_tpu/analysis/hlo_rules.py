@@ -17,6 +17,8 @@ from .hlo import (
     MATERIALIZE_OPS,
     TEMP_REGRESSION_RATIO,
     materialize_floor,
+    replica_group_size,
+    shape_max_elements,
 )
 
 _WIDE_NUMPY = frozenset({"int64", "uint64", "float64"})
@@ -237,6 +239,32 @@ def check_compiled_collectives(prog, module, metrics, fingerprint,
                 f"the committed fingerprint — XLA {what} the superstep "
                 "loop a collective the source shows once; per-superstep "
                 "ICI traffic changed shape",
+            ))
+    if prog.loop_payload_groups is not None:
+        # The per-AXIS contract (2D grid, ISSUE 17): the loop body must
+        # compile exactly the declared multiset of PAYLOAD collectives,
+        # identified by replica-group size — one group-size-c broadcast
+        # over the column axis, one group-size-r reduce over the row
+        # axis.  Payload = any non-scalar result: the byte floor would
+        # let a tiny-scale lint module misclassify the real wire moves,
+        # and the control scalars (changed / direction masses) are
+        # scalars at every scale.
+        got = sorted(
+            replica_group_size(inst.text) or 0
+            for _comp, inst in module.loop_instructions()
+            if inst.opcode in COLLECTIVE_OPS
+            and shape_max_elements(inst.shape) > 1
+        )
+        want = sorted(int(g) for g in prog.loop_payload_groups)
+        if got != want:
+            findings.append(make_finding(
+                "HLO004", "axis-groups",
+                f"loop-body payload collectives compiled with replica "
+                f"group sizes {got}, spec declares {want} — the "
+                "per-axis exchange contract (one collective per mesh "
+                "axis per superstep) does not hold in the optimized "
+                "module; a global-group collective here is the 1D O(V) "
+                "wire pattern this program exists to avoid",
             ))
     return findings
 

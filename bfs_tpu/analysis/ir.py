@@ -60,6 +60,7 @@ _FLAVOR_ENV = (
     "BFS_TPU_STATE_UPDATE", "BFS_TPU_IR_HBM_GB",
     "BFS_TPU_EXCHANGE", "BFS_TPU_EXCHANGE_DIV",
     "BFS_TPU_EXPANSION", "BFS_TPU_MXU_KERNEL", "BFS_TPU_TILES_BUILD",
+    "BFS_TPU_MESH",
 )
 
 #: Primitives whose presence in a loop body is a host round-trip (IR002).
@@ -109,6 +110,14 @@ class Program:
     exchange_dtypes: tuple = ("uint32", "int32", "bool")
     #: collective payloads under this many bytes are control scalars
     exchange_floor: int = 1024
+    #: expected multiset of replica-group SIZES of loop-body PAYLOAD
+    #: collectives (payload = any non-scalar result, >1 element — the
+    #: control scalars like `changed`/masses psums are excluded by shape,
+    #: not by a byte floor).  None = no check.  The 2D grid programs
+    #: declare exactly one collective per mesh axis per superstep:
+    #: ``(c, r)`` — the column broadcast at group size c, the row
+    #: min-reduce at group size r (HLO004).
+    loop_payload_groups: tuple | None = None
 
 
 @dataclass(frozen=True)
@@ -612,6 +621,102 @@ def _spec_sharded_relay_mxu():
         budget_bytes=_hbm_envelope(),
         mesh_axes=frozenset({"graph", "batch"}),
         required_axes=frozenset({"graph"}),
+    )
+
+
+def _grid_spec_parts():
+    """Shared inputs for the 2D grid specs: the 2x4 mesh over the
+    virtual x8 platform, the 8-shard relay graph and its per-cell
+    layout operands."""
+    _need_devices(8)
+    import jax.numpy as jnp
+
+    from ..graph.grid_layout import grid_layout_for
+    from ..ops.packed import packed_parent_fits, resolve_packed
+    from ..parallel.grid import (
+        _grid_dev_operands,
+        _grid_static,
+        _prepare_grid,
+        make_grid_mesh,
+    )
+    from ..parallel.sharded import _own_word_table_dev
+
+    mesh = _memo("grid_mesh24", lambda: make_grid_mesh(2, 4))
+    srg = _memo("grid_srg8", lambda: _prepare_grid(_tiny_graph(), 8))
+    packed = resolve_packed(packed_parent_fits(srg.num_vertices))
+    layout = grid_layout_for(srg, 2, 4)
+    operands = _grid_dev_operands(srg, 2, 4)
+    own = _own_word_table_dev(srg)
+    outdeg = jnp.asarray(srg.outdeg)
+    static = _grid_static(layout, packed)
+    return mesh, srg, packed, static, operands, own, outdeg
+
+
+def _spec_grid_relay(flavor: str):
+    """The 2D grid programs (ISSUE 17): candidate production local to
+    the r x c cell, a row-axis min-reduce and a column-axis frontier
+    broadcast — per-chip wire O(V/sqrt(n)).  ``bitmap`` (forced arm, no
+    direction cond) carries the STRICT collective-count contract: the
+    loop body must compile exactly one payload collective per mesh axis
+    per superstep — group sizes (c, r) = (4, 2) — so a stray global
+    all-gather (the 1D O(V) wire pattern) is an HLO004 finding, not a
+    silent perf regression.  ``auto`` compiles both density arms under
+    ``lax.cond`` (both branches sit in the loop computation, so the
+    strict count would double-count) and is policed by the fingerprint
+    row instead."""
+    import jax.numpy as jnp
+
+    from ..parallel.grid import _bfs_grid_fused
+
+    mesh, srg, packed, static, operands, own, outdeg = _grid_spec_parts()
+    if flavor == "auto":
+        direction = ("auto", 14.0, 24.0, srg.num_vertices, srg.num_edges)
+        exchange = ("auto", 8)
+    else:
+        direction = None
+        exchange = ("bitmap", 8)
+    return Program(
+        name=f"grid.relay_{flavor}", path="bfs_tpu/parallel/grid.py",
+        fn=_bfs_grid_fused,
+        args=(*operands, own, outdeg, jnp.int32(int(srg.old2new[0]))),
+        static_kwargs=dict(
+            mesh=mesh, static=static, max_levels=16,
+            telemetry=flavor == "auto", direction=direction,
+            exchange=exchange,
+        ),
+        v_elements=srg.num_vertices, packed=packed,
+        budget_bytes=_hbm_envelope(),
+        mesh_axes=frozenset({"row", "col"}),
+        required_axes=frozenset({"row", "col"}),
+        loop_payload_groups=(4, 2) if flavor == "bitmap" else None,
+    )
+
+
+def _spec_grid_segment():
+    """The bounded-segment grid program: per-cell checkpoint shards cut
+    at the axis-exchange boundary — same per-axis collective contract
+    as grid.relay_auto (the fused twin), policed by the fingerprint."""
+    import jax.numpy as jnp
+
+    from ..parallel.grid import _bfs_grid_segment, grid_segment_carry
+
+    mesh, srg, packed, static, operands, own, outdeg = _grid_spec_parts()
+    direction = ("auto", 14.0, 24.0, srg.num_vertices, srg.num_edges)
+    carry = grid_segment_carry(
+        srg, 2, 4, int(srg.old2new[0]), packed, True, True, outdeg
+    )
+    return Program(
+        name="grid.segment", path="bfs_tpu/parallel/grid.py",
+        fn=_bfs_grid_segment,
+        args=(carry, jnp.int32(8), *operands, own, outdeg),
+        static_kwargs=dict(
+            mesh=mesh, static=static, max_levels=16, telemetry=True,
+            direction=direction, exchange=("auto", 8),
+        ),
+        v_elements=srg.num_vertices, packed=packed,
+        budget_bytes=_hbm_envelope(),
+        mesh_axes=frozenset({"row", "col"}),
+        required_axes=frozenset({"row", "col"}),
     )
 
 
@@ -1127,6 +1232,9 @@ PROGRAM_SPECS = {
     ),
     "sharded.relay_push": lambda: _spec_sharded_relay("push"),
     "sharded.relay_mxu": _spec_sharded_relay_mxu,
+    "grid.relay_bitmap": lambda: _spec_grid_relay("bitmap"),
+    "grid.relay_auto": lambda: _spec_grid_relay("auto"),
+    "grid.segment": _spec_grid_segment,
     "algo.sssp_fused": lambda: _spec_algo_sssp_fused(False),
     "algo.sssp_fused_packed": lambda: _spec_algo_sssp_fused(True),
     "algo.sssp_segment": _spec_algo_sssp_segment,

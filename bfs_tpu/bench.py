@@ -1401,6 +1401,309 @@ def _multichip_bench(scale: int, edge_factor: int, repeats: int,
     _stamp("multichip final line emitted; done")
 
 
+def _grid_multichip_bench(r: int, c: int, scale: int, edge_factor: int,
+                          repeats: int, num_roots: int,
+                          do_check: bool) -> None:
+    """The 2D-grid MULTICHIP headline (ISSUE 17): ``BENCH_MESH=rxc``
+    runs :func:`bfs_tpu.parallel.grid.bfs_grid` on the r x c mesh with
+    the same journal phases as the 1D multichip bench.  The headline's
+    ``details.exchange`` carries the PER-AXIS wire story — ``col_bytes``
+    / ``row_bytes`` per level, both arm schedules, ``per_chip_bytes`` —
+    the O(V/sqrt(n)) evidence tools/ledger_compare.py diffs against a 1D
+    capture's flat curve.
+
+    The journal config includes ``mesh_shape`` (and its own ``bench``
+    tag), so flipping ``BENCH_MESH`` between shapes — or between the
+    grid and the legacy integer spelling — rotates the journal instead
+    of resuming a capture measured on a different wire topology.  Legacy
+    integer-mesh journals key exactly as before."""
+    from .graph.grid_layout import grid_tile_placement
+    from .models.direction import resolve_direction
+    from .parallel.exchange import resolve_exchange
+    from .parallel.grid import bfs_grid, make_grid_mesh
+
+    n = r * c
+    if len(jax.devices()) < n:
+        raise SystemExit(
+            f"BENCH_MESH={r}x{c} needs {n} devices, have "
+            f"{len(jax.devices())} (CPU: put "
+            "--xla_force_host_platform_device_count=8 in XLA_FLAGS "
+            "before jax initializes)"
+        )
+    backend = _generator_backend()
+    seed, block = 42, 8 * 1024
+    ex_cfg = resolve_exchange()
+    dir_cfg = resolve_direction()
+    graph_spec = os.environ.get("BENCH_GRAPH", "rmat") or "rmat"
+    jr = _open_journal({
+        "bench": "multichip_grid", "mesh_shape": f"{r}x{c}",
+        "scale": scale, "edge_factor": edge_factor, "repeats": repeats,
+        "num_roots": num_roots, "engine": "grid", "check": do_check,
+        "backend": backend, "seed": seed, "block": block,
+        "graph": graph_spec,
+        "exchange": list(ex_cfg.key()),
+        "direction": dir_cfg.mode,
+        "direction_alpha": dir_cfg.alpha, "direction_beta": dir_cfg.beta,
+    })
+    _install_signal_handlers(jr)
+
+    _stamp(f"grid multichip config: mesh={r}x{c} graph={graph_spec} "
+           f"scale={scale} ef={edge_factor} exchange={ex_cfg.mode} "
+           f"direction={dir_cfg.mode}")
+    with obs_span("bench.load_graph", scale=scale, graph=graph_spec):
+        if graph_spec == "rmat":
+            dg, source = load_or_build(
+                scale, edge_factor, seed, block, backend
+            )
+        elif graph_spec.startswith("path:"):
+            from .graph.generators import path_graph
+
+            dg, source = path_graph(int(graph_spec.split(":")[1])), 0
+        elif graph_spec.startswith("gnm:"):
+            from .graph.generators import gnm_graph
+
+            _, nv, ne = graph_spec.split(":")
+            dg, source = gnm_graph(int(nv), int(ne), seed=seed), 0
+        else:
+            raise SystemExit(
+                f"unknown BENCH_GRAPH {graph_spec!r}; use rmat, path:N "
+                "or gnm:N:M"
+            )
+    _stamp(f"device graph ready: V={dg.num_vertices} E={dg.num_edges}")
+    if jr is not None:
+        from .cache.layout import graph_content_hash
+
+        ghash = graph_content_hash(dg)
+        grec = jr.get("graph")
+        if grec is not None and grec["content_hash"] != ghash:
+            _stamp("journal: graph content hash mismatch — rotating")
+            jr.restart("graph-hash mismatch")
+            grec = None
+        if grec is None:
+            _boundary(jr, "graph", {
+                "content_hash": ghash,
+                "num_vertices": int(dg.num_vertices),
+                "num_edges": int(dg.num_edges),
+                "source": int(source),
+            })
+        done = jr.get("headline")
+        if done is not None:
+            _stamp("journal: grid multichip run complete; replaying "
+                   "headline")
+            print(json.dumps(done["headline"]), flush=True)
+            _finish_obs(jr)
+            return
+    fault_point("graph")
+
+    from .graph.grid_layout import grid_layout_for
+    from .graph.relay import build_sharded_relay_graph
+
+    _stamp(f"building {r}x{c} grid layout ({n} shards)...")
+    t0 = time.perf_counter()
+    with obs_span("bench.layout", kind="grid", shards=n):
+        srg = build_sharded_relay_graph(dg, n)
+        layout = grid_layout_for(srg, r, c)
+        placement = grid_tile_placement(srg, r, c)
+    build_seconds = time.perf_counter() - t0
+    _stamp(f"grid layout ready (build_seconds={build_seconds:.1f}, "
+           f"emax={layout.emax}, tiles={placement['total_tiles']})")
+    _boundary(jr, "layout", {
+        "build_seconds": build_seconds,
+        "emax": int(layout.emax),
+        "tile_placement": {
+            "cells": [[int(x) for x in row] for row in placement["cells"]],
+            "total_tiles": placement["total_tiles"],
+            "tile_rows_per_stripe": placement["tile_rows_per_stripe"],
+        },
+    })
+    mesh = make_grid_mesh(r, c)
+
+    # ---- reference: component + numerator from the grid engine itself
+    ref_rec = jr.get("reference") if jr is not None else None
+    if ref_rec is not None:
+        reached_mask = _restore_mask(jr, dg)
+        directed_traversed = int(ref_rec["directed_traversed"])
+        _stamp("journal: reference restored")
+    else:
+        _stamp("reference run (compile + warm)...")
+        with obs_span("bench.reference"):
+            ref = bfs_grid(srg, int(source), mesh=mesh)
+        reached_mask = ref.dist != np.iinfo(np.int32).max
+        esrc_h = (
+            unpad_edges(dg)[0]
+            if isinstance(dg, DeviceGraph)
+            else np.asarray(dg.src)
+        )
+        directed_traversed = int(np.count_nonzero(reached_mask[esrc_h]))
+        _boundary(jr, "reference", {
+            "directed_traversed": directed_traversed,
+            "vertices_reached": int(reached_mask.sum()),
+        }, arrays={"mask_packed": np.packbits(reached_mask)})
+    roots_rec = jr.get("roots") if jr is not None else None
+    if roots_rec is not None:
+        roots = [int(x) for x in roots_rec["roots"]]
+    else:
+        rng = np.random.default_rng(4242)
+        pool = np.flatnonzero(reached_mask)
+        roots = [int(source)] + [
+            int(s)
+            for s in rng.choice(pool, size=num_roots - 1, replace=False)
+        ]
+        _boundary(jr, "roots", {"roots": roots})
+
+    # ---- timed repeats (journaled per repeat; warm run compiles) ------
+    times = []
+    if jr is not None:
+        for i in range(repeats):
+            rep = jr.get(f"repeat:{i}")
+            if rep is None:
+                break
+            times.append(float(rep["seconds"]))
+        if times:
+            _stamp(f"journal: {len(times)}/{repeats} repeats restored")
+    levels = 0
+    if len(times) < repeats:
+        _stamp("warming grid program...")
+        with obs_span("bench.warm"):
+            levels = bfs_grid(srg, roots[0], mesh=mesh).num_levels
+    for i in range(len(times), repeats):
+        t0 = time.perf_counter()
+        with obs_span("bench.repeat", i=i):
+            for s in roots:
+                levels = bfs_grid(srg, s, mesh=mesh).num_levels
+        times.append(time.perf_counter() - t0)
+        _stamp(f"repeat {i + 1}/{repeats}: {times[-1]:.3f}s")
+        _boundary(jr, f"repeat:{i}", {"seconds": times[-1]})
+    total = float(np.median(times))
+    per_search = total / num_roots
+    teps = (directed_traversed / 2) / per_search
+
+    # ---- telemetry curve: per-axis exchange bytes + schedules ---------
+    curve_rec = jr.get("exchange_curve") if jr is not None else None
+    if curve_rec is not None:
+        curve = curve_rec["curve"]
+        _stamp("journal: exchange curve restored")
+    else:
+        _stamp("telemetry run (per-axis exchange bytes + schedules)...")
+        with obs_span("bench.level_curve"):
+            res_t, curve = bfs_grid(
+                srg, int(source), mesh=mesh, telemetry=True
+            )
+        levels = res_t.num_levels
+        _boundary(jr, "exchange_curve", {"curve": curve})
+    exchange = curve.get("exchange", {})
+    ledger = _sharded_phase_ledger(
+        srg, n, per_search, curve.get("levels", levels), exchange
+    )
+    # Per-axis wire columns on the phase rows (the grid twin of the 1D
+    # exchange-bytes column tools/ledger_compare.py renders).
+    steps = max(int(exchange.get("supersteps", levels)), levels, 1)
+    for phase, div in (("full_search", 1), ("full_superstep", steps)):
+        ledger["phases"][phase]["col_bytes"] = (
+            int(exchange.get("col_total_bytes", 0)) // div
+        )
+        ledger["phases"][phase]["row_bytes"] = (
+            int(exchange.get("row_total_bytes", 0)) // div
+        )
+    for row in ledger["per_shard"]:
+        s = row["shard"]
+        row["mesh_cell"] = [s // c, s % c]
+        row["resident_tiles"] = int(placement["cells"][s // c][s % c])
+
+    check_status = "skipped"
+    if do_check:
+        from .oracle.bfs import check
+
+        if isinstance(dg, DeviceGraph):
+            esrc, edst = unpad_edges(dg)
+            host_graph = Graph(dg.num_vertices, esrc, edst)
+        else:
+            host_graph = dg
+        inf = np.iinfo(np.int32).max
+        to_check = roots[: max(1, min(len(roots), int(os.environ.get(
+            "BENCH_CHECK_ROOTS", str(num_roots)
+        )))) ]
+        nv = 0
+        for s in to_check:
+            if jr is not None and jr.get(f"verify:{int(s)}") is not None:
+                nv += 1
+                continue
+            res = bfs_grid(srg, s, mesh=mesh)
+            np.testing.assert_array_equal(
+                res.dist != inf, reached_mask,
+                err_msg=f"root {s} does not cover the component",
+            )
+            violations = check(host_graph, res.dist, res.parent, s)
+            if violations:
+                raise SystemExit(
+                    f"BFS invariant violations from root {s}: "
+                    f"{violations[:5]}"
+                )
+            nv += 1
+            _stamp(f"root {s} verified ({nv}/{len(to_check)})")
+            _boundary(jr, f"verify:{int(s)}", {
+                "root": int(s), "verdict": "passed",
+            })
+        check_status = f"passed ({nv}/{num_roots} roots, host check)"
+
+    gtag = f"rmat{scale}" if graph_spec == "rmat" else graph_spec.replace(
+        ":", ""
+    )
+    doc = {
+        "metric": f"{gtag}_multichip{r}x{c}_teps",
+        "value": teps,
+        "unit": "TEPS",
+        "vs_baseline": teps / BASELINE_TEPS,
+        "details": {
+            "device": str(jax.devices()[0]),
+            "engine": "grid",
+            "graph": graph_spec,
+            "mesh": {"row": r, "col": c},
+            "num_vertices": int(dg.num_vertices),
+            "num_directed_edges": int(dg.num_edges),
+            "num_roots": num_roots,
+            "roots": roots,
+            "vertices_reached": int(reached_mask.sum()),
+            "directed_edges_traversed": directed_traversed,
+            "seconds_per_search": per_search,
+            "batch_seconds_median": total,
+            "batch_times": times,
+            "supersteps_last_root": int(curve.get("levels", levels)),
+            "layout_build_seconds": build_seconds,
+            "layout_emax": int(layout.emax),
+            "tile_placement": {
+                "cells": [
+                    [int(x) for x in row] for row in placement["cells"]
+                ],
+                "total_tiles": placement["total_tiles"],
+                "tile_rows_per_stripe": placement[
+                    "tile_rows_per_stripe"
+                ],
+            },
+            "check": check_status,
+            "exchange": exchange,
+            "direction_schedule": curve.get("direction_schedule"),
+            "level_curve": {
+                k: v for k, v in curve.items()
+                if k not in ("exchange", "direction_schedule")
+            },
+            "sharded_phases": ledger,
+            "timing_note": (
+                "per-search wall clock includes the host dist/parent "
+                "pull of bfs_grid; in-container virtual-mesh captures "
+                "measure the per-axis exchange/byte story, not peak "
+                "TEPS"
+            ),
+        },
+    }
+    print(json.dumps(doc), flush=True)
+    if jr is not None:
+        jr.put("headline", {"headline": doc})
+    _finish_obs(jr)
+    fault_point("headline")
+    _stamp("grid multichip final line emitted; done")
+
+
 def _exe_warm_marker(key: str) -> str:
     return os.path.join(
         os.environ.get("BFS_TPU_EXE_CACHE", ""), f"warm_{key}.json"
@@ -1499,7 +1802,20 @@ def main():
     # MULTICHIP mode (ISSUE 11): BENCH_MESH=<n> runs the sharded relay
     # on an n-shard ``graph`` mesh with its own journal phases; the
     # headline carries details.exchange + the sharded phase ledger.
-    if int(os.environ.get("BENCH_MESH", "0") or "0") > 0:
+    # ISSUE 17: BENCH_MESH=<r>x<c> routes to the 2D grid engine instead
+    # (per-axis exchange columns, mesh_shape in the journal key).
+    mesh_spec = (os.environ.get("BENCH_MESH", "0") or "0").strip().lower()
+    if "x" in mesh_spec:
+        if engine != "relay":
+            raise SystemExit("BENCH_MESH requires BENCH_ENGINE=relay")
+        from .graph.grid_layout import parse_mesh_spec
+
+        gr, gc = parse_mesh_spec(mesh_spec)
+        _grid_multichip_bench(
+            gr, gc, scale, edge_factor, repeats, num_roots, do_check
+        )
+        return
+    if int(mesh_spec) > 0:
         if engine != "relay":
             raise SystemExit("BENCH_MESH requires BENCH_ENGINE=relay")
         _multichip_bench(scale, edge_factor, repeats, num_roots, do_check)

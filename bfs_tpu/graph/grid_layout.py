@@ -1,0 +1,210 @@
+"""Per-cell 2D grid layouts over the sharded relay vertex space (ISSUE 17).
+
+The classic 2D BFS decomposition (the design both "Parallel Distributed
+BFS on the Kepler Architecture", arXiv 1408.1605, and "Compression and
+Sieve", arXiv 1208.5542, build on) places the adjacency on an ``r x c``
+logical mesh: cell ``(i, j)`` holds exactly the edges whose SOURCE falls
+in the row stripe ``R_i`` and whose DESTINATION falls in the column
+stripe ``C_j``.  A superstep then needs two small collectives instead of
+one O(V) one — a frontier broadcast along the column axis (each cell
+learns the ``R_i`` frontier, |R_i| = V/r bits) and a candidate min-reduce
+along the row axis (each mesh column settles its ``C_j`` destinations,
+|C_j| = V/c candidates) — per-chip wire O(V/r + V/c) = O(V/√n) on a
+square mesh, vs the 1D mesh's O(V).
+
+This module is the HOST side: it derives the per-cell edge layout from
+the existing :class:`~bfs_tpu.graph.relay.ShardedRelayGraph` built at
+``n = r*c`` shards, so the grid reuses the 1D relabeling, block
+structure, own-word tables and checkpoint shard layout unchanged:
+
+  * vertex block ``b`` (the 1D shard) is owned by cell ``(b // c,
+    b % c)`` — mesh-row-major, so the row stripe ``R_i`` = blocks
+    ``[i*c, (i+1)*c)`` is CONTIGUOUS in the global relabeled space and
+    the column-axis all-gather of owned words lands the ``R_i`` frontier
+    words already in order;
+  * the column stripe ``C_j`` = blocks ``{i'*c + j}`` (strided), local
+    destination id ``i'*block + local`` — the row-axis reduce space;
+  * per-edge candidate values are ORIGINAL source ids
+    (``src_l1[shard][slot]``, the MXU arm's key flavor), because a
+    cross-cell min must be over a shard-independent total order — the
+    canonical min-parent tie-break every engine shares.
+
+Since the 1D per-shard adjacency (``srg.adj_indptr`` — a CSR over GLOBAL
+relabeled source ids) stores edges sorted by source, the edges of cell
+``(i, j)`` are r contiguous slices of the 1D CSRs of the shards in
+``C_j``: no edge is rebuilt, only re-grouped.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+#: Unreached / min-identity sentinel for original-id candidates —
+#: the same lattice top as ops/packed.PACKED_SENTINEL and
+#: graph/adj_tiles.KEY_SENTINEL.
+GRID_KEY_SENTINEL = np.uint32(0xFFFFFFFF)
+
+
+@dataclass(frozen=True)
+class GridLayout:
+    """Per-cell edge layout for an ``r x c`` grid over an n-shard
+    ShardedRelayGraph (``n == r*c``).  Arrays are stacked over cells
+    (leading dim ``n``, mesh-row-major: cell ``(i, j)`` at ``i*c + j``)
+    and padded to the max per-cell edge count for uniform SPMD shapes.
+    """
+
+    r: int
+    c: int
+    block: int
+    emax: int  # padded per-cell edge count (>= 1)
+    #: int32[n, emax] — edge source, LOCAL to the cell's R_i stripe
+    #: (``global_new - i*c*block``); 0 at padding (its key is the
+    #: sentinel and its dst is out of range, so it can never win).
+    esrc: np.ndarray
+    #: int32[n, emax] — edge destination, LOCAL to the cell's C_j stripe
+    #: (``pos(i')*block + local`` with pos(i') = i'); ``r*block`` at
+    #: padding (out of range -> scatter mode='drop').
+    edst: np.ndarray
+    #: uint32[n, emax] — ORIGINAL source id; GRID_KEY_SENTINEL at padding.
+    ekey: np.ndarray
+    #: int32[n, c*block + 2] — CSR over the local source space for the
+    #: push (frontier-gather) body; last entry repeated, so the
+    #: frontier-list fill index ``c*block`` reads degree 0.
+    indptr: np.ndarray
+
+    @property
+    def num_cells(self) -> int:
+        return self.r * self.c
+
+
+def parse_mesh_spec(spec: str) -> tuple[int, int]:
+    """``"rxc"`` -> ``(r, c)``.  The 1D degenerate is ``"1x8"``; a bare
+    integer ``"8"`` is accepted as ``1x8`` so BENCH_MESH keeps working."""
+    s = str(spec).strip().lower()
+    if "x" in s:
+        rs, _, cs = s.partition("x")
+        r, c = int(rs), int(cs)
+    else:
+        r, c = 1, int(s)
+    if r < 1 or c < 1:
+        raise ValueError(f"mesh spec {spec!r}: both axes must be >= 1")
+    return r, c
+
+
+def build_grid_layout(srg, r: int, c: int) -> GridLayout:
+    """Derive the per-cell edge layout from an ``r*c``-shard
+    ShardedRelayGraph (host-side, memoized on the layout object by the
+    caller).  Edges come out of the 1D CSRs as contiguous slices; within
+    a cell they are regrouped by local source (stable), which only
+    affects iteration order — every consumer is a min-scatter."""
+    from ..parallel.sharded import _sharded_adj_keys
+
+    n = r * c
+    if srg.num_shards != n:
+        raise ValueError(
+            f"grid {r}x{c} needs a {n}-shard ShardedRelayGraph, "
+            f"got {srg.num_shards} shards"
+        )
+    if srg.adj_dst is None:
+        raise ValueError(
+            "this ShardedRelayGraph ships no per-shard adjacency "
+            "(pre-exchange layout); rebuild with build_sharded_relay_graph"
+        )
+    block = srg.block
+    keys_all = _sharded_adj_keys(srg)  # int32[n, emax_1d]; orig src ids
+    cells_src, cells_dst, cells_key = [], [], []
+    for i in range(r):
+        lo, hi = i * c * block, (i + 1) * c * block
+        for j in range(c):
+            srcs, dsts, keys = [], [], []
+            for i2 in range(r):
+                b = i2 * c + j  # dst shard (block) at stripe position i2
+                ip = srg.adj_indptr[b].astype(np.int64)
+                e0, e1 = int(ip[lo]), int(ip[hi])
+                if e1 <= e0:
+                    continue
+                counts = np.diff(ip[lo:hi + 1])
+                srcs.append(
+                    np.repeat(
+                        np.arange(c * block, dtype=np.int64), counts
+                    ).astype(np.int32)
+                )
+                dsts.append(srg.adj_dst[b, e0:e1] + np.int32(i2 * block))
+                keys.append(keys_all[b, e0:e1].astype(np.uint32))
+            if srcs:
+                es = np.concatenate(srcs)
+                order = np.argsort(es, kind="stable")
+                cells_src.append(es[order])
+                cells_dst.append(np.concatenate(dsts)[order])
+                cells_key.append(np.concatenate(keys)[order])
+            else:
+                cells_src.append(np.zeros(0, np.int32))
+                cells_dst.append(np.zeros(0, np.int32))
+                cells_key.append(np.zeros(0, np.uint32))
+    emax = max(1, max(e.size for e in cells_src))
+    esrc = np.zeros((n, emax), np.int32)
+    edst = np.full((n, emax), r * block, np.int32)
+    ekey = np.full((n, emax), GRID_KEY_SENTINEL, np.uint32)
+    indptr = np.zeros((n, c * block + 2), np.int32)
+    for cell in range(n):
+        es, ed, ek = cells_src[cell], cells_dst[cell], cells_key[cell]
+        esrc[cell, : es.size] = es
+        edst[cell, : ed.size] = ed
+        ekey[cell, : ek.size] = ek
+        counts = np.bincount(es, minlength=c * block)
+        ip = np.zeros(c * block + 2, np.int64)
+        ip[1 : c * block + 1] = np.cumsum(counts)
+        ip[c * block + 1] = ip[c * block]  # repeated: fill index reads deg 0
+        indptr[cell] = ip.astype(np.int32)
+    return GridLayout(
+        r=r, c=c, block=block, emax=emax,
+        esrc=esrc, edst=edst, ekey=ekey, indptr=indptr,
+    )
+
+
+def grid_layout_for(srg, r: int, c: int) -> GridLayout:
+    """Memoized :func:`build_grid_layout` on the (frozen) layout object —
+    layout data, like the masks and adjacency flavors; must not land
+    inside a caller's timed repeats."""
+    key = f"_grid_layout_{r}x{c}"
+    cached = getattr(srg, key, None)
+    if cached is None:
+        cached = build_grid_layout(srg, r, c)
+        object.__setattr__(srg, key, cached)
+    return cached
+
+
+def grid_tile_placement(srg, r: int, c: int, builder: str | None = None):
+    """Tile-superblock placement over the grid (the MXU tile-space view
+    of the same partition): which of PR 15's per-shard 128x128 adjacency
+    tiles are RESIDENT on each cell.  A tile of shard ``b`` (column
+    stripe ``C_{b % c}``) lands on cell ``(i, b % c)`` where ``i`` is the
+    row stripe its source tile row falls into — the tile analogue of the
+    edge regrouping above, reusing :func:`~bfs_tpu.graph.adj_tiles.
+    build_adj_tiles_sharded` verbatim.
+
+    Returns ``{"cells": int32[r, c] resident-tile counts,
+    "total_tiles": int, "tile_rows_per_stripe": int}`` — layout evidence
+    for the bench detail and the placement test (each shard's tiles
+    partition exactly across its mesh column's r cells)."""
+    from .adj_tiles import TILE, build_adj_tiles_sharded
+
+    block = srg.block
+    per = build_adj_tiles_sharded(srg, builder=builder)
+    counts = np.zeros((r, c), np.int64)
+    stripe_rows = c * block  # sources per row stripe
+    for b, at in enumerate(per):
+        j = b % c
+        row_src = at.row_idx[: at.nt].astype(np.int64) * TILE
+        # A 128-row source tile can straddle a stripe boundary only when
+        # c*block is not a multiple of 128; blocks are 1024-multiples in
+        # every shipped config, but clamp for odd test blocks.
+        stripe = np.clip(row_src // stripe_rows, 0, r - 1)
+        counts[:, j] += np.bincount(stripe, minlength=r)
+    return {
+        "cells": counts.astype(np.int64),
+        "total_tiles": int(sum(at.nt for at in per)),
+        "tile_rows_per_stripe": int(stripe_rows // TILE),
+    }

@@ -77,6 +77,19 @@ def record_frontier_words(acc, fwords, level):
 
 
 # bfs_tpu: hot traced
+def record_count(acc, level, count):
+    """Pre-reduced occupancy twin of :func:`record_frontier_words` for
+    programs where NO single device holds the global frontier words (the
+    2D grid: each cell owns one block and the settled count arrives as an
+    already-replicated ``psum`` scalar — the same scalar the termination
+    flag derives from, so occupancy telemetry costs no extra
+    collective)."""
+    import jax.numpy as jnp
+
+    return acc.at[_slot(level)].add(jnp.asarray(count, jnp.int32))
+
+
+# bfs_tpu: hot traced
 def record_frontier_bools(acc, frontier, level):
     """Bool-frontier twin (push/pull BfsState; batched states sum over the
     sources axis too — the curve is the global occupancy).  A wide acc

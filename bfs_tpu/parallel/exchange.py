@@ -192,18 +192,27 @@ def _dedup_mask(own_local):
 
 # bfs_tpu: hot traced
 def exchange_delta(
-    send_words, own_local, own_all, nw: int, budget: int, axis_name: str
+    send_words, own_local, own_all, nw: int, budget: int, axis_name: str,
+    fits_axes=None,
 ):
     """Word-list arm with density fallback: ship ``(compact index, word)``
     pairs for nonzero words when every shard fits ``budget`` entries, else
     the bitmap arm — ONE replicated ``lax.cond``, only the taken branch's
-    collective executes."""
+    collective executes.
+
+    ``fits_axes`` widens the density vote past the gather axis (the 2D
+    grid votes over BOTH mesh axes so the whole machine takes one arm per
+    superstep per axis — per-group votes would let different mesh rows
+    diverge and break the replicated arm-schedule telemetry); ``None``
+    keeps the 1D behavior (vote == gather axis)."""
     n = own_all.shape[0]
     kw = own_all.shape[1]
     send = jnp.take(send_words, own_local, axis=-1)
     live = (send != 0) & _dedup_mask(own_local)
     count = live.sum(dtype=jnp.int32)
-    fits = jax.lax.pmax(count, axis_name) <= jnp.int32(budget)
+    fits = jax.lax.pmax(
+        count, axis_name if fits_axes is None else fits_axes
+    ) <= jnp.int32(budget)
 
     def delta(send):
         idx = jnp.sort(
@@ -287,3 +296,223 @@ def exchange_report(bytes_acc, arm_acc, cfg: ExchangeConfig, kw: int,
         "flat_supersteps": schedule.count("flat"),
     }
     return out
+
+
+# ---------------------------------------------------------------------------
+# 2D grid: per-axis arms (ISSUE 17)
+#
+# On the r x c mesh a superstep has TWO wire moves, armed independently:
+#
+#   column axis (frontier broadcast) — each cell all-gathers its owned
+#     frontier words along the mesh row's c cells, producing the row
+#     stripe R_i's frontier [c*nw words].  Semantically the 1D exchange
+#     at group size c, so the three 1D arms are reused verbatim (with
+#     the delta density vote widened to both axes); the own-word tables
+#     passed in are the mesh row's c rows of the replicated [n, kw]
+#     table, so the sieve carries over unchanged.
+#   row axis (candidate min-reduce) — each mesh column min-reduces
+#     per-destination ORIGINAL-source-id candidates (u32, 0xFFFFFFFF =
+#     "no candidate") over its r cells, settling the column stripe C_j.
+#     Candidates are 32-bit per VERTEX (not packed bits), so the dense
+#     reduce is 32x a frontier word and arming matters even more:
+#       flat    — lax.pmin over the whole r*block candidate vector
+#       bitmap  — compact pmin: candidates regrouped through the mesh
+#                 column's own-word tables first, so structurally-padded
+#                 words never ship (the row-axis analogue of the sieved
+#                 bitmap; same payload shape on every cell of the column,
+#                 which is what makes the elementwise pmin correct)
+#       delta   — budgeted (index, value) list of live candidates,
+#                 all-gather + scatter-min, with the compact-pmin
+#                 fallback under ONE both-axes replicated density vote.
+#                 Unlike the 1D delta, FORCED delta keeps the fallback:
+#                 a static budget covering the dense worst case would be
+#                 r*block entries (the flat arm), so the forced budget is
+#                 r*kw entries and dense supersteps spill to compact pmin
+#                 (docs/ARCHITECTURE.md §25 records the deviation).
+#
+# Byte accounting keeps the 1D convention (each participant's payload
+# counted once: 4 * group_size * payload_words per group) and scales by
+# the number of groups (r mesh rows for the column axis, c mesh columns
+# for the row axis) so the accumulators record MACHINE totals — divide by
+# r*c for per-chip wire.  A size-1 axis is the identity: zero bytes, arm
+# code 0 ("none" in the schedule), which is exactly how 1x8 degenerates
+# to the 1D semantics.
+# ---------------------------------------------------------------------------
+
+
+def grid_row_budget(cfg: ExchangeConfig, r: int, kw: int) -> int:
+    """Static entry budget for the row-axis candidate list: ``r*kw``
+    entries forced-delta (one live candidate per real owned word of the
+    column stripe — past that density the compact arm is the cheaper
+    ship anyway), ``ceil(r*kw / div)`` for auto."""
+    if cfg.mode == "delta":
+        return int(r * kw)
+    return max(1, math.ceil(int(r) * int(kw) / int(cfg.budget_div)))
+
+
+def make_grid_col_exchange(cfg: ExchangeConfig, kw: int, nw: int,
+                           r: int, c: int,
+                           col_axis: str = "col", row_axis: str = "row"):
+    """Column-axis frontier broadcast closure: ``(send_words u32[nw],
+    own_local i32[kw], own_row i32[c, kw]) -> (stripe_words u32[c*nw],
+    machine_bytes i32, arm_code i32)``.  ``own_row`` is the mesh row's
+    slice of the replicated own-word table (rows ``[i*c, i*c+c)``)."""
+    if c == 1:
+        return lambda w, ol, orow: (w, jnp.int32(0), jnp.int32(0))
+    scale = jnp.int32(r)
+    if cfg.mode == "flat":
+        def col_flat(w, ol, orow):
+            fw, nb, arm = exchange_flat(w, c, col_axis)
+            return fw, nb * scale, arm
+        return col_flat
+    if cfg.mode == "bitmap":
+        def col_bitmap(w, ol, orow):
+            fw, nb, arm = exchange_bitmap(w, ol, orow, nw, col_axis)
+            return fw, nb * scale, arm
+        return col_bitmap
+    budget = cfg.delta_budget(kw)
+
+    def col_delta(w, ol, orow):
+        fw, nb, arm = exchange_delta(
+            w, ol, orow, nw, budget, col_axis,
+            fits_axes=(row_axis, col_axis),
+        )
+        return fw, nb * scale, arm
+    return col_delta
+
+
+def make_grid_row_reduce(cfg: ExchangeConfig, kw: int, nw: int,
+                         r: int, c: int,
+                         row_axis: str = "row", col_axis: str = "col"):
+    """Row-axis candidate min-reduce closure: ``(cand u32[r*block],
+    own_cj i32[r, kw]) -> (candg u32[r*block], machine_bytes i32,
+    arm_code i32)``.  ``cand`` holds min-ORIGINAL-source-id candidates
+    for the column stripe C_j (stripe position i2 covers block
+    ``i2*c + j`` at ``[i2*block, (i2+1)*block)``), already sieved by the
+    caller's reached-carry; ``own_cj`` is the column stripe's own-word
+    tables (``own_table[i2*c + j]`` stacked over i2 — identical on every
+    cell of the mesh column).  ``candg`` is the replicated min."""
+    block = nw * 32
+    rb = r * block
+    sent = jnp.uint32(0xFFFFFFFF)
+    if r == 1:
+        return lambda cand, own_cj: (cand, jnp.int32(0), jnp.int32(0))
+    groups = jnp.int32(c)
+
+    def row_flat(cand, own_cj):
+        candg = jax.lax.pmin(cand, row_axis)
+        return candg, jnp.int32(4 * r * rb) * groups, jnp.int32(EX_FLAT)
+
+    def _compact_pmin(cand, own_cj):
+        comp = jnp.take_along_axis(
+            cand.reshape(r, nw, 32), own_cj[:, :, None], axis=1
+        )  # [r, kw, 32] — the column stripe's REAL words only
+        comp = jax.lax.pmin(comp, row_axis)
+        out3 = jnp.full((r, nw, 32), sent, jnp.uint32)
+        out3 = out3.at[jnp.arange(r)[:, None], own_cj, :].set(comp)
+        return out3.reshape(rb)
+
+    def row_bitmap(cand, own_cj):
+        candg = _compact_pmin(cand, own_cj)
+        return candg, jnp.int32(4 * r * (r * kw * 32)) * groups, \
+            jnp.int32(EX_BITMAP)
+
+    if cfg.mode == "flat":
+        return row_flat
+    if cfg.mode == "bitmap":
+        return row_bitmap
+    budget = grid_row_budget(cfg, r, kw)
+
+    def row_delta(cand, own_cj):
+        live = cand != sent
+        count = live.sum(dtype=jnp.int32)
+        fits = jax.lax.pmax(
+            count, (row_axis, col_axis)
+        ) <= jnp.int32(budget)
+
+        def lst(cand):
+            idx = jnp.sort(
+                jnp.where(live, jnp.arange(rb, dtype=jnp.int32),
+                          jnp.int32(rb))
+            )[:budget]
+            vals = jnp.where(idx < rb, cand[jnp.clip(idx, 0, rb - 1)], sent)
+            payload = jnp.concatenate([idx.astype(jnp.uint32), vals])
+            gath = jax.lax.all_gather(payload, row_axis)  # [r, 2B]
+            gi = gath[:, :budget].astype(jnp.int32)
+            gv = gath[:, budget:]
+            flat = jnp.where(gi < rb, gi, jnp.int32(rb)).reshape(-1)
+            candg = jnp.full((rb,), sent, jnp.uint32).at[flat].min(
+                gv.reshape(-1), mode="drop"
+            )
+            return candg, jnp.int32(4 * r * 2 * budget) * groups, \
+                jnp.int32(EX_DELTA)
+
+        def fall(cand):
+            return row_bitmap(cand, own_cj)
+
+        return jax.lax.cond(fits, lst, fall, cand)
+    return row_delta
+
+
+def grid_exchange_report(col_bytes, col_arms, row_bytes, row_arms,
+                         cfg: ExchangeConfig, kw: int, nw: int,
+                         r: int, c: int,
+                         num_levels: int | None = None) -> dict:
+    """JSON-ready ``details.exchange`` for a grid run: the per-level
+    byte/arm curves for EACH axis plus the combined totals, against the
+    same 1D-flat baseline the 1D report uses (``n * nw * 4`` bytes per
+    executed superstep at ``n = r*c`` — what the 1D uncompressed
+    exchange ships for the SAME search on the same shard layout).  The
+    per-axis column names (``col_bytes``/``row_bytes``) are the contract
+    ``tools/ledger_compare.py --exact`` diffs."""
+    import numpy as np
+
+    n = r * c
+    bvc = np.asarray(col_bytes, dtype=np.int64)
+    avc = np.asarray(col_arms, dtype=np.int64)
+    bvr = np.asarray(row_bytes, dtype=np.int64)
+    avr = np.asarray(row_arms, dtype=np.int64)
+    nz = np.flatnonzero(avc | avr)
+    levels = int(nz[-1]) + 1 if nz.size else 0
+    executed = (
+        int(num_levels) if num_levels is not None
+        else (levels - 1 if levels else 0)
+    )
+    if num_levels is not None and executed + 1 < len(avc):
+        # Size-1-axis runs leave one accumulator all-zero; trust the
+        # loop-exit count for the per-level window in that case too.
+        levels = max(levels, min(executed + 1, len(avc)))
+    col_sched = [EX_NAMES.get(int(x), "none") for x in avc[1:levels]]
+    row_sched = [EX_NAMES.get(int(x), "none") for x in avr[1:levels]]
+    col_total = int(bvc.sum())
+    row_total = int(bvr.sum())
+    total = col_total + row_total
+    flat_total = int(executed * n * nw * 4)
+    per_level = [int(a + b) for a, b in zip(bvc[1:levels], bvr[1:levels])]
+    return {
+        "arm": cfg.mode,
+        "mesh": f"{r}x{c}",
+        "col_budget_words": int(cfg.delta_budget(kw)),
+        "row_budget_entries": int(grid_row_budget(cfg, r, kw)),
+        "col_bytes": [int(x) for x in bvc[1:levels]],
+        "row_bytes": [int(x) for x in bvr[1:levels]],
+        "bytes_per_level": per_level,
+        "col_schedule": col_sched,
+        "row_schedule": row_sched,
+        # index i = the superstep that settled level i+1 (1D convention)
+        "schedule": [
+            f"{a}+{b}" for a, b in zip(col_sched, row_sched)
+        ],
+        "col_total_bytes": col_total,
+        "row_total_bytes": row_total,
+        "total_bytes": total,
+        "flat_total_bytes": flat_total,
+        "reduction_vs_flat": (flat_total / total) if total else None,
+        "per_chip_bytes": (total / n) if n else 0.0,
+        "supersteps": executed,
+        "truncated": bool((avc[-1] | avr[-1]) != 0) and executed > levels - 1,
+        "axes": {
+            "col": {"size": c, "groups": r, "total_bytes": col_total},
+            "row": {"size": r, "groups": c, "total_bytes": row_total},
+        },
+    }

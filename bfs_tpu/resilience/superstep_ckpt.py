@@ -592,7 +592,7 @@ def run_multi_segmented(
 # CLI runner: the chaos-traversal subject process.
 #
 #   python -m bfs_tpu.resilience.superstep_ckpt \
-#       --config relay|multi|sharded --ckpt-dir D --out result.json
+#       --config relay|multi|sharded|grid --ckpt-dir D --out result.json
 #
 # Runs one traversal segmented-with-checkpoints and writes a result
 # document with dist/parent content hashes, the direction schedule, the
@@ -618,7 +618,7 @@ def _runner_main(argv=None) -> int:
 
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--config", required=True,
-                    choices=("relay", "multi", "sharded"))
+                    choices=("relay", "multi", "sharded", "grid"))
     ap.add_argument("--ckpt-dir", required=True)
     ap.add_argument("--out", required=True)
     ap.add_argument("--scale", type=int, default=8)
@@ -632,6 +632,8 @@ def _runner_main(argv=None) -> int:
                     help="forced supersteps per segment (every:<k>)")
     ap.add_argument("--shards", type=int, default=8,
                     help="sharded config: mesh size over the graph axis")
+    ap.add_argument("--mesh", default="2x4",
+                    help="grid config: 'rxc' mesh spec over (row, col)")
     args = ap.parse_args(argv)
 
     # Virtual multi-device CPU platform for the sharded config, set
@@ -678,6 +680,35 @@ def _runner_main(argv=None) -> int:
         doc.update(
             dist_hash=_hash(result.dist), parent_hash=_hash(result.parent),
             num_levels=result.num_levels,
+        )
+    elif args.config == "grid":
+        from ..graph.grid_layout import parse_mesh_spec
+        from ..parallel.grid import bfs_grid_segmented, make_grid_mesh
+
+        r, c = parse_mesh_spec(args.mesh)
+        mesh = make_grid_mesh(r, c)
+        base_config["mesh"] = f"{r}x{c}"
+        ckpt = SuperstepCheckpointer(
+            args.ckpt_dir, base_config, cfg=cfg, shards=r * c
+        )
+        result, curve = bfs_grid_segmented(
+            graph, args.source, mesh=mesh, ckpt=ckpt,
+            direction="auto", exchange="auto", telemetry=True,
+        )
+        # Both per-axis arm sequences and byte curves in the result doc:
+        # the chaos driver diffs resumed-vs-golden on exactly these, so a
+        # resume that re-voted an axis arm or re-shipped a settled
+        # destination is a hard diff, not a silent pass.
+        doc.update(
+            dist_hash=_hash(result.dist), parent_hash=_hash(result.parent),
+            num_levels=result.num_levels,
+            direction_schedule=curve["direction_schedule"],
+            exchange_schedule=curve["exchange"]["schedule"],
+            exchange_bytes=curve["exchange"]["bytes_per_level"],
+            col_schedule=curve["exchange"]["col_schedule"],
+            col_bytes=curve["exchange"]["col_bytes"],
+            row_schedule=curve["exchange"]["row_schedule"],
+            row_bytes=curve["exchange"]["row_bytes"],
         )
     else:  # sharded
         from ..parallel.sharded import bfs_sharded_segmented, make_mesh

@@ -1,0 +1,295 @@
+"""2D tile-grid sharding (ISSUE 17): the r x c (row, col) mesh over the
+MXU tile space vs the 1D mesh and the single-chip relay.
+
+The contract under test is BIT-IDENTITY, not mere correctness: on the
+same 8-shard ShardedRelayGraph the grid engine must reproduce the 1D
+run's dist/parent, direction schedule AND column-axis wire story exactly
+(the col exchange ships the same new-frontier words the 1D all-gather
+ships, so per-level col bytes and the col arm schedule coincide with the
+1D curve at any r*c = 8), while the 1x8 degenerate must collapse the row
+axis to an identity reduce — zero bytes, arm "none".  The ``grid_smoke``
+marker is the parity core tools/ci_gate.sh runs as its own stage.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+
+from bfs_tpu.graph.generators import (
+    gnm_graph,
+    path_graph,
+    rmat_graph,
+    star_graph,
+)
+from bfs_tpu.graph.grid_layout import (
+    grid_tile_placement,
+    parse_mesh_spec,
+)
+from bfs_tpu.graph.relay import build_sharded_relay_graph
+from bfs_tpu.oracle.bfs import canonical_bfs, check, queue_bfs
+from bfs_tpu.parallel.grid import (
+    bfs_grid,
+    bfs_grid_segmented,
+    make_grid_mesh,
+    resolve_grid_mesh,
+)
+from bfs_tpu.parallel.sharded import bfs_sharded, make_mesh
+from bfs_tpu.resilience import faults
+from bfs_tpu.resilience.faults import FaultInjected
+from bfs_tpu.resilience.superstep_ckpt import (
+    CkptConfig,
+    SuperstepCheckpointer,
+)
+
+pytestmark = pytest.mark.skipif(
+    not __import__(
+        "bfs_tpu.graph.benes", fromlist=["native_available"]
+    ).native_available(),
+    reason="native benes router unavailable",
+)
+
+SOURCE = 3
+
+
+def assert_oracle(g, res, s):
+    d, _ = queue_bfs(g, s)
+    _, p = canonical_bfs(g, s)
+    np.testing.assert_array_equal(res.dist, d)
+    np.testing.assert_array_equal(res.parent, p)
+    assert check(g, res.dist, res.parent, s) == []
+
+
+@pytest.fixture(scope="module")
+def gnm():
+    return gnm_graph(250, 1273, seed=1)
+
+
+@pytest.fixture(scope="module")
+def srg8(gnm):
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual platform")
+    return build_sharded_relay_graph(gnm, 8)
+
+
+@pytest.fixture(scope="module")
+def ref_1d(srg8):
+    """The 1D x8 golden: same shard layout, auto direction + exchange."""
+    return bfs_sharded(
+        srg8, SOURCE, mesh=make_mesh(graph=8), engine="relay",
+        telemetry=True, direction="auto", exchange="auto",
+    )
+
+
+# ------------------------------------------------------------ mesh spec --
+@pytest.mark.grid_smoke
+def test_parse_mesh_spec():
+    assert parse_mesh_spec("2x4") == (2, 4)
+    assert parse_mesh_spec("1x8") == (1, 8)
+    assert parse_mesh_spec("8") == (1, 8)  # BENCH_MESH back-compat
+    with pytest.raises(ValueError):
+        parse_mesh_spec("0x4")
+    with pytest.raises(ValueError):
+        parse_mesh_spec("2x")
+    with pytest.raises(ValueError):
+        parse_mesh_spec("grid")
+
+
+def test_resolve_grid_mesh_env(monkeypatch):
+    monkeypatch.setenv("BFS_TPU_MESH", "2x4")
+    assert resolve_grid_mesh() == (2, 4)
+    monkeypatch.delenv("BFS_TPU_MESH")
+    assert resolve_grid_mesh() == (1, len(jax.devices()))
+    assert resolve_grid_mesh("4x2") == (4, 2)
+
+
+def test_make_grid_mesh_too_many_devices():
+    with pytest.raises(ValueError, match="devices"):
+        make_grid_mesh(4, 4)
+
+
+# ----------------------------------------------------- single-chip parity --
+@pytest.mark.grid_smoke
+@pytest.mark.parametrize("shape", [(2, 4), (1, 8), (4, 2), (8, 1)])
+def test_grid_matches_oracle_all_shapes(gnm, srg8, shape):
+    r, c = shape
+    res = bfs_grid(srg8, SOURCE, mesh=make_grid_mesh(r, c))
+    assert_oracle(gnm, res, SOURCE)
+
+
+# ------------------------------------------------- 1D/grid bit-identity --
+@pytest.mark.grid_smoke
+@pytest.mark.parametrize("shape", [(2, 4), (1, 8)])
+def test_grid_bit_identical_to_1d(srg8, ref_1d, shape):
+    """dist/parent, direction schedule, and the COLUMN axis's per-level
+    bytes + arm schedule must all equal the 1D x8 run's — the col
+    exchange ships exactly the words the 1D all-gather ships."""
+    r, c = shape
+    ref, refc = ref_1d
+    res, curve = bfs_grid(
+        srg8, SOURCE, mesh=make_grid_mesh(r, c), telemetry=True,
+        direction="auto", exchange="auto",
+    )
+    np.testing.assert_array_equal(res.dist, ref.dist)
+    np.testing.assert_array_equal(res.parent, ref.parent)
+    assert res.num_levels == ref.num_levels
+    assert (
+        curve["direction_schedule"]["schedule"]
+        == refc["direction_schedule"]["schedule"]
+    )
+    ex = curve["exchange"]
+    assert ex["col_schedule"] == refc["exchange"]["schedule"]
+    assert ex["col_bytes"] == refc["exchange"]["bytes_per_level"]
+    if r == 1:
+        # Degenerate row axis: identity reduce, nothing on the wire —
+        # the grid at 1x8 IS the 1D engine, bytes included.
+        assert all(b == 0 for b in ex["row_bytes"])
+        assert all(a == "none" for a in ex["row_schedule"])
+        assert ex["total_bytes"] == refc["exchange"]["total_bytes"]
+    else:
+        # Real row axis: candidates move, and every level's combined
+        # per-chip wire stays under the 1D flat all-gather's share.
+        assert any(b > 0 for b in ex["row_bytes"])
+        assert ex["axes"]["row"]["size"] == r
+
+
+# ------------------------------------------------------- graph shapes ----
+@pytest.mark.grid_smoke
+@pytest.mark.parametrize("make", [
+    lambda: star_graph(300),
+    lambda: path_graph(61),
+    lambda: rmat_graph(7, 4, seed=5),
+], ids=["star", "path", "rmat"])
+def test_grid_graph_shapes(make):
+    g = make()
+    res = bfs_grid(g, 0, mesh=make_grid_mesh(2, 4))
+    assert_oracle(g, res, 0)
+
+
+@pytest.mark.parametrize("arm", ["flat", "bitmap", "delta", "auto"])
+def test_grid_exchange_arms(gnm, srg8, arm):
+    res = bfs_grid(
+        srg8, SOURCE, mesh=make_grid_mesh(2, 4), exchange=arm
+    )
+    assert_oracle(gnm, res, SOURCE)
+
+
+def test_grid_nonzero_source_disconnected():
+    g = gnm_graph(200, 220, seed=3)
+    res = bfs_grid(g, 137, mesh=make_grid_mesh(2, 4))
+    assert_oracle(g, res, 137)
+    assert (res.dist == np.iinfo(np.int32).max).any()
+
+
+@pytest.mark.grid_smoke
+def test_grid_packed_fallback_deep_graph():
+    """80 levels overflows the 62-level packed word; the truncation
+    re-run must deliver the full unpacked traversal."""
+    g = path_graph(80)
+    res = bfs_grid(g, 0, mesh=make_grid_mesh(2, 4))
+    d, p = queue_bfs(g, 0)
+    np.testing.assert_array_equal(res.dist, d)
+    np.testing.assert_array_equal(res.parent, p)
+    assert res.num_levels == 80
+
+
+# ------------------------------------------------------ tile placement ---
+def test_grid_tile_placement_partitions(srg8):
+    """Each shard's adjacency tiles partition exactly across its mesh
+    column's r cells; 1x8 degenerates to the per-shard tile counts."""
+    p24 = grid_tile_placement(srg8, 2, 4)
+    assert p24["cells"].shape == (2, 4)
+    assert int(p24["cells"].sum()) == p24["total_tiles"]
+    p18 = grid_tile_placement(srg8, 1, 8)
+    assert p18["cells"].shape == (1, 8)
+    assert int(p18["cells"].sum()) == p24["total_tiles"]
+    # Column j of the 2x4 placement holds exactly the tiles of the
+    # shards b with b % 4 == j (the column-stripe ownership rule).
+    col24 = p24["cells"].sum(axis=0)
+    col18 = p18["cells"].reshape(8)
+    for j in range(4):
+        assert col24[j] == col18[j] + col18[j + 4]
+
+
+# --------------------------------------------------- segmented / resume --
+@pytest.fixture(scope="module")
+def seg_setup():
+    if len(jax.devices()) < 8:
+        pytest.skip("needs the 8-device virtual platform")
+    g = rmat_graph(7, 4, seed=3)
+    mesh = make_grid_mesh(2, 4)
+    ref, refc = bfs_grid(
+        g, SOURCE, mesh=mesh, telemetry=True,
+        direction="auto", exchange="auto",
+    )
+    return g, mesh, ref, refc
+
+
+def _run_grid_seg(setup, tmp_path, k=2):
+    g, mesh, _ref, _refc = setup
+    mgr = SuperstepCheckpointer(
+        tmp_path, {"t": 1}, cfg=CkptConfig("every", k), shards=8
+    )
+    res, curve = bfs_grid_segmented(
+        g, SOURCE, mesh=mesh, ckpt=mgr, telemetry=True,
+        direction="auto", exchange="auto",
+    )
+    return mgr, res, curve
+
+
+def _assert_grid_identical(res, curve, setup):
+    _g, _mesh, ref, refc = setup
+    np.testing.assert_array_equal(res.dist, ref.dist)
+    np.testing.assert_array_equal(res.parent, ref.parent)
+    assert (
+        curve["direction_schedule"]["schedule"]
+        == refc["direction_schedule"]["schedule"]
+    )
+    # BOTH per-axis wire records are part of the bit-identity contract.
+    for k in ("col_schedule", "col_bytes", "row_schedule", "row_bytes"):
+        assert curve["exchange"][k] == refc["exchange"][k], k
+
+
+@pytest.mark.grid_smoke
+def test_grid_segmented_parity(seg_setup, tmp_path):
+    mgr, res, curve = _run_grid_seg(seg_setup, tmp_path, k=2)
+    _assert_grid_identical(res, curve, seg_setup)
+    assert mgr.report()["shards"] == 8
+
+
+@pytest.mark.chaos
+def test_grid_kill_resume(seg_setup, tmp_path):
+    """Die at superstep boundary 3 with per-cell epochs on disk; the
+    resumed run must restore a checkpoint (not restart) and land
+    bit-identical, per-axis wire records included."""
+    os.environ["BFS_TPU_FAULT"] = "raise:superstep:3"
+    faults.reset()
+    try:
+        with pytest.raises(FaultInjected):
+            _run_grid_seg(seg_setup, tmp_path, k=1)
+    finally:
+        os.environ.pop("BFS_TPU_FAULT", None)
+        faults.reset()
+    g, mesh, _ref, _refc = seg_setup
+    mgr = SuperstepCheckpointer(
+        tmp_path, {"t": 1}, cfg=CkptConfig("every", 1), shards=8
+    )
+    assert len(mgr.epochs()) >= 1
+    res, curve = bfs_grid_segmented(
+        g, SOURCE, mesh=mesh, ckpt=mgr, telemetry=True,
+        direction="auto", exchange="auto",
+    )
+    assert mgr.report()["resumed_from_epoch"] is not None
+    _assert_grid_identical(res, curve, seg_setup)
+
+
+def test_grid_segmented_rejects_wrong_shard_count(seg_setup, tmp_path):
+    g, mesh, _ref, _refc = seg_setup
+    with pytest.raises(ValueError, match="shards"):
+        bfs_grid_segmented(
+            g, SOURCE, mesh=mesh,
+            ckpt=SuperstepCheckpointer(
+                tmp_path, {"t": 1}, cfg=CkptConfig("every", 1), shards=2
+            ),
+        )

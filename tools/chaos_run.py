@@ -44,8 +44,10 @@ bit-identical to an un-killed golden run on dist/parent content hashes,
 the direction schedule AND the exchange-arm sequence, and must provably
 have resumed from a checkpoint epoch rather than silently restarting.
 Covers the single-chip relay (packed + sparse hybrid, auto direction),
-batched multi-source, and the x8 sharded relay (whose per-shard epoch
-files also exercise the shard-loss fallback in tests).
+batched multi-source, the x8 sharded relay (whose per-shard epoch
+files also exercise the shard-loss fallback in tests), and the 2D
+r x c grid engine (``--mesh 2x4`` — per-cell epochs, and the resumed
+run must also replay BOTH per-axis byte curves and arm schedules).
 
 Usage (CPU, tiny config — the tier-1-adjacent shape):
     python tools/chaos_run.py --iterations 5 --seed 1
@@ -173,13 +175,22 @@ def diff_exchange(final: dict, golden: dict) -> list[str]:
     """MULTICHIP determinism: the exchange arm schedule and the per-level
     bytes-on-the-wire are pure functions of (graph, arm config), so a
     resumed run must reproduce the golden run's exactly — a drift means
-    the resume re-ran a DIFFERENT exchange than it journaled."""
+    the resume re-ran a DIFFERENT exchange than it journaled.  Grid
+    captures (ISSUE 17) additionally carry one byte curve + arm schedule
+    PER MESH AXIS; those keys are diffed only when the golden has them,
+    so 1D captures keep their original contract."""
     eg = golden["details"].get("exchange")
     ef = final["details"].get("exchange")
     if not isinstance(eg, dict):
         return []
+    keys = ["arm", "schedule", "bytes_per_level", "total_bytes"]
+    keys += [
+        k for k in ("col_bytes", "row_bytes", "col_schedule",
+                    "row_schedule", "per_chip_bytes")
+        if k in eg
+    ]
     bad = []
-    for k in ("arm", "schedule", "bytes_per_level", "total_bytes"):
+    for k in keys:
         if not isinstance(ef, dict) or ef.get(k) != eg.get(k):
             bad.append(
                 f"details.exchange.{k}: resumed "
@@ -571,16 +582,21 @@ def chaos_serve(args, rng: random.Random) -> int:
 #: Traversal-chaos subject configs (ISSUE 14): the superstep_ckpt CLI
 #: runner's --config values — relay = single-chip packed + sparse-hybrid
 #: auto-direction, multi = batched multi-source push, sharded = the x8
-#: sharded relay with auto direction + auto exchange.
-TRAVERSAL_CONFIGS = ("relay", "multi", "sharded")
+#: sharded relay with auto direction + auto exchange, grid = the 2D
+#: r x c grid engine (ISSUE 17) with per-CELL checkpoint epochs and
+#: per-axis exchange determinism.
+TRAVERSAL_CONFIGS = ("relay", "multi", "sharded", "grid")
 
 #: Result-document fields that must be BIT-IDENTICAL between a resumed
 #: run and the un-killed golden run (dist/parent content hashes, the
 #: direction schedule, the exchange-arm sequence and its per-level
-#: bytes).  Fields a config does not produce are absent on both sides.
+#: bytes; grid runs additionally pin both per-axis byte curves and arm
+#: schedules).  Fields a config does not produce are absent on both
+#: sides.
 TRAVERSAL_DETERMINISTIC = (
     "dist_hash", "parent_hash", "num_levels", "direction_schedule",
     "exchange_schedule", "exchange_bytes",
+    "col_schedule", "col_bytes", "row_schedule", "row_bytes",
 )
 
 
@@ -591,21 +607,27 @@ def run_traversal(args, cfg: str, ckpt_dir: str, out: str,
     env.pop("BFS_TPU_FAULT", None)
     if fault is not None:
         env["BFS_TPU_FAULT"] = fault
-    if cfg == "sharded":
+    if cfg in ("sharded", "grid"):
         flags = env.get("XLA_FLAGS", "")
         if "xla_force_host_platform_device_count" not in flags:
             env["XLA_FLAGS"] = (
                 flags + " --xla_force_host_platform_device_count=8"
             ).strip()
+    cmd = [
+        sys.executable, "-m", "bfs_tpu.resilience.superstep_ckpt",
+        "--config", cfg, "--ckpt-dir", ckpt_dir, "--out", out,
+        "--scale", str(args.scale), "--edge-factor",
+        str(args.edge_factor),
+        "--seed", str(args.seed if args.seed is not None else 3),
+        "--interval", str(args.ckpt_interval),
+    ]
+    if cfg == "grid":
+        # --mesh carries an 'rxc' spec through to the grid runner; the
+        # bench-mode integer spelling means "not a grid shape" here.
+        mesh = str(args.mesh)
+        cmd += ["--mesh", mesh if "x" in mesh else "2x4"]
     proc = subprocess.run(
-        [
-            sys.executable, "-m", "bfs_tpu.resilience.superstep_ckpt",
-            "--config", cfg, "--ckpt-dir", ckpt_dir, "--out", out,
-            "--scale", str(args.scale), "--edge-factor",
-            str(args.edge_factor),
-            "--seed", str(args.seed if args.seed is not None else 3),
-            "--interval", str(args.ckpt_interval),
-        ],
+        cmd,
         capture_output=True, text=True, env=env, cwd=REPO_ROOT,
         timeout=args.timeout,
     )
@@ -720,10 +742,13 @@ def main(argv=None) -> int:
     ap.add_argument("--roots", type=int, default=3)
     ap.add_argument("--repeats", type=int, default=2)
     ap.add_argument("--engine", default="push")
-    ap.add_argument("--mesh", type=int, default=0,
-                    help="bench mode: run the MULTICHIP (BENCH_MESH=n) "
-                    "sharded-relay bench on an n-shard virtual mesh and "
-                    "chaos its journal phases (forces engine=relay)")
+    ap.add_argument("--mesh", default="",
+                    help="bench mode: run the MULTICHIP bench and chaos "
+                    "its journal phases (forces engine=relay). An integer "
+                    "n runs the 1D sharded relay (BENCH_MESH=n); an 'rxc' "
+                    "spec (e.g. 2x4) runs the 2D grid engine with per-"
+                    "axis exchange determinism checks. Traversal mode "
+                    "passes an rxc value through to the grid config")
     ap.add_argument("--cache-dir",
                     default=os.path.join(tempfile.gettempdir(), "chaos_cache"),
                     help="shared artifact cache across all runs (graph npz "

@@ -47,6 +47,16 @@ echo "== ci gate: MXU-arm parity smoke (ISSUE 15) =="
 JAX_PLATFORMS=cpu python -m pytest tests/test_expansion_mxu.py -q \
     -m 'mxu_smoke' -p no:cacheprovider
 
+echo "== ci gate: 2D grid parity smoke (ISSUE 17) =="
+# The tile-grid engine's bit-identity core: 2x4/1x8 vs the 1D x8 mesh
+# and the single-chip oracle (dist/parent, direction schedule, col-axis
+# bytes + arm schedule ≡ the 1D curve), the >62-level packed fallback,
+# and fused-vs-segmented parity — a grid/1D divergence must fail the
+# gate on its own stage (~seconds; the full matrix incl. chaos
+# kill/resume runs in tier-1's tests/test_grid.py).
+JAX_PLATFORMS=cpu python -m pytest tests/test_grid.py -q \
+    -m 'grid_smoke' -p no:cacheprovider
+
 echo "== ci gate: algorithm-parity smoke (ISSUE 16) =="
 # The semiring substrate's oracle core: SSSP vs Dijkstra (dist + the
 # canonical parents), CC vs union-find, packed truncation fallback,

@@ -29,6 +29,9 @@ import sys
 PHASE_ORDER = ["vperm", "broadcast", "net_apply", "rowmin", "state_update",
                "expansion", "full_superstep", "full_superstep_telemetry"]
 
+#: Per-axis exchange columns of a 2D-grid capture (details.exchange).
+AXIS_KEYS = ("col_bytes", "row_bytes", "col_schedule", "row_schedule")
+
 
 def load_doc(path: str) -> dict:
     """Headline line(s) or raw ledger file -> the containing doc.  Bench
@@ -57,7 +60,8 @@ def load_doc(path: str) -> dict:
 
 def extract(doc: dict, path: str):
     """(phases {name: seconds}, full ledger dict, direction_schedule|None,
-    bytes {name: exchange bytes}, per_shard rows, exchange arm schedule).
+    bytes {name: exchange bytes}, per_shard rows, exchange arm schedule,
+    expansion-arm record, per-axis exchange columns).
 
     Understands BOTH capture shapes: single-chip headlines
     (``details.superstep_phases``) and sharded MULTICHIP headlines
@@ -107,7 +111,19 @@ def extract(doc: dict, path: str):
                 "arm": exp.get("arm"),
                 "per_level": exp.get("per_level"),
             }
-    return phases, ledger, sched, xbytes, per_shard, xsched, esched
+    # Per-AXIS wire columns (ISSUE 17): grid captures split the
+    # per-level exchange curve into a column-axis and a row-axis share
+    # plus one arm schedule each.  Old 1D captures simply lack the keys
+    # — the dict stays empty and every per-axis comparison is skipped,
+    # so a grid capture still diffs against its pre-grid golden.
+    axes = {}
+    if isinstance(details, dict) and isinstance(details.get("exchange"),
+                                                dict):
+        ex = details["exchange"]
+        axes = {
+            k: ex[k] for k in AXIS_KEYS if ex.get(k) is not None
+        }
+    return phases, ledger, sched, xbytes, per_shard, xsched, esched, axes
 
 
 def fmt_s(s: float) -> str:
@@ -131,8 +147,12 @@ def main() -> int:
     )
     args = ap.parse_args()
 
-    pb, lb, sb, xb, shb, xsb, esb = extract(load_doc(args.before), args.before)
-    pa, la, sa, xa, sha, xsa, esa = extract(load_doc(args.after), args.after)
+    pb, lb, sb, xb, shb, xsb, esb, axb = extract(
+        load_doc(args.before), args.before
+    )
+    pa, la, sa, xa, sha, xsa, esa, axa = extract(
+        load_doc(args.after), args.after
+    )
 
     names = [p for p in PHASE_ORDER if p in pb or p in pa]
     names += [p for p in sorted(set(pb) | set(pa)) if p not in names]
@@ -182,6 +202,18 @@ def main() -> int:
                     and (ba - bb) / bb > args.threshold
                 ):
                     regressed.append((f"{name}:bytes", (ba - bb) / bb))
+            if args.exact:
+                # Grid phase rows split bytes per axis; compare each
+                # column only when BOTH captures carry it.
+                rb = lb.get("phases", {}).get(name)
+                ra = la.get("phases", {}).get(name)
+                for axk in ("col_bytes", "row_bytes"):
+                    if (
+                        isinstance(rb, dict) and isinstance(ra, dict)
+                        and axk in rb and axk in ra
+                        and rb[axk] != ra[axk]
+                    ):
+                        mismatched.append(f"{name}:{axk}")
         else:
             print(f"| {name} | {bs} | {as_} | {ds} |")
 
@@ -200,6 +232,34 @@ def main() -> int:
             print(f"| {s} | {rw} | {ae} | {eb} |")
         if args.exact and (shb or []) != (sha or []):
             mismatched.append("per_shard")
+
+    if axb or axa:
+        # Per-axis per-level table (grid captures).  zip to the longer
+        # curve so a level present on one side only renders as '—'.
+        nlev = max(
+            len(axb.get("col_bytes") or []), len(axa.get("col_bytes") or [])
+        )
+        print()
+        print("| level | col bytes | row bytes | col arm | row arm |")
+        print("|---|---|---|---|---|")
+
+        def _cell(side, key, i):
+            v = side.get(key)
+            return v[i] if v is not None and i < len(v) else "—"
+
+        for i in range(nlev):
+            cols = " | ".join(
+                f"{_cell(axb, k, i)} -> {_cell(axa, k, i)}"
+                for k in AXIS_KEYS
+            )
+            print(f"| {i + 1} | {cols} |")
+        if args.exact:
+            for k in AXIS_KEYS:
+                if (
+                    axb.get(k) is not None and axa.get(k) is not None
+                    and list(axb[k]) != list(axa[k])
+                ):
+                    mismatched.append(f"exchange:{k}")
 
     if args.exact and xsb != xsa:
         mismatched.append("exchange_schedule")
