@@ -1206,6 +1206,43 @@ def _spec_algo_cc_sharded():
 
 
 #: name -> builder.  Order is the report order.
+def _spec_stream_sb_expand():
+    """The streamed arm's per-superblock expansion program (ISSUE 18):
+    one column superblock's tiles expanded into the candidate grid — the
+    candidate carry is donated (callers chain ``cand2d = prog(cand2d,
+    ...)``), the streamed operands are the cache's device slabs, and the
+    math is the resident XLA twin's per-chunk body with a local
+    segment-min, so dtype/transfer/footprint rules must hold exactly as
+    for the resident expansion."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..stream.prefetch import frontier_blocks
+    from ..stream.runner import _cand_init_program, _sb_expand_program
+    from ..stream.store import HostTileStore
+
+    eng = _relay_engine_mxu()
+    at = eng.adj_tiles
+    store = HostTileStore(at)
+    tiles, row_idx, col_local = store.fetch(0)
+    fwords = np.zeros(at.rows // 32 + (1 if at.rows % 32 else 0),
+                      dtype=np.uint32)
+    fwords[0] = 1
+    return Program(
+        name="stream.sb_expand", path="bfs_tpu/stream/runner.py",
+        fn=_sb_expand_program(store.pad_tiles(0)),
+        args=(
+            _cand_init_program(at.vtp)(),
+            jnp.asarray(frontier_blocks(fwords, at.rtp)),
+            jnp.asarray(store.keys2d),
+            jnp.asarray(tiles), jnp.asarray(row_idx),
+            jnp.asarray(col_local), jnp.int32(0),
+        ),
+        v_elements=eng.relay_graph.vr, packed=eng.packed,
+        donate={0: "cand2d"},
+    )
+
+
 PROGRAM_SPECS = {
     "bfs.push_fused": _spec_push_fused,
     "bfs.pull_fused": _spec_pull_fused,
@@ -1219,6 +1256,7 @@ PROGRAM_SPECS = {
     "relay.step_sparse": lambda: _spec_relay_step("sparse"),
     "relay.segment": _spec_relay_segment,
     "relay.segment_mxu": _spec_relay_segment_mxu,
+    "stream.sb_expand": _spec_stream_sb_expand,
     "multisource.segment_push": lambda: _spec_multi_segment("push"),
     "multisource.segment_pull": lambda: _spec_multi_segment("pull"),
     "sharded.relay_segment": _spec_sharded_relay_segment,

@@ -1859,6 +1859,12 @@ def main():
         # and direction knobs.
         "expansion": os.environ.get("BFS_TPU_EXPANSION", "auto") or "auto",
         "mxu_kernel": os.environ.get("BFS_TPU_MXU_KERNEL", "auto") or "auto",
+        # Tile residency (ISSUE 18): a streamed run's timed repeats page
+        # adjacency through the host->HBM cache — resident- and
+        # stream-timed medians must never blend, and the cache budget
+        # changes the eviction pattern a streamed capture journals.
+        "tiles": os.environ.get("BFS_TPU_TILES", "resident") or "resident",
+        "stream_cache_gb": os.environ.get("BFS_TPU_STREAM_CACHE_GB", ""),
     })
     _install_signal_handlers(jr)
 
@@ -2540,6 +2546,49 @@ def main():
                     + (f"(x{ratio:.2f} vs fused)" if ratio else "")
                 )
                 _boundary(jr, "superstep_ckpt", {"superstep_ckpt": detail})
+
+    # Streamed-arm ledger (ISSUE 18): when the engine pages adjacency
+    # from the host store (BFS_TPU_TILES=stream, or auto over budget),
+    # one UNTIMED streamed traversal journals the per-level
+    # bytes-streamed / hit / miss / evict curve as details.stream, with
+    # an in-capture bit-identity check against the resident mxu arm
+    # (dist/parent + direction schedule).  BENCH_STREAM_CHECK=0 skips
+    # the resident compare at true beyond-HBM scales, where shipping the
+    # whole tile layout is exactly what streaming exists to avoid.
+    if engine == "relay" and getattr(eng, "_stream_effective",
+                                     lambda: False)():
+        st_rec = jr.get("stream") if jr is not None else None
+        if st_rec is not None:
+            layout_detail["stream"] = st_rec["stream"]
+            _stamp("journal: stream ledger restored")
+        else:
+            _stamp("stream ledger (untimed streamed traversal)...")
+            with obs_span("bench.stream"):
+                t0 = time.perf_counter()
+                s_res, s_curve = eng.run_streamed(source, telemetry=True)
+                stream_s = time.perf_counter() - t0
+            detail = dict(eng.stream_report)
+            detail["seconds"] = stream_s
+            detail["direction_schedule"] = s_curve["direction_schedule"]
+            if os.environ.get("BENCH_STREAM_CHECK", "1") != "0":
+                prev_mode = eng.tiles_mode
+                eng.tiles_mode = "resident"
+                try:
+                    with obs_span("bench.stream_resident_check"):
+                        r_res = eng.run(source)
+                finally:
+                    eng.tiles_mode = prev_mode
+                detail["bit_identical"] = bool(
+                    np.array_equal(s_res.dist, r_res.dist)
+                    and np.array_equal(s_res.parent, r_res.parent)
+                )
+            layout_detail["stream"] = detail
+            _stamp(
+                "stream ledger done "
+                f"({detail['bytes_streamed']} bytes streamed, "
+                f"{detail['evictions']} evictions)"
+            )
+            _boundary(jr, "stream", {"stream": detail})
 
     # Device level curve (ISSUE 6 tentpole b): one UNTIMED fused search
     # carrying the obs/telemetry accumulator as extra while_loop state —

@@ -479,6 +479,60 @@ def load_or_build_tiles(rg, *, cache: LayoutCache | None = None,
     return at, info
 
 
+def verify_tiles_bundle(rg, *, cache: LayoutCache | None = None) -> dict:
+    """Integrity report of the adj-tiles sidecar bundle for ``rg``
+    WITHOUT building on a miss (the cache_warm ``--tiles`` check): loads
+    the bundle — every array fingerprint-checked by :meth:`LayoutCache.load`,
+    a corrupt field surfaces as ``absent`` — then validates the geometry
+    invariants the streamed host store (stream/store.py) leans on:
+    version/shape agreement with the relay graph, a monotone
+    ``sb_indptr`` closing at ``nt``, and every real tile's row/column ids
+    inside the padded spaces.  Returns a JSON-ready dict; never raises on
+    a bad bundle."""
+    from ..graph.adj_tiles import (
+        SB_VERTS,
+        TILE,
+        TILES_VERSION,
+        tiles_from_arrays,
+    )
+
+    cache = cache if cache is not None else LayoutCache()
+    key = tiles_key(rg)
+    loaded = cache.load(key)
+    if loaded is None:
+        return {"key": key, "ok": False, "status": "absent"}
+    _doc, arrays = loaded
+    try:
+        at = tiles_from_arrays(arrays)
+    except Exception as exc:  # stale dims row / shape drift
+        return {"key": key, "ok": False, "status": f"unreadable: {exc}"}
+    problems = []
+    if int(arrays["dims"][0]) != TILES_VERSION:
+        problems.append(
+            f"tiles version {int(arrays['dims'][0])} != {TILES_VERSION}"
+        )
+    if at.rows != rg.vr:
+        problems.append(f"rows {at.rows} != relay vr {rg.vr}")
+    sb = np.asarray(at.sb_indptr)
+    if not (np.all(np.diff(sb) >= 0) and int(sb[0]) == 0
+            and int(sb[-1]) == at.nt):
+        problems.append("sb_indptr not a monotone span table closing at nt")
+    nt = at.nt
+    if nt:
+        if int(np.asarray(at.row_idx[:nt]).max()) >= at.rtp // TILE:
+            problems.append("real tile row_idx outside the padded row space")
+        if int(np.asarray(at.col_id[:nt]).max()) >= at.vtp // TILE:
+            problems.append("real tile col_id outside the padded col space")
+    return {
+        "key": key,
+        "ok": not problems,
+        "status": "ok" if not problems else "; ".join(problems),
+        "num_tiles": int(at.nt),
+        "num_superblocks": int(at.vtp // SB_VERTS),
+        "tile_bytes": int(at.nbytes),
+    }
+
+
 # ---------------------------------------------------------------------------
 # Phase-probe verdict memo (ISSUE 15 satellite): probe_phase_kernels is a
 # pure function of (layout shapes, kernel/probe sources, backend, knobs) —

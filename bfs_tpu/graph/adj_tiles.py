@@ -388,6 +388,33 @@ def build_adj_tiles_sharded(
     return out
 
 
+def num_superblocks(at: AdjTiles) -> int:
+    """Column-superblock count of a layout (one 16384-destination output
+    block each — the kernel grid extent AND the streaming transfer unit
+    of bfs_tpu/stream)."""
+    return int(at.vtp // SB_VERTS)
+
+
+def sb_span(at: AdjTiles, g: int) -> tuple[int, int]:
+    """Tile span ``[lo, hi)`` of column superblock ``g``.  Spans cover
+    REAL tiles only: padding tiles carry ``col_id = vtp // TILE`` (the
+    dropped overflow segment), which searchsorted places past every
+    span — ``sb_indptr[num_superblocks] == nt``."""
+    return int(at.sb_indptr[g]), int(at.sb_indptr[g + 1])
+
+
+def sb_row_blocks(at: AdjTiles, g: int) -> np.ndarray:
+    """Ascending unique frontier ROW BLOCKS superblock ``g``'s tiles
+    read (``row_idx`` values, each naming one 4-word block of the padded
+    frontier).  This is the demand-derivation input of the streamed arm:
+    the kernel's per-tile early-out skips a tile iff its frontier block
+    is all zero, so a superblock whose every row block is dead is — by
+    the same predicate — untouched, and its 2 KB tiles need never reach
+    HBM."""
+    lo, hi = sb_span(at, g)
+    return np.unique(np.asarray(at.row_idx[lo:hi]))
+
+
 def tile_occupancy_hist(at: AdjTiles) -> dict:
     """Per-tile set-bit histogram over power-of-two buckets — the density
     evidence the bench ships in ``details.expansion`` (a layout living in

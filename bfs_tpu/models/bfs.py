@@ -1543,7 +1543,8 @@ class RelayEngine:
 
     def __init__(self, graph, *, sparse_hybrid: bool = True,
                  applier: str = "auto", direction: str | None = None,
-                 expansion: str | None = None):
+                 expansion: str | None = None,
+                 tiles_mode: str | None = None):
         from ..graph.relay import RelayGraph, build_relay_graph, valid_slot_words
 
         rg = graph if isinstance(graph, RelayGraph) else build_relay_graph(graph)
@@ -1593,6 +1594,15 @@ class RelayEngine:
         self._mxu_dev = None
         self.expansion_probe = None
         self._resolve_expansion_static(expansion)
+        # Tile residency (ISSUE 18): resident keeps the whole tile layout
+        # in HBM (the PR 15 contract); stream pages it per column
+        # superblock from the host store under BFS_TPU_STREAM_CACHE_GB;
+        # auto streams exactly when the layout outgrows the cache budget.
+        # Resolved and frozen now, like direction/expansion — routing
+        # happens per run (run / run_segmented), not per program.
+        from ..ops.relay_mxu import resolve_tiles_mode
+
+        self.tiles_mode = resolve_tiles_mode(tiles_mode)
         self.applier_probe = None
         self._probe_net_arg = None
 
@@ -2370,9 +2380,46 @@ class RelayEngine:
             dist_new, parent_slots, source, flavor="gather"
         )
 
+    def _stream_effective(self) -> bool:
+        """Whether this engine's runs page adjacency from the host store
+        (ISSUE 18): only the mxu arm has a superblock decomposition, so
+        gather engines stay resident whatever the knob says; ``auto``
+        streams exactly when the tile layout outgrows the stream cache
+        budget (the resident upload would not have fit anyway)."""
+        if self.expansion != "mxu" or self.adj_tiles is None:
+            return False
+        if self.tiles_mode == "stream":
+            return True
+        if self.tiles_mode == "auto":
+            from ..ops.relay_mxu import stream_cache_budget_bytes
+
+            return self.adj_tiles.nbytes > stream_cache_budget_bytes()
+        return False
+
+    def run_streamed(self, source: int = 0, *, ckpt=None,
+                     max_levels: int | None = None,
+                     telemetry: bool = False,
+                     cache_budget_bytes: int | None = None):
+        """Streamed single-source BFS (ISSUE 18): the host-paged twin of
+        :meth:`run_segmented` — adjacency superblocks stream host->HBM
+        through the budgeted LRU cache, dist/parent and the direction
+        schedule stay bit-identical to the resident arms, and the stream
+        ledger lands on :attr:`stream_report`.  Delegates to
+        stream/runner.py (imported lazily: the package imports this
+        module)."""
+        from ..stream.runner import run_streamed as _run
+
+        check_sources(self.relay_graph.num_vertices, source)
+        return _run(
+            self, source, ckpt=ckpt, max_levels=max_levels,
+            telemetry=telemetry, cache_budget_bytes=cache_budget_bytes,
+        )
+
     def run(self, source: int = 0, *, max_levels: int | None = None) -> BfsResult:
         from ..ops.packed import packed_truncated
 
+        if self._stream_effective():
+            return self.run_streamed(source, max_levels=max_levels)
         rg = self.relay_graph
         check_sources(rg.num_vertices, source)
         max_levels = int(max_levels) if max_levels is not None else rg.vr
@@ -2622,6 +2669,14 @@ class RelayEngine:
         checkpoints are dead weight; resume is for killed runs)."""
         from ..ops.packed import packed_truncated
 
+        if self._stream_effective():
+            # Streamed engines run the host-paged loop: same carry keys,
+            # same checkpoint epochs (a streamed run resumes a segmented
+            # epoch and vice versa), adjacency through the cache.
+            return self.run_streamed(
+                source, ckpt=ckpt, max_levels=max_levels,
+                telemetry=telemetry,
+            )
         rg = self.relay_graph
         check_sources(rg.num_vertices, source)
         max_levels = int(max_levels) if max_levels is not None else rg.vr

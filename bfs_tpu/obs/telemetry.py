@@ -269,6 +269,32 @@ def level_curve(
     return out
 
 
+def stream_report(levels: list, *, budget_bytes: int, store: dict,
+                  cache: dict) -> dict:
+    """JSON-ready ``stream`` ledger phase (pure host — no jax): the
+    per-level rows the streamed runner journals (arm, demanded superblock
+    count, and the hit/miss/evict/corrupt/bytes deltas for that level)
+    plus their per-run totals, the host store shape, and the cache's
+    lifetime counter snapshot.  Totals sum the per-level DELTAS, so a
+    cache reused across runs (it is memoized on the engine) still reports
+    honest per-run streaming volume."""
+    total_keys = (
+        "bytes_streamed", "hits", "misses", "evictions",
+        "corrupt_refetches",
+    )
+    totals = {
+        k: int(sum(int(row.get(k, 0)) for row in levels))
+        for k in total_keys
+    }
+    return {
+        "budget_bytes": int(budget_bytes),
+        **{k: store[k] for k in sorted(store)},
+        "levels": [dict(row) for row in levels],
+        **totals,
+        "cache": dict(cache),
+    }
+
+
 def render_curve_ascii(curve: dict, width: int = 50) -> str:
     """Terminal bar chart of a level curve (the dashboard/CLI view)."""
     occ = curve.get("occupancy", [])

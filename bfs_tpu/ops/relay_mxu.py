@@ -44,6 +44,13 @@ Knobs::
     BFS_TPU_MXU_KERNEL   auto | pallas | xla   (default auto: pallas on
                          TPU backends, the XLA twin elsewhere)
     BFS_TPU_MXU_TILE_GB  float tile-storage budget for auto/mxu (default 4)
+    BFS_TPU_TILES        resident | stream | auto   (default resident):
+                         where the tile layout LIVES — device-resident, or
+                         paged per-superblock from host RAM by demand
+                         (bfs_tpu/stream, ISSUE 18); auto streams exactly
+                         when the layout exceeds the stream cache budget
+    BFS_TPU_STREAM_CACHE_GB  float HBM superblock-cache budget for the
+                         streamed arm (default 1)
 """
 
 from __future__ import annotations
@@ -58,9 +65,12 @@ from ..graph.adj_tiles import SB_TILES, SB_VERTS, TILE, TILE_WORDS
 
 __all__ = [
     "EXPANSION_MODES",
+    "TILES_MODES",
     "resolve_expansion",
     "resolve_mxu_kernel",
+    "resolve_tiles_mode",
     "tiles_budget_bytes",
+    "stream_cache_budget_bytes",
     "expand_frontier_mxu",
     "expand_frontier_mxu_xla",
     "mxu_device_operands",
@@ -104,6 +114,39 @@ def resolve_mxu_kernel(kernel: str | None = None) -> str:
         except Exception:  # pragma: no cover - backend init failure
             return "xla"
     return kernel
+
+
+TILES_MODES = ("resident", "stream", "auto")
+
+
+def resolve_tiles_mode(mode: str | None = None) -> str:
+    """``BFS_TPU_TILES`` (an explicit argument wins): where the mxu arm's
+    tile layout lives.  ``resident`` ships the whole layout to HBM at
+    engine init (the ISSUE 15 behavior and the default); ``stream`` pages
+    column superblocks from a pinned host store on frontier demand
+    (bfs_tpu/stream, ISSUE 18); ``auto`` streams exactly when the layout
+    exceeds :func:`stream_cache_budget_bytes` — the layout fits, keep it
+    resident.  Raises on unknown modes, same contract as
+    :func:`resolve_expansion`."""
+    if mode is None:
+        mode = os.environ.get("BFS_TPU_TILES", "resident") or "resident"
+    if mode not in TILES_MODES:
+        raise ValueError(
+            f"unknown tiles mode {mode!r}; use 'resident', 'stream' or "
+            "'auto'"
+        )
+    return mode
+
+
+def stream_cache_budget_bytes() -> int:
+    """HBM budget for the streamed arm's superblock cache
+    (``BFS_TPU_STREAM_CACHE_GB``, default 1 GB) — the working-set ceiling
+    the LRU accounts against, NOT a hard allocator limit (in-flight
+    expands keep their operand references alive past eviction, exactly
+    like the serve registry's resident map)."""
+    return int(
+        float(os.environ.get("BFS_TPU_STREAM_CACHE_GB", "1")) * (1 << 30)
+    )
 
 
 def tiles_budget_bytes() -> int:

@@ -618,7 +618,7 @@ def _runner_main(argv=None) -> int:
 
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--config", required=True,
-                    choices=("relay", "multi", "sharded", "grid"))
+                    choices=("relay", "multi", "sharded", "grid", "stream"))
     ap.add_argument("--ckpt-dir", required=True)
     ap.add_argument("--out", required=True)
     ap.add_argument("--scale", type=int, default=8)
@@ -670,6 +670,39 @@ def _runner_main(argv=None) -> int:
             num_levels=result.num_levels,
             direction_schedule=curve["direction_schedule"],
         )
+    elif args.config == "stream":
+        # The host-paged mxu arm (ISSUE 18): adjacency superblocks
+        # stream through the budgeted HBM cache; a kill loses the cache
+        # (it holds derived content only) but NOT the carry — resume from
+        # the epoch must replay dist/parent AND the direction schedule
+        # bit-identically with a cold cache.  The budget is pinned to one
+        # max-size superblock so even the toy graph exercises real
+        # eviction under chaos.
+        from ..models.bfs import RelayEngine
+        from ..stream import HostTileStore
+
+        eng = RelayEngine(
+            graph, sparse_hybrid=True, direction="auto", expansion="mxu",
+            tiles_mode="stream",
+        )
+        store = HostTileStore(eng.adj_tiles)
+        budget = max(
+            store.sb_bytes(g) for g in range(store.num_superblocks)
+        )
+        ckpt = SuperstepCheckpointer(args.ckpt_dir, base_config, cfg=cfg)
+        result, curve = eng.run_streamed(
+            args.source, ckpt=ckpt, telemetry=True,
+            cache_budget_bytes=budget,
+        )
+        doc.update(
+            dist_hash=_hash(result.dist), parent_hash=_hash(result.parent),
+            num_levels=result.num_levels,
+            direction_schedule=curve["direction_schedule"],
+        )
+        # The stream ledger rides the doc for the journal/inspection, but
+        # the chaos differ must NOT pin it: a resumed run's cache starts
+        # cold, so hit/miss/bytes curves legitimately differ from golden.
+        doc["stream"] = eng.stream_report
     elif args.config == "multi":
         ckpt = SuperstepCheckpointer(args.ckpt_dir, base_config, cfg=cfg)
         v = graph.num_vertices

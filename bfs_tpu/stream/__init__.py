@@ -1,0 +1,45 @@
+"""Beyond-HBM traversal: frontier-driven superblock streaming (ISSUE 18).
+
+Every resident arm caps a single chip near s26-s27 because the adjacency
+must fit HBM next to the packed state.  The PR 15 tile layout
+(graph/adj_tiles.py) was built to be independently loadable: tiles sort
+by (column superblock, row block), ``sb_indptr`` bounds each superblock's
+span, and the kernel's per-tile empty-frontier early-out means the
+frontier's live ROW BLOCKS fully determine which superblocks a superstep
+can touch.  This package exploits exactly that:
+
+  * :mod:`.store`    — the pinned HOST tile store: per-superblock operand
+                       slabs (pow2-padded, content-fingerprinted) cut from
+                       an AdjTiles layout or its sidecar bundle;
+  * :mod:`.cache`    — the content-addressed HBM superblock cache: an LRU
+                       budget-accounted like the serve registry
+                       (``BFS_TPU_STREAM_CACHE_GB``), corrupt or evicted
+                       entries re-fetched from host and counted;
+  * :mod:`.prefetch` — the hoisted demand predicate (the kernel early-out
+                       computed host-side per level) and the
+                       one-superblock-lookahead prefetch iterator;
+  * :mod:`.runner`   — the streamed superstep loop: bit-identical
+                       dist/parent and direction schedule to the resident
+                       mxu arm (uint32 min is exact and order-free, so
+                       the per-superblock decomposition cannot perturb a
+                       byte), resumable via the PR 14 superstep
+                       checkpoints (the carry keys are the segment
+                       program's own).
+
+Wired as ``BFS_TPU_TILES=resident|stream|auto`` through
+models/bfs.RelayEngine: packed state stays resident, adjacency does not —
+the s28-s30 scale class no resident engine can reach.
+"""
+
+from .cache import SuperblockCache
+from .prefetch import demand_set, iter_prefetched
+from .store import HostTileStore
+from .runner import run_streamed
+
+__all__ = [
+    "HostTileStore",
+    "SuperblockCache",
+    "demand_set",
+    "iter_prefetched",
+    "run_streamed",
+]

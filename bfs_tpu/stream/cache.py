@@ -1,0 +1,171 @@
+"""The content-addressed HBM superblock cache (ISSUE 18).
+
+An LRU over device-resident superblock operand slabs, budget-accounted
+exactly like the serve registry's graph-residency map
+(serve/registry.py): room is made BEFORE an upload, a single entry larger
+than the whole budget is allowed in alone (the documented oversized
+allowance), and every eviction lands a trace marker plus a metrics
+counter so HBM thrash is visible in the same dashboards.
+
+Keys are the store's CONTENT fingerprints (stream/store.py), which makes
+corruption detectable: with verify-on-hit enabled
+(``BFS_TPU_STREAM_VERIFY=1``, or ``verify=True``), a hit pulls the device
+bytes back and re-hashes them — a mismatch drops the entry, counts a
+``corrupt_refetch``, and falls through to the host re-fetch path instead
+of expanding against rotten adjacency.  Verify costs a device->host copy
+per hit, so it is OFF by default and ON in the pathology tests.
+
+Eviction drops the cache's REFERENCE; an in-flight expand holding the
+operands keeps the buffers alive until it retires (the same transient
+overshoot semantics as the registry's resident map), so the budget is a
+working-set target, not a hard allocator limit.
+
+Lock-free by design: the streamed superstep loop is one host thread
+driving async device work, so unlike the registry there is no
+cross-thread registration path to guard."""
+
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+
+import numpy as np
+
+from .store import HostTileStore, superblock_fingerprint
+
+__all__ = ["SuperblockCache", "stream_verify_enabled"]
+
+#: Counter names every report/delta carries, in ledger order.
+COUNTER_KEYS = (
+    "hits", "misses", "evictions", "corrupt_refetches", "bytes_streamed",
+)
+
+
+def stream_verify_enabled(verify: bool | None = None) -> bool:
+    """``BFS_TPU_STREAM_VERIFY=1`` (an explicit argument wins)."""
+    if verify is not None:
+        return bool(verify)
+    return os.environ.get("BFS_TPU_STREAM_VERIFY", "") == "1"
+
+
+class SuperblockCache:
+    """LRU of device superblock slabs under a byte budget."""
+
+    def __init__(self, store: HostTileStore, *,
+                 budget_bytes: int | None = None,
+                 verify: bool | None = None):
+        from ..ops.relay_mxu import stream_cache_budget_bytes
+
+        self.store = store
+        self.budget_bytes = (
+            stream_cache_budget_bytes()
+            if budget_bytes is None
+            else int(budget_bytes)
+        )
+        self.verify = stream_verify_enabled(verify)
+        # fingerprint -> (nbytes, device operands, superblock id); order
+        # = LRU (the id is reporting provenance — content-addressing may
+        # serve one entry to several identical superblocks).
+        self._resident: OrderedDict[str, tuple[int, tuple, int]] = (
+            OrderedDict()
+        )
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.corrupt_refetches = 0
+        self.bytes_streamed = 0
+
+    # ----------------------------------------------------------- accounting --
+    def resident_bytes(self) -> int:
+        return sum(n for n, _ops, _g in self._resident.values())
+
+    def counters(self) -> dict:
+        """Current counter snapshot — the runner diffs consecutive
+        snapshots into the per-level stream ledger rows."""
+        return {k: int(getattr(self, k)) for k in COUNTER_KEYS}
+
+    def report(self) -> dict:
+        """JSON-ready cache summary for ``details.stream``."""
+        return {
+            "budget_bytes": int(self.budget_bytes),
+            "resident_bytes": int(self.resident_bytes()),
+            "resident_entries": len(self._resident),
+            "verify": bool(self.verify),
+            **self.counters(),
+        }
+
+    # ---------------------------------------------------------------- fetch --
+    def get(self, g: int) -> tuple:
+        """Device operands ``(tiles, row_idx, col_local)`` for superblock
+        ``g`` — LRU hit, or host fetch + upload with room made first."""
+        import jax.numpy as jnp
+
+        key = self.store.fingerprint(g)
+        ent = self._resident.get(key)
+        if ent is not None:
+            if self.verify and not self._verify_entry(key, ent):
+                # Rotten device bytes: drop our reference and fall
+                # through to the host re-fetch — counted, never crashed,
+                # never silently expanded against.
+                self._drop_corrupt(key, ent, g)
+            else:
+                self._resident.move_to_end(key)
+                # A hit still settles any transient overshoot left by an
+                # oversized entry or an in-flight-pinned deferral.
+                self._make_room(0, keep=key)
+                self.hits += 1
+                return ent[1]
+        tiles, row_idx, col_local = self.store.fetch(g)
+        nbytes = self.store.sb_bytes(g)
+        # Room BEFORE the upload (the registry discipline): the budget
+        # bounds cache + incoming, not cache-then-oops.
+        self._make_room(nbytes, keep=key)
+        ops = (
+            jnp.asarray(tiles), jnp.asarray(row_idx),
+            jnp.asarray(col_local),
+        )
+        self._resident[key] = (nbytes, ops, int(g))
+        self.misses += 1
+        self.bytes_streamed += nbytes
+        return ops
+
+    # ------------------------------------------------------------- internals --
+    def _verify_entry(self, key: str, ent: tuple) -> bool:
+        import jax
+
+        _nbytes, ops, _g = ent
+        host = [np.asarray(a) for a in jax.device_get(ops)]
+        return superblock_fingerprint(*host) == key
+
+    def _drop_corrupt(self, key: str, ent: tuple, g: int) -> None:
+        from ..obs import get_registry, instant
+
+        nbytes, _ops, _g = ent
+        self._resident.pop(key, None)
+        self.corrupt_refetches += 1
+        instant("stream.corrupt_refetch", superblock=g, bytes=nbytes)
+        get_registry().counter("superblock_corrupt_refetches")
+
+    def _make_room(self, incoming: int, *, keep: str) -> None:
+        while (
+            self._resident
+            and self.resident_bytes() + incoming > self.budget_bytes
+        ):
+            victim = next(
+                (k for k in self._resident if k != keep), None
+            )
+            if victim is None:
+                # ``keep`` alone exceeds the budget: the documented
+                # single-oversized-superblock allowance (the registry's
+                # rule) — it comes in alone and leaves first.
+                return
+            self._evict(victim)
+
+    def _evict(self, key: str) -> None:
+        from ..obs import get_registry, instant
+
+        nbytes, _ops, g = self._resident.pop(key)
+        self.evictions += 1
+        instant("stream.evict", superblock=g, bytes=nbytes)
+        get_registry().counter("superblock_evictions")
+        get_registry().counter("superblock_evicted_bytes", nbytes)

@@ -1,0 +1,75 @@
+"""Frontier-driven demand + prefetch for the streamed arm (ISSUE 18).
+
+The mxu kernel's per-tile early-out (ops/relay_mxu.py) skips a tile —
+before its 2 KB DMA is even issued — iff the tile's 4-word frontier block
+is all zero.  :func:`demand_set` HOISTS exactly that predicate out of the
+kernel: pad the frontier words the way ``_pad_frontier_words`` does,
+reshape to row blocks, and a superblock is DEMANDED iff any of its tiles'
+row blocks is live.  Undemanded superblocks expand to all-sentinel
+candidate rows (the segment-min identity), so skipping their transfer is
+bit-free: the streamed candidate grid matches the resident expansion's
+bytes exactly (tests/test_stream.py pins demand against the brute-force
+per-tile predicate on star/path/gnm/rmat).
+
+:func:`iter_prefetched` is the overlap half: a one-superblock lookahead
+that issues the NEXT slab's ``cache.get`` (an async host->HBM upload —
+JAX dispatch returns before the copy lands) before yielding the current
+one, so the copy rides under the previous block's expand instead of
+serializing after it.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..graph.adj_tiles import TILE, TILE_WORDS
+from .cache import SuperblockCache
+from .store import HostTileStore
+
+__all__ = ["frontier_blocks", "demand_set", "iter_prefetched"]
+
+
+def frontier_blocks(fwords: np.ndarray, rtp: int) -> np.ndarray:
+    """Host twin of ``_pad_frontier_words``: frontier words padded to the
+    row space + one zero pad block, reshaped uint32[rtp//TILE + 1, 4] —
+    row ``b`` is exactly the block the kernel's early-out reads for a
+    tile with ``row_idx == b``."""
+    fw = np.asarray(fwords, dtype=np.uint32).reshape(-1)
+    want = rtp // 32 + TILE // 32
+    out = np.zeros(want, dtype=np.uint32)
+    out[: fw.shape[0]] = fw
+    return out.reshape(-1, TILE_WORDS)
+
+
+def demand_set(store: HostTileStore, fwords: np.ndarray) -> np.ndarray:
+    """Ascending superblock ids this frontier can touch: superblock ``g``
+    is demanded iff any of its tiles' frontier row blocks is nonzero —
+    the kernel early-out predicate, evaluated per superblock instead of
+    per tile.  An empty superblock (no real tiles) is never demanded."""
+    blocks = frontier_blocks(fwords, store.rtp)
+    live = (blocks != 0).any(axis=1)
+    out = [
+        g
+        for g in range(store.num_superblocks)
+        if store.real_tiles(g) and bool(live[store.row_blocks(g)].any())
+    ]
+    return np.asarray(out, dtype=np.int32)
+
+
+def iter_prefetched(cache: SuperblockCache, demand):
+    """Yield ``(g, device_operands)`` over the demand set with a
+    one-superblock lookahead: the next slab's upload is dispatched before
+    the current one is yielded, so the host->HBM copy overlaps the
+    consumer's expand of the current block (both are async dispatches;
+    the device interleaves them)."""
+    it = iter(demand)
+    try:
+        g = next(it)
+    except StopIteration:
+        return
+    ops = cache.get(int(g))
+    for nxt in it:
+        nxt_ops = cache.get(int(nxt))  # in flight under g's expand
+        yield int(g), ops
+        g, ops = nxt, nxt_ops
+    yield int(g), ops

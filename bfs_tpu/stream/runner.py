@@ -1,0 +1,507 @@
+"""The streamed superstep loop: host-paged adjacency, resident state.
+
+Drives the SAME superstep bodies the segmented relay program
+(models/bfs._relay_segment_program, mxu flavor) runs — the per-superstep
+direction decision compiles the identical predicate
+(direction.frontier_masses_words + take_pull with the sparse-budget
+override), the push levels run the engine's own AOT sparse body, and the
+pull levels run the mxu expansion DECOMPOSED per column superblock:
+
+    resident:  segment_min over ALL tiles' candidate rows, keyed col_id
+    streamed:  per-superblock segment_min over the superblock's tiles,
+               keyed col_local, placed at rows [g*128, (g+1)*128)
+
+Superblocks partition the destination columns, uint32 min is exact and
+order-free, and an empty segment fills with the sentinel — so the
+streamed candidate grid is byte-identical to the resident one for ANY
+demand subset that covers every live tile, which is exactly what the
+hoisted early-out predicate (prefetch.demand_set) guarantees.  Undemanded
+superblocks contribute all-sentinel rows = the grid's initial value;
+skipping their transfer perturbs nothing.
+
+Checkpoints: the carry keys are the segment program's own
+(RelayEngine.segment_keys), snapshots ride the same
+SuperstepCheckpointer epochs, and the restore gate is the shared
+restore_arrays — a streamed run can resume a segmented run's epoch and
+vice versa, and a SIGKILL mid-traversal resumes with a COLD cache but a
+bit-identical schedule (the hysteresis pair travels in the carry, and the
+cache holds derived content only).
+
+This is a HOST-DRIVEN loop (per-level demand needs the frontier words
+host-side — that is the point of hoisting the predicate), so it is not a
+hot region; its jitted sub-programs are module-level lru_cache factories
+(the RCD001 discipline).
+"""
+
+from __future__ import annotations
+
+import functools
+import time as _time
+
+import numpy as np
+
+from ..graph.adj_tiles import SB_TILES, TILE, TILE_WORDS
+from .cache import SuperblockCache
+from .prefetch import demand_set, iter_prefetched
+from .store import HostTileStore
+
+__all__ = ["run_streamed"]
+
+
+# ---------------------------------------------------------------------------
+# Jitted sub-programs (module-level lru_cache factories — RCD001).
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=8)
+def _frontier_blocks_program(rows: int, rtp: int):
+    """Frontier words -> uint32[rtp//TILE + 1, 4] row blocks, on device
+    (the per-tile gather operand; the demand set reads the host twin)."""
+    import jax
+
+    from ..ops.relay_mxu import _pad_frontier_words
+
+    @jax.jit
+    def prep(fwords):
+        return _pad_frontier_words(fwords, rows, rtp).reshape(
+            -1, TILE_WORDS
+        )
+
+    return prep
+
+
+@functools.lru_cache(maxsize=8)
+def _cand_init_program(vtp: int):
+    """The all-sentinel candidate grid uint32[vtp//TILE, TILE] — the
+    segment-min identity every undemanded superblock's rows keep."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.relay_mxu import SENT
+
+    @jax.jit
+    def init():
+        return jnp.full((vtp // TILE, TILE), SENT, jnp.uint32)
+
+    return init
+
+
+@functools.lru_cache(maxsize=32)
+def _sb_expand_program(ntp_g: int):
+    """One superblock's expansion into the candidate grid: the EXACT
+    per-tile math of expand_frontier_mxu_xla's ``per_chunk`` (same
+    chunked lax.map shape), with the global segment_min replaced by the
+    superblock-local one (col_local keys, pad tiles in the dropped
+    SB_TILES segment) and a dynamic-slice placement at the superblock's
+    output rows.  Keyed on the pow2-padded tile count, so a graph
+    compiles one program per bucket.  The grid carry is donated — it is
+    dead the moment the placement returns (callers chain
+    ``cand2d = prog(cand2d, ...)``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops.relay_mxu import SENT
+
+    chunk = min(256, ntp_g)
+    nc = ntp_g // chunk
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def expand(cand2d, fwp4, keys2d, tiles, row_idx, col_local, g):
+        fblk = fwp4[row_idx]  # [ntp_g, 4]
+        shifts = jnp.arange(32, dtype=jnp.uint32)
+
+        def per_chunk(args):
+            tk, fb, rk = args
+            lane = jnp.arange(TILE, dtype=jnp.int32)
+            fbits = (fb[:, lane >> 5] >> (lane & 31).astype(jnp.uint32)) & 1
+            rowmask = jnp.uint32(0) - fbits  # 0 / ~0 per (tile, u)
+            contrib = tk & rowmask[:, :, None]  # [chunk, 128, 4]
+            bits = (contrib[:, :, :, None] >> shifts) & 1
+            keyrow = keys2d[rk]  # [chunk, 128]
+            cand = jnp.min(
+                jnp.where(
+                    bits != 0,
+                    keyrow[:, :, None, None],
+                    SENT,
+                ),
+                axis=1,
+            )  # [chunk, 4, 32]
+            return cand.reshape(-1, TILE)
+
+        cands = jax.lax.map(
+            per_chunk,
+            (
+                tiles.reshape(nc, chunk, TILE, TILE_WORDS),
+                fblk.reshape(nc, chunk, TILE_WORDS),
+                row_idx.reshape(nc, chunk),
+            ),
+        ).reshape(-1, TILE)
+        block = jax.ops.segment_min(
+            cands, col_local, num_segments=SB_TILES + 1,
+            indices_are_sorted=False,
+        )[:SB_TILES]
+        return jax.lax.dynamic_update_slice(
+            cand2d, block, (g * SB_TILES, jnp.int32(0))
+        )
+
+    return expand
+
+
+@functools.lru_cache(maxsize=8)
+def _apply_program(packed: bool, cols: int):
+    """Candidate grid -> state update: exactly the mxu superstep's apply
+    half (ops/relay_mxu.mxu_superstep[_packed] after ``_expand``).  Both
+    the state and the grid are donated — each is dead once the superstep
+    returns."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..ops import relay as R
+    from ..ops.relax import INT32_MAX
+    from ..ops.relay_mxu import SENT
+
+    @functools.partial(jax.jit, donate_argnums=(0, 1))
+    def apply(st, cand2d):
+        cand = cand2d.reshape(-1)[:cols]
+        if packed:
+            return R.apply_relay_candidates_packed(st, cand)
+        cand_i = jnp.where(
+            cand == SENT, jnp.int32(INT32_MAX), cand.astype(jnp.int32)
+        )
+        return R.apply_relay_candidates(st, cand_i)
+
+    return apply
+
+
+@functools.lru_cache(maxsize=8)
+def _decide_program(vr: int, num_adj: int, v_thresh: int, alpha: float,
+                    beta: float):
+    """The auto-mode per-superstep direction decision — the same
+    functions, operands and float32 order the segment program's body
+    compiles (frontier_masses_words + the sparse-budget override +
+    take_pull), so the streamed schedule replays the resident one
+    bit-identically."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..models.bfs import sparse_budgets
+    from ..models.direction import frontier_masses_words, take_pull
+
+    @jax.jit
+    def decide(fwords, outdeg, mu, prev):
+        fsize, fe = frontier_masses_words(fwords, outdeg, vr)
+        m_u = jnp.maximum(mu - fe, 0.0)
+        bv, be = sparse_budgets(vr, num_adj)
+        budget_ok = (fsize <= bv) & (fe <= jnp.float32(be))
+        use_pull = (
+            take_pull(prev, fsize, fe, m_u, v_thresh, alpha, beta)
+            | ~budget_ok
+        )
+        return use_pull, m_u
+
+    return decide
+
+
+@functools.lru_cache(maxsize=8)
+def _take_sparse_program(vr: int, num_adj: int):
+    """The legacy hybrid's dispatch predicate (mode=push with the sparse
+    operands): sparse exactly when the fused ``small()`` holds."""
+    import jax
+
+    from ..models.bfs import _take_sparse
+
+    @jax.jit
+    def pred(st, outdeg):
+        return _take_sparse(st, outdeg, vr, num_adj)
+
+    return pred
+
+
+@functools.lru_cache(maxsize=4)
+def _record_program():
+    """Telemetry accumulation — the segment body's own record calls."""
+    import jax
+
+    from ..obs import telemetry as T
+
+    @jax.jit
+    def rec(occ, dirs, fwords, level, code):
+        return (
+            T.record_frontier_words(occ, fwords, level),
+            T.record_direction(dirs, level, code),
+        )
+
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# Engine-attached store/cache memos.
+# ---------------------------------------------------------------------------
+
+def _store_for(eng) -> HostTileStore:
+    store = getattr(eng, "_stream_store", None)
+    if store is None:
+        store = HostTileStore(eng.adj_tiles)
+        eng._stream_store = store
+    return store
+
+
+def _cache_for(eng, store: HostTileStore,
+               budget_bytes: int | None) -> SuperblockCache:
+    from ..ops.relay_mxu import stream_cache_budget_bytes
+
+    budget = (
+        stream_cache_budget_bytes()
+        if budget_bytes is None
+        else int(budget_bytes)
+    )
+    cached = getattr(eng, "_stream_cache", None)
+    if cached is None or cached.budget_bytes != budget:
+        cached = SuperblockCache(store, budget_bytes=budget)
+        eng._stream_cache = cached
+    return cached
+
+
+def _keys2d_for(eng, store: HostTileStore):
+    """The resident key-table operand, shipped once per engine (O(V) like
+    the state — only the O(E) tile slabs stream)."""
+    import jax.numpy as jnp
+
+    dev = getattr(eng, "_stream_keys2d", None)
+    if dev is None:
+        dev = jnp.asarray(store.keys2d)
+        eng._stream_keys2d = dev
+    return dev
+
+
+# ---------------------------------------------------------------------------
+# The loop.
+# ---------------------------------------------------------------------------
+
+def _counters_delta(after: dict, before: dict) -> dict:
+    return {k: int(after[k]) - int(before[k]) for k in after}
+
+
+def _run_streamed_flavor(eng, store, cache, source: int, ckpt,
+                         max_levels: int, packed: bool, telemetry: bool):
+    """One carry flavor through the streamed per-level loop; returns
+    ``(host RelayState, curve|None, stream ledger)``.  Mirrors
+    models/bfs._run_segmented_flavor's carry, checkpoint and finish
+    semantics superstep-for-superstep."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..obs import telemetry as T
+    from ..ops import relay as Rops
+    from ..ops.packed import PACKED_MAX_LEVELS, packed_cap
+    from ..ops.relax import INT32_MAX
+    from ..ops.relay_mxu import mxu_static
+    from ..resilience.superstep_ckpt import restore_arrays
+
+    rg = eng.relay_graph
+    vr = rg.vr
+    rows, cols, rtp, vtp, _ntp = mxu_static(eng.adj_tiles)
+    outdeg = eng._sparse_tensors[3]
+    num_adj = int(eng._sparse_tensors[1].shape[0])
+    # The segment program's mode normalization: without the sparse
+    # operands the dense mxu body is the only body.
+    mode = eng.direction.mode
+    sparse = eng.sparse_hybrid
+    if mode == "pull" or (mode in ("auto", "push") and not sparse):
+        sparse = False
+        mode = "pull"
+    cap = packed_cap(max_levels) if packed else max_levels
+    keys = tuple(eng.segment_keys(packed, telemetry))
+    arrays = None
+    if ckpt is not None:
+        arrays, _shards = restore_arrays(ckpt, packed, require=keys)
+    carry = eng.segment_carry(
+        source, packed=packed, telemetry=telemetry, restore=arrays
+    )
+    keys2d_dev = _keys2d_for(eng, store)
+    fwp4_prog = _frontier_blocks_program(rows, rtp)
+    cand_init = _cand_init_program(vtp)
+    apply_prog = _apply_program(packed, cols)
+    per_level: list[dict] = []
+
+    def mk_state(c):
+        if packed:
+            return Rops.PackedRelayState(
+                c["pk"], c["fw"], c["level"], c["changed"]
+            )
+        return Rops.RelayState(
+            c["dist"], c["parent"], c["fw"], c["level"], c["changed"]
+        )
+
+    level, changed = jax.device_get((carry["level"], carry["changed"]))
+    while bool(changed) and int(level) < cap:
+        interval = ckpt.interval() if ckpt is not None else cap
+        seg_end = min(int(level) + interval, cap)
+        t0 = _time.perf_counter()
+        while bool(changed) and int(level) < seg_end:
+            st = mk_state(carry)
+            use_pull = None
+            m_u_dev = None
+            use_pull_dev = None
+            if mode == "auto":
+                use_pull_dev, m_u_dev = _decide_program(
+                    vr, num_adj, rg.num_vertices, eng.direction.alpha,
+                    eng.direction.beta,
+                )(carry["fw"], outdeg, carry["mu"], carry["prev"])
+                use_pull = bool(jax.device_get(use_pull_dev))
+            elif sparse:
+                use_pull = not bool(
+                    jax.device_get(
+                        _take_sparse_program(vr, num_adj)(st, outdeg)
+                    )
+                )
+            before = cache.counters()
+            if use_pull is None or use_pull:
+                fw_host = np.asarray(jax.device_get(carry["fw"]))
+                demand = demand_set(store, fw_host)
+                fwp4 = fwp4_prog(carry["fw"])
+                cand2d = cand_init()
+                for g, ops in iter_prefetched(cache, demand):
+                    cand2d = _sb_expand_program(store.pad_tiles(g))(
+                        cand2d, fwp4, keys2d_dev, *ops, jnp.int32(g)
+                    )
+                st2 = apply_prog(st, cand2d)
+                row = {"arm": "pull", "demanded": int(demand.shape[0])}
+            else:
+                st2 = eng._step_body("sparse", st)(
+                    st, *eng._sparse_tensors_for(packed)[:3]
+                )
+                row = {"arm": "push", "demanded": 0}
+            if packed:
+                carry["pk"] = st2.packed
+            else:
+                carry["dist"], carry["parent"] = st2.dist, st2.parent
+            carry["fw"] = st2.fwords
+            carry["level"] = st2.level
+            carry["changed"] = st2.changed
+            if mode == "auto":
+                carry["mu"] = m_u_dev
+                carry["prev"] = use_pull_dev
+            if telemetry:
+                code = (
+                    T.DIR_PULL
+                    if (use_pull is None or use_pull)
+                    else T.DIR_PUSH
+                )
+                carry["occ"], carry["dirs"] = _record_program()(
+                    carry["occ"], carry["dirs"], st2.fwords, st2.level,
+                    np.int32(code),
+                )
+            level, changed = jax.device_get(
+                (carry["level"], carry["changed"])
+            )
+            row.update(
+                level=int(level),
+                **_counters_delta(cache.counters(), before),
+            )
+            per_level.append(row)
+        seg_s = _time.perf_counter() - t0
+        if ckpt is not None:
+            # Same disabled-store contract as the segmented driver: the
+            # fault boundary is still marked, the O(V) carry pull is not
+            # paid.
+            snap = {}
+            if ckpt.enabled:
+                snap = {
+                    k: np.asarray(v)
+                    for k, v in jax.device_get(carry).items()
+                }
+                snap["packed_flag"] = np.int32(packed)
+            seg_levels = int(level) - (
+                seg_end - interval if seg_end - interval >= 0 else 0
+            )
+            ckpt.save_epoch(int(level), snap)
+            ckpt.note_segment(min(seg_levels, interval), seg_s)
+    from ..models.bfs import _relay_segment_finish_program
+
+    if packed:
+        state_dev = _relay_segment_finish_program(
+            tuple(rg.in_classes), rg.vr, True
+        )(carry["pk"], carry["fw"], carry["level"], carry["changed"])
+    else:
+        state_dev = Rops.RelayState(
+            carry["dist"], carry["parent"], carry["fw"], carry["level"],
+            carry["changed"],
+        )
+    curve = None
+    if telemetry:
+        from ..obs.telemetry import (
+            direction_schedule,
+            edge_curve_from_levels,
+            level_curve,
+            read_telemetry,
+        )
+
+        fe_key = ("segment_edge_curve",)
+        fe_fn = eng._compiled.get(fe_key)
+        if fe_fn is None:
+            fe_fn = jax.jit(edge_curve_from_levels)
+            eng._compiled[fe_key] = fe_fn
+        fe_dev = fe_fn(
+            state_dev.dist, eng._sparse_tensors[3],
+            state_dev.dist == INT32_MAX,
+        )
+        fv, fe, dirs = read_telemetry(
+            (carry["occ"], fe_dev, carry["dirs"])
+        )
+        curve_cap = (
+            min(PACKED_MAX_LEVELS, max_levels) if packed else max_levels
+        )
+        curve = level_curve(fv, fe, cap=curve_cap)
+        curve["direction_schedule"] = direction_schedule(
+            dirs, mode=eng.direction.mode, alpha=eng.direction.alpha,
+            beta=eng.direction.beta,
+        )
+    ledger = T.stream_report(
+        per_level, budget_bytes=cache.budget_bytes, store=store.report(),
+        cache=cache.report(),
+    )
+    return jax.device_get(state_dev), curve, ledger
+
+
+def run_streamed(eng, source: int = 0, *, ckpt=None,
+                 max_levels: int | None = None, telemetry: bool = False,
+                 cache_budget_bytes: int | None = None):
+    """Streamed single-source BFS on a forced-mxu RelayEngine: adjacency
+    paged per superblock from the host store under the
+    ``BFS_TPU_STREAM_CACHE_GB`` budget (``cache_budget_bytes`` forces),
+    dist/parent and the direction schedule bit-identical to the resident
+    arms, resumable from ``ckpt`` epochs.  Returns a BfsResult, or
+    ``(BfsResult, curve)`` with ``telemetry``; the stream ledger
+    (per-level bytes/hit/miss/evict rows) lands on
+    ``eng.stream_report``."""
+    from ..ops.packed import packed_truncated
+
+    if eng.expansion != "mxu":
+        raise ValueError(
+            "streamed traversal needs the mxu expansion arm "
+            "(BFS_TPU_EXPANSION=mxu / expansion='mxu')"
+        )
+    rg = eng.relay_graph
+    max_levels = int(max_levels) if max_levels is not None else rg.vr
+    store = _store_for(eng)
+    cache = _cache_for(eng, store, cache_budget_bytes)
+    packed = eng.packed
+    state, curve, ledger = _run_streamed_flavor(
+        eng, store, cache, source, ckpt, max_levels, packed, telemetry
+    )
+    if packed and packed_truncated(state.changed, state.level, max_levels):
+        # Deeper than the packed level field: same detect-and-rerun
+        # contract as run()/run_segmented (packed epochs cannot feed the
+        # unpacked re-run).
+        if ckpt is not None:
+            ckpt.clear()
+        state, curve, ledger = _run_streamed_flavor(
+            eng, store, cache, source, ckpt, max_levels, False, telemetry
+        )
+    if ckpt is not None:
+        ckpt.clear()
+    eng.stream_report = ledger
+    result = eng._to_result(state, source)
+    if telemetry:
+        return result, curve
+    return result

@@ -1,0 +1,166 @@
+"""The pinned host tile store: per-superblock operand slabs (ISSUE 18).
+
+An :class:`~bfs_tpu.graph.adj_tiles.AdjTiles` layout (built in-process or
+loaded from the cache/layout.py sidecar bundle — possibly memmapped) is
+cut once, at store init, into per-column-superblock operand slabs held in
+plain host RAM:
+
+  * ``tiles``     uint32[ntp_g, 128, 4] — the superblock's real tiles,
+                  padded to a power-of-two count with INERT tiles (zero
+                  bits, ``row_idx = rtp // TILE`` = the guaranteed-zero
+                  frontier pad block, ``col_local = SB_TILES`` = the
+                  dropped overflow segment) so the per-superblock
+                  expansion program compiles once per pow2 bucket, not
+                  once per superblock;
+  * ``row_idx``   int32[ntp_g] — frontier row-block per tile (the 4-word
+                  block the kernel's early-out reads);
+  * ``col_local`` int32[ntp_g] — column tile WITHIN the superblock
+                  (``col_id - g * SB_TILES``), the segment-min key.
+
+Each slab carries a blake2b-16 CONTENT fingerprint over its padded bytes
+— the HBM cache's key (content-addressed: two identical superblocks, e.g.
+two empty ones, share one device entry) and the corruption oracle the
+cache's verify-on-hit re-hashes against.
+
+The store also precomputes each superblock's unique row-block set: the
+demand-derivation input (prefetch.demand_set) — a superblock whose every
+row block is dead is, by the kernel's own per-tile early-out predicate,
+untouched by the superstep, so its tiles need never reach HBM.
+
+``keys2d`` (O(V), like the packed state) stays a single resident operand;
+only the O(E) tile slabs stream.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+
+from ..graph.adj_tiles import (
+    SB_TILES,
+    SB_VERTS,
+    TILE,
+    TILE_WORDS,
+    AdjTiles,
+    sb_span,
+)
+
+__all__ = ["HostTileStore", "superblock_fingerprint"]
+
+
+def superblock_fingerprint(tiles: np.ndarray, row_idx: np.ndarray,
+                           col_local: np.ndarray) -> str:
+    """Content key of one PADDED superblock slab: blake2b-16 over the
+    dtype/shape-tagged bytes of the three operand arrays — the same
+    derivation for the host slab at store init and for device bytes
+    pulled back by the cache's verify-on-hit, so a single flipped bit on
+    either side is a key mismatch."""
+    h = hashlib.blake2b(digest_size=16)
+    for a in (tiles, row_idx, col_local):
+        a = np.ascontiguousarray(a)
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(memoryview(a))
+    return h.hexdigest()
+
+
+def _pow2_pad(n: int) -> int:
+    """Smallest power of two >= max(n, 1) — the compile-count bound: the
+    per-superblock expansion program is keyed on the padded tile count,
+    so a graph compiles at most log2(largest superblock) programs."""
+    p = 1
+    while p < max(int(n), 1):
+        p <<= 1
+    return p
+
+
+class HostTileStore:
+    """Immutable per-superblock slabs of one tile layout, in host RAM.
+
+    Single-threaded by design (the streamed superstep loop is one host
+    thread driving async device work); nothing here takes a lock."""
+
+    def __init__(self, at: AdjTiles):
+        self.rows = int(at.rows)
+        self.cols = int(at.cols)
+        self.rtp = int(at.rtp)
+        self.vtp = int(at.vtp)
+        self.nt = int(at.nt)
+        self.num_superblocks = int(at.vtp // SB_VERTS)
+        self.keys2d = np.ascontiguousarray(at.keys2d, dtype=np.uint32)
+        pad_block = self.rtp // TILE  # the guaranteed-zero frontier block
+        self._tiles: list[np.ndarray] = []
+        self._row_idx: list[np.ndarray] = []
+        self._col_local: list[np.ndarray] = []
+        self._row_blocks: list[np.ndarray] = []
+        self._fingerprints: list[str] = []
+        self._real_tiles: list[int] = []
+        for g in range(self.num_superblocks):
+            lo, hi = sb_span(at, g)
+            nt_g = hi - lo
+            ntp_g = _pow2_pad(nt_g)
+            tiles = np.zeros((ntp_g, TILE, TILE_WORDS), dtype=np.uint32)
+            row_idx = np.full(ntp_g, pad_block, dtype=np.int32)
+            col_local = np.full(ntp_g, SB_TILES, dtype=np.int32)
+            if nt_g:
+                tiles[:nt_g] = at.tiles[lo:hi]
+                row_idx[:nt_g] = at.row_idx[lo:hi]
+                col_local[:nt_g] = (
+                    np.asarray(at.col_id[lo:hi], dtype=np.int32)
+                    - g * SB_TILES
+                )
+            self._tiles.append(tiles)
+            self._row_idx.append(row_idx)
+            self._col_local.append(col_local)
+            self._row_blocks.append(np.unique(row_idx[:nt_g]))
+            self._fingerprints.append(
+                superblock_fingerprint(tiles, row_idx, col_local)
+            )
+            self._real_tiles.append(int(nt_g))
+
+    # ------------------------------------------------------------ geometry --
+    def real_tiles(self, g: int) -> int:
+        return self._real_tiles[g]
+
+    def pad_tiles(self, g: int) -> int:
+        return int(self._tiles[g].shape[0])
+
+    def row_blocks(self, g: int) -> np.ndarray:
+        """Ascending unique frontier row blocks superblock ``g`` reads."""
+        return self._row_blocks[g]
+
+    def fingerprint(self, g: int) -> str:
+        return self._fingerprints[g]
+
+    def fetch(self, g: int) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """The padded host slab ``(tiles, row_idx, col_local)`` — what the
+        cache uploads on a miss (and re-uploads on a corrupt hit)."""
+        return self._tiles[g], self._row_idx[g], self._col_local[g]
+
+    def sb_bytes(self, g: int) -> int:
+        """Device bytes of superblock ``g``'s padded slab — the cache's
+        budget-accounting unit."""
+        return int(
+            self._tiles[g].nbytes + self._row_idx[g].nbytes
+            + self._col_local[g].nbytes
+        )
+
+    @property
+    def nbytes(self) -> int:
+        """Host bytes pinned by the slabs + the resident key table."""
+        return (
+            sum(self.sb_bytes(g) for g in range(self.num_superblocks))
+            + int(self.keys2d.nbytes)
+        )
+
+    def report(self) -> dict:
+        """JSON-ready store shape for the stream ledger / cache_warm."""
+        return {
+            "num_superblocks": self.num_superblocks,
+            "real_tiles": int(self.nt),
+            "host_store_bytes": int(self.nbytes),
+            "max_superblock_bytes": max(
+                self.sb_bytes(g) for g in range(self.num_superblocks)
+            ),
+        }

@@ -1,0 +1,487 @@
+"""Beyond-HBM streamed traversal (ISSUE 18): host store geometry, the
+hoisted demand predicate vs the kernel's in-kernel early-out, cache
+pathology (eviction under a one-superblock budget, corrupt device bytes
+re-fetched and counted), and the acceptance core — streamed dist/parent
+and direction schedule BIT-IDENTICAL to the resident mxu and gather arms
+under a budget small enough to force real eviction — plus checkpointed
+kill-boundary resume honesty with a cold cache.
+
+Fixture shapes mirror tests/test_expansion_mxu.py: a STAR (hub
+explosion), a PATH deeper than the packed 62-level cap, a G(n,m) whose
+ramp makes the Beamer predicate actually switch, and an R-MAT (skewed
+degrees, scrambled relabel keys).  The multi-superblock cases build on a
+>16K-vertex G(n,m): ``vtp`` rounds up to 16384-vertex superblocks, so
+anything smaller is a single superblock and can never evict."""
+
+import numpy as np
+import pytest
+
+from bfs_tpu.graph import adj_tiles as AT
+from bfs_tpu.graph.csr import Graph
+from bfs_tpu.graph.generators import gnm_graph, path_graph, rmat_graph
+from bfs_tpu.models.bfs import RelayEngine
+from bfs_tpu.stream import HostTileStore, SuperblockCache, demand_set
+from bfs_tpu.stream.prefetch import frontier_blocks, iter_prefetched
+from bfs_tpu.stream.store import superblock_fingerprint
+
+SOURCE = 3
+
+
+def star_graph(n: int = 256) -> Graph:
+    hub = np.zeros(n - 1, np.int32)
+    leaves = np.arange(1, n, dtype=np.int32)
+    return Graph(n, np.concatenate([hub, leaves]),
+                 np.concatenate([leaves, hub]))
+
+
+@pytest.fixture(scope="module")
+def gnm():
+    return gnm_graph(1 << 10, 3 << 10, seed=5)
+
+
+@pytest.fixture(scope="module")
+def big_gnm():
+    """>16K vertices -> multiple column superblocks (the eviction shapes)."""
+    return gnm_graph(1 << 15, 1 << 17, seed=11)
+
+
+@pytest.fixture(scope="module")
+def big_engines(big_gnm):
+    """(streamed mxu, resident mxu, gather) engines over one relay graph
+    build — module-scoped: three engines' programs are the expensive part
+    of this file."""
+    stream_eng = RelayEngine(big_gnm, expansion="mxu", direction="auto",
+                             tiles_mode="stream")
+    resident_eng = RelayEngine(stream_eng.relay_graph, expansion="mxu",
+                               direction="auto")
+    gather_eng = RelayEngine(stream_eng.relay_graph, expansion="gather",
+                             direction="auto")
+    return stream_eng, resident_eng, gather_eng
+
+
+def assert_same(a, b):
+    np.testing.assert_array_equal(a.dist, b.dist)
+    np.testing.assert_array_equal(a.parent, b.parent)
+    assert a.num_levels == b.num_levels
+
+
+# ---------------------------------------------------------------------------
+# Host store geometry.
+# ---------------------------------------------------------------------------
+
+def test_store_covers_layout_exactly(gnm):
+    eng = RelayEngine(gnm, expansion="mxu")
+    at = eng.adj_tiles
+    store = HostTileStore(at)
+    assert store.num_superblocks == at.vtp // AT.SB_VERTS
+    assert sum(
+        store.real_tiles(g) for g in range(store.num_superblocks)
+    ) == at.nt
+    for g in range(store.num_superblocks):
+        tiles, row_idx, col_local = store.fetch(g)
+        nt_g = store.real_tiles(g)
+        lo, hi = AT.sb_span(at, g)
+        np.testing.assert_array_equal(tiles[:nt_g], at.tiles[lo:hi])
+        np.testing.assert_array_equal(row_idx[:nt_g], at.row_idx[lo:hi])
+        np.testing.assert_array_equal(
+            col_local[:nt_g],
+            np.asarray(at.col_id[lo:hi], np.int32) - g * AT.SB_TILES,
+        )
+        # Pad tiles are INERT: zero bits, the guaranteed-zero frontier
+        # pad block, the dropped overflow segment.
+        assert not tiles[nt_g:].any()
+        assert (row_idx[nt_g:] == at.rtp // AT.TILE).all()
+        assert (col_local[nt_g:] == AT.SB_TILES).all()
+        # pow2 padding (the compile-count bound) and honest accounting.
+        assert store.pad_tiles(g) & (store.pad_tiles(g) - 1) == 0
+        assert store.sb_bytes(g) == (
+            tiles.nbytes + row_idx.nbytes + col_local.nbytes
+        )
+
+
+def test_store_fingerprint_is_content_addressed(gnm):
+    eng = RelayEngine(gnm, expansion="mxu")
+    store = HostTileStore(eng.adj_tiles)
+    tiles, row_idx, col_local = store.fetch(0)
+    assert store.fingerprint(0) == superblock_fingerprint(
+        tiles, row_idx, col_local
+    )
+    bad = tiles.copy()
+    bad[0, 0, 0] ^= 1
+    assert superblock_fingerprint(
+        bad, row_idx, col_local
+    ) != store.fingerprint(0)
+
+
+# ---------------------------------------------------------------------------
+# Demand set == the kernel's per-tile early-out predicate, hoisted.
+# ---------------------------------------------------------------------------
+
+def _brute_force_demand(at, fwords):
+    """The in-kernel predicate, literally: tile t is live iff its 4-word
+    frontier block is nonzero; superblock g is demanded iff any of its
+    REAL tiles is live."""
+    blocks = frontier_blocks(fwords, at.rtp)
+    live_tiles = blocks[np.asarray(at.row_idx[: at.nt])].any(axis=1)
+    out = []
+    for g in range(at.vtp // AT.SB_VERTS):
+        lo, hi = AT.sb_span(at, g)
+        if live_tiles[lo:hi].any():
+            out.append(g)
+    return np.asarray(out, dtype=np.int32)
+
+
+@pytest.mark.parametrize("maker", [
+    lambda: star_graph(),
+    lambda: path_graph(300),
+    lambda: gnm_graph(1 << 10, 3 << 10, seed=5),
+    lambda: rmat_graph(8, 8, seed=7),
+])
+def test_demand_matches_in_kernel_early_out(maker):
+    g = maker()
+    eng = RelayEngine(g, expansion="mxu")
+    at = eng.adj_tiles
+    store = HostTileStore(at)
+    rng = np.random.default_rng(3)
+    nwords = at.rows // 32 + (1 if at.rows % 32 else 0)
+    cases = [
+        np.zeros(nwords, np.uint32),                      # empty frontier
+        np.zeros(nwords, np.uint32),                      # single source bit
+        rng.integers(0, 1 << 32, nwords, dtype=np.uint32),  # dense
+        (rng.integers(0, 1 << 32, nwords, dtype=np.uint32)
+         * (rng.random(nwords) < 0.1)).astype(np.uint32),   # sparse words
+    ]
+    cases[1][0] = 1
+    for fwords in cases:
+        np.testing.assert_array_equal(
+            demand_set(store, fwords), _brute_force_demand(at, fwords)
+        )
+
+
+def test_empty_superblock_never_demanded():
+    # A path graph reaches few columns; force an all-ones frontier and
+    # check only superblocks with real tiles appear.
+    g = path_graph(300)
+    eng = RelayEngine(g, expansion="mxu")
+    store = HostTileStore(eng.adj_tiles)
+    fwords = np.full(eng.adj_tiles.rows // 32 + 1, 0xFFFFFFFF, np.uint32)
+    for gg in demand_set(store, fwords):
+        assert store.real_tiles(int(gg)) > 0
+
+
+# ---------------------------------------------------------------------------
+# Cache pathology.
+# ---------------------------------------------------------------------------
+
+def test_cache_eviction_under_one_superblock_budget(big_engines):
+    stream_eng, _, _ = big_engines
+    store = HostTileStore(stream_eng.adj_tiles)
+    assert store.num_superblocks >= 2, "eviction shape needs >=2 superblocks"
+    budget = max(
+        store.sb_bytes(g) for g in range(store.num_superblocks)
+    )
+    cache = SuperblockCache(store, budget_bytes=budget)
+    demanded = [
+        g for g in range(store.num_superblocks) if store.real_tiles(g)
+    ]
+    for g in demanded:        # cold sweep: all misses
+        cache.get(g)
+    for g in demanded:        # second sweep under a 1-superblock budget
+        cache.get(g)
+    assert cache.misses >= len(demanded)
+    assert cache.evictions > 0
+    assert cache.resident_bytes() <= budget
+    assert cache.bytes_streamed >= sum(store.sb_bytes(g) for g in demanded)
+    rep = cache.report()
+    assert rep["evictions"] == cache.evictions
+    assert rep["budget_bytes"] == budget
+
+
+def test_cache_single_oversized_allowance(gnm):
+    eng = RelayEngine(gnm, expansion="mxu")
+    store = HostTileStore(eng.adj_tiles)
+    cache = SuperblockCache(store, budget_bytes=1)  # smaller than any slab
+    ops = cache.get(0)
+    assert cache.resident_bytes() == store.sb_bytes(0)  # in alone
+    again = cache.get(0)
+    assert again is ops and cache.hits == 1  # still resident, no thrash
+
+
+def test_cache_hit_returns_same_buffers(gnm):
+    eng = RelayEngine(gnm, expansion="mxu")
+    store = HostTileStore(eng.adj_tiles)
+    cache = SuperblockCache(store, budget_bytes=1 << 30)
+    a = cache.get(0)
+    b = cache.get(0)
+    assert a is b
+    assert (cache.hits, cache.misses) == (1, 1)
+    assert cache.bytes_streamed == store.sb_bytes(0)
+
+
+def test_corrupt_superblock_refetched_not_crashed(gnm):
+    import jax.numpy as jnp
+
+    eng = RelayEngine(gnm, expansion="mxu")
+    store = HostTileStore(eng.adj_tiles)
+    cache = SuperblockCache(store, budget_bytes=1 << 30, verify=True)
+    cache.get(0)
+    key = store.fingerprint(0)
+    nbytes, (tiles, row_idx, col_local), g0 = cache._resident[key]
+    rotten = np.asarray(tiles).copy()
+    rotten[0, 0, 0] ^= 1  # a single flipped bit in HBM
+    cache._resident[key] = (
+        nbytes, (jnp.asarray(rotten), row_idx, col_local), g0
+    )
+    fresh = cache.get(0)
+    assert cache.corrupt_refetches == 1
+    assert cache.misses == 2  # the re-fetch is an honest miss
+    # The served operands are the host truth again, not the rotten bytes.
+    np.testing.assert_array_equal(np.asarray(fresh[0]), store.fetch(0)[0])
+    # And a verified clean hit does not count as corrupt.
+    cache.get(0)
+    assert cache.corrupt_refetches == 1
+
+
+def test_stream_verify_env_knob(monkeypatch, gnm):
+    from bfs_tpu.stream.cache import stream_verify_enabled
+
+    monkeypatch.delenv("BFS_TPU_STREAM_VERIFY", raising=False)
+    assert stream_verify_enabled() is False
+    monkeypatch.setenv("BFS_TPU_STREAM_VERIFY", "1")
+    assert stream_verify_enabled() is True
+    assert stream_verify_enabled(False) is False  # explicit arg wins
+
+
+def test_iter_prefetched_order_and_coverage(gnm):
+    eng = RelayEngine(gnm, expansion="mxu")
+    store = HostTileStore(eng.adj_tiles)
+    cache = SuperblockCache(store, budget_bytes=1 << 30)
+    demand = np.asarray(
+        [g for g in range(store.num_superblocks) if store.real_tiles(g)],
+        np.int32,
+    )
+    seen = [g for g, _ops in iter_prefetched(cache, demand)]
+    assert seen == [int(g) for g in demand]
+    assert list(iter_prefetched(cache, np.asarray([], np.int32))) == []
+
+
+# ---------------------------------------------------------------------------
+# Knob surface.
+# ---------------------------------------------------------------------------
+
+def test_tiles_mode_knob(monkeypatch):
+    from bfs_tpu.ops import relay_mxu as MX
+
+    monkeypatch.delenv("BFS_TPU_TILES", raising=False)
+    assert MX.resolve_tiles_mode() == "resident"
+    monkeypatch.setenv("BFS_TPU_TILES", "stream")
+    assert MX.resolve_tiles_mode() == "stream"
+    assert MX.resolve_tiles_mode("auto") == "auto"  # arg wins
+    monkeypatch.setenv("BFS_TPU_TILES", "paged")
+    with pytest.raises(ValueError):
+        MX.resolve_tiles_mode()
+    monkeypatch.setenv("BFS_TPU_STREAM_CACHE_GB", "0.5")
+    assert MX.stream_cache_budget_bytes() == (1 << 30) // 2
+
+
+def test_stream_requires_mxu_arm(gnm):
+    eng = RelayEngine(gnm, expansion="gather")
+    assert not eng._stream_effective()
+    with pytest.raises(ValueError, match="mxu"):
+        eng.run_streamed(SOURCE)
+
+
+def test_auto_mode_streams_only_over_budget(monkeypatch, gnm):
+    eng = RelayEngine(gnm, expansion="mxu", tiles_mode="auto")
+    monkeypatch.setenv("BFS_TPU_STREAM_CACHE_GB", "1")
+    assert not eng._stream_effective()  # tiny layout fits easily
+    monkeypatch.setenv(
+        "BFS_TPU_STREAM_CACHE_GB", str(eng.adj_tiles.nbytes / 2 / (1 << 30))
+    )
+    assert eng._stream_effective()  # layout outgrew the budget
+
+
+# ---------------------------------------------------------------------------
+# Bit-identity: streamed == resident mxu == gather, eviction forced.
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("maker", [
+    lambda: star_graph(),
+    lambda: path_graph(300),   # > packed cap: exercises unpacked rerun
+    lambda: gnm_graph(1 << 10, 3 << 10, seed=5),
+    lambda: rmat_graph(8, 8, seed=7),
+])
+def test_streamed_matches_resident_small_shapes(maker):
+    g = maker()
+    resident = RelayEngine(g, expansion="mxu", direction="auto")
+    streamed = RelayEngine(resident.relay_graph, expansion="mxu",
+                           direction="auto", tiles_mode="stream")
+    assert_same(resident.run(SOURCE), streamed.run(SOURCE))
+
+
+def test_streamed_bit_identical_under_forced_eviction(big_engines):
+    """THE acceptance core: a cache budget of one max superblock forces
+    real eviction + host re-fetch mid-traversal, and dist/parent + the
+    direction schedule still match the resident mxu arm AND the gather
+    arm bit-for-bit."""
+    stream_eng, resident_eng, gather_eng = big_engines
+    store = HostTileStore(stream_eng.adj_tiles)
+    budget = max(
+        store.sb_bytes(g) for g in range(store.num_superblocks)
+    )
+    s_res, s_curve = stream_eng.run_streamed(
+        SOURCE, telemetry=True, cache_budget_bytes=budget
+    )
+    ledger = stream_eng.stream_report
+    assert ledger["evictions"] > 0, "budget failed to force eviction"
+    assert ledger["bytes_streamed"] > 0
+    r_res, r_curve = resident_eng.run_segmented(
+        SOURCE, ckpt=_off_ckpt(), telemetry=True
+    )
+    g_res, g_curve = gather_eng.run_segmented(
+        SOURCE, ckpt=_off_ckpt(), telemetry=True
+    )
+    assert_same(s_res, r_res)
+    assert_same(s_res, g_res)
+    assert (
+        s_curve["direction_schedule"]["schedule"]
+        == r_curve["direction_schedule"]["schedule"]
+        == g_curve["direction_schedule"]["schedule"]
+    )
+    # The per-level ledger is internally consistent: totals are the sum
+    # of the per-level deltas, and only pull levels stream bytes.
+    rows = ledger["levels"]
+    assert sum(r["bytes_streamed"] for r in rows) == ledger["bytes_streamed"]
+    assert all(
+        r["bytes_streamed"] == 0 for r in rows if r["arm"] == "push"
+    )
+
+
+def test_stream_ledger_on_engine_run_routing(big_engines):
+    """run() on a stream-mode engine takes the streamed path and leaves
+    the ledger behind."""
+    stream_eng, resident_eng, _ = big_engines
+    res = stream_eng.run(SOURCE)
+    assert_same(res, resident_eng.run(SOURCE))
+    assert stream_eng.stream_report["misses"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# Checkpoint resume with a cold cache.
+# ---------------------------------------------------------------------------
+
+def _off_ckpt():
+    import tempfile
+
+    from bfs_tpu.resilience.superstep_ckpt import (
+        CkptConfig,
+        SuperstepCheckpointer,
+    )
+
+    return SuperstepCheckpointer(
+        tempfile.mkdtemp(prefix="stream_off_"), {"t": 1},
+        cfg=CkptConfig("off"),
+    )
+
+
+def _mgr(tmp_path, k=1):
+    from bfs_tpu.resilience.superstep_ckpt import (
+        CkptConfig,
+        SuperstepCheckpointer,
+    )
+
+    return SuperstepCheckpointer(
+        str(tmp_path), {"cfg": "stream-test"},
+        cfg=CkptConfig(mode="every", k=k),
+    )
+
+
+def test_streamed_resume_from_epoch_cold_cache(gnm, tmp_path):
+    """Interrupt a checkpointed streamed run mid-traversal (fault point
+    at a segment boundary), then resume with a FRESH engine — cold HBM
+    cache, cold jit caches — and require bit-identical dist/parent +
+    direction schedule plus an honest resumed_from_epoch."""
+    import os as _os
+
+    from bfs_tpu.resilience import faults
+    from bfs_tpu.resilience.faults import FaultInjected
+
+    golden_eng = RelayEngine(gnm, expansion="mxu", direction="auto",
+                             tiles_mode="stream")
+    golden, golden_curve = golden_eng.run_streamed(SOURCE, telemetry=True)
+
+    eng = RelayEngine(gnm, expansion="mxu", direction="auto",
+                      tiles_mode="stream")
+    _os.environ["BFS_TPU_FAULT"] = "raise:superstep:2"
+    faults.reset()
+    try:
+        with pytest.raises(FaultInjected):
+            eng.run_streamed(SOURCE, ckpt=_mgr(tmp_path), telemetry=True)
+    finally:
+        _os.environ.pop("BFS_TPU_FAULT", None)
+        faults.reset()
+    resumed_eng = RelayEngine(gnm, expansion="mxu", direction="auto",
+                              tiles_mode="stream")
+    mgr = _mgr(tmp_path)
+    res, curve = resumed_eng.run_streamed(SOURCE, ckpt=mgr, telemetry=True)
+    assert mgr.resumed_from_epoch is not None
+    assert_same(res, golden)
+    assert (
+        curve["direction_schedule"]["schedule"]
+        == golden_curve["direction_schedule"]["schedule"]
+    )
+    assert mgr.epochs() == []  # cleared on completion
+
+
+def test_streamed_and_segmented_epochs_interchange(gnm, tmp_path):
+    """The carry keys are the segment program's own: an epoch written by
+    the SEGMENTED runner resumes a STREAMED run bit-identically."""
+    import os as _os
+
+    from bfs_tpu.resilience import faults
+    from bfs_tpu.resilience.faults import FaultInjected
+
+    resident = RelayEngine(gnm, expansion="mxu", direction="auto")
+    golden = resident.run(SOURCE)
+    _os.environ["BFS_TPU_FAULT"] = "raise:superstep:2"
+    faults.reset()
+    try:
+        with pytest.raises(FaultInjected):
+            resident.run_segmented(SOURCE, ckpt=_mgr(tmp_path))
+    finally:
+        _os.environ.pop("BFS_TPU_FAULT", None)
+        faults.reset()
+    streamed = RelayEngine(gnm, expansion="mxu", direction="auto",
+                           tiles_mode="stream")
+    mgr = _mgr(tmp_path)
+    res = streamed.run_streamed(SOURCE, ckpt=mgr)
+    assert mgr.resumed_from_epoch is not None
+    assert_same(res, golden)
+
+
+# ---------------------------------------------------------------------------
+# Telemetry ledger shape.
+# ---------------------------------------------------------------------------
+
+def test_stream_report_shape():
+    from bfs_tpu.obs.telemetry import stream_report
+
+    rows = [
+        {"level": 1, "arm": "push", "demanded": 0, "bytes_streamed": 0,
+         "hits": 0, "misses": 0, "evictions": 0, "corrupt_refetches": 0},
+        {"level": 2, "arm": "pull", "demanded": 2, "bytes_streamed": 64,
+         "hits": 1, "misses": 2, "evictions": 1, "corrupt_refetches": 0},
+    ]
+    doc = stream_report(
+        rows, budget_bytes=128,
+        store={"num_superblocks": 2, "real_tiles": 4,
+               "host_store_bytes": 256, "max_superblock_bytes": 128},
+        cache={"hits": 5, "misses": 9},
+    )
+    assert doc["budget_bytes"] == 128
+    assert doc["bytes_streamed"] == 64 and doc["evictions"] == 1
+    assert doc["levels"] == rows and doc["levels"] is not rows
+    assert doc["cache"]["misses"] == 9
+    import json
+
+    json.dumps(doc)  # JSON-ready end to end

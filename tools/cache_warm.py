@@ -92,6 +92,12 @@ def main(argv=None) -> int:
     ap.add_argument("--block", type=int, default=8 * 1024)
     ap.add_argument("--pull", action="store_true",
                     help="also warm the ELL pull-layout bundles")
+    ap.add_argument("--tiles", action="store_true",
+                    help="also prebuild + verify the adj-tiles sidecar "
+                    "bundle per scale (the streamed arm's host-store "
+                    "feed, ISSUE 18): builds through the on-disk layout "
+                    "cache, re-loads it fingerprint-checked, and prints "
+                    "superblock counts + host-store bytes")
     ap.add_argument("--compile", action="store_true",
                     help="also AOT-compile the fused relay program per "
                     "scale (TPU backends; populates the exe cache)")
@@ -166,6 +172,37 @@ def main(argv=None) -> int:
                 f"{time.perf_counter() - t0:.1f}s",
                 flush=True,
             )
+        if args.tiles:
+            from bfs_tpu.cache.layout import (
+                LayoutCache,
+                load_or_build_tiles,
+                verify_tiles_bundle,
+            )
+            from bfs_tpu.stream import HostTileStore
+
+            tile_cache = LayoutCache()
+            t0 = time.perf_counter()
+            at, tinfo = load_or_build_tiles(rg, cache=tile_cache)
+            verdict = verify_tiles_bundle(rg, cache=tile_cache)
+            store_report = HostTileStore(at).report()
+            print(
+                f"s{scale}: adj-tiles sidecar ready in "
+                f"{time.perf_counter() - t0:.1f}s "
+                f"(cache={tinfo.get('cache')}, "
+                f"verify={'ok' if verdict['ok'] else verdict['status']})",
+                flush=True,
+            )
+            print(
+                json.dumps({
+                    "scale": scale,
+                    "tiles_key": verdict["key"],
+                    "verify_ok": verdict["ok"],
+                    **store_report,
+                }),
+                flush=True,
+            )
+            if not verdict["ok"]:
+                return 1
         if args.compile:
             if jax.default_backend() != "tpu":
                 print(

@@ -584,8 +584,13 @@ def chaos_serve(args, rng: random.Random) -> int:
 #: auto-direction, multi = batched multi-source push, sharded = the x8
 #: sharded relay with auto direction + auto exchange, grid = the 2D
 #: r x c grid engine (ISSUE 17) with per-CELL checkpoint epochs and
-#: per-axis exchange determinism.
-TRAVERSAL_CONFIGS = ("relay", "multi", "sharded", "grid")
+#: per-axis exchange determinism, stream = the host-paged mxu arm
+#: (ISSUE 18) under a one-superblock cache budget — a kill loses the
+#: HBM cache (derived content) but the resumed run must replay
+#: dist/parent and the direction schedule bit-identically with a cold
+#: cache; the stream hit/miss/bytes ledger is deliberately NOT in the
+#: deterministic key set.
+TRAVERSAL_CONFIGS = ("relay", "multi", "sharded", "grid", "stream")
 
 #: Result-document fields that must be BIT-IDENTICAL between a resumed
 #: run and the un-killed golden run (dist/parent content hashes, the

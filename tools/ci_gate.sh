@@ -29,6 +29,15 @@ if [[ "${1:-}" == "--no-tests" ]]; then
 fi
 
 if [[ "$RUN_TESTS" == "1" ]]; then
+    echo "== ci gate 0/3: warm analysis caches =="
+    # Populate the content-addressed lint caches (.bench_cache/{ir,hlo,pal})
+    # BEFORE tier-1: the suite's lint_ir/lint_hlo/lint_pallas tests then
+    # hit warm caches instead of each paying the cold jax trace/compile
+    # (~74 s) inside the pytest run, and the final lint stage is pure
+    # cache reads.  Lint FAILURES are deliberately not fatal here — this
+    # stage only warms; stage 3 is the one that gates.
+    JAX_PLATFORMS=cpu python -m bfs_tpu.analysis --all || true
+
     echo "== ci gate 1/3: tier-1 tests =="
     JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' \
         -p no:cacheprovider

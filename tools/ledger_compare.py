@@ -32,6 +32,14 @@ PHASE_ORDER = ["vperm", "broadcast", "net_apply", "rowmin", "state_update",
 #: Per-axis exchange columns of a 2D-grid capture (details.exchange).
 AXIS_KEYS = ("col_bytes", "row_bytes", "col_schedule", "row_schedule")
 
+#: Streaming-run totals of a ``details.stream`` ledger (ISSUE 18), in
+#: table order.  Like the per-axis columns, the phase is compared only
+#: when BOTH captures carry it — a streamed capture still diffs against
+#: its pre-stream golden.
+STREAM_KEYS = (
+    "bytes_streamed", "hits", "misses", "evictions", "corrupt_refetches",
+)
+
 
 def load_doc(path: str) -> dict:
     """Headline line(s) or raw ledger file -> the containing doc.  Bench
@@ -70,7 +78,9 @@ def extract(doc: dict, path: str):
     the per-level arm record).  The last element is the EXPANSION-arm
     record (ISSUE 15): ``details.expansion``'s selected arm + per-level
     arm schedule, diffed under ``--exact`` like the direction and
-    exchange schedules."""
+    exchange schedules.  A ninth element carries the ``details.stream``
+    ledger (ISSUE 18) — per-level bytes-streamed / hit / miss / evict
+    rows plus run totals — ``None`` on captures that never streamed."""
     ledger = doc
     details = doc.get("details")
     if isinstance(details, dict):
@@ -123,7 +133,12 @@ def extract(doc: dict, path: str):
         axes = {
             k: ex[k] for k in AXIS_KEYS if ex.get(k) is not None
         }
-    return phases, ledger, sched, xbytes, per_shard, xsched, esched, axes
+    stream = None
+    if isinstance(details, dict) and isinstance(details.get("stream"),
+                                                dict):
+        stream = details["stream"]
+    return (phases, ledger, sched, xbytes, per_shard, xsched, esched,
+            axes, stream)
 
 
 def fmt_s(s: float) -> str:
@@ -147,10 +162,10 @@ def main() -> int:
     )
     args = ap.parse_args()
 
-    pb, lb, sb, xb, shb, xsb, esb, axb = extract(
+    pb, lb, sb, xb, shb, xsb, esb, axb, strb = extract(
         load_doc(args.before), args.before
     )
-    pa, la, sa, xa, sha, xsa, esa, axa = extract(
+    pa, la, sa, xa, sha, xsa, esa, axa, stra = extract(
         load_doc(args.after), args.after
     )
 
@@ -260,6 +275,52 @@ def main() -> int:
                     and list(axb[k]) != list(axa[k])
                 ):
                     mismatched.append(f"exchange:{k}")
+
+    if strb or stra:
+        # Streamed-run ledger (ISSUE 18): totals row + the per-level
+        # bytes/hit/miss/evict curve.  zip to the longer level list so a
+        # level present on one side only renders as '—'; the phase is
+        # PINNED under --exact only when both captures carry it (an old
+        # pre-stream golden simply lacks details.stream).
+        def _tot(side, key):
+            return side.get(key, "—") if side else "—"
+
+        print()
+        print("| stream | " + " | ".join(STREAM_KEYS) + " |")
+        print("|---|" + "---|" * len(STREAM_KEYS))
+        print(
+            "| totals | "
+            + " | ".join(
+                f"{_tot(strb, k)} -> {_tot(stra, k)}" for k in STREAM_KEYS
+            )
+            + " |"
+        )
+        lev_b = (strb or {}).get("levels") or []
+        lev_a = (stra or {}).get("levels") or []
+        print()
+        print("| level | arm | demanded | bytes streamed | hits | misses "
+              "| evictions |")
+        print("|---|---|---|---|---|---|---|")
+
+        def _row(rows, i, key):
+            return rows[i].get(key, "—") if i < len(rows) else "—"
+
+        for i in range(max(len(lev_b), len(lev_a))):
+            cols = " | ".join(
+                f"{_row(lev_b, i, k)} -> {_row(lev_a, i, k)}"
+                for k in ("arm", "demanded", "bytes_streamed", "hits",
+                          "misses", "evictions")
+            )
+            lvl = _row(lev_b, i, "level")
+            if lvl == "—":
+                lvl = _row(lev_a, i, "level")
+            print(f"| {lvl} | {cols} |")
+        if args.exact and strb and stra:
+            for k in STREAM_KEYS:
+                if strb.get(k) != stra.get(k):
+                    mismatched.append(f"stream:{k}")
+            if lev_b != lev_a:
+                mismatched.append("stream:levels")
 
     if args.exact and xsb != xsa:
         mismatched.append("exchange_schedule")
