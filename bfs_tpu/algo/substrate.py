@@ -44,6 +44,7 @@ from dataclasses import dataclass
 
 import numpy as np
 
+from .. import knobs
 from ..ops.relax import INT32_MAX
 
 # --------------------------------------------------------------- contract --
@@ -160,7 +161,7 @@ def resolve_delta(delta: int | str | None = None) -> int:
     threshold increment (``inf`` maps to INT32_MAX: the first bucket
     already spans every finite distance)."""
     if delta is None:
-        delta = os.environ.get("BFS_TPU_SSSP_DELTA", "") or 64
+        delta = knobs.get("BFS_TPU_SSSP_DELTA")
     if isinstance(delta, str):
         if delta.lower() in ("inf", "infinite", "single"):
             return int(INT32_MAX)

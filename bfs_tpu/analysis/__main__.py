@@ -33,6 +33,15 @@ Passes:
   alignment, grid write-aliasing, dynamic-slice bounds and
   interpret-vs-XLA-twin bit parity (:mod:`bfs_tpu.analysis.pallas`).
   Same caching discipline.
+* ``--knobs`` (or the ``knobs`` subcommand) — the knob-provenance pass:
+  proves the typed env-knob registry (:mod:`bfs_tpu.knobs`) against the
+  sources (no raw ``BFS_TPU_*`` env reads, no dead registry rows), the
+  LIVE cache-key builders (every knob's ``affects`` domains match what
+  the IR/HLO/Pallas caches, probe key, bench journal and serve engine
+  fingerprint actually hash), scope discipline, README doc coverage and
+  parser round-trips (:mod:`bfs_tpu.analysis.knobs`).  Pure stdlib;
+  same caching discipline with a jax-free key.  ``--write-docs``
+  regenerates the README knob reference table from the registry first.
 * ``--all`` (or the ``all`` subcommand) — every pass in one run with
   merged baseline handling and a single exit code: the pre-merge gate
   surface ``tools/ci_gate.sh`` chains after tier-1.
@@ -113,7 +122,7 @@ def _default_ast_paths(root: str) -> list[str]:
 
 
 def _family(rule: str) -> str:
-    for fam in ("IR", "HLO", "PAL"):
+    for fam in ("IR", "HLO", "PAL", "KNB"):
         if rule.startswith(fam):
             return fam
     return "AST"
@@ -124,7 +133,9 @@ def _meta_suffix(meta: dict, tag: str, noun: str) -> str:
     including the HLO fingerprint status, whose 'missing'/'foreign'
     states mean the regression tripwires are OFF and must be visible
     on every surface that runs the pass."""
-    built = meta.get("programs", meta.get("kernels", []))
+    built = meta.get(
+        "programs", meta.get("kernels", meta.get("knobs", []))
+    )
     return (
         f"{tag}: {len(built)} {noun}(s), cache {meta['cache']}"
         + (f", skipped {sorted(meta['skipped'])}"
@@ -210,6 +221,7 @@ def _run_all(args, root: str, baseline_path: str) -> int:
         return 2
     findings = analyze_paths(_default_ast_paths(root), root)
     from . import hlo, ir, pallas
+    from . import knobs as knob_pass
 
     metas = {}
     for fam, run in (
@@ -218,6 +230,8 @@ def _run_all(args, root: str, baseline_path: str) -> int:
         ("HLO", lambda: hlo.analyze_hlo(
             use_cache=not args.no_cache, root=root)),
         ("PAL", lambda: pallas.analyze_pallas(
+            use_cache=not args.no_cache, root=root)),
+        ("KNB", lambda: knob_pass.analyze_knobs(
             use_cache=not args.no_cache, root=root)),
     ):
         fam_findings, meta = run()
@@ -236,14 +250,16 @@ def _run_all(args, root: str, baseline_path: str) -> int:
         _meta_suffix(metas[fam], tag, noun)
         for fam, tag, noun in (("IR", "ir", "program"),
                                ("HLO", "hlo", "program"),
-                               ("PAL", "pal", "kernel"))
+                               ("PAL", "pal", "kernel"),
+                               ("KNB", "knb", "knob"))
     )
     return _report(
         args, findings, baseline,
         stale_filter=lambda r: enforced[_family(r)],
         label="[--all]", meta_suffix=f" [{per_pass}]",
         json_extra={"passes": {"ir": metas["IR"], "hlo": metas["HLO"],
-                               "pal": metas["PAL"]}},
+                               "pal": metas["PAL"],
+                               "knb": metas["KNB"]}},
     )
 
 
@@ -257,6 +273,8 @@ def main(argv=None) -> int:
         argv = ["--hlo"] + argv[1:]
     elif argv and argv[0] == "pallas":  # subcommand spelling of --pallas
         argv = ["--pallas"] + argv[1:]
+    elif argv and argv[0] == "knobs":  # subcommand spelling of --knobs
+        argv = ["--knobs"] + argv[1:]
     elif argv and argv[0] == "all":  # subcommand spelling of --all
         argv = ["--all"] + argv[1:]
     ap = argparse.ArgumentParser(
@@ -291,10 +309,20 @@ def main(argv=None) -> int:
                          "every registered kernel at lint scale: VMEM "
                          "proofs, tile alignment, grid-aliasing, ds "
                          "bounds, interpret-vs-XLA parity; imports jax)")
+    ap.add_argument("--knobs", action="store_true",
+                    help="run the knob-provenance pass instead (proves "
+                         "the typed env-knob registry against the "
+                         "sources, the live cache-key builders, the "
+                         "README table and the parsers; pure stdlib)")
+    ap.add_argument("--write-docs", action="store_true",
+                    help="knob pass: regenerate the README knob "
+                         "reference table from the registry before "
+                         "analyzing")
     ap.add_argument("--all", action="store_true", dest="all_passes",
-                    help="run every pass (AST + IR + HLO + Pallas) with "
-                         "merged baseline handling and one exit code — "
-                         "the pre-merge gate surface (tools/ci_gate.sh)")
+                    help="run every pass (AST + IR + HLO + Pallas + "
+                         "Knobs) with merged baseline handling and one "
+                         "exit code — the pre-merge gate surface "
+                         "(tools/ci_gate.sh)")
     ap.add_argument("--no-cache", action="store_true",
                     help="IR/HLO pass: ignore the content-addressed result "
                          "cache")
@@ -318,7 +346,8 @@ def main(argv=None) -> int:
     baseline_path = args.baseline or default_baseline_path()
 
     picked = [f for f, on in (("--ir", args.ir), ("--hlo", args.hlo),
-                              ("--pallas", args.pallas)) if on]
+                              ("--pallas", args.pallas),
+                              ("--knobs", args.knobs)) if on]
     if len(picked) > 1:
         print(f"analysis: {' and '.join(picked)} are separate passes — "
               "run one at a time", file=sys.stderr)
@@ -331,6 +360,10 @@ def main(argv=None) -> int:
         print("analysis: --update-fingerprints/--snapshot only apply to "
               "the --hlo pass", file=sys.stderr)
         return 2
+    if args.write_docs and not args.knobs:
+        print("analysis: --write-docs only applies to the --knobs pass",
+              file=sys.stderr)
+        return 2
     if args.all_passes and args.write_baseline:
         print("analysis: --write-baseline spans one pass at a time — run "
               "it without --all (AST regenerates, --ir/--hlo/--pallas "
@@ -340,7 +373,7 @@ def main(argv=None) -> int:
     if args.all_passes:
         return _run_all(args, root, baseline_path)
 
-    if args.ir or args.hlo or args.pallas:
+    if args.ir or args.hlo or args.pallas or args.knobs:
         pass_name = picked[0]
         if args.paths or args.changed:
             print(
@@ -364,6 +397,22 @@ def main(argv=None) -> int:
                 use_cache=not args.no_cache, root=root
             )
             rule_family = lambda r: _family(r) == "PAL"  # noqa: E731
+        elif args.knobs:
+            # Alias: the pass module shares its name with the registry
+            # it proves (bfs_tpu.knobs vs bfs_tpu.analysis.knobs).
+            from . import knobs as knob_pass
+
+            if args.write_docs:
+                changed = knob_pass.write_docs(root=root)
+                print(
+                    "analysis: README knob table "
+                    + ("regenerated" if changed else "already current"),
+                    file=sys.stderr,
+                )
+            findings, meta = knob_pass.analyze_knobs(
+                use_cache=not args.no_cache, root=root
+            )
+            rule_family = lambda r: _family(r) == "KNB"  # noqa: E731
         else:
             from . import hlo
 
@@ -460,11 +509,13 @@ def main(argv=None) -> int:
 
     if args.write_baseline:
         errors = [f for f in findings if f.severity == "error"]
-        if args.ir or args.hlo or args.pallas:
-            # Never clobber the committed file from the IR/HLO/Pallas
-            # passes: its entries span ALL passes.  Print the lines to
-            # curate in.
-            which = "IR" if args.ir else ("PAL" if args.pallas else "HLO")
+        if args.ir or args.hlo or args.pallas or args.knobs:
+            # Never clobber the committed file from the jax/knob passes:
+            # its entries span ALL passes.  Print the lines to curate in.
+            which = ("IR" if args.ir
+                     else "PAL" if args.pallas
+                     else "KNB" if args.knobs
+                     else "HLO")
             print(Baseline.render(errors), end="")
             print(
                 f"analysis: {len(errors)} {which} finding(s) rendered "
@@ -500,8 +551,13 @@ def main(argv=None) -> int:
         return 0
 
     if meta is not None:
-        tag = "hlo" if args.hlo else ("pal" if args.pallas else "ir")
-        noun = "kernel" if args.pallas else "program"
+        tag = ("hlo" if args.hlo
+               else "pal" if args.pallas
+               else "knb" if args.knobs
+               else "ir")
+        noun = ("kernel" if args.pallas
+                else "knob" if args.knobs
+                else "program")
         meta_suffix = f" [{_meta_suffix(meta, tag, noun)}]"
         json_extra = {"ir": meta}
     else:

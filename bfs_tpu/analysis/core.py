@@ -202,6 +202,38 @@ RULES: dict[str, tuple[str, str]] = {
                "interpret-mode output is not bit-identical to its "
                "shipping XLA fallback twin — one of the two is wrong "
                "on every backend that selects it"),
+    # -- Knob-provenance rules (bfs_tpu.analysis.knobs — proves the
+    # typed env-knob registry against the sources, the live cache-key
+    # builders and the docs; the fifth rung: AST = source, jaxpr = what
+    # we ask, HLO = what XLA emits, PAL = what the kernels do, KNB =
+    # what the knobs that select between all of the above mean) ----------
+    "KNB000": ("error",
+               "knob pass could not prove a surface: a lint-surface "
+               "module failed to parse or a cache-key provider failed "
+               "to import — an unprovable key is an unkeyed one"),
+    "KNB001": ("error",
+               "knob provenance broken: a raw os.environ read of a "
+               "BFS_TPU_* name bypasses the typed accessor, an "
+               "accessor reads an unregistered name, or a registered "
+               "knob has no live read site (dead registry row)"),
+    "KNB002": ("error",
+               "cache-key completeness broken: a knob's declared "
+               "affects domains disagree with the LIVE flavor tuple a "
+               "cache/journal/engine key actually hashes — a warm "
+               "entry would replay under a knob it was never keyed on"),
+    "KNB003": ("error",
+               "knob scope discipline broken: a call-scoped knob is "
+               "baked into an import-time constant, or a knob is read "
+               "inside a traced region (the value burns into the "
+               "compiled program while looking like a runtime switch)"),
+    "KNB004": ("error",
+               "knob doc coverage broken: a registered knob has no "
+               "README reference-table row, or a table row documents a "
+               "knob that no longer exists"),
+    "KNB005": ("error",
+               "knob parser round-trip broken: a registered default is "
+               "rejected by its own parser, a canary is accepted, or a "
+               "rejection error fails to name the offending env var"),
 }
 
 

@@ -64,19 +64,24 @@ import os
 import re
 from dataclasses import dataclass, field
 
+from .. import knobs
 from .core import Finding
 from .ir import (
     PROGRAM_SPECS,
     Program,
     SkipProgram,
     _ensure_jax_env,
-    _FLAVOR_ENV,
     _source_fingerprint,
     repo_root,
 )
 
 #: Bump to invalidate every cached HLO result (rule semantics changed).
 HLO_VERSION = 1
+
+#: Env knobs keying the HLO result cache — DERIVED from the registry
+#: (``affects`` contains ``hlo``); KNB002 proves membership against
+#: bfs_tpu/knobs.py both ways.
+_HLO_FLAVOR_ENV = knobs.flavor_env("hlo")
 
 #: Temp-bytes regression tolerance over the committed fingerprint.
 TEMP_REGRESSION_RATIO = 0.10
@@ -477,7 +482,7 @@ def write_fingerprints(path: str, fingerprints: dict) -> None:
 # --------------------------------------------------------------------------
 
 def default_cache_dir(root: str | None = None) -> str:
-    env = os.environ.get("BFS_TPU_HLO_CACHE", "")
+    env = knobs.raw("BFS_TPU_HLO_CACHE") or ""
     if env:
         return env
     return os.path.join(root or repo_root(), ".bench_cache", "hlo")
@@ -493,7 +498,7 @@ def _cache_key(root: str, fingerprints_path: str) -> str:
     h.update(str(len(jax.devices())).encode())
     h.update(str(HLO_VERSION).encode())
     h.update(",".join(sorted(PROGRAM_SPECS)).encode())
-    for env in _FLAVOR_ENV:
+    for env in _HLO_FLAVOR_ENV:
         h.update(f"{env}={os.environ.get(env, '')};".encode())
     # The committed fingerprint file is a rule input: edit it and the
     # regression findings change, so the cache must miss.

@@ -48,20 +48,17 @@ import os
 import sys
 from dataclasses import dataclass, field
 
+from .. import knobs
 from .core import Finding
 
 #: Bump to invalidate every cached IR result (rule semantics changed).
 IR_VERSION = 1
 
-#: Env knobs that change which program flavors the registry builds.
-_FLAVOR_ENV = (
-    "BFS_TPU_DIRECTION", "BFS_TPU_DIRECTION_ALPHA", "BFS_TPU_DIRECTION_BETA",
-    "BFS_TPU_PACKED", "BFS_TPU_PALLAS", "BFS_TPU_ROWMIN",
-    "BFS_TPU_STATE_UPDATE", "BFS_TPU_IR_HBM_GB",
-    "BFS_TPU_EXCHANGE", "BFS_TPU_EXCHANGE_DIV",
-    "BFS_TPU_EXPANSION", "BFS_TPU_MXU_KERNEL", "BFS_TPU_TILES_BUILD",
-    "BFS_TPU_MESH",
-)
+#: Env knobs that change which program flavors the registry builds —
+#: DERIVED from the registry (``affects`` contains ``ir``); KNB002
+#: proves membership against bfs_tpu/knobs.py both ways instead of a
+#: hand-maintained list (the PR 15 stale-cache bug class).
+_FLAVOR_ENV = knobs.flavor_env("ir")
 
 #: Primitives whose presence in a loop body is a host round-trip (IR002).
 _CALLBACK_PRIMS = frozenset({
@@ -355,7 +352,7 @@ def _hbm_envelope() -> int:
     """Per-chip HBM budget the IR004 proof checks against.
     ``BFS_TPU_IR_HBM_GB`` overrides (e.g. a bench-scale run proving a
     real config); the default is the v5e envelope."""
-    return int(float(os.environ.get("BFS_TPU_IR_HBM_GB", "16")) * (1 << 30))
+    return int(knobs.get("BFS_TPU_IR_HBM_GB") * (1 << 30))
 
 
 _BUILD_CACHE: dict = {}
@@ -1381,7 +1378,7 @@ def _cache_key(root: str) -> str:
 
 
 def default_cache_dir(root: str | None = None) -> str:
-    env = os.environ.get("BFS_TPU_IR_CACHE", "")
+    env = knobs.raw("BFS_TPU_IR_CACHE") or ""
     if env:
         return env
     return os.path.join(root or repo_root(), ".bench_cache", "ir")

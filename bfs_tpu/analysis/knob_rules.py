@@ -1,0 +1,487 @@
+"""Rule logic for the knob-provenance pass (KNB001–KNB005).
+
+Pure functions over ASTs and the registry — no caching, no CLI; the
+pass driver (:mod:`bfs_tpu.analysis.knobs`) owns surfaces and the
+content-addressed result cache.  Everything here is stdlib-only
+(``ast`` + ``re``): the rung must run in tier-1 on a bare CPU image,
+and discovering ``os.environ`` reads must work even in modules that
+would fail to import.
+
+The contract being proven (ISSUE 19): every ``BFS_TPU_*`` env read in
+the shipped code goes through the typed registry accessors
+(:func:`bfs_tpu.knobs.get` / :func:`bfs_tpu.knobs.raw`), every
+registered knob is actually read somewhere (a registry row whose read
+sites vanished is as fatal as an unregistered read — the PAL000
+both-ways pin, applied to knobs), every knob's declared ``affects``
+set matches the LIVE key builders (imported, not grepped), no
+call-scoped knob is baked into an import-time constant or read inside
+a traced region, every knob has a README table row, and every parser
+round-trips its default while rejecting its canary.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib
+import re
+
+from .. import knobs as registry
+from .core import Finding, SourceFile, dotted_name, hot_regions
+
+#: Accessor spellings counted as registry reads: ``knobs.get(...)`` /
+#: ``knobs.raw(...)`` — the uniform ``from .. import knobs`` binding —
+#: plus the in-registry spellings ``get``/``raw``/``parse_value`` used
+#: by bfs_tpu/knobs.py itself (exempted from KNB001 separately).
+_ACCESSOR_ATTRS = frozenset({"get", "raw"})
+
+_KNOB_NAME = re.compile(r"BFS_TPU_\w+")
+
+
+def _literal_knob(node) -> str | None:
+    """The ``BFS_TPU_*`` literal at ``node``, else None (non-literal
+    knob names — e.g. ``for e in _FLAVOR_ENV: os.environ.get(e)`` in
+    the key builders — are out of KNB001 scope by design: the loops
+    iterate registry-derived tuples that KNB002 proves instead)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        if node.value.startswith("BFS_TPU_"):
+            return node.value
+    return None
+
+
+def _is_environ(node) -> bool:
+    """True for any expression spelling ``...environ`` (``os.environ``,
+    a bare ``environ`` import, ``__import__('os').environ``)."""
+    return (
+        (isinstance(node, ast.Attribute) and node.attr == "environ")
+        or (isinstance(node, ast.Name) and node.id == "environ")
+    )
+
+
+def iter_env_reads(tree: ast.AST):
+    """Yield ``(node, knob_name, kind)`` for every RAW env read of a
+    literal ``BFS_TPU_*`` name: ``kind`` is ``'get'`` (``environ.get``
+    / ``getenv``) or ``'subscript'`` (``environ[...]`` in Load
+    context).  Writes (``environ[...] = ``, ``setdefault``, ``pop``,
+    ``del``) are deliberately NOT reads — the save/restore fixtures and
+    the bench's setdefault defaults are legitimate raw-env surface."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if (
+                isinstance(fn, ast.Attribute)
+                and fn.attr == "get"
+                and _is_environ(fn.value)
+                and node.args
+            ):
+                name = _literal_knob(node.args[0])
+                if name:
+                    yield node, name, "get"
+            elif (
+                dotted_name(fn) in ("os.getenv", "getenv") and node.args
+            ):
+                name = _literal_knob(node.args[0])
+                if name:
+                    yield node, name, "get"
+        elif isinstance(node, ast.Subscript):
+            if (
+                isinstance(node.ctx, ast.Load)
+                and _is_environ(node.value)
+            ):
+                name = _literal_knob(node.slice)
+                if name:
+                    yield node, name, "subscript"
+
+
+def iter_accessor_reads(tree: ast.AST):
+    """Yield ``(node, knob_name, attr)`` for every ``knobs.get("...")``
+    / ``knobs.raw("...")`` call with a literal knob argument."""
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = node.func
+        if (
+            isinstance(fn, ast.Attribute)
+            and fn.attr in _ACCESSOR_ATTRS
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id == "knobs"
+            and node.args
+        ):
+            name = _literal_knob(node.args[0])
+            if name:
+                yield node, name, fn.attr
+
+
+# --------------------------------------------------------------------------
+# KNB001 — provenance: raw reads, unregistered names, vanished rows.
+# --------------------------------------------------------------------------
+
+def check_provenance(
+    sources: list[SourceFile],
+    knob_table: dict | None = None,
+    registry_path: str = "bfs_tpu/knobs.py",
+) -> list[Finding]:
+    """KNB001 over the whole surface, both directions:
+
+    * a raw ``os.environ``/``getenv`` read of a literal ``BFS_TPU_*``
+      name anywhere outside the registry module itself — registered or
+      not — bypasses the typed accessor (a typo'd value silently
+      changes what a capture measured);
+    * an accessor read of a name the registry doesn't carry (the
+      accessor would raise at runtime; the lint catches it statically);
+    * a registered knob with NO literal accessor read anywhere on the
+      surface — a dead row is a doc/key entry for a knob nothing obeys,
+      exactly as wrong as an unregistered read (set equality, pinned
+      both ways like PAL000's kernel-site pin).
+    """
+    table = registry.KNOBS if knob_table is None else knob_table
+    findings: list[Finding] = []
+    read_names: set[str] = set()
+    for src in sources:
+        in_registry_module = src.path == registry_path
+        for node, name, kind in iter_env_reads(src.tree):
+            if in_registry_module:
+                continue  # knobs.py IS the accessor implementation
+            spelled = (
+                "os.environ[...]" if kind == "subscript"
+                else "os.environ.get/getenv"
+            )
+            if name in table:
+                msg = (
+                    f"raw {spelled} read of registered knob {name} "
+                    "bypasses the typed accessor — use knobs.get "
+                    "(typed, validated) or knobs.raw (path knobs)"
+                )
+            else:
+                msg = (
+                    f"env read of unregistered knob {name} — every "
+                    "BFS_TPU_* knob must carry a bfs_tpu/knobs.py row "
+                    "(parser, default, affects) before it is read"
+                )
+            f = src.finding("KNB001", node, msg)
+            if f:
+                findings.append(f)
+        for node, name, _attr in iter_accessor_reads(src.tree):
+            read_names.add(name)
+            if name not in table:
+                f = src.finding(
+                    "KNB001", node,
+                    f"accessor read of unregistered knob {name} — "
+                    "knobs.get/raw would raise KnobError at runtime; "
+                    "add the registry row",
+                )
+                if f:
+                    findings.append(f)
+    for name in sorted(set(table) - read_names):
+        findings.append(Finding(
+            rule="KNB001", path=registry_path, line=0, col=0,
+            message=(
+                f"registered knob {name} has no accessor read site "
+                "anywhere on the lint surface — its read sites "
+                "vanished; prune the registry row or restore the read "
+                "(a dead row documents and keys a knob nothing obeys)"
+            ),
+            snippet=f"knb:{name}:unread",
+        ))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# KNB002 — cache-key completeness against the LIVE key builders.
+# --------------------------------------------------------------------------
+
+#: domain -> (module, attribute) holding the live tuple of knob names
+#: that key that cache/config.  Imported (not grepped): the proof is
+#: about what the running key builders actually hash.
+KEY_PROVIDERS: dict[str, tuple[str, str]] = {
+    "ir": ("bfs_tpu.analysis.ir", "_FLAVOR_ENV"),
+    "hlo": ("bfs_tpu.analysis.hlo", "_HLO_FLAVOR_ENV"),
+    "pal": ("bfs_tpu.analysis.pallas", "_PAL_FLAVOR_ENV"),
+    "probe": ("bfs_tpu.cache.layout", "_PROBE_ENV"),
+    "journal": ("bfs_tpu.resilience.journal", "ENV_CONFIG_KEYS"),
+    "serve": ("bfs_tpu.serve.registry", "ENGINE_FLAVOR_ENV"),
+}
+
+
+def check_key_completeness(
+    knob_table: dict | None = None,
+    providers: dict | None = None,
+    registry_path: str = "bfs_tpu/knobs.py",
+) -> list[Finding]:
+    """KNB002/KNB000: import every key provider and set-compare its
+    live tuple against the registry's ``affects`` declarations, both
+    ways.  A behavior knob missing from a flavor list is the PR 15 bug
+    class (a warm cache hit replayed under a knob it was never keyed
+    on); an extra name is a key hashing a knob that declares no effect
+    — either the declaration or the key builder is lying.  A provider
+    that cannot be imported is KNB000: an unprovable key is an unkeyed
+    one.  ``providers`` entries may also be ``(tuple, None)``-style
+    pre-resolved sequences (test fixtures)."""
+    table = registry.KNOBS if knob_table is None else knob_table
+    provs = KEY_PROVIDERS if providers is None else providers
+    findings: list[Finding] = []
+    for domain in sorted(provs):
+        spec = provs[domain]
+        declared = {
+            k.name for k in table.values() if domain in k.affects
+        }
+        is_ref = (
+            isinstance(spec, tuple)
+            and len(spec) == 2
+            and all(isinstance(s, str) for s in spec)
+            and "." in spec[0]
+        )
+        if is_ref:
+            mod_name, attr = spec
+            try:
+                mod = importlib.import_module(mod_name)
+                live = set(getattr(mod, attr))
+            except Exception as exc:  # import error, missing attr
+                findings.append(Finding(
+                    rule="KNB000", path=registry_path, line=0, col=0,
+                    message=(
+                        f"[{domain}] key provider {mod_name}.{attr} "
+                        f"failed to import: {type(exc).__name__}: {exc}"
+                        " — a key builder that cannot be checked is "
+                        "unproven"
+                    ),
+                    snippet=f"knb:{domain}:provider",
+                ))
+                continue
+            where = f"{mod_name}.{attr}"
+        else:  # pre-resolved sequence (test fixture)
+            live = set(spec)
+            where = f"<fixture:{domain}>"
+        for name in sorted(declared - live):
+            findings.append(Finding(
+                rule="KNB002", path=registry_path, line=0, col=0,
+                message=(
+                    f"{name} declares affects['{domain}'] but is "
+                    f"MISSING from {where} — a warm cache/journal "
+                    "entry would replay under a knob value it was "
+                    "never keyed on (the PR 15 stale-flavor bug class)"
+                ),
+                snippet=f"knb:{name}:{domain}:unkeyed",
+            ))
+        for name in sorted(live - declared):
+            findings.append(Finding(
+                rule="KNB002", path=registry_path, line=0, col=0,
+                message=(
+                    f"{where} keys on {name} which does not declare "
+                    f"affects['{domain}'] — either declare it in "
+                    "bfs_tpu/knobs.py or stop keying on it"
+                ),
+                snippet=f"knb:{name}:{domain}:undeclared",
+            ))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# KNB003 — scope discipline: import-baked call knobs, traced-region reads.
+# --------------------------------------------------------------------------
+
+def _enclosing_functions(tree: ast.AST) -> dict[int, bool]:
+    """Map of line -> True for lines lexically inside any function body
+    (module/class level lines are absent)."""
+    covered: dict[int, bool] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for ln in range(node.lineno, (node.end_lineno or node.lineno) + 1):
+                covered[ln] = True
+    return covered
+
+
+def check_scope(
+    sources: list[SourceFile], knob_table: dict | None = None
+) -> list[Finding]:
+    """KNB003, two shapes:
+
+    * a ``scope='call'`` knob read at module/class level — the value is
+      baked into an import-time constant, so an env change (or a test
+      monkeypatch) after import silently does nothing; only knobs
+      DECLARED ``scope='import'`` (the kernel-geometry constants) may
+      be read there;
+    * any knob accessor read lexically inside a TRACED hot region — the
+      read executes at trace time and its value is burned into the
+      compiled program while looking like a runtime switch; resolve the
+      knob outside and pass the value in.
+    """
+    table = registry.KNOBS if knob_table is None else knob_table
+    findings: list[Finding] = []
+    for src in sources:
+        in_fn = _enclosing_functions(src.tree)
+        traced_spans = [
+            (r.start, r.end) for r in hot_regions(src) if r.traced
+        ]
+        for node, name, attr in iter_accessor_reads(src.tree):
+            k = table.get(name)
+            if k is None:
+                continue  # KNB001's finding already covers it
+            line = getattr(node, "lineno", 0)
+            if not in_fn.get(line) and k.scope != "import":
+                f = src.finding(
+                    "KNB003", node,
+                    f"call-scoped knob {name} read at import time — "
+                    "the value is baked into a module constant, so "
+                    "later env changes silently do nothing; move the "
+                    "read into the resolve path or declare "
+                    "scope='import' in its registry row",
+                )
+                if f:
+                    findings.append(f)
+            for start, end in traced_spans:
+                if start <= line <= end:
+                    f = src.finding(
+                        "KNB003", node,
+                        f"knob {name} read inside traced region "
+                        f"(lines {start}-{end}) — the env read "
+                        "executes at trace time and the value is "
+                        "burned into the compiled program; resolve "
+                        "it outside the trace and pass it in",
+                    )
+                    if f:
+                        findings.append(f)
+                    break
+    return findings
+
+
+# --------------------------------------------------------------------------
+# KNB004 — README doc coverage, both ways.
+# --------------------------------------------------------------------------
+
+def readme_knob_rows(readme_text: str) -> dict[str, int]:
+    """``{knob name: first line number}`` for every markdown table row
+    anywhere in the README whose FIRST cell names a ``BFS_TPU_*`` var
+    (backticks stripped).  Separator rows (``| --- |``) don't match."""
+    rows: dict[str, int] = {}
+    for i, line in enumerate(readme_text.splitlines(), start=1):
+        s = line.strip()
+        if not s.startswith("|"):
+            continue
+        first = s.strip("|").split("|", 1)[0].strip().strip("`")
+        m = _KNOB_NAME.fullmatch(first)
+        if m and first not in rows:
+            rows[first] = i
+    return rows
+
+
+def check_docs(
+    readme_text: str,
+    knob_table: dict | None = None,
+    readme_path: str = "README.md",
+) -> list[Finding]:
+    """KNB004 both ways: every registered knob has a README table row
+    (the generated reference table — ``bfs-tpu-lint --knobs
+    --write-docs`` — guarantees this mechanically) and every README
+    table row whose first cell names a ``BFS_TPU_*`` var names a LIVE
+    knob (a stale row documents a knob that no longer exists)."""
+    table = registry.KNOBS if knob_table is None else knob_table
+    rows = readme_knob_rows(readme_text)
+    findings: list[Finding] = []
+    for name in sorted(set(table) - set(rows)):
+        findings.append(Finding(
+            rule="KNB004", path=readme_path, line=0, col=0,
+            message=(
+                f"registered knob {name} has no README table row — "
+                "regenerate the reference table with `bfs-tpu-lint "
+                "--knobs --write-docs`"
+            ),
+            snippet=f"knb:{name}:undocumented",
+        ))
+    for name in sorted(set(rows) - set(table)):
+        findings.append(Finding(
+            rule="KNB004", path=readme_path, line=rows[name], col=0,
+            message=(
+                f"README table row documents {name} which is not a "
+                "registered knob — stale doc row; prune it or "
+                "register the knob"
+            ),
+            snippet=f"knb:{name}:stale-row",
+        ))
+    return findings
+
+
+# --------------------------------------------------------------------------
+# KNB005 — parser round-trip: defaults parse, canaries reject.
+# --------------------------------------------------------------------------
+
+#: Kinds whose parsers accept ANY string, so no canary can exist.
+_FREEFORM_KINDS = frozenset({"str", "path"})
+
+
+def check_parsers(
+    knob_table: dict | None = None,
+    registry_path: str = "bfs_tpu/knobs.py",
+) -> list[Finding]:
+    """KNB005: for every knob, the registered default must be inside
+    its own parser's domain (``knobs.get`` with the var unset must
+    never raise), and the registered canary must be REJECTED with a
+    :class:`~bfs_tpu.knobs.KnobError` whose message names the knob (the
+    operator-facing contract: a typo'd env var tells you WHICH var).
+    A missing canary is itself a finding except for the freeform
+    ``str``/``path`` kinds, which accept everything."""
+    table = registry.KNOBS if knob_table is None else knob_table
+    findings: list[Finding] = []
+    for name in sorted(table):
+        k = table[name]
+        try:
+            if knob_table is None:
+                registry.parse_value(name, k.default)
+            else:
+                k.parse(k.default)
+        except Exception as exc:
+            findings.append(Finding(
+                rule="KNB005", path=registry_path, line=0, col=0,
+                message=(
+                    f"{name}: registered default {k.default!r} is "
+                    f"rejected by its own parser ({exc}) — every "
+                    "unset-env read would raise"
+                ),
+                snippet=f"knb:{name}:default-rejected",
+            ))
+            continue
+        if k.canary is None:
+            if k.kind not in _FREEFORM_KINDS:
+                findings.append(Finding(
+                    rule="KNB005", path=registry_path, line=0, col=0,
+                    message=(
+                        f"{name}: no canary registered — a "
+                        f"{k.kind}-kind parser must demonstrably "
+                        "reject SOMETHING, or validation is "
+                        "untestable"
+                    ),
+                    snippet=f"knb:{name}:no-canary",
+                ))
+            continue
+        try:
+            if knob_table is None:
+                registry.parse_value(name, k.canary)
+            else:
+                k.parse(k.canary)
+            rejected, named = False, False
+        except registry.KnobError as exc:
+            rejected, named = True, name in str(exc)
+        except (ValueError, TypeError) as exc:
+            # Fixture tables call k.parse directly (no KnobError wrap);
+            # the live registry path always wraps.
+            rejected = True
+            named = knob_table is not None or name in str(exc)
+        if not rejected:
+            findings.append(Finding(
+                rule="KNB005", path=registry_path, line=0, col=0,
+                message=(
+                    f"{name}: canary {k.canary!r} was ACCEPTED by the "
+                    "parser — the canary must be outside the domain, "
+                    "or the parser lost its validation"
+                ),
+                snippet=f"knb:{name}:canary-accepted",
+            ))
+        elif not named:
+            findings.append(Finding(
+                rule="KNB005", path=registry_path, line=0, col=0,
+                message=(
+                    f"{name}: rejection error does not name the knob "
+                    "— operators must see WHICH env var is bad"
+                ),
+                snippet=f"knb:{name}:error-unnamed",
+            ))
+    return findings

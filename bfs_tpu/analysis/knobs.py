@@ -1,0 +1,250 @@
+"""The knob-provenance pass — fifth analyzer rung (KNB).
+
+``bfs-tpu-lint --knobs`` proves the env-knob contract the typed registry
+(:mod:`bfs_tpu.knobs`) establishes, the same way the Pallas rung proves
+the kernel contract:
+
+* **KNB001** — provenance, both ways: no raw ``os.environ`` read of a
+  ``BFS_TPU_*`` name outside the registry module, no accessor read of an
+  unregistered name, and no registered knob without a live read site
+  (set equality, pinned like PAL000's kernel-site pin).
+* **KNB002** — cache-key completeness against the LIVE key builders
+  (imported, not grepped): every knob's ``affects`` domains match the
+  flavor tuples the IR/HLO/Pallas caches, the probe verdict key, the
+  bench journal and the serve engine fingerprint actually hash.
+* **KNB003** — scope discipline: call-scoped knobs never baked into
+  import-time constants, no knob read inside a traced hot region.
+* **KNB004** — README doc coverage, both ways (stale rows fail).
+* **KNB005** — parser round-trip: every default parses, every canary is
+  rejected with an error naming the knob.
+
+The pass is pure stdlib (AST + the registry + one import per key
+provider) — no jax in the cache key, so results are content-addressed on
+the lint surface alone and a warm ``--all`` pays zero extra wall time.
+Findings share ``baseline.txt`` with the other rungs via synthetic
+``knb:<name>:<detail>`` snippets (line-number independent, like the
+PAL000 pin).  ``--write-docs`` regenerates the README knob reference
+table between the ``knob-table`` markers straight from the registry,
+which is what keeps KNB004 mechanically satisfiable.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+from .. import knobs
+from . import iter_python_files
+from .core import Finding, SourceFile
+from .ir import repo_root
+from .knob_rules import (
+    check_docs,
+    check_key_completeness,
+    check_parsers,
+    check_provenance,
+    check_scope,
+)
+
+#: Bump on any rule-semantics change: old cached verdicts must not
+#: satisfy a stricter pass.
+KNB_VERSION = 1
+
+_DOC_BEGIN = "<!-- knob-table:begin -->"
+_DOC_END = "<!-- knob-table:end -->"
+
+
+def default_cache_dir(root: str | None = None) -> str:
+    env = knobs.raw("BFS_TPU_KNB_CACHE") or ""
+    if env:
+        return env
+    return os.path.join(root or repo_root(), ".bench_cache", "knb")
+
+
+def _surface_paths(root: str) -> list[str]:
+    """The lint surface: the package, the tools scripts and the root
+    ``bench.py`` shim — everywhere shipped code could read env."""
+    out = []
+    for rel in ("bfs_tpu", "tools", "bench.py"):
+        p = os.path.join(root, rel)
+        if os.path.exists(p):
+            out.append(p)
+    return out
+
+
+def _collect_sources(root: str) -> tuple[list[SourceFile], list[Finding]]:
+    sources: list[SourceFile] = []
+    findings: list[Finding] = []
+    for path in iter_python_files(_surface_paths(root)):
+        try:
+            sources.append(SourceFile(path, root))
+        except SyntaxError as exc:
+            rel = os.path.relpath(
+                os.path.abspath(path), root
+            ).replace(os.sep, "/")
+            findings.append(Finding(
+                rule="KNB000", path=rel, line=exc.lineno or 0, col=0,
+                message=f"could not parse: {exc.msg}",
+                snippet=f"knb:parse:{rel}",
+            ))
+    return sources, findings
+
+
+def _knb_fingerprint(root: str) -> str:
+    """Content hash of everything the pass reads: the lint surface
+    (which includes the registry itself and every key-provider module)
+    plus the README (KNB004's input) plus the pass version.  No jax
+    version, no env values — the pass is static and env-independent, so
+    the key must be too (an env change must NOT fork the verdict)."""
+    h = hashlib.blake2b(digest_size=16)
+    for path in iter_python_files(_surface_paths(root)):
+        h.update(os.path.relpath(path, root).encode())
+        with open(path, "rb") as f:
+            h.update(f.read())
+    readme = os.path.join(root, "README.md")
+    if os.path.exists(readme):
+        with open(readme, "rb") as f:
+            h.update(f.read())
+    h.update(str(KNB_VERSION).encode())
+    return h.hexdigest()
+
+
+def _finding_to_dict(f: Finding) -> dict:
+    return {
+        "rule": f.rule, "path": f.path, "line": f.line, "col": f.col,
+        "message": f.message, "snippet": f.snippet,
+    }
+
+
+def analyze_knobs(
+    knob_table: dict | None = None,
+    *,
+    providers: dict | None = None,
+    readme_text: str | None = None,
+    use_cache: bool = True,
+    cache_dir: str | None = None,
+    root: str | None = None,
+) -> tuple[list, dict]:
+    """Run the knob pass.  Returns ``(findings, meta)``; ``meta``
+    records cache disposition and the knob names checked.  The three
+    override parameters feed test fixtures (a synthetic registry, a
+    pre-resolved provider map, a README body); any override disables
+    the cache and — for a custom table — the live-registry pins, since
+    only the canonical registry proves the repo."""
+    root = root or repo_root()
+    custom = (
+        knob_table is not None
+        or providers is not None
+        or readme_text is not None
+    )
+    table = knobs.KNOBS if knob_table is None else knob_table
+    meta: dict = {
+        "cache": "off" if (custom or not use_cache) else "miss",
+        "knobs": sorted(table), "skipped": {},
+    }
+
+    cache_path = None
+    if not custom and use_cache:
+        key = _knb_fingerprint(root)
+        cache_path = os.path.join(
+            cache_dir or default_cache_dir(root), f"knb_{key}.json"
+        )
+        if os.path.exists(cache_path):
+            try:
+                with open(cache_path, encoding="utf-8") as fh:
+                    doc = json.load(fh)
+                meta.update(doc.get("meta", {}))
+                meta["cache"] = "hit"
+                return [Finding(**d) for d in doc["findings"]], meta
+            except (ValueError, KeyError, TypeError):
+                pass  # corrupt cache entry: recompute and overwrite
+
+    sources, findings = _collect_sources(root)
+    findings.extend(check_provenance(sources, knob_table))
+    findings.extend(check_key_completeness(knob_table, providers))
+    findings.extend(check_scope(sources, knob_table))
+    readme_path = os.path.join(root, "README.md")
+    if readme_text is None:
+        if os.path.exists(readme_path):
+            with open(readme_path, encoding="utf-8") as fh:
+                readme_text = fh.read()
+        else:
+            readme_text = ""
+            meta["skipped"]["README.md"] = "missing"
+    if "README.md" not in meta["skipped"]:
+        findings.extend(check_docs(readme_text, knob_table))
+    findings.extend(check_parsers(knob_table))
+
+    findings.sort(key=lambda f: (f.path, f.rule, f.snippet))
+    if cache_path is not None:
+        try:
+            os.makedirs(os.path.dirname(cache_path), exist_ok=True)
+            tmp = f"{cache_path}.tmp.{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as fh:
+                json.dump(
+                    {"meta": {k: v for k, v in meta.items()
+                              if k != "cache"},
+                     "findings": [_finding_to_dict(f) for f in findings]},
+                    fh,
+                )
+            os.replace(tmp, cache_path)
+        except OSError:
+            pass
+    return findings, meta
+
+
+# --------------------------------------------------------------------------
+# README reference table (KNB004's mechanical half).
+# --------------------------------------------------------------------------
+
+def render_knob_table(knob_table: dict | None = None) -> str:
+    """The README reference table, rendered straight from the registry
+    — one row per knob, sorted, pipe-escaped.  KNB004 checks the rows;
+    ``--write-docs`` writes them, so docs can never drift from code."""
+    table = knobs.KNOBS if knob_table is None else knob_table
+    lines = [
+        "| Knob | Type | Default | Keys | Description |",
+        "| --- | --- | --- | --- | --- |",
+    ]
+    for name in sorted(table):
+        k = table[name]
+        default = f"`{k.default}`" if k.default else "*(unset)*"
+        keys = ", ".join(sorted(k.affects)) if k.affects else "—"
+        doc = " ".join(str(k.doc).split()).replace("|", "\\|")
+        lines.append(
+            f"| `{name}` | {k.kind} | {default} | {keys} | {doc} |"
+        )
+    return "\n".join(lines)
+
+
+def write_docs(root: str | None = None) -> bool:
+    """Regenerate the README table between the ``knob-table`` markers
+    (appending a fresh reference section if the markers are absent).
+    Returns True when the README changed on disk."""
+    root = root or repo_root()
+    readme_path = os.path.join(root, "README.md")
+    text = ""
+    if os.path.exists(readme_path):
+        with open(readme_path, encoding="utf-8") as fh:
+            text = fh.read()
+    table = render_knob_table()
+    block = f"{_DOC_BEGIN}\n{table}\n{_DOC_END}"
+    if _DOC_BEGIN in text and _DOC_END in text:
+        head, rest = text.split(_DOC_BEGIN, 1)
+        _, tail = rest.split(_DOC_END, 1)
+        new = head + block + tail
+    else:
+        section = (
+            "\n## Environment knob reference\n\n"
+            "Generated from `bfs_tpu/knobs.py` by `bfs-tpu-lint --knobs "
+            "--write-docs` — edit the registry, not this table "
+            "(KNB004 fails on drift).\n\n"
+        )
+        new = text.rstrip("\n") + "\n" + section + block + "\n"
+    if new == text:
+        return False
+    tmp = f"{readme_path}.tmp.{os.getpid()}"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        fh.write(new)
+    os.replace(tmp, readme_path)
+    return True

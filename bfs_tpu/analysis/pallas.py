@@ -70,11 +70,11 @@ import math
 import os
 from dataclasses import dataclass, field
 
+from .. import knobs
 from .core import Finding
 from .ir import (
     SkipProgram,
     _ensure_jax_env,
-    _FLAVOR_ENV,
     _source_fingerprint,
     repo_root,
 )
@@ -82,22 +82,18 @@ from .ir import (
 #: Bump to invalidate every cached Pallas result (rule semantics changed).
 PAL_VERSION = 1
 
-#: Env knobs that change kernel flavors/shapes beyond the IR set: the
-#: relay_pallas module constants (TILE_ROWS/OUTER_TT/DMA_DEPTH/GUARDS,
-#: tile-major vs per-stage local pass) are read at import, and the VMEM
-#: budget is a rule input.
-_PAL_FLAVOR_ENV = _FLAVOR_ENV + (
-    "BFS_TPU_TM", "BFS_TPU_LANE_COMPACT", "BFS_TPU_TILE_ROWS",
-    "BFS_TPU_OUTER_TT", "BFS_TPU_DMA_DEPTH", "BFS_TPU_GUARDS",
-    "BFS_TPU_PAL_VMEM_MB",
-)
+#: Env knobs that change kernel flavors/shapes — DERIVED from the
+#: registry (``affects`` contains ``pal``): the IR flavor set plus the
+#: relay_pallas module constants (read at import) and the VMEM budget
+#: rule input.  KNB002 proves membership against bfs_tpu/knobs.py.
+_PAL_FLAVOR_ENV = knobs.flavor_env("pal")
 
 
 def vmem_budget_bytes() -> int:
     """Per-core VMEM budget the PAL001 proof checks against.
     ``BFS_TPU_PAL_VMEM_MB`` overrides (e.g. proving a raised
     scoped-vmem config); the default is the classic 16 MB/core."""
-    return int(float(os.environ.get("BFS_TPU_PAL_VMEM_MB", "16")) * (1 << 20))
+    return int(knobs.get("BFS_TPU_PAL_VMEM_MB") * (1 << 20))
 
 
 # --------------------------------------------------------------------------
@@ -945,7 +941,7 @@ KERNEL_SPECS = {
 # --------------------------------------------------------------------------
 
 def default_cache_dir(root: str | None = None) -> str:
-    env = os.environ.get("BFS_TPU_PAL_CACHE", "")
+    env = knobs.raw("BFS_TPU_PAL_CACHE") or ""
     if env:
         return env
     return os.path.join(root or repo_root(), ".bench_cache", "pal")

@@ -47,6 +47,8 @@ import functools
 import os
 import threading
 
+from .. import knobs
+
 _lock = threading.Lock()
 _retrace_counts: dict[str, int] = {}  # guarded-by: _lock
 _hot_registry: dict[str, object] = {}  # guarded-by: _lock
@@ -57,12 +59,7 @@ def transfer_guard_level() -> str | None:
 
     ``BFS_TPU_TRANSFER_GUARD`` accepts ``1``/``disallow``, ``log``, or any
     explicit jax level name (``disallow_explicit`` for paranoia runs)."""
-    raw = os.environ.get("BFS_TPU_TRANSFER_GUARD", "").strip().lower()
-    if raw in ("", "0", "off", "false", "allow"):
-        return None
-    if raw in ("1", "on", "true", "disallow"):
-        return "disallow"
-    return raw
+    return knobs.get("BFS_TPU_TRANSFER_GUARD")
 
 
 @contextlib.contextmanager
@@ -191,10 +188,7 @@ _lock_tls = threading.local()
 
 def lock_order_mode() -> str | None:
     """``'record'`` / ``'raise'`` / None (off — the default)."""
-    raw = os.environ.get("BFS_TPU_LOCK_ORDER", "").strip().lower()
-    if raw in ("", "0", "off", "false"):
-        return None
-    return "raise" if raw == "raise" else "record"
+    return knobs.get("BFS_TPU_LOCK_ORDER")
 
 
 def _held_stack() -> list:

@@ -139,8 +139,10 @@ def _behind(frac: float) -> bool:
 # record, and _boundary() marks the phase boundary (where BFS_TPU_FAULT can
 # inject a crash and where a resumed run picks up).  See module docstring.
 
+from . import knobs
 from .obs.spans import span as obs_span
 from .resilience.faults import fault_point
+from .resilience.journal import env_config
 
 #: Set once the provisional headline is computable: a zero-arg-to-status
 #: emitter the SIGTERM/SIGALRM handler uses to flush a partial result line
@@ -167,7 +169,7 @@ def _restore_mask(jr, dg):
 def _open_journal(cfg: dict):
     """The run journal for this exact bench config (None when disabled via
     BFS_TPU_JOURNAL=0)."""
-    if os.environ.get("BFS_TPU_JOURNAL", "1") == "0":
+    if not knobs.get("BFS_TPU_JOURNAL"):
         return None
     from .config import journal_dir
     from .resilience.journal import RunJournal
@@ -1706,7 +1708,7 @@ def _grid_multichip_bench(r: int, c: int, scale: int, edge_factor: int,
 
 def _exe_warm_marker(key: str) -> str:
     return os.path.join(
-        os.environ.get("BFS_TPU_EXE_CACHE", ""), f"warm_{key}.json"
+        knobs.raw("BFS_TPU_EXE_CACHE") or "", f"warm_{key}.json"
     )
 
 
@@ -1717,14 +1719,14 @@ def _exe_cache_warm(key: str) -> bool:
     let warm artifacts from a smaller fallback scale zero the ~830 s cold
     compile estimate at the requested scale — exactly the blind spot the
     estimator exists to close.)"""
-    d = os.environ.get("BFS_TPU_EXE_CACHE", "")
+    d = knobs.raw("BFS_TPU_EXE_CACHE") or ""
     return bool(d) and os.path.exists(_exe_warm_marker(key))
 
 
 def _mark_exe_warm(key: str) -> None:
     """Record that ``key``'s fused program is in the exe cache (called
     after the warm run completes on a TPU backend)."""
-    d = os.environ.get("BFS_TPU_EXE_CACHE", "")
+    d = knobs.raw("BFS_TPU_EXE_CACHE") or ""
     if not d or jax.default_backend() != "tpu":
         return
     try:
@@ -1843,28 +1845,14 @@ def main():
         # BENCH_APPLIER must map to a different journal, never to a resume
         # that mixes xla- and pallas-timed repeats into one median.
         "applier": os.environ.get("BENCH_APPLIER", "auto"),
-        # Direction knobs likewise (ISSUE 7): two different push/pull
-        # schedules (or thresholds, or forced per-phase kernels) must
-        # never blend into one median — and conversely a resumed run with
-        # the same knobs replays the SAME schedule bit-identically (the
-        # schedule is a pure on-device function of graph + thresholds).
-        "direction": os.environ.get("BFS_TPU_DIRECTION", "auto") or "auto",
-        "direction_alpha": os.environ.get("BFS_TPU_DIRECTION_ALPHA", ""),
-        "direction_beta": os.environ.get("BFS_TPU_DIRECTION_BETA", ""),
-        "rowmin_kernel": os.environ.get("BFS_TPU_ROWMIN", "auto") or "auto",
-        "state_update_kernel": os.environ.get("BFS_TPU_STATE_UPDATE", "auto")
-        or "auto",
-        # The expansion arm (ISSUE 15): gather- and mxu-timed repeats
-        # must never blend into one median, same contract as the applier
-        # and direction knobs.
-        "expansion": os.environ.get("BFS_TPU_EXPANSION", "auto") or "auto",
-        "mxu_kernel": os.environ.get("BFS_TPU_MXU_KERNEL", "auto") or "auto",
-        # Tile residency (ISSUE 18): a streamed run's timed repeats page
-        # adjacency through the host->HBM cache — resident- and
-        # stream-timed medians must never blend, and the cache budget
-        # changes the eviction pattern a streamed capture journals.
-        "tiles": os.environ.get("BFS_TPU_TILES", "resident") or "resident",
-        "stream_cache_gb": os.environ.get("BFS_TPU_STREAM_CACHE_GB", ""),
+        # Every registered knob declaring the ``journal`` domain rides
+        # in via the registry-derived map (ISSUE 7/15/18/19: direction
+        # schedule, kernel arms, expansion, exchange, tile residency,
+        # packing, sssp delta) — two different knob configs must never
+        # blend into one median, and conversely a resumed run with the
+        # same knobs replays the SAME schedule bit-identically.  KNB002
+        # proves this set matches bfs_tpu/knobs.py.
+        **env_config(),
     })
     _install_signal_handlers(jr)
 

@@ -47,6 +47,7 @@ from typing import Any
 
 import numpy as np
 
+from .. import knobs
 from ..utils.metrics import bump_artifact
 
 logger = logging.getLogger(__name__)
@@ -353,7 +354,7 @@ def resolve_builder(builder: str | None = None) -> str:
     """Relay builder flavor: explicit arg > ``BFS_TPU_LAYOUT_BUILD`` >
     ``device`` (the first-touch default since ISSUE 10; ``host`` is the
     pinned oracle builder)."""
-    builder = builder or os.environ.get("BFS_TPU_LAYOUT_BUILD", "device")
+    builder = builder or knobs.get("BFS_TPU_LAYOUT_BUILD")
     if builder not in ("device", "host"):
         raise ValueError(
             f"unknown layout builder {builder!r}; use device|host"
@@ -452,7 +453,7 @@ def load_or_build_tiles(rg, *, cache: LayoutCache | None = None,
         tiles_to_arrays,
     )
 
-    if cache is None and os.environ.get("BFS_TPU_TILES_CACHE", "") == "1":
+    if cache is None and knobs.get("BFS_TPU_TILES_CACHE"):
         cache = LayoutCache()
     builder = resolve_tiles_builder(builder)
     at, info = _load_or_build(
@@ -548,6 +549,11 @@ _PROBE_SOURCES = (
     "profiling.py",
 )
 
+#: Knob env keying the probe verdict — DERIVED from the registry
+#: (``affects`` contains ``probe``); KNB002 proves membership against
+#: bfs_tpu/knobs.py instead of a hand list.
+_PROBE_ENV = knobs.flavor_env("probe")
+
 
 def probe_verdict_key(eng) -> str:
     """Content key of one engine's probe verdict: layout geometry (the
@@ -579,7 +585,7 @@ def probe_verdict_key(eng) -> str:
         f"{jax.__version__}|{jax.default_backend()}|"
         f"{getattr(dev, 'device_kind', '?')}".encode()
     )
-    for knob in ("BFS_TPU_PAL_VMEM_MB", "BFS_TPU_MXU_KERNEL"):
+    for knob in _PROBE_ENV:
         h.update(f"{knob}={os.environ.get(knob, '')}".encode())
     return f"probe_{h.hexdigest()}"
 

@@ -32,15 +32,16 @@ from __future__ import annotations
 import os
 from dataclasses import dataclass, field
 
+from . import knobs
+
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def cache_root() -> str:
     """Root directory for all persistent artifact caches
     (``BFS_TPU_CACHE_DIR``; default ``<repo>/.bench_cache``)."""
-    return os.environ.get(
-        "BFS_TPU_CACHE_DIR", os.path.join(_REPO_ROOT, ".bench_cache")
-    )
+    v = knobs.raw("BFS_TPU_CACHE_DIR")
+    return v if v is not None else os.path.join(_REPO_ROOT, ".bench_cache")
 
 
 def layout_cache_dir() -> str:
@@ -54,9 +55,8 @@ def journal_dir() -> str:
     kill/resume runs can share warm artifact caches but not journals),
     else ``<cache root>/journal`` — resume state lives with the other
     per-config artifacts it must stay consistent with."""
-    return os.environ.get(
-        "BFS_TPU_JOURNAL_DIR", os.path.join(cache_root(), "journal")
-    )
+    v = knobs.raw("BFS_TPU_JOURNAL_DIR")
+    return v if v is not None else os.path.join(cache_root(), "journal")
 
 
 def compile_cache_dir() -> str:
@@ -70,7 +70,8 @@ def compile_cache_dir() -> str:
 def exe_cache_dir() -> str:
     """Serialized-executable cache directory (``BFS_TPU_EXE_CACHE`` wins
     when set; an explicitly EMPTY value means disabled and is respected)."""
-    return os.environ.get("BFS_TPU_EXE_CACHE", os.path.join(cache_root(), "exe"))
+    v = knobs.raw("BFS_TPU_EXE_CACHE")
+    return v if v is not None else os.path.join(cache_root(), "exe")
 
 
 def enable_compile_cache(*, min_compile_seconds: float = 5.0) -> dict:
@@ -103,7 +104,7 @@ def enable_compile_cache(*, min_compile_seconds: float = 5.0) -> dict:
     os.environ.setdefault("BFS_TPU_EXE_CACHE", exe_cache_dir())
     return {
         "jax_compilation_cache_dir": cc_dir,
-        "exe_cache_dir": os.environ["BFS_TPU_EXE_CACHE"],
+        "exe_cache_dir": knobs.raw("BFS_TPU_EXE_CACHE"),
         "layout_cache_dir": layout_cache_dir(),
     }
 

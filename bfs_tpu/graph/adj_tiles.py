@@ -299,9 +299,9 @@ def _dev_scatter(tile_of, lb_s, dup, nt: int):
 def resolve_tiles_builder(builder: str | None = None) -> str:
     """``BFS_TPU_TILES_BUILD=device|host`` (default device — the PR 10
     convention; host is the pinned oracle)."""
-    import os
+    from .. import knobs
 
-    builder = builder or os.environ.get("BFS_TPU_TILES_BUILD", "device")
+    builder = builder or knobs.get("BFS_TPU_TILES_BUILD")
     if builder not in ("device", "host"):
         raise ValueError(
             f"unknown tiles builder {builder!r}; use device|host"

@@ -27,6 +27,7 @@ import threading
 
 import numpy as np
 
+from .. import knobs
 from ..utils.native_loader import NativeLib
 
 _REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -119,7 +120,7 @@ def _reserve_hugepages(n: int) -> int | None:
     the prior value when the sysctl was raised, else None.  Set
     ``BFS_TPU_HUGEPAGES=0`` to skip entirely (the router falls back to 4KB
     pages).  Needs root; silently a no-op without it."""
-    if os.environ.get("BFS_TPU_HUGEPAGES", "1") == "0":
+    if not knobs.get("BFS_TPU_HUGEPAGES"):
         return None
     try:
         pages = (20 * n + (2 << 20) - 1) // (2 << 20) + 16

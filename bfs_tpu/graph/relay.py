@@ -66,7 +66,9 @@ _build_log_prev_level: int | None = None
 
 def _ensure_build_log():
     global _build_log_handler, _build_log_prev_level
-    enabled = __import__("os").environ.get("BFS_TPU_BUILD_LOG", "") not in ("", "0")
+    from .. import knobs
+
+    enabled = knobs.get("BFS_TPU_BUILD_LOG")
     with _build_log_lock:
         if enabled:
             if _build_log_handler is None:

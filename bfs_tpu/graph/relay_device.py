@@ -70,6 +70,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from . import benes
+from .. import knobs
 from .csr import DeviceGraph, Graph, INF_DIST
 from .relay import (
     COMPACT_MIN_D,
@@ -109,7 +110,7 @@ def resolve_segments(segments: str | None = None) -> str:
     """``xla`` (on-device programs) or ``host`` (shared vectorized numpy
     segments): explicit arg > ``BFS_TPU_LAYOUT_SEGMENTS`` > backend
     default (xla on accelerators, host on the CPU backend — measured)."""
-    segments = segments or os.environ.get("BFS_TPU_LAYOUT_SEGMENTS", "auto")
+    segments = segments or knobs.get("BFS_TPU_LAYOUT_SEGMENTS")
     if segments in ("", "auto"):
         return "host" if jax.default_backend() == "cpu" else "xla"
     if segments not in ("xla", "host"):
@@ -122,7 +123,7 @@ def resolve_segments(segments: str | None = None) -> str:
 def resolve_route(route: str | None = None) -> str:
     """The route arm: explicit arg > ``BFS_TPU_LAYOUT_ROUTE`` > native
     where available (measured fastest on the build CPU), else jax."""
-    route = route or os.environ.get("BFS_TPU_LAYOUT_ROUTE", "auto")
+    route = route or knobs.get("BFS_TPU_LAYOUT_ROUTE")
     if route in ("", "auto"):
         return "native" if benes.native_available() else "jax"
     if route not in ("native", "jax"):

@@ -28,6 +28,7 @@ import numpy as np
 
 logger = logging.getLogger(__name__)
 
+from .. import knobs
 from ..analysis.runtime import traced
 from ..obs.spans import span as obs_span
 from ..graph.csr import DeviceGraph, Graph, build_device_graph
@@ -1130,7 +1131,7 @@ def compile_exe_cached(lowered, compiler_options):
     import os
     import pickle
 
-    cache_dir = os.environ.get("BFS_TPU_EXE_CACHE", "")
+    cache_dir = knobs.raw("BFS_TPU_EXE_CACHE") or ""
     if not cache_dir or jax.default_backend() != "tpu":
         with obs_span("compile"):
             return lowered.compile(compiler_options=compiler_options)
@@ -1226,13 +1227,13 @@ def _probe_appliers(rg, compiler_options, loops: int = 16) -> dict:
     from ..ops import relay_pallas as RP
 
     t0_probe = time.perf_counter()
-    probe_budget = float(os.environ.get("BFS_TPU_PROBE_BUDGET", "600"))
+    probe_budget = knobs.get("BFS_TPU_PROBE_BUDGET")
     # BFS_TPU_PROBE_COARSE=1 (set by bench.py when the RUN is behind its
     # own budget) forces the coarse arms unconditionally: the full flat
     # mask ship + adaptive repeat loops never start, so the probe's cost
     # is bounded by the pallas warm + one K-loop pair + a ~100 MB prefix
     # regardless of what the probe's own clock says.
-    coarse_forced = os.environ.get("BFS_TPU_PROBE_COARSE", "") == "1"
+    coarse_forced = knobs.get("BFS_TPU_PROBE_COARSE")
 
     def _pstamp(msg):
         print(
@@ -1716,20 +1717,11 @@ class RelayEngine:
         default.  Off-TPU the fused kernels only exist in interpret mode
         (measured for the ledger's verdict, never competitive), so auto
         resolves to the XLA arms with the basis recorded."""
-        import os
-
         sel, basis = {}, {}
-        forced = {}
-        for phase, env in (
-            ("rowmin", "BFS_TPU_ROWMIN"),
-            ("state_update", "BFS_TPU_STATE_UPDATE"),
-        ):
-            v = os.environ.get(env, "auto") or "auto"
-            if v not in ("auto", "pallas", "xla"):
-                raise ValueError(
-                    f"unknown {env} {v!r}; use 'auto', 'pallas' or 'xla'"
-                )
-            forced[phase] = v
+        forced = {
+            "rowmin": knobs.get("BFS_TPU_ROWMIN"),
+            "state_update": knobs.get("BFS_TPU_STATE_UPDATE"),
+        }
         need_auto = [p for p, v in forced.items() if v == "auto"]
         # The expansion arm's measured half rides the SAME probe (ISSUE
         # 15): 'auto' that survived the static gates builds the tile
@@ -1738,7 +1730,7 @@ class RelayEngine:
         # arms.  BFS_TPU_PHASE_PROBE=force runs the probe on any backend
         # (the interpret-arm measurement the ledger also takes).
         probe_exp = self.expansion == "auto-probe"
-        force_probe = os.environ.get("BFS_TPU_PHASE_PROBE", "") == "force"
+        force_probe = knobs.get("BFS_TPU_PHASE_PROBE") == "force"
         on_tpu = jax.default_backend() == "tpu" or force_probe
         if probe_exp:
             if not on_tpu:
@@ -1864,7 +1856,7 @@ class RelayEngine:
         fits = packed_parent_fits(self.relay_graph.num_vertices)
         if req == "mxu":
             if self.packed and not fits:
-                if os.environ.get("BFS_TPU_PACKED", "") == "1":
+                if knobs.get("BFS_TPU_PACKED") == "1":
                     raise ValueError(
                         "BFS_TPU_EXPANSION=mxu with BFS_TPU_PACKED=1 "
                         "needs V <= 2^26: the mxu arm's packed parent "
@@ -1946,11 +1938,9 @@ class RelayEngine:
 
     def _resolve_applier(self, applier: str) -> str:
         """Forced env/arg choice, or the measured probe on TPU 'auto'."""
-        import os
-
         from ..ops.relay_pallas import pallas_enabled
 
-        env = os.environ.get("BFS_TPU_PALLAS", "")
+        env = knobs.get("BFS_TPU_PALLAS")
         if env in ("0", "1"):
             return "pallas" if env == "1" else "xla"
         if not pallas_enabled():

@@ -55,6 +55,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import knobs
 from ..analysis.runtime import traced
 from ..obs import telemetry as T
 from ..ops.relax import INT32_MAX
@@ -87,13 +88,13 @@ def resolve_direction(mode: str | None = None) -> DirectionConfig:
     thresholds (silently clamping a typo'd knob would quietly change
     what a capture measured)."""
     if mode is None:
-        mode = os.environ.get("BFS_TPU_DIRECTION", "auto") or "auto"
+        mode = knobs.get("BFS_TPU_DIRECTION")
     if mode not in DIRECTION_MODES:
         raise ValueError(
             f"unknown direction {mode!r}; use 'push', 'pull' or 'auto'"
         )
-    alpha = float(os.environ.get("BFS_TPU_DIRECTION_ALPHA", DEFAULT_ALPHA))
-    beta = float(os.environ.get("BFS_TPU_DIRECTION_BETA", DEFAULT_BETA))
+    alpha = float(knobs.get("BFS_TPU_DIRECTION_ALPHA"))
+    beta = float(knobs.get("BFS_TPU_DIRECTION_BETA"))
     if alpha <= 0 or beta <= 0:
         raise ValueError(
             f"direction thresholds must be positive (alpha={alpha}, "

@@ -35,6 +35,8 @@ import os
 import threading
 import time
 
+from .. import knobs
+
 #: Buffer bound: a serve process answering queries forever must not leak
 #: memory through its own observability.  Past the cap new events are
 #: dropped and counted (the drop count rides in every export).
@@ -48,7 +50,7 @@ _next_id = [0]  # guarded-by: _lock
 
 
 def spans_enabled() -> bool:
-    return os.environ.get("BFS_TPU_SPANS", "1") != "0"
+    return knobs.get("BFS_TPU_SPANS")
 
 
 def _wall_us() -> int:

@@ -87,9 +87,9 @@ def packed_rank_fits(in_classes) -> bool:
 def resolve_packed(fits: bool) -> bool:
     """``BFS_TPU_PACKED=0/1`` forces the carry flavor; otherwise run
     packed exactly when the layout fits."""
-    import os
+    from .. import knobs
 
-    env = os.environ.get("BFS_TPU_PACKED", "")
+    env = knobs.get("BFS_TPU_PACKED")
     if env in ("0", "1"):
         return env == "1"
     return bool(fits)

@@ -21,6 +21,7 @@ import os
 import jax
 import jax.numpy as jnp
 
+from .. import knobs
 from .relax import (
     INT32_MAX,
     BfsState,
@@ -36,9 +37,7 @@ from .relax import (
 #: VERDICT r4 #7).  Levels larger than this are gathered in row chunks,
 #: bounding the temp at ~4*BUDGET bytes while leaving small graphs' (and
 #: every test's) program unchanged.
-_CHUNK_ELEMS = int(
-    float(os.environ.get("BFS_TPU_PULL_CHUNK_MB", "128")) * (1 << 20) / 4
-)
+_CHUNK_ELEMS = int(knobs.get("BFS_TPU_PULL_CHUNK_MB") * (1 << 20) / 4)
 
 
 def _rowmin_level(tab: jax.Array, mat_t: jax.Array) -> jax.Array:

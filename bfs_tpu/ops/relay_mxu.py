@@ -61,6 +61,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import knobs
 from ..graph.adj_tiles import SB_TILES, SB_VERTS, TILE, TILE_WORDS
 
 __all__ = [
@@ -89,7 +90,7 @@ def resolve_expansion(mode: str | None = None) -> str:
     unknown modes — a typo'd knob must never silently change what a
     capture measured."""
     if mode is None:
-        mode = os.environ.get("BFS_TPU_EXPANSION", "auto") or "auto"
+        mode = knobs.get("BFS_TPU_EXPANSION")
     if mode not in EXPANSION_MODES:
         raise ValueError(
             f"unknown expansion {mode!r}; use 'auto', 'gather' or 'mxu'"
@@ -103,7 +104,7 @@ def resolve_mxu_kernel(kernel: str | None = None) -> str:
     only, never a shipping loop) or ``xla`` (the twin).  ``auto`` follows
     the backend like every other per-phase kernel here."""
     if kernel is None:
-        kernel = os.environ.get("BFS_TPU_MXU_KERNEL", "auto") or "auto"
+        kernel = knobs.get("BFS_TPU_MXU_KERNEL")
     if kernel not in ("auto", "pallas", "xla"):
         raise ValueError(
             f"unknown mxu kernel {kernel!r}; use 'auto', 'pallas' or 'xla'"
@@ -129,7 +130,7 @@ def resolve_tiles_mode(mode: str | None = None) -> str:
     resident.  Raises on unknown modes, same contract as
     :func:`resolve_expansion`."""
     if mode is None:
-        mode = os.environ.get("BFS_TPU_TILES", "resident") or "resident"
+        mode = knobs.get("BFS_TPU_TILES")
     if mode not in TILES_MODES:
         raise ValueError(
             f"unknown tiles mode {mode!r}; use 'resident', 'stream' or "
@@ -144,9 +145,7 @@ def stream_cache_budget_bytes() -> int:
     the LRU accounts against, NOT a hard allocator limit (in-flight
     expands keep their operand references alive past eviction, exactly
     like the serve registry's resident map)."""
-    return int(
-        float(os.environ.get("BFS_TPU_STREAM_CACHE_GB", "1")) * (1 << 30)
-    )
+    return int(knobs.get("BFS_TPU_STREAM_CACHE_GB") * (1 << 30))
 
 
 def tiles_budget_bytes() -> int:
@@ -154,9 +153,7 @@ def tiles_budget_bytes() -> int:
     (``BFS_TPU_MXU_TILE_GB``, default 4 GB): a scale-free tail can
     degrade toward one 2 KB tile per edge, and the arm must never OOM a
     host just by being probed."""
-    return int(
-        float(os.environ.get("BFS_TPU_MXU_TILE_GB", "4")) * (1 << 30)
-    )
+    return int(knobs.get("BFS_TPU_MXU_TILE_GB") * (1 << 30))
 
 
 def mxu_device_operands(at) -> tuple:

@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import knobs
 from ..graph.relay import StageSpec
 
 logger = logging.getLogger(__name__)
@@ -39,16 +40,16 @@ logger = logging.getLogger(__name__)
 LANES = 128
 #: pass-B tile rows: 2048 rows * 128 lanes * 4 B = 1 MB of VMEM for x.
 #: Env-tunable for on-chip sweeps (tools/profile_net_kernel.py).
-TILE_ROWS = int(os.environ.get("BFS_TPU_TILE_ROWS", "2048"))
+TILE_ROWS = knobs.get("BFS_TPU_TILE_ROWS")
 #: outer-pass inner-chunk rows; the x block is (B, OUTER_TT, 128).
-OUTER_TT = int(os.environ.get("BFS_TPU_OUTER_TT", "64"))
+OUTER_TT = knobs.get("BFS_TPU_OUTER_TT")
 #: mask-DMA pipeline depth (buffers per pass).  2 = classic double
 #: buffering: stage si+1's DMA is issued when stage si starts computing.
 #: The per-stage mask DMA is ~0.5-1 MB, whose issue+semaphore latency
 #: exceeds its transfer time, so at depth 2 the pipeline is
 #: issue-latency-bound; deeper prefetch (4) keeps more copies in flight.
 #: Only relevant on the per-stage path (BFS_TPU_TM=0).
-DMA_DEPTH = max(2, int(os.environ.get("BFS_TPU_DMA_DEPTH", "2")))
+DMA_DEPTH = max(2, knobs.get("BFS_TPU_DMA_DEPTH"))
 
 #: Tile-major pass-B mask streaming: the local pass's masks are relaid
 #: host-side so ALL ~45 stages' rows for one x-tile are contiguous, and the
@@ -63,18 +64,18 @@ DMA_DEPTH = max(2, int(os.environ.get("BFS_TPU_DMA_DEPTH", "2")))
 #: as default for the structural simplicity (no DMA-depth tuning).
 #: Incompatible with BFS_TPU_LANE_COMPACT (which keeps the per-stage
 #: path).
-TILE_MAJOR = os.environ.get("BFS_TPU_TM", "1") != "0"
+TILE_MAJOR = knobs.get("BFS_TPU_TM")
 
 
 def _tile_major_enabled() -> bool:
-    return TILE_MAJOR and os.environ.get("BFS_TPU_LANE_COMPACT", "0") != "1"
+    return TILE_MAJOR and not knobs.get("BFS_TPU_LANE_COMPACT")
 
 _warned = False
 
 #: Tail-range DMA/compute guards (static per stage, dynamic per tile).  At
 #: m1 ~ 0.94n the skippable ranges are tiny while the conditional DMAs can
 #: cost pipeline overlap — BFS_TPU_GUARDS=0 disables them for measurement.
-_GUARDS = os.environ.get("BFS_TPU_GUARDS", "1") != "0"
+_GUARDS = knobs.get("BFS_TPU_GUARDS")
 
 
 def pallas_enabled() -> bool:
@@ -84,7 +85,7 @@ def pallas_enabled() -> bool:
     platform differently — ADVICE.md round 2), and logs once when the fused
     path is disabled so a silent fallback is visible."""
     global _warned
-    env = os.environ.get("BFS_TPU_PALLAS", "")
+    env = knobs.get("BFS_TPU_PALLAS")
     if env in ("0", "1"):
         return env == "1"
     try:
@@ -151,7 +152,7 @@ def _lane_compactable(st: StageSpec) -> bool:
     DMA-starved windows (3-27 GB/s was typical in round 3, where 100 MB is
     4-30 ms) — hence BFS_TPU_LANE_COMPACT=1 as an opt-in switch rather
     than dead code."""
-    if os.environ.get("BFS_TPU_LANE_COMPACT", "0") != "1":
+    if not knobs.get("BFS_TPU_LANE_COMPACT"):
         return False
     return (
         32 <= st.d < 4096

@@ -71,6 +71,8 @@ from dataclasses import dataclass
 import jax
 import jax.numpy as jnp
 
+from .. import knobs
+
 #: Arm codes, recorded per level in the telemetry exchange-arm
 #: accumulator (0 = level not executed, same convention as the
 #: direction codes).
@@ -116,13 +118,13 @@ def resolve_exchange(mode: str | None = None) -> ExchangeConfig:
     raise (same contract as resolve_direction: silently clamping a typo'd
     knob would change what a capture measured)."""
     if mode is None:
-        mode = os.environ.get("BFS_TPU_EXCHANGE", "auto") or "auto"
+        mode = knobs.get("BFS_TPU_EXCHANGE")
     if mode not in EXCHANGE_MODES:
         raise ValueError(
             f"unknown exchange {mode!r}; use 'auto', 'bitmap', 'delta' or "
             "'flat'"
         )
-    div = int(os.environ.get("BFS_TPU_EXCHANGE_DIV", str(DEFAULT_BUDGET_DIV)))
+    div = knobs.get("BFS_TPU_EXCHANGE_DIV")
     if div < 1:
         raise ValueError(f"BFS_TPU_EXCHANGE_DIV must be >= 1 (got {div})")
     return ExchangeConfig(mode=mode, budget_div=div)

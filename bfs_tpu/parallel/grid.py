@@ -57,6 +57,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .compat import pcast_carry, pcast_varying, shard_map as _shard_map
+from .. import knobs
 from ..graph.grid_layout import (
     GRID_KEY_SENTINEL,
     grid_layout_for,
@@ -73,7 +74,7 @@ def resolve_grid_mesh(spec: str | None = None) -> tuple[int, int]:
     """``(r, c)`` from an explicit spec or ``BFS_TPU_MESH`` (``"rxc"``);
     no knob -> the 1D degenerate ``1 x num_devices``."""
     if spec is None:
-        spec = os.environ.get("BFS_TPU_MESH", "") or ""
+        spec = knobs.get("BFS_TPU_MESH") or ""
     if not spec:
         return 1, len(jax.devices())
     return parse_mesh_spec(spec)

@@ -33,6 +33,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from .compat import pcast_carry, pcast_varying, shard_map as _shard_map
+from .. import knobs
 
 from ..graph.csr import DeviceGraph, Graph, build_device_graph
 from ..graph.ell import ShardedPullGraph, build_sharded_pull_graph
@@ -1589,7 +1590,7 @@ def _resolve_sharded_expansion(expansion, srg, packed: bool):
             "rebuild with build_sharded_relay_graph"
         )
     if packed and not packed_parent_fits(srg.num_vertices):
-        if os.environ.get("BFS_TPU_PACKED", "") == "1":
+        if knobs.get("BFS_TPU_PACKED") == "1":
             raise ValueError(
                 "BFS_TPU_EXPANSION=mxu with BFS_TPU_PACKED=1 needs "
                 "V <= 2^26: the mxu packed parent field carries "

@@ -58,6 +58,8 @@ import os
 import signal
 import threading
 
+from .. import knobs
+
 
 class FaultInjected(RuntimeError):
     """Raised by :func:`fault_point` under ``BFS_TPU_FAULT=raise:...``."""
@@ -79,7 +81,7 @@ def fault_spec(env: str | None = None) -> tuple[str, str, float] | None:
     ``action`` is ``'kill'``, ``'raise'`` or ``'delay'`` (the documented
     ``phase:`` prefix is an alias for ``kill``); ``arg`` is the 1-based
     nth-arrival count for kill/raise and the sleep SECONDS for delay."""
-    spec = env if env is not None else os.environ.get("BFS_TPU_FAULT", "")
+    spec = env if env is not None else knobs.get("BFS_TPU_FAULT")
     spec = spec.strip()
     if not spec:
         return None

@@ -40,6 +40,8 @@ import time
 import zlib
 from typing import Any
 
+from .. import knobs
+
 JOURNAL_VERSION = 1
 
 _HEADER_PHASE = "_header"
@@ -58,6 +60,25 @@ def config_key(config: dict) -> str:
     stem, so one config maps to one journal the way one graph maps to one
     layout bundle."""
     return hashlib.blake2b(_canon(config).encode(), digest_size=8).hexdigest()
+
+
+#: Knob names that must ride in every bench journal config — DERIVED
+#: from the registry (``affects`` contains ``journal``); KNB002 proves
+#: membership both ways against bfs_tpu/knobs.py.
+ENV_CONFIG_KEYS = knobs.flavor_env("journal")
+
+
+def env_config() -> dict:
+    """``{journal config key: effective raw value}`` for every
+    journal-affecting knob: the env value when set and non-empty, else
+    the registered default — so a default run and an explicit-default
+    run resume each other, and any knob flip maps to a different
+    :func:`config_key` (never to a resume blending two configs)."""
+    out = {}
+    for jk, name in knobs.journal_map().items():
+        v = knobs.raw(name)
+        out[jk] = v if v else knobs.KNOBS[name].default
+    return out
 
 
 def read_records(path: str) -> list:

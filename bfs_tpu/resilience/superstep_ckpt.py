@@ -66,6 +66,7 @@ import numpy as np
 # utils.checkpoint (and with it jax) is imported lazily inside the store
 # methods — journal.py's idiom — so resolve_ckpt()/CkptConfig stay
 # importable in no-jax contexts (the lint stub, config-only callers).
+from .. import knobs
 from .faults import fault_point
 from .journal import config_key
 
@@ -114,7 +115,7 @@ def resolve_ckpt(spec: str | None = None) -> CkptConfig:
     intervals raise — silently clamping a typo'd knob would change what a
     capture measured (the resolve_direction contract)."""
     if spec is None:
-        spec = os.environ.get("BFS_TPU_CKPT", "off") or "off"
+        spec = knobs.get("BFS_TPU_CKPT")
     spec = spec.strip()
     mode, _, arg = spec.partition(":")
     if mode not in CKPT_MODES:
@@ -184,7 +185,7 @@ class SuperstepCheckpointer:
         self.mtbf_s = (
             float(mtbf_s)
             if mtbf_s is not None
-            else float(os.environ.get("BFS_TPU_CKPT_MTBF_S", DEFAULT_MTBF_S))
+            else knobs.get("BFS_TPU_CKPT_MTBF_S")
         )
         self._k = self.cfg.k if self.cfg.mode == "every" else DEFAULT_K0
         # Measured economics (medians are overkill: both costs are

@@ -47,17 +47,36 @@ hot caller, but registration can happen from any thread.
 
 from __future__ import annotations
 
+import hashlib
 import threading
 from collections import OrderedDict
 from dataclasses import dataclass, field
 
 import numpy as np
 
+from .. import knobs
 from ..analysis.runtime import make_lock
 from ..graph.csr import DeviceGraph, Graph, build_device_graph
 from ..graph.ell import PullGraph, build_pull_graph, device_ell, drop_device_operands
 
 ENGINES = ("pull", "push", "relay")
+
+#: Knob env keying resident engine operands — DERIVED from the registry
+#: (``affects`` contains ``serve``); KNB002 proves membership against
+#: bfs_tpu/knobs.py.  A knob flip between acquires (tests flipping
+#: BFS_TPU_PACKED, an operator retuning direction thresholds) must never
+#: reuse operands resolved under the old flavor — the same stale-key
+#: contract the lint caches and the bench journal enforce.
+ENGINE_FLAVOR_ENV = knobs.flavor_env("serve")
+
+
+def _engine_env_fingerprint() -> str:
+    """blake2b-6 over the raw serve-affecting knob values — the fourth
+    element of the resident-operand LRU key."""
+    parts = ";".join(
+        f"{n}={knobs.raw(n) or ''}" for n in ENGINE_FLAVOR_ENV
+    )
+    return hashlib.blake2b(parts.encode(), digest_size=6).hexdigest()
 
 
 @dataclass
@@ -112,10 +131,11 @@ class GraphRegistry:
         # Replaced epochs still pinned by in-flight work, keyed
         # (name, epoch); entries leave when their last pin drops.
         self._retired: dict[tuple[str, int], RegisteredGraph] = {}  # guarded-by: _lock
-        # (name, epoch, engine) -> (bytes, operands-ref); order = LRU.
-        self._resident: OrderedDict[tuple[str, int, str], tuple[int, object]] = (
-            OrderedDict()
-        )  # guarded-by: _lock
+        # (name, epoch, engine, env fingerprint) -> (bytes,
+        # operands-ref); order = LRU.
+        self._resident: OrderedDict[
+            tuple[str, int, str, str], tuple[int, object]
+        ] = OrderedDict()  # guarded-by: _lock
         self.device_budget_bytes = device_budget_bytes  # immutable after init
         self.metrics = metrics  # guarded-by: _lock
         self.evictions = 0  # guarded-by: _lock
@@ -444,7 +464,7 @@ class GraphRegistry:
         import jax.numpy as jnp
 
         layout = self._layout_for(rec, engine)
-        key = (rec.name, rec.epoch, engine)
+        key = (rec.name, rec.epoch, engine, _engine_env_fingerprint())
         with self._lock:
             if key in self._resident:
                 self._resident.move_to_end(key)
@@ -498,7 +518,7 @@ class GraphRegistry:
             return operands
 
     # bfs_tpu: holds _lock
-    def _pinned(self, key: tuple[str, int, str]) -> bool:
+    def _pinned(self, key: tuple[str, int, str, str]) -> bool:
         rec = self._rec_for(key[0], key[1])
         return rec is not None and rec.pins > 0
 
@@ -547,8 +567,8 @@ class GraphRegistry:
             self._evict(victim)
 
     # bfs_tpu: holds _lock
-    def _evict(self, key: tuple[str, int, str], rec=None) -> None:
-        name, epoch, engine = key
+    def _evict(self, key: tuple[str, int, str, str], rec=None) -> None:
+        name, epoch, engine = key[0], key[1], key[2]
         nbytes = self._resident[key][0]
         self._resident.pop(key)  # drops OUR reference to the operands
         # ``rec`` comes from _retire's swap-time path: an unpinned old
@@ -602,5 +622,11 @@ class GraphRegistry:
             return sum(b for b, _ in self._resident.values())
 
     def resident_keys(self) -> list[tuple[str, int, str]]:
+        """Resident operand identities as (name, epoch, engine), in LRU
+        order.  The internal map key additionally carries the engine-env
+        fingerprint (:func:`_engine_env_fingerprint`) so a knob-flavor
+        change can never reuse a stale engine — but that is a cache-
+        correctness detail, not part of the observable identity (the
+        same triple may appear once per resident env flavor)."""
         with self._lock:
-            return list(self._resident)
+            return [(k[0], k[1], k[2]) for k in self._resident]

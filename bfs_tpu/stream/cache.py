@@ -31,6 +31,7 @@ from collections import OrderedDict
 
 import numpy as np
 
+from .. import knobs
 from .store import HostTileStore, superblock_fingerprint
 
 __all__ = ["SuperblockCache", "stream_verify_enabled"]
@@ -45,7 +46,7 @@ def stream_verify_enabled(verify: bool | None = None) -> bool:
     """``BFS_TPU_STREAM_VERIFY=1`` (an explicit argument wins)."""
     if verify is not None:
         return bool(verify)
-    return os.environ.get("BFS_TPU_STREAM_VERIFY", "") == "1"
+    return knobs.get("BFS_TPU_STREAM_VERIFY")
 
 
 class SuperblockCache:

@@ -12,6 +12,8 @@ from __future__ import annotations
 import logging
 import os
 
+from .. import knobs
+
 _FORMAT = (
     "%(asctime)s %(levelname)-5s [%(name)s.%(funcName)s:%(lineno)d] %(message)s"
 )
@@ -23,7 +25,7 @@ def configure(level: int | str | None = None) -> None:
     if _configured:
         return
     if level is None:
-        level = os.environ.get("BFS_TPU_LOG", "INFO")
+        level = knobs.get("BFS_TPU_LOG")
     logging.basicConfig(level=level, format=_FORMAT)
     _configured = True
 

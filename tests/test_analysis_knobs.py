@@ -1,0 +1,501 @@
+"""Tests for bfs_tpu.analysis.knobs — the knob-provenance pass (ISSUE 19):
+every KNB rule must trip on a fixture and stay quiet on its near-miss,
+the set-equality pins must fire in BOTH directions (a raw read is as
+fatal as a dead registry row; a missing key member as fatal as an extra
+one), the repo's own registry + sources + key builders + README must run
+clean modulo the baseline, the registry defaults must equal the module
+constants they replaced (the migration's no-behavior-change proof), the
+content-addressed result cache must hit on an unchanged tree, and the
+CLI must exit non-zero on a regression and honor baseline/stale/
+write-baseline semantics.
+
+The repo-wide runs carry the ``lint_knobs`` marker so ``-m 'not
+lint_knobs'`` can skip them; plain tier-1 runs them (they are stdlib-only
+and fast — no jax tracing in this rung).
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+from bfs_tpu import knobs as reg
+from bfs_tpu.analysis import Baseline
+from bfs_tpu.analysis.core import SourceFile
+from bfs_tpu.analysis.knob_rules import (
+    check_docs,
+    check_key_completeness,
+    check_parsers,
+    check_provenance,
+    check_scope,
+    readme_knob_rows,
+)
+from bfs_tpu.analysis.knobs import (
+    analyze_knobs,
+    render_knob_table,
+    write_docs,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules_of(findings):
+    return sorted({f.rule for f in findings})
+
+
+def snippets_of(findings):
+    return {f.snippet for f in findings}
+
+
+def _src(code, path="fx.py"):
+    return SourceFile(os.path.join(REPO, path), REPO, text=code)
+
+
+def _knob(name, *, kind="enum", default="auto", parse=None, affects=(),
+          scope="call", canary="bogus", journal_key=None):
+    if parse is None:
+        def parse(raw, _n=name):
+            if raw not in ("auto", "on", "off"):
+                raise ValueError(f"{_n}={raw!r}: not one of auto/on/off")
+            return raw
+    return reg.Knob(
+        name=name, kind=kind, default=default, parse=parse,
+        doc=f"fixture knob {name}", affects=frozenset(affects),
+        scope=scope, canary=canary, journal_key=journal_key,
+    )
+
+
+def _table(*knobs_):
+    return {k.name: k for k in knobs_}
+
+
+# -------------------------------------------------------------- KNB001 --
+
+def test_knb001_raw_read_spellings_trip_accessor_passes():
+    table = _table(_knob("BFS_TPU_FX"))
+    trip = _src(
+        "import os\n"
+        "from os import environ, getenv\n"
+        "def f():\n"
+        "    a = os.environ.get('BFS_TPU_FX', 'auto')\n"
+        "    b = os.getenv('BFS_TPU_FX')\n"
+        "    c = getenv('BFS_TPU_FX')\n"
+        "    d = environ['BFS_TPU_FX']\n"
+        "    return a, b, c, d\n"
+    )
+    found = check_provenance([trip], table)
+    raw = [f for f in found if "bypasses the typed accessor" in f.message]
+    assert len(raw) == 4
+    assert all(f.rule == "KNB001" for f in raw)
+
+    ok = _src(
+        "from bfs_tpu import knobs\n"
+        "def f():\n"
+        "    return knobs.get('BFS_TPU_FX')\n"
+    )
+    assert check_provenance([ok], table) == []
+
+
+def test_knb001_writes_and_nonliteral_reads_are_allowed():
+    table = _table(_knob("BFS_TPU_FX"))
+    src = _src(
+        "import os\n"
+        "from bfs_tpu import knobs\n"
+        "def f(names):\n"
+        "    os.environ['BFS_TPU_FX'] = '1'\n"       # write
+        "    os.environ.setdefault('BFS_TPU_FX', '1')\n"  # write
+        "    os.environ.pop('BFS_TPU_FX', None)\n"   # write
+        "    del os.environ['BFS_TPU_FX']\n"         # write
+        "    vals = [os.environ.get(n, '') for n in names]\n"  # non-literal
+        "    return vals, knobs.get('BFS_TPU_FX')\n"
+    )
+    assert check_provenance([src], table) == []
+
+
+def test_knb001_both_directions_unregistered_and_dead_row():
+    table = _table(_knob("BFS_TPU_FX"), _knob("BFS_TPU_DEAD"))
+    src = _src(
+        "from bfs_tpu import knobs\n"
+        "def f():\n"
+        "    a = knobs.get('BFS_TPU_FX')\n"
+        "    return a, knobs.raw('BFS_TPU_ROGUE')\n"
+    )
+    found = check_provenance([src], table)
+    assert rules_of(found) == ["KNB001"]
+    snips = snippets_of(found)
+    # direction 1: accessor read of an unregistered name
+    assert any("BFS_TPU_ROGUE" in f.message for f in found)
+    # direction 2: a registered row with no read site is equally fatal
+    assert "knb:BFS_TPU_DEAD:unread" in snips
+    # the read knob itself is clean
+    assert not any("BFS_TPU_FX" in s for s in snips)
+
+
+def test_knb001_registry_module_is_exempt():
+    table = _table(_knob("BFS_TPU_FX"))
+    inside = _src(
+        "import os\n"
+        "def raw(name):\n"
+        "    return os.environ.get('BFS_TPU_FX')\n",
+        path="bfs_tpu/knobs.py",
+    )
+    reader = _src(
+        "from bfs_tpu import knobs\n"
+        "def f():\n"
+        "    return knobs.get('BFS_TPU_FX')\n"
+    )
+    assert check_provenance([inside, reader], table) == []
+
+
+def test_knb001_suppression_pragma_is_honored():
+    table = _table(_knob("BFS_TPU_FX"))
+    src = _src(
+        "import os\n"
+        "from bfs_tpu import knobs\n"
+        "def f():\n"
+        "    knobs.get('BFS_TPU_FX')\n"
+        "    # bfs_tpu: ok KNB001\n"
+        "    return os.environ.get('BFS_TPU_FX')\n"
+    )
+    assert check_provenance([src], table) == []
+
+
+# -------------------------------------------------------------- KNB002 --
+
+def test_knb002_both_directions_on_fixture_providers():
+    table = _table(
+        _knob("BFS_TPU_A", affects=("ir",)),
+        _knob("BFS_TPU_B", affects=("ir",)),
+    )
+    # live tuple misses B (unkeyed) and carries C (undeclared)
+    found = check_key_completeness(
+        table, {"ir": ("BFS_TPU_A", "BFS_TPU_C")}
+    )
+    assert rules_of(found) == ["KNB002"]
+    assert snippets_of(found) == {
+        "knb:BFS_TPU_B:ir:unkeyed", "knb:BFS_TPU_C:ir:undeclared",
+    }
+    # near-miss: exact match is clean
+    assert check_key_completeness(
+        table, {"ir": ("BFS_TPU_A", "BFS_TPU_B")}
+    ) == []
+
+
+def test_knb002_unimportable_provider_is_knb000():
+    table = _table(_knob("BFS_TPU_A", affects=("ir",)))
+    found = check_key_completeness(
+        table, {"ir": ("no.such.module", "_FLAVOR_ENV")}
+    )
+    assert rules_of(found) == ["KNB000"]
+    assert snippets_of(found) == {"knb:ir:provider"}
+
+
+@pytest.mark.lint_knobs
+def test_knb002_live_registry_matches_live_key_builders():
+    """The tentpole proof: the registry's ``affects`` declarations and
+    the ACTUAL imported flavor tuples / journal keys / engine
+    fingerprint env are the same sets, in both directions, for every
+    domain."""
+    assert check_key_completeness() == []
+
+
+def test_journal_env_config_resume_semantics():
+    """A default run and an explicit-default run must produce the same
+    journal config (they resume each other); a changed knob forks it."""
+    from bfs_tpu.resilience.journal import env_config
+
+    def clean(env):
+        for k in reg.KNOBS:
+            env.pop(k, None)
+
+    saved = {k: os.environ[k] for k in reg.KNOBS if k in os.environ}
+    try:
+        clean(os.environ)
+        base = env_config()
+        os.environ["BFS_TPU_DIRECTION"] = "auto"  # the registered default
+        assert env_config() == base
+        os.environ["BFS_TPU_DIRECTION"] = "pull"
+        assert env_config() != base
+    finally:
+        clean(os.environ)
+        os.environ.update(saved)
+
+
+# -------------------------------------------------------------- KNB003 --
+
+def test_knb003_import_time_read_of_call_knob_trips():
+    table = _table(
+        _knob("BFS_TPU_CALL", scope="call"),
+        _knob("BFS_TPU_IMP", scope="import"),
+    )
+    src = _src(
+        "from bfs_tpu import knobs\n"
+        "BAD = knobs.get('BFS_TPU_CALL')\n"
+        "OK = knobs.get('BFS_TPU_IMP')\n"
+    )
+    found = check_scope([src], table)
+    assert rules_of(found) == ["KNB003"]
+    assert len(found) == 1 and "BFS_TPU_CALL" in found[0].message
+
+    near = _src(
+        "from bfs_tpu import knobs\n"
+        "def f():\n"
+        "    return knobs.get('BFS_TPU_CALL')\n"
+    )
+    assert check_scope([near], table) == []
+
+
+def test_knb003_read_inside_traced_region_trips():
+    table = _table(_knob("BFS_TPU_CALL"))
+    src = _src(
+        "from bfs_tpu import knobs\n"
+        "# bfs_tpu: hot traced\n"
+        "def body(x):\n"
+        "    return x + (knobs.get('BFS_TPU_CALL') == 'on')\n"
+    )
+    found = check_scope([src], table)
+    assert rules_of(found) == ["KNB003"]
+    assert "trace time" in found[0].message
+
+    near = _src(  # hot but NOT traced: runtime read is fine
+        "from bfs_tpu import knobs\n"
+        "# bfs_tpu: hot\n"
+        "def body(x):\n"
+        "    return x + (knobs.get('BFS_TPU_CALL') == 'on')\n"
+    )
+    assert check_scope([near], table) == []
+
+
+# -------------------------------------------------------------- KNB004 --
+
+def test_knb004_both_directions_and_rendered_table_is_clean():
+    table = _table(_knob("BFS_TPU_FX"), _knob("BFS_TPU_GONE"))
+    readme = (
+        "# fixture\n\n"
+        "| Knob | Default |\n| --- | --- |\n"
+        "| `BFS_TPU_FX` | `auto` |\n"
+        "| `BFS_TPU_STALE` | `1` |\n"
+    )
+    found = check_docs(readme, table)
+    assert rules_of(found) == ["KNB004"]
+    assert snippets_of(found) == {
+        "knb:BFS_TPU_GONE:undocumented", "knb:BFS_TPU_STALE:stale-row",
+    }
+    # the stale finding points at the offending row's line
+    stale = [f for f in found if f.snippet.endswith("stale-row")][0]
+    assert readme.splitlines()[stale.line - 1].startswith("| `BFS_TPU_STALE`")
+    # near-miss: the generated table covers the whole fixture registry
+    assert check_docs(render_knob_table(table), table) == []
+
+
+def test_readme_row_parser_skips_separators_and_strips_backticks():
+    rows = readme_knob_rows(
+        "| Knob | x |\n| --- | --- |\n| `BFS_TPU_A` | 1 |\n"
+        "| BFS_TPU_B | 2 |\n| not a knob | 3 |\n"
+    )
+    assert rows == {"BFS_TPU_A": 3, "BFS_TPU_B": 4}
+
+
+def test_write_docs_bootstraps_markers_and_is_idempotent(tmp_path):
+    root = tmp_path
+    (root / "README.md").write_text("# repo\n\nbody text\n")
+    assert write_docs(root=str(root)) is True
+    text = (root / "README.md").read_text()
+    assert "<!-- knob-table:begin -->" in text
+    assert "body text" in text  # existing prose kept
+    # every live knob got a row — KNB004 satisfied mechanically
+    assert set(readme_knob_rows(text)) == set(reg.KNOBS)
+    # second run: no drift, no rewrite
+    assert write_docs(root=str(root)) is False
+    # a hand-edited table region is regenerated in place, prose kept
+    (root / "README.md").write_text(text.replace(
+        "<!-- knob-table:begin -->",
+        "<!-- knob-table:begin -->\n| `BFS_TPU_STALE` | x |", 1))
+    assert write_docs(root=str(root)) is True
+    assert "BFS_TPU_STALE" not in (root / "README.md").read_text()
+
+
+# -------------------------------------------------------------- KNB005 --
+
+def test_knb005_default_and_canary_roundtrip_fixture():
+    def picky(raw):
+        if raw != "auto":
+            raise ValueError("nope")  # does not name the knob
+        return raw
+
+    table = _table(
+        _knob("BFS_TPU_OK"),
+        _knob("BFS_TPU_BAD_DEFAULT", default="zap"),
+        _knob("BFS_TPU_LOOSE", parse=lambda raw: raw),  # accepts canary
+        _knob("BFS_TPU_NO_CANARY", canary=None),
+        _knob("BFS_TPU_FREEFORM", kind="path", parse=lambda raw: raw,
+              canary=None),
+    )
+    found = check_parsers(table)
+    assert rules_of(found) == ["KNB005"]
+    assert snippets_of(found) == {
+        "knb:BFS_TPU_BAD_DEFAULT:default-rejected",
+        "knb:BFS_TPU_LOOSE:canary-accepted",
+        "knb:BFS_TPU_NO_CANARY:no-canary",
+    }
+
+
+@pytest.mark.lint_knobs
+def test_knb005_live_registry_roundtrips():
+    assert check_parsers() == []
+
+
+def test_knob_error_names_the_var_for_operators():
+    with pytest.raises(reg.KnobError) as exc:
+        reg.parse_value("BFS_TPU_DIRECTION", "sideways")
+    assert "BFS_TPU_DIRECTION" in str(exc.value)
+    assert exc.value.knob == "BFS_TPU_DIRECTION"
+
+
+def test_registry_defaults_match_module_constants():
+    """The migration's no-behavior-change pin: the registry defaults
+    must equal the module constants the hand-rolled reads used to
+    fall back to."""
+    from bfs_tpu.models.direction import DEFAULT_ALPHA, DEFAULT_BETA
+    from bfs_tpu.parallel.exchange import DEFAULT_BUDGET_DIV
+    from bfs_tpu.resilience.superstep_ckpt import DEFAULT_MTBF_S
+    from bfs_tpu.ops import relay_pallas
+
+    assert reg.get("BFS_TPU_DIRECTION_ALPHA") == DEFAULT_ALPHA
+    assert reg.get("BFS_TPU_DIRECTION_BETA") == DEFAULT_BETA
+    assert reg.get("BFS_TPU_EXCHANGE_DIV") == DEFAULT_BUDGET_DIV
+    assert reg.get("BFS_TPU_CKPT_MTBF_S") == DEFAULT_MTBF_S
+    # import-scoped kernel geometry: the module constants ARE the
+    # accessor reads now; they must agree with the registry defaults
+    # when the env is unset (tier-1 never sets them).
+    if "BFS_TPU_TILE_ROWS" not in os.environ:
+        assert relay_pallas.TILE_ROWS == reg.parse_value(
+            "BFS_TPU_TILE_ROWS", reg.KNOBS["BFS_TPU_TILE_ROWS"].default)
+    if "BFS_TPU_OUTER_TT" not in os.environ:
+        assert relay_pallas.OUTER_TT == reg.parse_value(
+            "BFS_TPU_OUTER_TT", reg.KNOBS["BFS_TPU_OUTER_TT"].default)
+
+
+# ------------------------------------------------- repo-wide + caching --
+
+@pytest.mark.lint_knobs
+def test_repo_knob_self_lint_is_clean():
+    """The whole contract holds on the shipped tree: no raw reads, no
+    dead rows, complete keys, clean scopes, full docs, round-tripping
+    parsers — with zero baseline entries needed."""
+    findings, meta = analyze_knobs(use_cache=False)
+    assert findings == []
+    assert meta["skipped"] == {}
+    assert len(meta["knobs"]) == len(reg.KNOBS)
+
+
+@pytest.mark.lint_knobs
+def test_knob_result_cache_miss_then_hit(tmp_path):
+    f1, m1 = analyze_knobs(cache_dir=str(tmp_path))
+    assert m1["cache"] == "miss"
+    f2, m2 = analyze_knobs(cache_dir=str(tmp_path))
+    assert m2["cache"] == "hit"
+    assert [f.snippet for f in f2] == [f.snippet for f in f1]
+    assert m2["knobs"] == m1["knobs"]
+
+
+def test_fixture_overrides_disable_cache(tmp_path):
+    _, meta = analyze_knobs(
+        _table(_knob("BFS_TPU_FX")), cache_dir=str(tmp_path)
+    )
+    assert meta["cache"] == "off"
+    assert list(tmp_path.iterdir()) == []
+
+
+# ------------------------------------------------------------------ CLI --
+
+def _poison(monkeypatch):
+    """Register a knob nothing reads or documents: the live pass must
+    fail with the dead-row and undocumented findings."""
+    monkeypatch.setitem(
+        reg.KNOBS, "BFS_TPU_KNBTEST_GHOST", _knob("BFS_TPU_KNBTEST_GHOST")
+    )
+
+
+@pytest.mark.lint_knobs
+def test_cli_knobs_exits_nonzero_on_regression(monkeypatch, capsys):
+    from bfs_tpu.analysis import __main__ as cli
+
+    _poison(monkeypatch)
+    rc = cli.main(["--knobs", "--no-cache", "--no-baseline"])
+    out = capsys.readouterr()
+    assert rc == 1
+    assert "KNB001" in out.out and "KNB004" in out.out
+    assert "BFS_TPU_KNBTEST_GHOST" in out.out
+
+
+@pytest.mark.lint_knobs
+def test_cli_knobs_subcommand_and_baseline_accept(monkeypatch, tmp_path,
+                                                  capsys):
+    from bfs_tpu.analysis import __main__ as cli
+
+    _poison(monkeypatch)
+    findings, _ = analyze_knobs(use_cache=False)
+    bl = tmp_path / "baseline.txt"
+    bl.write_text(Baseline.render(findings, "fixture ghost knob"))
+    rc = cli.main(["knobs", "--no-cache", "--baseline", str(bl)])
+    out = capsys.readouterr()
+    assert rc == 0
+    assert "baseline-accepted" in out.err
+
+
+@pytest.mark.lint_knobs
+def test_cli_stale_knb_entry_fails_default_surface(tmp_path, capsys):
+    from bfs_tpu.analysis import __main__ as cli
+
+    bl = tmp_path / "baseline.txt"
+    bl.write_text("KNB001  deadbeefdead  [bfs_tpu/knobs.py:0] gone\n")
+    rc = cli.main(["--knobs", "--no-cache", "--baseline", str(bl)])
+    out = capsys.readouterr()
+    assert rc == 1
+    assert "STALE" in out.err
+
+
+@pytest.mark.lint_knobs
+def test_cli_knobs_write_baseline_prints_never_clobbers(monkeypatch,
+                                                        tmp_path, capsys):
+    from bfs_tpu.analysis import __main__ as cli
+
+    _poison(monkeypatch)
+    bl = tmp_path / "baseline.txt"
+    bl.write_text("# hand-curated\n")
+    rc = cli.main(["--knobs", "--no-cache", "--write-baseline",
+                   "--baseline", str(bl)])
+    out = capsys.readouterr()
+    assert rc == 0
+    assert "KNB finding(s) rendered above" in out.err
+    assert bl.read_text() == "# hand-curated\n"  # not clobbered
+    assert "KNB001" in out.out  # candidates printed for curation
+
+
+def test_cli_knobs_rejects_scoping_and_orphan_write_docs(capsys):
+    from bfs_tpu.analysis import __main__ as cli
+
+    assert cli.main(["--knobs", "bfs_tpu/models/bfs.py"]) == 2
+    assert cli.main(["--knobs", "--changed"]) == 2
+    assert cli.main(["--knobs", "--ir"]) == 2
+    assert cli.main(["--write-docs"]) == 2
+    capsys.readouterr()
+
+
+@pytest.mark.lint_knobs
+def test_cli_write_docs_green_and_json_meta(tmp_path, capsys):
+    """--write-docs regenerates (here: confirms current) the README
+    table, then the pass runs green; --json carries the knob meta."""
+    from bfs_tpu.analysis import __main__ as cli
+
+    rc = cli.main(["--knobs", "--no-cache", "--write-docs", "--json"])
+    out = capsys.readouterr()
+    assert rc == 0
+    assert "already current" in out.err
+    import json as _json
+
+    doc = _json.loads(out.out)
+    assert doc["findings"] == []
+    assert doc["ir"]["knobs"]  # meta payload rides the shared key

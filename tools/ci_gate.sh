@@ -13,10 +13,11 @@
 #      segment, resume, assert bit-identity (~seconds).  Runs even with
 #      --no-tests: a checkpoint/resume divergence must fail the gate
 #      independently of where tier-1 ran.
-#   3. bfs-tpu-lint --all: AST + IR + HLO + Pallas with merged baseline
-#      handling — one exit code over every analyzer rung.  The jax
-#      passes are content-address-cached (.bench_cache/{ir,hlo,pal}),
-#      so a tree tier-1 just ran on lints in seconds.
+#   3. bfs-tpu-lint --all: AST + IR + HLO + Pallas + Knobs with merged
+#      baseline handling — one exit code over every analyzer rung.
+#      The non-AST passes are content-address-cached
+#      (.bench_cache/{ir,hlo,pal,knb}), so a tree tier-1 just ran on
+#      lints in seconds.
 #
 # Exit 0 = mergeable.  Any test failure, any unbaselined finding, or any
 # STALE baseline entry is non-zero.
@@ -30,8 +31,9 @@ fi
 
 if [[ "$RUN_TESTS" == "1" ]]; then
     echo "== ci gate 0/3: warm analysis caches =="
-    # Populate the content-addressed lint caches (.bench_cache/{ir,hlo,pal})
-    # BEFORE tier-1: the suite's lint_ir/lint_hlo/lint_pallas tests then
+    # Populate the content-addressed lint caches
+    # (.bench_cache/{ir,hlo,pal,knb}) BEFORE tier-1: the suite's
+    # lint_ir/lint_hlo/lint_pallas/lint_knobs tests then
     # hit warm caches instead of each paying the cold jax trace/compile
     # (~74 s) inside the pytest run, and the final lint stage is pure
     # cache reads.  Lint FAILURES are deliberately not fatal here — this
@@ -78,9 +80,9 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_algo_sssp.py \
     -m 'algo_smoke' -p no:cacheprovider
 
 if [[ "$RUN_TESTS" == "1" ]]; then
-    echo "== ci gate 3/3: lint --all (AST + IR + HLO + Pallas) =="
+    echo "== ci gate 3/3: lint --all (AST + IR + HLO + Pallas + Knobs) =="
 else
-    echo "== ci gate: lint --all (AST + IR + HLO + Pallas) =="
+    echo "== ci gate: lint --all (AST + IR + HLO + Pallas + Knobs) =="
 fi
 JAX_PLATFORMS=cpu python -m bfs_tpu.analysis --all
 

@@ -275,7 +275,9 @@ def main(argv=None) -> int:
         "max_weight": args.max_weight,
     }
     jr = None
-    if not args.no_journal and os.environ.get("BFS_TPU_JOURNAL", "1") != "0":
+    from bfs_tpu import knobs
+
+    if not args.no_journal and knobs.get("BFS_TPU_JOURNAL"):
         from bfs_tpu.config import journal_dir
         from bfs_tpu.resilience.journal import RunJournal
 
