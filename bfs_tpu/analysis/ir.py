@@ -463,6 +463,29 @@ def _spec_serve_batch(engine: str):
     )
 
 
+def _spec_label_lookup():
+    """The serve label tier's point-query program (serve/labels.py):
+    one batched gather+min over the uint16[K, V] landmark rows — no
+    V-sized carry, no donation (IR001 trivially holds), and its whole
+    point is being orders of magnitude smaller than a traversal."""
+    import jax.numpy as jnp
+
+    from ..serve.labels import _label_bounds, build_label_index
+
+    idx = _memo("labels", lambda: build_label_index(_tiny_graph(), 3))
+    return Program(
+        name="serve.label_lookup", path="bfs_tpu/serve/labels.py",
+        fn=_label_bounds,
+        args=(
+            jnp.asarray(idx.dist),
+            jnp.zeros((4,), jnp.int32),
+            jnp.ones((4,), jnp.int32),
+        ),
+        v_elements=idx.num_vertices,
+        budget_bytes=_hbm_envelope(),
+    )
+
+
 def _spec_direction_fused():
     import jax.numpy as jnp
 
@@ -1245,6 +1268,7 @@ PROGRAM_SPECS = {
     "bfs.pull_fused": _spec_pull_fused,
     "serve.batch_push": lambda: _spec_serve_batch("push"),
     "serve.batch_pull": lambda: _spec_serve_batch("pull"),
+    "serve.label_lookup": _spec_label_lookup,
     "direction.fused_auto": _spec_direction_fused,
     "relay.fused": _spec_relay_fused,
     "relay.fused_mxu": _spec_relay_fused_mxu,

@@ -1777,6 +1777,194 @@ def _cold_path_estimator(mbs: float, backend: str, edge_factor: int,
     return est
 
 
+def _labels_bench(scale: int, edge_factor: int, k: int) -> None:
+    """The LABEL-TIER headline (ISSUE 20): BENCH_LABELS=<K> measures the
+    landmark distance-label oracle against the exact-traversal serve shape
+    on one batch of random point queries.
+
+    Two timed arms over the SAME pairs: **exact** runs one single-source
+    traversal per query — what every ``dist(u, v)`` cost before the label
+    tier — and **labels** answers tight pairs from the device-resident
+    index and pays a traversal only for the fallbacks, which is exactly
+    the serve path's dispatch (serve/server.py query_dist).  Every label
+    answer is compared against the exact arm's answer for the same pair —
+    the headline journals ``wrong_answers`` (must be 0) next to the
+    hit/fallback split, and ``details.labels`` is the ledger-diffable
+    record (tools/ledger_compare.py labels table).
+
+    Journaled like the other dedicated modes: graph -> labels_build ->
+    pairs -> exact -> labels_serve -> headline, each a durable record a
+    killed capture resumes from; the label index itself rides the
+    content-addressed sidecar cache, so a resumed build is a warm hit."""
+    from .cache.layout import graph_content_hash, load_or_build_labels
+    from .models.multisource import bfs_multi
+    from .serve.labels import LabelOracle, labels_budget_bytes
+
+    backend = _generator_backend()
+    seed, block = 42, 8 * 1024
+    pairs = int(os.environ.get("BENCH_PAIRS", "128"))
+    engine = "pull"
+    jr = _open_journal({
+        "bench": "labels", "k": k, "scale": scale,
+        "edge_factor": edge_factor, "pairs": pairs, "engine": engine,
+        "backend": backend, "seed": seed, "block": block,
+        **env_config(),
+    })
+    _install_signal_handlers(jr)
+    _stamp(f"labels config: k={k} scale={scale} ef={edge_factor} "
+           f"pairs={pairs} device={jax.devices()[0]}")
+
+    with obs_span("bench.load_graph", scale=scale):
+        dg, _source = load_or_build(scale, edge_factor, seed, block, backend)
+    _stamp(f"device graph ready: V={dg.num_vertices} E={dg.num_edges}")
+    if jr is not None:
+        ghash = graph_content_hash(dg)
+        grec = jr.get("graph")
+        if grec is not None and grec["content_hash"] != ghash:
+            _stamp("journal: graph content hash mismatch — rotating")
+            jr.restart("graph-hash mismatch")
+            grec = None
+        if grec is None:
+            _boundary(jr, "graph", {
+                "content_hash": ghash,
+                "num_vertices": int(dg.num_vertices),
+                "num_edges": int(dg.num_edges),
+            })
+        done = jr.get("headline")
+        if done is not None:
+            _stamp("journal: labels run complete; replaying headline")
+            print(json.dumps(done["headline"]), flush=True)
+            _finish_obs(jr)
+            return
+    fault_point("graph")
+
+    # ---- label index: sidecar-cached, budget-gated --------------------
+    t0 = time.perf_counter()
+    with obs_span("bench.labels_build", k=k):
+        idx, linfo = load_or_build_labels(
+            dg, k, cache=_layout_cache(), engine=engine
+        )
+        oracle = LabelOracle(idx, budget_bytes=labels_budget_bytes())
+    build_wall = time.perf_counter() - t0
+    _stamp(
+        f"label index ready in {build_wall:.1f}s (K={idx.k}, "
+        f"{idx.device_bytes >> 10} KB on device, "
+        f"cache={linfo.get('cache')})"
+    )
+    _boundary(jr, "labels_build", {
+        "k": idx.k, "cache": linfo.get("cache"),
+        "build_seconds": float(linfo.get("build_seconds", -1.0)),
+        "index_bytes": int(idx.nbytes),
+        "device_bytes": int(idx.device_bytes),
+    })
+
+    # ---- query pairs (journaled so a resume re-times the same batch) --
+    prec = jr.get("pairs") if jr is not None else None
+    if prec is not None:
+        us = np.asarray(prec["u"], dtype=np.int32)
+        vs = np.asarray(prec["v"], dtype=np.int32)
+    else:
+        rng = np.random.default_rng(4242)
+        us = rng.integers(0, dg.num_vertices, size=pairs).astype(np.int32)
+        vs = rng.integers(0, dg.num_vertices, size=pairs).astype(np.int32)
+        _boundary(jr, "pairs", {
+            "u": [int(x) for x in us], "v": [int(x) for x in vs],
+        })
+
+    def _exact_row(u: int) -> np.ndarray:
+        return np.asarray(bfs_multi(dg, [int(u)], engine=engine).dist)[0]
+
+    # ---- exact arm: one traversal per point query ---------------------
+    erec = jr.get("exact") if jr is not None else None
+    if erec is not None:
+        exact_seconds = float(erec["seconds"])
+        exact_d = np.asarray(erec["dist"], dtype=np.int64)
+        _stamp("journal: exact arm restored")
+    else:
+        _exact_row(int(us[0]))  # compile + warm outside the clock
+        t0 = time.perf_counter()
+        with obs_span("bench.labels_exact_arm", pairs=pairs):
+            exact_d = np.asarray(
+                [_exact_row(int(u))[int(v)] for u, v in zip(us, vs)],
+                dtype=np.int64,
+            )
+        exact_seconds = time.perf_counter() - t0
+        _boundary(jr, "exact", {
+            "seconds": exact_seconds, "dist": [int(d) for d in exact_d],
+        })
+    _stamp(f"exact arm: {pairs} queries in {exact_seconds:.2f}s "
+           f"({pairs / exact_seconds:.1f} q/s)")
+
+    # ---- label arm: batched lookup, traversal only on fallback --------
+    srec = jr.get("labels_serve") if jr is not None else None
+    if srec is not None:
+        label_seconds = float(srec["seconds"])
+        label_d = np.asarray(srec["dist"], dtype=np.int64)
+        tight_hits = int(srec["tight_hits"])
+        _stamp("journal: label arm restored")
+    else:
+        oracle.dist(us, vs)  # compile + warm at batch shape, off the clock
+        t0 = time.perf_counter()
+        with obs_span("bench.labels_serve_arm", pairs=pairs):
+            d, tight, _bk = oracle.dist(us, vs)
+            label_d = d.astype(np.int64)
+            for i in np.flatnonzero(~tight):
+                label_d[i] = int(_exact_row(int(us[i]))[int(vs[i])])
+        label_seconds = time.perf_counter() - t0
+        tight_hits = int(tight.sum())
+        _boundary(jr, "labels_serve", {
+            "seconds": label_seconds, "tight_hits": tight_hits,
+            "dist": [int(x) for x in label_d],
+        })
+    fallbacks = pairs - tight_hits
+    wrong = int(np.count_nonzero(label_d != exact_d))
+    _stamp(
+        f"label arm: {pairs} queries in {label_seconds:.2f}s "
+        f"({pairs / label_seconds:.1f} q/s; {tight_hits} tight, "
+        f"{fallbacks} fallbacks, {wrong} wrong)"
+    )
+    if wrong:
+        raise SystemExit(
+            f"label tier returned {wrong} answers that disagree with the "
+            "exact traversal — the tightness certificate is broken"
+        )
+
+    labels_qps = pairs / label_seconds
+    exact_qps = pairs / exact_seconds
+    doc = {
+        "metric": f"rmat{scale}_labels_k{idx.k}_qps",
+        "value": labels_qps,
+        "unit": "queries/s",
+        "details": {
+            "device": str(jax.devices()[0]),
+            "engine": engine,
+            "num_vertices": int(dg.num_vertices),
+            "num_directed_edges": int(dg.num_edges),
+            "labels": {
+                "k": int(idx.k),
+                "pairs": int(pairs),
+                "tight_hits": tight_hits,
+                "fallbacks": fallbacks,
+                "tight_rate": tight_hits / pairs,
+                "wrong_answers": wrong,
+                "labels_qps": labels_qps,
+                "exact_qps": exact_qps,
+                "speedup": labels_qps / exact_qps,
+                "build_seconds": float(linfo.get("build_seconds", -1.0)),
+                "index_bytes": int(idx.nbytes),
+                "device_bytes": int(idx.device_bytes),
+                "cache": linfo.get("cache"),
+            },
+        },
+    }
+    print(json.dumps(doc), flush=True)
+    if jr is not None:
+        jr.put("headline", {"headline": doc})
+    _finish_obs(jr)
+    fault_point("headline")
+    _stamp("labels final line emitted; done")
+
+
 def main():
     # A cold driver run pays the full relay layout build; per-phase stderr
     # stamps make a slow build diagnosable from the capture's tail instead
@@ -1821,6 +2009,13 @@ def main():
         if engine != "relay":
             raise SystemExit("BENCH_MESH requires BENCH_ENGINE=relay")
         _multichip_bench(scale, edge_factor, repeats, num_roots, do_check)
+        return
+
+    # LABEL-TIER mode (ISSUE 20): BENCH_LABELS=<K> benches the landmark
+    # distance-label oracle vs the exact-traversal point-query shape.
+    labels_k = int(os.environ.get("BENCH_LABELS", "0") or "0")
+    if labels_k > 0:
+        _labels_bench(scale, edge_factor, labels_k)
         return
 
     _stamp(

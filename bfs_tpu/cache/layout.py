@@ -534,6 +534,111 @@ def verify_tiles_bundle(rg, *, cache: LayoutCache | None = None) -> dict:
     }
 
 
+def labels_key(graph, k: int) -> str:
+    """Content key for the landmark distance-label SIDECAR bundle
+    (ISSUE 20): (graph content, K, label code version).  Landmark
+    SAMPLING is itself seeded from the graph content hash
+    (:func:`bfs_tpu.serve.labels.sample_landmarks`), so the key needs no
+    landmark list — same graph + same K always means the same index."""
+    from ..serve.labels import LABELS_VERSION
+
+    return (
+        f"labels_k{int(k)}_v{LABELS_VERSION}_s{STORE_VERSION}"
+        f"_{graph_content_hash(graph)}"
+    )
+
+
+def load_or_build_labels(graph, k: int, *, cache: LayoutCache | None = None,
+                         engine: str = "pull",
+                         ckpt_dir: str | os.PathLike | None = None):
+    """``(LabelIndex, info)`` — the serve label tier's landmark index,
+    disk-cached as a sidecar bundle next to the layout bundle (info
+    contract: :func:`_load_or_build`).  The K-root sweep itself is
+    chunk-checkpointed (:func:`bfs_tpu.serve.labels.build_label_index`),
+    so a killed COLD build resumes; a warm hit never recomputes."""
+    from ..serve.labels import (
+        build_label_index,
+        labels_from_arrays,
+        labels_to_arrays,
+    )
+
+    return _load_or_build(
+        graph,
+        cache=cache,
+        tag=None,
+        kind="labels",
+        key_fn=lambda: labels_key(graph, k),
+        build_fn=lambda: build_label_index(
+            graph, k, engine=engine, ckpt_dir=ckpt_dir
+        ),
+        to_arrays=labels_to_arrays,
+        from_arrays=labels_from_arrays,
+        build_meta={"engine": engine, "k": int(k)},
+    )
+
+
+def verify_labels_bundle(graph, k: int, *,
+                         cache: LayoutCache | None = None) -> dict:
+    """Integrity report of the label sidecar bundle WITHOUT building on a
+    miss (the cache_warm ``--labels`` check): loads the bundle — every
+    array fingerprint-checked by :meth:`LayoutCache.load` — then
+    validates the label invariants the oracle leans on: version/shape
+    agreement with the graph, landmark ids in range, each landmark at
+    distance 0 from itself and its own parent, and the unreachable
+    sentinel agreeing between dist and parent.  Returns a JSON-ready
+    dict; never raises on a bad bundle."""
+    from ..serve.labels import LABEL_INF, LABELS_VERSION, labels_from_arrays
+
+    cache = cache if cache is not None else LayoutCache()
+    key = labels_key(graph, k)
+    loaded = cache.load(key)
+    if loaded is None:
+        return {"key": key, "ok": False, "status": "absent"}
+    _doc, arrays = loaded
+    try:
+        idx = labels_from_arrays(arrays)
+    except Exception as exc:  # version bump / shape drift
+        return {"key": key, "ok": False, "status": f"unreadable: {exc}"}
+    problems = []
+    dims = np.asarray(arrays["dims"])
+    if int(dims[0]) != LABELS_VERSION:
+        problems.append(f"labels version {int(dims[0])} != {LABELS_VERSION}")
+    if idx.num_vertices != graph.num_vertices:
+        problems.append(
+            f"num_vertices {idx.num_vertices} != graph "
+            f"{graph.num_vertices}"
+        )
+    if idx.dist.shape != (idx.k, idx.num_vertices):
+        problems.append(f"dist shape {idx.dist.shape} != (K, V)")
+    if idx.parent.shape != idx.dist.shape:
+        problems.append("parent shape differs from dist")
+    lm = np.asarray(idx.landmarks)
+    if lm.size and (
+        int(lm.min()) < 0 or int(lm.max()) >= idx.num_vertices
+    ):
+        problems.append("landmark id outside the vertex space")
+    if not problems and lm.size:
+        rows = np.arange(idx.k)
+        if np.asarray(idx.dist)[rows, lm].any():
+            problems.append("a landmark is not at distance 0 from itself")
+        if (np.asarray(idx.parent)[rows, lm] != lm).any():
+            problems.append("a landmark is not its own parent")
+        sent = np.asarray(idx.dist) == LABEL_INF
+        orphan = np.asarray(idx.parent) < 0
+        if (sent != orphan).any():
+            problems.append(
+                "unreachable sentinel disagrees between dist and parent"
+            )
+    return {
+        "key": key,
+        "ok": not problems,
+        "status": "ok" if not problems else "; ".join(problems),
+        "k": int(idx.k),
+        "index_bytes": int(idx.nbytes),
+        "device_bytes": int(idx.device_bytes),
+    }
+
+
 # ---------------------------------------------------------------------------
 # Phase-probe verdict memo (ISSUE 15 satellite): probe_phase_kernels is a
 # pure function of (layout shapes, kernel/probe sources, backend, knobs) —

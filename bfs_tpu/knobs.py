@@ -155,6 +155,18 @@ def _parse_ckpt(raw: str):
     return raw.strip()
 
 
+def _parse_labels(raw: str):
+    """off | <K> — landmark distance-label count; 'off' (or 0) parses to
+    0 = no label tier, any positive int is the landmark budget K."""
+    s = raw.strip().lower()
+    if s in ("off", "0"):
+        return 0
+    v = int(s)
+    if v < 1:
+        raise ValueError("use off | <K> with K >= 1")
+    return v
+
+
 def _parse_fault(raw: str):
     """kill:<phase>[:nth] | raise:<phase>[:nth] | phase:<phase>[:nth] |
     delay:<phase>[:seconds]; '' = no fault.  Full parsing (nth/seconds
@@ -333,6 +345,29 @@ KNOBS: dict[str, Knob] = {k.name: k for k in (
        "superstep checkpointing: off | every:<k> | auto (Young/Daly "
        "interval) — selects fused vs segmented programs",
        affects=_FLAVOR, canary="sometimes"),
+    # -- serve label oracle / fleet router --------------------------------
+    _k("BFS_TPU_LABELS", "spec", "off", _parse_labels,
+       "landmark distance-label oracle tier: off | <K> landmark roots "
+       "precomputed at serve register() time; point queries answer from "
+       "labels when the tightness certificate holds",
+       affects=("journal", "serve"), canary="many",
+       journal_key="labels"),
+    _k("BFS_TPU_LABELS_GB", "float", "2", _float(0.0),
+       "device budget for the resident label index (uint16[K,V]); an "
+       "over-budget index serves exact-only",
+       canary="big"),
+    _k("BFS_TPU_LABELS_VERIFY", "int", "0", _int(0),
+       "sample-verify every Nth tight label answer against the exact "
+       "traversal; a mismatch quarantines the index (0 = off)",
+       canary="-1"),
+    _k("BFS_TPU_ROUTER_FAILURES", "int", "2", _int(1),
+       "fleet router per-replica breaker: consecutive submit failures "
+       "before the replica is routed around",
+       canary="0"),
+    _k("BFS_TPU_ROUTER_COOLDOWN_S", "float", "2.0", _float(0.0),
+       "fleet router breaker cooldown before an opened replica is "
+       "retried",
+       canary="slow"),
     # -- sharded exchange / mesh ------------------------------------------
     _k("BFS_TPU_EXCHANGE", "enum", "auto", _enum("auto", "bitmap", "delta", "flat"),
        "sharded frontier exchange arm: sieved bitmaps, word-list deltas "

@@ -22,18 +22,27 @@ programs keyed by (graph, epoch, engine, batch shape, direction)),
 transient-failure retry with backoff (:mod:`bfs_tpu.resilience.retry`),
 result LRU, oracle degradation), :class:`ServeHealth` (ISSUE 9: circuit
 breaker per executable, hung-call watchdog, sampled on-device integrity
-checks — the self-healing layer).
+checks — the self-healing layer), :class:`LabelOracle` +
+``BfsServer.query_dist`` (ISSUE 20: landmark distance-label tier — point
+queries answer from a precomputed device-resident label index when the
+tightness certificate holds, exact-traversal fallback otherwise), and
+:class:`FleetRouter` (ISSUE 20: N replicas behind a deterministic
+hash-by-graph router with failover and rolling epoch swaps over the
+shared on-disk caches).
 """
 
 from .algo import registry_cc, registry_sssp
 from .registry import ENGINES, GraphRegistry, RegisteredGraph
 from .executor import ExecutableCache, build_batch_runner, run_oracle_batch
 from .health import HungCallError, ServeHealth, run_with_deadline
+from .labels import LabelBudgetError, LabelIndex, LabelOracle, build_label_index
+from .router import FleetRouter, NoReplicaAvailable
 from .server import (
     DEFAULT_RETRY_POLICY,
     AdmissionError,
     BfsServer,
     CircuitOpenError,
+    DistReply,
     QueryTimeout,
     ServeError,
     ServeReply,
@@ -42,6 +51,13 @@ from .server import (
 
 __all__ = [
     "DEFAULT_RETRY_POLICY",
+    "DistReply",
+    "FleetRouter",
+    "LabelBudgetError",
+    "LabelIndex",
+    "LabelOracle",
+    "NoReplicaAvailable",
+    "build_label_index",
     "ENGINES",
     "GraphRegistry",
     "RegisteredGraph",

@@ -39,6 +39,7 @@ latency/batching/cache statistics the loadgen prints.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from collections import OrderedDict, deque
@@ -47,7 +48,9 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from .. import knobs
 from ..analysis.runtime import make_lock
+from ..graph.csr import INF_DIST
 from ..models.bfs import check_sources
 from ..models.multisource import MultiBfsResult, collapse_multi_source
 from ..obs.spans import span as obs_span
@@ -62,6 +65,8 @@ from .executor import (
 )
 from .health import HungCallError, ServeHealth
 from .registry import ENGINES, GraphRegistry
+
+logger = logging.getLogger(__name__)
 
 #: Default device-path retry shape: short delays (a serving tick is
 #: latency-bound) and few attempts; callers pass ``retry_policy`` for a
@@ -105,6 +110,37 @@ class ServeReply:
     parent: np.ndarray
     num_levels: int
     record: QueryRecord
+
+
+def _parent_chain(parent: np.ndarray, u: int, v: int) -> list | None:
+    """Path ``[u, ..., v]`` from a single-source parent tree rooted at
+    ``u`` (walks v's parent pointers back to the root)."""
+    chain = [int(v)]
+    cur = int(v)
+    limit = int(parent.shape[-1])
+    while cur != u:
+        cur = int(parent[cur])
+        if cur < 0 or len(chain) > limit:
+            return None
+        chain.append(cur)
+    return chain[::-1]
+
+
+@dataclass
+class DistReply:
+    """One point-distance query (``query_dist``).  ``method`` records the
+    tier that produced the answer: ``'labels'`` (tight certificate —
+    provably exact), ``'exact'`` (traversal fallback), or
+    ``'labels_verified'`` (a sampled tight answer that was ALSO checked
+    against the traversal before shipping)."""
+
+    graph: str
+    u: int
+    v: int
+    dist: int
+    method: str
+    landmark: int | None = None
+    path: list | None = None
 
 
 @dataclass
@@ -197,6 +233,14 @@ class BfsServer:
         # A LISTENER, not an attribute overwrite — servers sharing one
         # registry each subscribe their own health; close() detaches.
         self.registry.add_retire_listener(self._health.forget_epoch)
+        # Label oracle tier (ISSUE 20): per-(name, epoch) landmark
+        # distance-label indexes built at register() time when
+        # BFS_TPU_LABELS is on.  The retire listener drops an epoch's
+        # index with its device state — an epoch bump can never serve
+        # stale labels.
+        self._labels: dict[tuple, object] = {}  # guarded-by: _lock
+        self._label_tick = 0  # guarded-by: _lock (verify sampling)
+        self.registry.add_retire_listener(self._drop_label_epoch)
         # Direction policy resolved ONCE: a malformed BFS_TPU_DIRECTION /
         # alpha / beta knob fails server construction loudly instead of
         # raising inside every tick (which would silently degrade every
@@ -240,6 +284,9 @@ class BfsServer:
         # Detach the health hook: a shared registry outlives this server
         # and must not call into its dead ServeHealth.
         self.registry.remove_retire_listener(self._health.forget_epoch)
+        self.registry.remove_retire_listener(self._drop_label_epoch)
+        with self._lock:
+            self._labels.clear()
 
     def pause(self) -> None:
         """Hold batch formation (admission continues) — lets tests and
@@ -261,8 +308,17 @@ class BfsServer:
         old epoch's device operands are released when its last in-flight
         reference drops.  Executable and result caches need no purge —
         their keys carry the epoch, so old entries can never serve the
-        new graph and age out of their LRUs naturally."""
-        return self.registry.register(name, graph, **kw)
+        new graph and age out of their LRUs naturally.
+
+        With ``BFS_TPU_LABELS=<K>`` and a host graph, registration also
+        builds (or warm-loads from the layout store's sidecar) the
+        landmark distance-label index for the NEW epoch — the hot-swap
+        contract extends to the label tier: point queries admitted after
+        this call answer from the new index, the old one dies with its
+        epoch."""
+        rec = self.registry.register(name, graph, **kw)
+        self._maybe_build_labels(rec)
+        return rec
 
     def unregister(self, name: str) -> None:
         """Drop a graph AND every cache derived from it.  Use this (not
@@ -275,6 +331,8 @@ class BfsServer:
         with self._lock:
             for key in [k for k in self._result_cache if k[0] == name]:
                 del self._result_cache[key]
+            for key in [k for k in self._labels if k[0] == name]:
+                del self._labels[key]
 
     def query(self, graph: str, source: int, **kw) -> Future:
         """Single-source shortest-path query; reply rows are 1-D."""
@@ -289,6 +347,167 @@ class BfsServer:
         return self.submit(
             graph, sources, mode="collapse" if collapse else "tree", **kw
         )
+
+    # ------------------------------------------------------- label tier --
+    def _drop_label_epoch(self, name: str, epoch: int) -> None:
+        # Retire listener: fires under the registry lock — touch only our
+        # own state, never call back into the registry.
+        with self._lock:
+            self._labels.pop((name, epoch), None)
+
+    def _label_oracle(self, name: str, epoch: int):
+        with self._lock:
+            return self._labels.get((name, epoch))
+
+    def _maybe_build_labels(self, rec) -> None:
+        """Build/load the label index for a freshly registered epoch.
+        Label availability is best-effort: a build failure or a budget
+        reject logs, bumps a counter, and the server keeps serving
+        exact-only — the tier may only ever ADD speed."""
+        k = knobs.get("BFS_TPU_LABELS")
+        if not k:
+            return
+        if rec.graph is None:
+            self.metrics.bump("label_build_skipped")
+            return
+        from .labels import LabelBudgetError, build_label_oracle
+
+        try:
+            oracle, info = build_label_oracle(
+                rec.graph, k, cache=self.registry.layout_cache
+            )
+        except LabelBudgetError as exc:
+            logger.warning("label index over budget: %s", exc)
+            self.metrics.bump("label_budget_rejects")
+            return
+        except Exception:
+            logger.warning(
+                "label index build failed; serving exact-only",
+                exc_info=True,
+            )
+            self.metrics.bump("label_build_errors")
+            return
+        with self._lock:
+            self._labels[(rec.name, rec.epoch)] = oracle
+        self.metrics.bump("label_builds")
+        self.metrics.bump(
+            "label_build_cache_hits" if info.get("cache") == "hit"
+            else "label_build_cache_misses"
+        )
+
+    def query_dist(self, graph: str, u: int, v: int, *,
+                   want_path: bool = False, **kw) -> Future:
+        """Point query ``dist(u, v)`` — the label oracle tier.
+
+        Tight label answers (provably exact via the triangle-inequality
+        certificate) resolve IMMEDIATELY from the device-resident index —
+        no traversal, no batch queue, same fast-path shape as a result
+        cache hit.  Non-tight pairs, and graphs registered without labels
+        (``BFS_TPU_LABELS=off``), chain onto the exact traversal path
+        (:meth:`query` from ``u``, every robustness property included).
+        Every ``BFS_TPU_LABELS_VERIFY``-th tight answer is ALSO re-derived
+        through the exact path and cross-checked before shipping; a
+        mismatch quarantines the index (label_verify_failures) and the
+        exact answer ships instead — sampled verification, like every
+        other serve reply.  Returns a Future resolving to
+        :class:`DistReply`; ``want_path`` additionally reconstructs a
+        shortest path (label tier: through the certifying landmark;
+        fallback: from the traversal's parent tree)."""
+        u, v = int(u), int(v)
+        rec = self.registry.get(graph)
+        check_sources(rec.num_vertices, np.asarray([u, v], dtype=np.int32))
+        oracle = self._label_oracle(graph, rec.epoch)
+        if oracle is not None:
+            d, tight, best_k = oracle.dist_one(u, v)
+            if tight:
+                self.metrics.bump("label_hits")
+                path = oracle.path(u, v) if want_path else None
+                verify_every = knobs.get("BFS_TPU_LABELS_VERIFY")
+                if verify_every > 0:
+                    with self._lock:
+                        self._label_tick += 1
+                        sample = self._label_tick % verify_every == 0
+                    if sample:
+                        return self._verify_label_answer(
+                            graph, rec.epoch, u, v, d, best_k, path, **kw
+                        )
+                fut: Future = Future()
+                fut.set_result(DistReply(
+                    graph, u, v, d, "labels",
+                    landmark=int(oracle.index.landmarks[best_k]),
+                    path=path,
+                ))
+                return fut
+            self.metrics.bump("label_fallbacks")
+        else:
+            self.metrics.bump("label_misses")
+        return self._exact_dist(graph, u, v, want_path, **kw)
+
+    def query_path(self, graph: str, u: int, v: int, **kw) -> Future:
+        """Shortest-path point query; sugar for ``query_dist(...,
+        want_path=True)`` — exact path through the certifying landmark
+        when the label bound is tight, traversal parent-chain otherwise."""
+        return self.query_dist(graph, u, v, want_path=True, **kw)
+
+    def _exact_dist(self, graph: str, u: int, v: int, want_path: bool,
+                    **kw) -> Future:
+        """Chain a point query onto the exact traversal path."""
+        outer: Future = Future()
+        inner = self.submit(graph, [u], mode="single", **kw)
+
+        def _done(f: Future):
+            try:
+                reply = f.result()
+            except BaseException as exc:
+                outer.set_exception(exc)
+                return
+            try:
+                d = int(reply.dist[v])
+                path = (
+                    _parent_chain(reply.parent, u, v)
+                    if want_path and d < INF_DIST else None
+                )
+                outer.set_result(DistReply(
+                    graph, u, v, d, "exact", path=path
+                ))
+            except BaseException as exc:  # defensive: never hang the future
+                outer.set_exception(exc)
+
+        inner.add_done_callback(_done)
+        return outer
+
+    def _verify_label_answer(self, graph: str, epoch: int, u: int, v: int,
+                             label_d: int, best_k: int, path,
+                             **kw) -> Future:
+        """Sampled cross-check: re-derive the answer through the exact
+        path and compare before shipping.  A mismatch drops the epoch's
+        index (it can never be trusted again) and ships the EXACT answer."""
+        outer: Future = Future()
+        inner = self._exact_dist(graph, u, v, False, **kw)
+
+        def _done(f: Future):
+            try:
+                exact = f.result()
+            except BaseException as exc:
+                outer.set_exception(exc)
+                return
+            if exact.dist != label_d:
+                self.metrics.bump("label_verify_failures")
+                logger.error(
+                    "label answer mismatch on %s: dist(%d,%d) labels=%d "
+                    "exact=%d — quarantining the label index",
+                    graph, u, v, label_d, exact.dist,
+                )
+                self._drop_label_epoch(graph, epoch)
+                outer.set_result(exact)
+                return
+            self.metrics.bump("label_verifies")
+            outer.set_result(DistReply(
+                graph, u, v, label_d, "labels_verified", path=path
+            ))
+
+        inner.add_done_callback(_done)
+        return outer
 
     def submit(
         self,
@@ -755,6 +974,11 @@ class BfsServer:
             "budget_bytes": self.registry.device_budget_bytes,
         }
         out["executables_cached"] = len(self.exe_cache)
+        with self._lock:
+            out["labels"] = {
+                f"{name}@{epoch}": oracle.report()
+                for (name, epoch), oracle in self._labels.items()
+            }
         # Breaker snapshot (per-circuit state/failures/open-for) + watchdog
         # budgets + integrity sampling state — the self-healing view the
         # chaos driver asserts its transitions against.

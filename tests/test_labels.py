@@ -1,0 +1,366 @@
+"""Landmark distance-label oracle tier tests (ISSUE 20).
+
+Covers: deterministic degree-weighted landmark sampling, the tightness
+certificate (every tight answer bit-exact vs the host oracle; every
+non-tight pair falls back to the exact traversal — star leaves, path
+ends, gnm and R-MAT pairs), certified-disconnected pairs, exact path
+reconstruction through the certifying landmark, the sidecar cache
+round-trip + corruption rebuild, the budget gate, serve-tier epoch-swap
+invalidation, sampled verification, and kill/resume of the chunked
+precompute through the superstep-checkpoint store (bit-identical to an
+uninterrupted build).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from bfs_tpu.cache.layout import (
+    LayoutCache,
+    graph_content_hash,
+    labels_key,
+    load_or_build_labels,
+    verify_labels_bundle,
+)
+from bfs_tpu.graph.csr import Graph, INF_DIST
+from bfs_tpu.graph.generators import (
+    gnm_graph,
+    path_graph,
+    rmat_graph,
+    star_graph,
+)
+from bfs_tpu.oracle.bfs import queue_bfs
+from bfs_tpu.resilience import faults
+from bfs_tpu.resilience.faults import FaultInjected
+from bfs_tpu.serve import BfsServer, LabelBudgetError, LabelOracle
+from bfs_tpu.serve.labels import (
+    LABEL_INF,
+    build_label_index,
+    sample_landmarks,
+)
+
+TIMEOUT = 300
+
+GRAPHS = {
+    "star": lambda: star_graph(40),
+    "path": lambda: path_graph(33),
+    "gnm": lambda: gnm_graph(150, 400, seed=11),
+    "rmat": lambda: rmat_graph(7, 4, seed=5),
+}
+
+
+def _pairs(graph, n, seed=0):
+    rng = np.random.default_rng(seed)
+    v = graph.num_vertices
+    return (
+        rng.integers(0, v, size=n).astype(np.int32),
+        rng.integers(0, v, size=n).astype(np.int32),
+    )
+
+
+def _truth(graph, cache, u):
+    if u not in cache:
+        cache[u] = queue_bfs(graph, int(u))[0]
+    return cache[u]
+
+
+# ------------------------------------------------------------- sampling --
+
+def test_landmarks_deterministic_and_in_range():
+    g = GRAPHS["gnm"]()
+    a = sample_landmarks(g, 8)
+    b = sample_landmarks(g, 8)
+    np.testing.assert_array_equal(a, b)
+    assert a.dtype == np.int32 and a.shape == (8,)
+    assert len(set(a.tolist())) == 8
+    deg = np.bincount(np.asarray(g.src), minlength=g.num_vertices)
+    assert (0 <= a).all() and (a < g.num_vertices).all()
+    assert (deg[a] > 0).all()  # zero-degree vertices are never landmarks
+
+
+def test_landmarks_clamped_to_usable_roots():
+    g = path_graph(5)
+    lm = sample_landmarks(g, 64)
+    assert lm.shape[0] == 5  # clamped: only 5 usable roots exist
+
+
+# -------------------------------------------- certificate vs host oracle --
+
+@pytest.mark.parametrize("name", sorted(GRAPHS))
+def test_tight_answers_match_host_oracle(name):
+    g = GRAPHS[name]()
+    oracle = LabelOracle(build_label_index(g, 6))
+    us, vs = _pairs(g, 300, seed=3)
+    d, tight, _, upper, lower = oracle.bounds(us, vs)
+    cache = {}
+    for u, v, du, t, up, lo in zip(us, vs, d, tight, upper, lower):
+        want = int(_truth(g, cache, int(u))[v])
+        if t:
+            assert int(du) == want, f"tight answer wrong for ({u},{v})"
+        if want < INF_DIST:
+            # The bounds must sandwich the true distance on every
+            # connected pair, tight or not.
+            assert int(lo) <= want <= int(up)
+
+
+def test_star_leaf_pairs_never_tight_but_served_exactly():
+    """The adversarial shape: every leaf-leaf pair has upper=2, lower=0 —
+    the certificate must refuse them all, and the serve tier must answer
+    them exactly through the fallback traversal."""
+    g = GRAPHS["star"]()
+    idx = build_label_index(g, 4)
+    oracle = LabelOracle(idx)
+    # A leaf that IS a landmark makes its own pairs legitimately tight
+    # (d(L, u) = 0 collapses the sandwich) — the adversarial pairs are
+    # the leaf-leaf pairs with NO landmark endpoint.
+    lm = set(idx.landmarks.tolist())
+    leaves = np.asarray(
+        [x for x in range(1, g.num_vertices) if x not in lm], dtype=np.int32
+    )
+    us, vs = leaves[:-1], leaves[1:]
+    d, tight, _ = oracle.dist(us, vs)
+    assert not tight.any()
+    assert (d >= 2).all()  # upper bound, never below the true distance
+
+
+def test_disconnected_pairs_certified_exact():
+    # Two separate paths: any landmark reaching exactly one side
+    # certifies cross-pairs disconnected (exact INF_DIST, tight).
+    edges = np.array([[0, 1], [1, 2], [3, 4], [4, 5]], dtype=np.int32)
+    g = Graph.from_undirected_edges(6, edges)
+    oracle = LabelOracle(build_label_index(g, 6))
+    d, tight, _ = oracle.dist([0, 2, 1], [3, 5, 4])
+    assert tight.all()
+    assert (d == INF_DIST).all()
+
+
+def test_path_reconstruction_is_exact_shortest_path():
+    g = GRAPHS["gnm"]()
+    oracle = LabelOracle(build_label_index(g, 8))
+    edge_set = {
+        (int(a), int(b)) for a, b in zip(g.src, g.dst)
+    }
+    us, vs = _pairs(g, 200, seed=7)
+    d, tight, _ = oracle.dist(us, vs)
+    cache = {}
+    checked = 0
+    for u, v, t in zip(us, vs, tight):
+        if not t:
+            continue
+        path = oracle.path(int(u), int(v))
+        want = int(_truth(g, cache, int(u))[v])
+        if want >= INF_DIST:
+            assert path is None or len(path) == 1
+            continue
+        assert path is not None
+        assert path[0] == int(u) and path[-1] == int(v)
+        assert len(path) == want + 1  # a SHORTEST path, not just a walk
+        for a, b in zip(path, path[1:]):
+            assert (a, b) in edge_set
+        checked += 1
+    assert checked  # the certificate fired on at least one connected pair
+
+
+# ------------------------------------------------------- sidecar bundle --
+
+def test_sidecar_roundtrip_corruption_and_verify(tmp_path):
+    g = GRAPHS["gnm"]()
+    cache = LayoutCache(tmp_path)
+    key = labels_key(g, 5)
+
+    absent = verify_labels_bundle(g, 5, cache=cache)
+    assert not absent["ok"] and absent["status"] == "absent"
+
+    idx, info = load_or_build_labels(g, 5, cache=cache)
+    assert info["cache"] == "miss" and info["key"] == key
+    idx2, info2 = load_or_build_labels(g, 5, cache=cache)
+    assert info2["cache"] == "hit"
+    np.testing.assert_array_equal(idx.dist, idx2.dist)
+    np.testing.assert_array_equal(idx.parent, idx2.parent)
+    np.testing.assert_array_equal(idx.landmarks, idx2.landmarks)
+
+    verdict = verify_labels_bundle(g, 5, cache=cache)
+    assert verdict["ok"] and verdict["status"] == "ok"
+    assert verdict["k"] == 5
+    assert verdict["device_bytes"] == idx.device_bytes
+    assert verdict["index_bytes"] == idx.nbytes
+
+    # Corrupt the stored dist rows: the fingerprint check must drop the
+    # bundle (verify -> absent) and the next load must REBUILD, not trust.
+    bundle_dir = os.path.join(str(tmp_path), key)
+    target = max(
+        (os.path.join(bundle_dir, f) for f in os.listdir(bundle_dir)),
+        key=os.path.getsize,
+    )
+    with open(target, "r+b") as f:
+        f.seek(0)
+        f.write(b"\xff" * 64)
+    assert not verify_labels_bundle(g, 5, cache=cache)["ok"]
+    idx3, info3 = load_or_build_labels(g, 5, cache=cache)
+    assert info3["cache"] == "miss"
+    np.testing.assert_array_equal(idx.dist, idx3.dist)
+
+
+def test_budget_gate_rejects_oversized_index():
+    g = GRAPHS["gnm"]()
+    idx = build_label_index(g, 4)
+    with pytest.raises(LabelBudgetError):
+        LabelOracle(idx, budget_bytes=idx.device_bytes - 1)
+    LabelOracle(idx, budget_bytes=idx.device_bytes)  # exactly at budget: ok
+
+
+# ------------------------------------------------- kill/resume precompute --
+
+@pytest.mark.chaos
+def test_precompute_kill_resume_bit_identical(tmp_path):
+    from bfs_tpu.resilience.superstep_ckpt import SuperstepCheckpointer
+
+    g = GRAPHS["gnm"]()
+    golden = build_label_index(g, 4, chunk=1, ckpt_dir=tmp_path / "golden")
+
+    os.environ["BFS_TPU_CKPT"] = "every:1"
+    os.environ["BFS_TPU_FAULT"] = "raise:superstep:2"
+    faults.reset()
+    try:
+        with pytest.raises(FaultInjected):
+            build_label_index(g, 4, chunk=1, ckpt_dir=tmp_path / "ck")
+    finally:
+        os.environ.pop("BFS_TPU_FAULT", None)
+        faults.reset()
+
+    try:
+        # The fault fired AFTER epoch 2's durability: the store must
+        # resume the sweep at chunk 2, not restart it.
+        ck = SuperstepCheckpointer(tmp_path / "ck", {
+            "kind": "labels", "graph": graph_content_hash(g), "k": 4,
+            "engine": "pull", "chunk": 1,
+        })
+        found = ck.load_latest()
+        assert found is not None and int(found[0]) == 2
+        resumed = build_label_index(g, 4, chunk=1, ckpt_dir=tmp_path / "ck")
+    finally:
+        os.environ.pop("BFS_TPU_CKPT", None)
+    np.testing.assert_array_equal(resumed.dist, golden.dist)
+    np.testing.assert_array_equal(resumed.parent, golden.parent)
+    np.testing.assert_array_equal(resumed.landmarks, golden.landmarks)
+    assert (resumed.dist != LABEL_INF).any()
+
+
+# ------------------------------------------------------------ serve tier --
+
+def _label_server(graph, k, tmp_path=None, **kw):
+    os.environ["BFS_TPU_LABELS"] = str(k)
+    try:
+        srv = BfsServer(max_batch=8, **kw)
+        srv.register("g", graph)
+    finally:
+        os.environ.pop("BFS_TPU_LABELS", None)
+    return srv
+
+
+@pytest.mark.parametrize("name", sorted(GRAPHS))
+def test_server_point_queries_exact_with_fallback(name):
+    """Every query_dist answer — label-tier hit or traversal fallback —
+    must equal the host oracle, and the hit/fallback counters must
+    account for every query."""
+    g = GRAPHS[name]()
+    with _label_server(g, 6) as srv:
+        us, vs = _pairs(g, 25, seed=13)
+        cache = {}
+        for u, v in zip(us, vs):
+            reply = srv.query_dist("g", int(u), int(v)).result(TIMEOUT)
+            want = int(_truth(g, cache, int(u))[v])
+            assert reply.dist == want, (
+                f"dist({u},{v}) = {reply.dist} via {reply.method}, "
+                f"oracle says {want}"
+            )
+            assert reply.method in ("labels", "exact", "labels_verified")
+        c = srv.metrics.report()["counters"]
+        assert c.get("label_hits", 0) + c.get("label_fallbacks", 0) == 25
+        assert c.get("label_builds", 0) == 1
+
+
+def test_server_star_fallback_on_every_leaf_pair():
+    g = GRAPHS["star"]()
+    lm = set(sample_landmarks(g, 4).tolist())
+    leaves = [x for x in range(1, g.num_vertices) if x not in lm]
+    pairs = list(zip(leaves[0::2], leaves[1::2]))[:4]
+    with _label_server(g, 4) as srv:
+        cache = {}
+        for u, v in pairs:
+            reply = srv.query_dist("g", u, v).result(TIMEOUT)
+            assert reply.method == "exact"  # never tight on these pairs
+            assert reply.dist == int(_truth(g, cache, u)[v]) == 2
+        c = srv.metrics.report()["counters"]
+        assert c.get("label_fallbacks", 0) == len(pairs)
+        assert c.get("label_hits", 0) == 0
+
+
+def test_server_sampled_verification_clean():
+    g = GRAPHS["gnm"]()
+    os.environ["BFS_TPU_LABELS_VERIFY"] = "2"
+    try:
+        with _label_server(g, 8) as srv:
+            us, vs = _pairs(g, 30, seed=5)
+            cache = {}
+            for u, v in zip(us, vs):
+                reply = srv.query_dist("g", int(u), int(v)).result(TIMEOUT)
+                assert reply.dist == int(_truth(g, cache, int(u))[v])
+            c = srv.metrics.report()["counters"]
+            if c.get("label_hits", 0) >= 2:
+                assert c.get("label_verifies", 0) >= 1
+            assert c.get("label_verify_failures", 0) == 0
+    finally:
+        os.environ.pop("BFS_TPU_LABELS_VERIFY", None)
+
+
+def test_epoch_swap_invalidates_and_rebuilds():
+    g = GRAPHS["gnm"]()
+    with _label_server(g, 6) as srv:
+        rec1 = srv.registry.get("g")
+        assert srv._label_oracle("g", rec1.epoch) is not None
+        os.environ["BFS_TPU_LABELS"] = "6"
+        try:
+            srv.register("g", g)  # epoch bump
+        finally:
+            os.environ.pop("BFS_TPU_LABELS", None)
+        rec2 = srv.registry.get("g")
+        assert rec2.epoch != rec1.epoch
+        assert srv._label_oracle("g", rec1.epoch) is None  # retired
+        assert srv._label_oracle("g", rec2.epoch) is not None
+        reply = srv.query_dist("g", 3, 90).result(TIMEOUT)
+        assert reply.dist == int(queue_bfs(g, 3)[0][90])
+
+
+def test_unregister_drops_label_state():
+    g = GRAPHS["gnm"]()
+    with _label_server(g, 4) as srv:
+        rec = srv.registry.get("g")
+        srv.unregister("g")
+        assert srv._label_oracle("g", rec.epoch) is None
+
+
+def test_budget_reject_keeps_serving_exact():
+    g = GRAPHS["gnm"]()
+    os.environ["BFS_TPU_LABELS_GB"] = "0.0000001"  # ~100 bytes
+    try:
+        with _label_server(g, 6) as srv:
+            c = srv.metrics.report()["counters"]
+            assert c.get("label_budget_rejects", 0) == 1
+            reply = srv.query_dist("g", 3, 90).result(TIMEOUT)
+            assert reply.method == "exact"
+            assert reply.dist == int(queue_bfs(g, 3)[0][90])
+            assert srv.metrics.report()["counters"].get("label_misses", 0) == 1
+    finally:
+        os.environ.pop("BFS_TPU_LABELS_GB", None)
+
+
+def test_labels_off_serves_exact_only():
+    g = GRAPHS["gnm"]()
+    with BfsServer(max_batch=8) as srv:  # BFS_TPU_LABELS defaults off
+        srv.register("g", g)
+        reply = srv.query_dist("g", 0, 1).result(TIMEOUT)
+        assert reply.method == "exact"
+        assert reply.dist == int(queue_bfs(g, 0)[0][1])
+        assert srv.metrics.report()["counters"].get("label_builds", 0) == 0

@@ -1,0 +1,144 @@
+"""Hash-routed serve fleet smoke tests (ISSUE 20) — the `fleet_smoke`
+ci-gate stage.
+
+Two in-process replicas behind a :class:`FleetRouter` sharing one
+on-disk layout/label cache: deterministic primary routing, the
+sequential rolling register (replica 0 pays the build, replica 1
+warm-hits the sidecar), a mid-load epoch swap, an induced replica
+failure (the server is CLOSED directly, exercising the
+completion-time failover path, not the kill_replica bookkeeping), and
+the breaker/NoReplicaAvailable terminal states — with every routed
+answer checked against the host oracle throughout.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from bfs_tpu.cache.layout import LayoutCache
+from bfs_tpu.graph.generators import gnm_graph
+from bfs_tpu.oracle.bfs import queue_bfs
+from bfs_tpu.serve import FleetRouter, NoReplicaAvailable
+
+pytestmark = pytest.mark.fleet_smoke
+
+TIMEOUT = 300
+G = "fleet-g"
+
+
+@pytest.fixture(scope="module")
+def fleet_graph():
+    return gnm_graph(150, 400, seed=11)
+
+
+@pytest.fixture()
+def fleet(fleet_graph, tmp_path):
+    os.environ["BFS_TPU_LABELS"] = "6"
+    try:
+        rt = FleetRouter(
+            replicas=2, layout_cache=LayoutCache(tmp_path), max_batch=8
+        )
+        rt.register(G, fleet_graph)
+    finally:
+        os.environ.pop("BFS_TPU_LABELS", None)
+    with rt:
+        yield rt
+
+
+def _truth(graph, cache, u):
+    if u not in cache:
+        cache[u] = queue_bfs(graph, int(u))[0]
+    return cache[u]
+
+
+def test_rolling_register_shares_sidecar(fleet):
+    """Replica 0 pays the label build; replica 1 warm-hits the shared
+    content-addressed bundle — the no-thundering-herd contract."""
+    counters = [
+        srv.metrics.report()["counters"] for srv in fleet.servers
+    ]
+    assert counters[0].get("label_builds", 0) == 1
+    assert counters[0].get("label_build_cache_misses", 0) == 1
+    assert counters[1].get("label_builds", 0) == 1
+    assert counters[1].get("label_build_cache_hits", 0) == 1
+    assert fleet.metrics.report()["counters"]["router_rolling_registers"] == 2
+
+
+def test_routing_is_deterministic(fleet):
+    for s in (0, 7, 42):
+        assert fleet._ring(G, [s]) == fleet._ring(G, [s])
+    assert {fleet._ring(G, [s])[0] for s in range(32)} == {0, 1}
+
+
+def test_full_and_point_queries_oracle_exact(fleet, fleet_graph):
+    cache = {}
+    rng = np.random.default_rng(0)
+    v = fleet_graph.num_vertices
+    for s in rng.integers(0, v, size=6):
+        reply = fleet.query(G, int(s)).result(TIMEOUT)
+        np.testing.assert_array_equal(
+            np.asarray(reply.dist), _truth(fleet_graph, cache, int(s))
+        )
+    for u, w in rng.integers(0, v, size=(8, 2)):
+        reply = fleet.query_dist(G, int(u), int(w)).result(TIMEOUT)
+        assert reply.dist == int(_truth(fleet_graph, cache, int(u))[w])
+
+
+def test_epoch_swap_under_load_stays_exact(fleet, fleet_graph):
+    cache = {}
+    v = fleet_graph.num_vertices
+    futs = [fleet.query_dist(G, u, (u * 7 + 3) % v) for u in range(8)]
+    os.environ["BFS_TPU_LABELS"] = "6"
+    try:
+        fleet.register(G, fleet_graph)  # rolling epoch bump mid-flight
+    finally:
+        os.environ.pop("BFS_TPU_LABELS", None)
+    futs += [fleet.query_dist(G, u, (u * 5 + 1) % v) for u in range(8)]
+    for i, f in enumerate(futs):
+        reply = f.result(TIMEOUT)
+        want = int(_truth(fleet_graph, cache, reply.u)[reply.v])
+        assert reply.dist == want, f"query {i} wrong across the swap"
+    assert (
+        fleet.metrics.report()["counters"]["router_rolling_registers"] == 4
+    )
+
+
+def test_failover_on_closed_replica(fleet, fleet_graph):
+    """Close one replica DIRECTLY (no router bookkeeping): queries whose
+    primary it was must fail over to the survivor and stay exact."""
+    cache = {}
+    v = fleet_graph.num_vertices
+    victim = fleet._ring(G, [0, 1])[0]
+    fleet.servers[victim].close()
+    reply = fleet.query_dist(G, 0, 1).result(TIMEOUT)
+    assert reply.dist == int(_truth(fleet_graph, cache, 0)[1])
+    # Keep hammering: every source routes somewhere and every answer is
+    # exact, whichever side of the ring it lands on.
+    for s in range(10):
+        reply = fleet.query(G, s % v).result(TIMEOUT)
+        np.testing.assert_array_equal(
+            np.asarray(reply.dist), _truth(fleet_graph, cache, s % v)
+        )
+    c = fleet.metrics.report()["counters"]
+    assert c.get("router_failovers", 0) >= 1
+
+
+def test_kill_replica_routes_around(fleet, fleet_graph):
+    cache = {}
+    fleet.kill_replica(1)
+    assert fleet.alive() == [0]
+    for s in (3, 90):
+        reply = fleet.query(G, s).result(TIMEOUT)
+        np.testing.assert_array_equal(
+            np.asarray(reply.dist), _truth(fleet_graph, cache, s)
+        )
+    c = fleet.metrics.report()["counters"]
+    assert c.get("router_replicas_killed", 0) == 1
+
+
+def test_all_replicas_dead_raises(fleet):
+    fleet.kill_replica(0)
+    fleet.kill_replica(1)
+    with pytest.raises(NoReplicaAvailable):
+        fleet.query(G, 0)

@@ -98,6 +98,15 @@ def main(argv=None) -> int:
                     "feed, ISSUE 18): builds through the on-disk layout "
                     "cache, re-loads it fingerprint-checked, and prints "
                     "superblock counts + host-store bytes")
+    ap.add_argument("--labels", action="store_true",
+                    help="also prebuild + verify the landmark distance-"
+                    "label sidecar per scale (the serve label tier's "
+                    "index, ISSUE 20): builds through the on-disk layout "
+                    "cache, re-loads it fingerprint-checked, and prints "
+                    "K, index bytes, and build seconds")
+    ap.add_argument("--landmarks", type=int, metavar="K", default=0,
+                    help="landmark count for --labels (default: the "
+                    "BFS_TPU_LABELS knob, or 32 when that is off)")
     ap.add_argument("--compile", action="store_true",
                     help="also AOT-compile the fused relay program per "
                     "scale (TPU backends; populates the exe cache)")
@@ -202,6 +211,42 @@ def main(argv=None) -> int:
                 flush=True,
             )
             if not verdict["ok"]:
+                return 1
+        if args.labels:
+            from bfs_tpu import knobs
+            from bfs_tpu.cache.layout import (
+                LayoutCache,
+                load_or_build_labels,
+                verify_labels_bundle,
+            )
+
+            k = args.landmarks or knobs.get("BFS_TPU_LABELS") or 32
+            label_cache = LayoutCache()
+            t0 = time.perf_counter()
+            idx, linfo = load_or_build_labels(dg, k, cache=label_cache)
+            lverdict = verify_labels_bundle(dg, k, cache=label_cache)
+            print(
+                f"s{scale}: label sidecar ready in "
+                f"{time.perf_counter() - t0:.1f}s "
+                f"(K={idx.k}, index={idx.device_bytes >> 20} MB on device, "
+                f"cold build was {linfo.get('build_seconds', -1.0):.1f}s, "
+                f"cache={linfo.get('cache')}, "
+                f"verify={'ok' if lverdict['ok'] else lverdict['status']})",
+                flush=True,
+            )
+            print(
+                json.dumps({
+                    "scale": scale,
+                    "labels_key": lverdict["key"],
+                    "verify_ok": lverdict["ok"],
+                    "k": idx.k,
+                    "index_bytes": idx.nbytes,
+                    "device_bytes": idx.device_bytes,
+                    "build_seconds": linfo.get("build_seconds", -1.0),
+                }),
+                flush=True,
+            )
+            if not lverdict["ok"]:
                 return 1
         if args.compile:
             if jax.default_backend() != "tpu":

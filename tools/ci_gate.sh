@@ -79,6 +79,17 @@ JAX_PLATFORMS=cpu python -m pytest tests/test_algo_sssp.py \
     tests/test_algo_cc.py tests/test_graph500.py -q \
     -m 'algo_smoke' -p no:cacheprovider
 
+echo "== ci gate: serve-fleet smoke (ISSUE 20) =="
+# The label-tier + router core: 2 replicas rolling-register over one
+# shared label sidecar (replica 1 must warm-hit, not rebuild), an epoch
+# swap under in-flight queries, an induced replica close with failover,
+# and every routed answer checked against the host oracle — a wrong
+# point answer or a thundering-herd rebuild must fail the gate on its
+# own stage (~seconds; the label certificate/kill-resume matrix runs in
+# tier-1's tests/test_labels.py).
+JAX_PLATFORMS=cpu python -m pytest tests/test_serve_fleet.py -q \
+    -m 'fleet_smoke' -p no:cacheprovider
+
 if [[ "$RUN_TESTS" == "1" ]]; then
     echo "== ci gate 3/3: lint --all (AST + IR + HLO + Pallas + Knobs) =="
 else

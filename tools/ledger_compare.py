@@ -40,6 +40,14 @@ STREAM_KEYS = (
     "bytes_streamed", "hits", "misses", "evictions", "corrupt_refetches",
 )
 
+#: Label-tier record of a ``details.labels`` capture (ISSUE 20,
+#: BENCH_LABELS mode), in table order.  The first five are deterministic
+#: per (graph, K, pairs) and pinned under ``--exact``; the qps/speedup
+#: tail is wall-clock and only tabulated.  Compared only when BOTH
+#: captures carry the record — pre-label goldens simply lack it.
+LABELS_PINNED = ("k", "pairs", "tight_hits", "fallbacks", "wrong_answers")
+LABELS_KEYS = LABELS_PINNED + ("labels_qps", "exact_qps", "speedup")
+
 
 def load_doc(path: str) -> dict:
     """Headline line(s) or raw ledger file -> the containing doc.  Bench
@@ -87,12 +95,22 @@ def extract(doc: dict, path: str):
         ledger = details.get("superstep_phases")
         if not isinstance(ledger, dict):
             ledger = details.get("sharded_phases")
+    labels = None
+    if isinstance(details, dict) and isinstance(details.get("labels"),
+                                                dict):
+        labels = details["labels"]
     if not isinstance(ledger, dict) or "phases" not in ledger:
-        raise SystemExit(
-            f"{path}: no superstep phase ledger found (need a bench "
-            "headline with details.superstep_phases or "
-            "details.sharded_phases, or a raw ledger JSON)"
-        )
+        if labels is not None:
+            # A BENCH_LABELS capture has no superstep ledger — the labels
+            # record IS its ledger.
+            ledger = {"phases": {}}
+        else:
+            raise SystemExit(
+                f"{path}: no superstep phase ledger found (need a bench "
+                "headline with details.superstep_phases or "
+                "details.sharded_phases or details.labels, or a raw "
+                "ledger JSON)"
+            )
     phases = {
         name: float(rec["seconds"])
         for name, rec in ledger["phases"].items()
@@ -138,7 +156,7 @@ def extract(doc: dict, path: str):
                                                 dict):
         stream = details["stream"]
     return (phases, ledger, sched, xbytes, per_shard, xsched, esched,
-            axes, stream)
+            axes, stream, labels)
 
 
 def fmt_s(s: float) -> str:
@@ -162,10 +180,10 @@ def main() -> int:
     )
     args = ap.parse_args()
 
-    pb, lb, sb, xb, shb, xsb, esb, axb, strb = extract(
+    pb, lb, sb, xb, shb, xsb, esb, axb, strb, labb = extract(
         load_doc(args.before), args.before
     )
-    pa, la, sa, xa, sha, xsa, esa, axa, stra = extract(
+    pa, la, sa, xa, sha, xsa, esa, axa, stra, laba = extract(
         load_doc(args.after), args.after
     )
 
@@ -321,6 +339,42 @@ def main() -> int:
                     mismatched.append(f"stream:{k}")
             if lev_b != lev_a:
                 mismatched.append("stream:levels")
+
+    if labb or laba:
+        # Label-tier record (ISSUE 20): one totals row.  The counter
+        # half (k/pairs/hits/fallbacks/wrong) is deterministic per
+        # (graph, K, pair batch) and pinned under --exact; the qps half
+        # is wall clock and only tabulated.  A capture answering ANY
+        # query wrongly, or whose label tier is not strictly faster than
+        # the exact arm, fails the diff outright — that is the claim a
+        # label-tier PR makes.
+        def _lv(side, key):
+            return side.get(key, "—") if side else "—"
+
+        print()
+        print("| labels | " + " | ".join(LABELS_KEYS) + " |")
+        print("|---|" + "---|" * len(LABELS_KEYS))
+        print(
+            "| totals | "
+            + " | ".join(
+                f"{_lv(labb, k)} -> {_lv(laba, k)}" for k in LABELS_KEYS
+            )
+            + " |"
+        )
+        if args.exact and labb and laba:
+            for k in LABELS_PINNED:
+                if labb.get(k) != laba.get(k):
+                    mismatched.append(f"labels:{k}")
+        for side_name, side in (("before", labb), ("after", laba)):
+            if not side:
+                continue
+            if int(side.get("wrong_answers", 0)) != 0:
+                regressed.append((f"labels:{side_name}:wrong_answers", 1.0))
+            if float(side.get("speedup", 0.0)) <= 1.0:
+                regressed.append((
+                    f"labels:{side_name}:speedup",
+                    float(side.get("speedup", 0.0)) - 1.0,
+                ))
 
     if args.exact and xsb != xsa:
         mismatched.append("exchange_schedule")

@@ -14,9 +14,21 @@ steady phase must run at a 100% compile-cache hit rate — the acceptance
 gate this tool exists to demonstrate.  Exit code 1 on any wrong answer or
 a sub-100% steady-state hit rate.
 
+``--replicas N`` (ISSUE 20) switches to FLEET mode: the same oracle-checked
+discipline driven through a :class:`~bfs_tpu.serve.FleetRouter` of N
+replicas — a point-query-heavy mix through the landmark label tier
+(``query_dist``), a mid-load rolling epoch swap (re-register under load;
+later replicas warm-hit the shared sidecar store), and, with >= 2
+replicas, an induced replica failure mid-run that MUST surface as router
+failovers, never as a wrong or lost answer.  Compare a ``--replicas 1``
+capture against ``--replicas 2`` for the QPS-scaling / p99-held evidence
+pair (SERVE_FLEET_x*.json).
+
 Usage (mirrors the tier-1 test platform: 8 virtual CPU devices):
     JAX_PLATFORMS=cpu python tools/serve_loadgen.py --scale 10 \
         --requests 200 --concurrency 8 --multi-frac 0.25
+    JAX_PLATFORMS=cpu python tools/serve_loadgen.py --scale 10 \
+        --replicas 2 --requests 200 --concurrency 8
 """
 
 from __future__ import annotations
@@ -107,6 +119,231 @@ def warmup(server, name: str, v: int, max_batch: int) -> int:
         b *= 2
 
 
+def fleet_main(args) -> int:
+    """FLEET mode: N routed replicas, point-query-heavy, every answer
+    oracle-checked; a rolling epoch swap and an induced replica failure
+    land mid-load.  Exit 1 on any wrong/lost answer, or when the induced
+    failure produced zero router failovers."""
+    from bfs_tpu.serve import FleetRouter
+
+    if args.landmarks > 0:
+        os.environ["BFS_TPU_LABELS"] = str(args.landmarks)
+    rng = np.random.default_rng(args.seed)
+    t0 = time.perf_counter()
+    graph = rmat_graph(args.scale, args.edge_factor, seed=args.seed)
+    v = graph.num_vertices
+    name = f"rmat{args.scale}"
+    print(
+        f"graph: R-MAT scale {args.scale} ef {args.edge_factor} "
+        f"(V={v}, E={graph.num_edges} directed) built in "
+        f"{time.perf_counter() - t0:.1f}s",
+        flush=True,
+    )
+
+    pool = rng.integers(0, v, size=max(args.source_pool, 4))
+
+    def make_mix(n: int) -> list:
+        mix = []
+        for _ in range(n):
+            if rng.random() < args.point_frac:
+                mix.append(
+                    ("point", int(rng.choice(pool)), int(rng.choice(pool)))
+                )
+            else:
+                mix.append(("full", int(rng.choice(pool)), -1))
+        return mix
+
+    reqs = make_mix(args.requests)
+    swap_at = (
+        int(args.requests * args.swap_at) if args.swap_at >= 0 else -1
+    )
+    chaos_n = (
+        int(args.requests * args.chaos_frac)
+        if args.chaos_frac > 0 and args.replicas >= 2 else 0
+    )
+
+    wrong: list[str] = []
+    latencies: list[float] = []
+    lock = threading.Lock()
+    oracle_cache: dict = {}
+
+    def truth_row(s: int) -> np.ndarray:
+        if (s,) not in oracle_cache:
+            oracle_cache[(s,)] = queue_bfs(graph, s)[0]
+        return oracle_cache[(s,)]
+
+    with FleetRouter(
+        replicas=args.replicas,
+        layout_cache=args.cache_dir or None,
+        engine=args.engine,
+        max_batch=args.max_batch,
+        tick_s=args.tick_ms / 1e3,
+        queue_depth=args.queue_depth,
+        watchdog_s=args.watchdog_s,
+    ) as rt:
+        t_reg = time.perf_counter()
+        rt.register(name, graph)
+        print(
+            f"fleet: {args.replicas} replicas registered in "
+            f"{time.perf_counter() - t_reg:.2f}s "
+            f"(labels K={args.landmarks})",
+            flush=True,
+        )
+        # Warm every replica directly (the router would only warm the
+        # hash-selected one): every power-of-two batch bucket via the
+        # classic staged warmup, plus the label-lookup shape.
+        t0 = time.perf_counter()
+        nwarm = 0
+        for srv in rt.servers:
+            nwarm += warmup(srv, name, v, args.max_batch)
+            srv.query_dist(name, 0, min(1, v - 1)).result(timeout=600)
+        print(
+            f"warmup: {nwarm} queries over {args.replicas} replicas in "
+            f"{time.perf_counter() - t0:.1f}s",
+            flush=True,
+        )
+
+        events = {"swapped_s": None}
+
+        def _maybe_event(i: int) -> None:
+            if i == swap_at:
+                t = time.perf_counter()
+                rt.register(name, graph)  # rolling epoch bump under load
+                events["swapped_s"] = time.perf_counter() - t
+                print(
+                    f"epoch swap at request {i}: rolled "
+                    f"{args.replicas} replicas in {events['swapped_s']:.2f}s",
+                    flush=True,
+                )
+
+        def one_request(batch: list, latency_sink: list, i: int) -> None:
+            kind, a, b = batch[i]
+            t = time.perf_counter()
+            if kind == "point":
+                reply = rt.query_dist(name, a, b).result(
+                    timeout=args.timeout_s + 60
+                )
+                lat = time.perf_counter() - t
+                want = int(truth_row(a)[b])
+                errs = (
+                    []
+                    if args.no_check or int(reply.dist) == want
+                    else [
+                        f"dist({a},{b}) = {reply.dist} "
+                        f"({reply.method}), oracle says {want}"
+                    ]
+                )
+            else:
+                reply = rt.query(name, a).result(timeout=args.timeout_s + 60)
+                lat = time.perf_counter() - t
+                errs = []
+                if not args.no_check:
+                    if not np.array_equal(reply.dist, truth_row(a)):
+                        errs.append(f"dist mismatch for source {a}")
+                    errs += check(graph, reply.dist, reply.parent, [a])
+            with lock:
+                latency_sink.append(lat)
+                wrong.extend(errs)
+
+        def run_phase(batch: list, latency_sink: list,
+                      with_events: bool) -> float:
+            cursor = [0]
+
+            def worker():
+                while True:
+                    with lock:
+                        if cursor[0] >= len(batch):
+                            return
+                        i = cursor[0]
+                        cursor[0] += 1
+                    try:
+                        if with_events:
+                            _maybe_event(i)
+                        one_request(batch, latency_sink, i)
+                    except Exception as exc:
+                        with lock:
+                            wrong.append(
+                                f"request {i} ({batch[i]}) failed: {exc!r}"
+                            )
+
+            t0 = time.perf_counter()
+            threads = [
+                threading.Thread(target=worker)
+                for _ in range(args.concurrency)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return time.perf_counter() - t0
+
+        steady_s = run_phase(reqs, latencies, True)
+
+        # ---- chaos phase (untimed for QPS): one replica down, every
+        # request must complete through failover, still oracle-checked.
+        chaos_latencies: list[float] = []
+        chaos_s = None
+        if chaos_n:
+            # Close the server directly (NOT kill_replica): submits now
+            # raise ServerClosed at admission — and in-flight chained
+            # queries fail AFTER admission — which is exactly the
+            # failover path the run must demonstrate.
+            rt.servers[-1].close()
+            print(
+                f"chaos: replica {len(rt.servers) - 1} closed; driving "
+                f"{chaos_n} requests through failover",
+                flush=True,
+            )
+            chaos_s = run_phase(make_mix(chaos_n), chaos_latencies, False)
+        report = rt.report()
+
+    router = report["router"]
+    label_counters = {
+        k: sum(
+            rep["counters"].get(k, 0) for rep in report["replicas"]
+        )
+        for k in ("label_hits", "label_fallbacks", "label_misses",
+                  "label_builds", "label_build_cache_hits")
+    }
+    out = {
+        "mode": "fleet",
+        "replicas": args.replicas,
+        "requests": args.requests,
+        "concurrency": args.concurrency,
+        "point_frac": args.point_frac,
+        "landmarks": args.landmarks,
+        "oracle_checked": 0 if args.no_check else args.requests + chaos_n,
+        "wrong_answers": len(wrong),
+        "steady_seconds": steady_s,
+        "queries_per_sec": args.requests / steady_s if steady_s > 0 else 0.0,
+        "latency_p50_ms": percentile(latencies, 50) * 1e3,
+        "latency_p99_ms": percentile(latencies, 99) * 1e3,
+        "epoch_swap_seconds": events["swapped_s"],
+        "chaos_requests": chaos_n,
+        "chaos_seconds": chaos_s,
+        "chaos_latency_p99_ms": (
+            percentile(chaos_latencies, 99) * 1e3 if chaos_latencies else None
+        ),
+        "router_failovers": router.get("router_failovers", 0),
+        "router_breaker_opens": router.get("router_breaker_opens", 0),
+        "router_rolling_registers": router.get("router_rolling_registers", 0),
+        "labels": label_counters,
+        "router_report": router,
+    }
+    print(json.dumps(out, indent=2, sort_keys=True))
+    for msg in wrong[:10]:
+        print(f"WRONG: {msg}", file=sys.stderr)
+    if wrong:
+        return 1
+    if chaos_n and not router.get("router_failovers", 0):
+        print(
+            "FAIL: induced replica failure produced zero router failovers",
+            file=sys.stderr,
+        )
+        return 1
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--scale", type=int, default=10, help="R-MAT scale")
@@ -140,7 +377,30 @@ def main(argv=None) -> int:
                     help="persistent layout-bundle dir (default off; pass "
                     "a dir — e.g. .bench_cache/layout — to measure "
                     "warm-vs-cold registration across runs)")
+    ap.add_argument("--replicas", type=int, default=0,
+                    help="FLEET mode (ISSUE 20): drive a FleetRouter of N "
+                    "replicas with a point-query-heavy mix, a mid-load "
+                    "epoch swap, and (N >= 2) an induced replica failure; "
+                    "0 = classic single-server mode")
+    ap.add_argument("--point-frac", type=float, default=0.6,
+                    help="fleet mode: fraction of requests that are "
+                    "dist(u, v) point queries through the label tier")
+    ap.add_argument("--landmarks", type=int, default=16,
+                    help="fleet mode: landmark count for the label tier "
+                    "(sets BFS_TPU_LABELS; 0 = exact-only)")
+    ap.add_argument("--swap-at", type=float, default=0.5,
+                    help="fleet mode: re-register the graph (rolling epoch "
+                    "swap) after this fraction of requests (<0 disables)")
+    ap.add_argument("--chaos-frac", type=float, default=0.2,
+                    help="fleet mode, >= 2 replicas: after the timed "
+                    "steady phase, close one replica and drive this "
+                    "extra fraction of requests through the failover "
+                    "path (0 disables); the run FAILS unless the router "
+                    "failed over with zero wrong answers")
     args = ap.parse_args(argv)
+
+    if args.replicas >= 1:
+        return fleet_main(args)
 
     rng = np.random.default_rng(args.seed)
     t0 = time.perf_counter()
